@@ -1,0 +1,24 @@
+"""batch_shipyard_tpu: TPU-native batch/HPC container-workload orchestration.
+
+A ground-up re-design of the capabilities of Azure/batch-shipyard
+(reference: /root/reference, v3.9.1) for Cloud TPU VM pods: a stateless
+CLI + storage-mediated control plane that provisions TPU pools, executes
+containerized batch and gang-scheduled multi-worker tasks (JAX
+distributed over ICI/DCN instead of MPI over Infiniband), moves data,
+and provides task factories, job DAGs/schedules, autoscale, monitoring,
+federation scheduling, and Slurm bursting.
+
+Layer map (mirrors SURVEY.md section 1, re-imagined for TPU):
+
+  L6 cli/        click command tree
+  L5 fleet.py    orchestration: action_* per CLI verb
+  L4 pool/ jobs/ data/ monitor/ federation/ slurm/ remotefs/  domain services
+  L3 config/     schema validation + typed settings (the de-facto type system)
+  L2 state/      object/table/queue/lease state store (GCS or local/memory)
+     substrate/  compute substrate (Cloud TPU pods, fake pods, localhost)
+  L1 agent/      node-side: nodeprep, task runner, cascade image replicator
+  L0 models/ ops/ parallel/  the TPU compute path (JAX/XLA/pallas) that the
+     reference delegated to MPI+CUDA third parties
+"""
+
+from batch_shipyard_tpu.version import __version__  # noqa: F401

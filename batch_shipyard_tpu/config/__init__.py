@@ -1,0 +1,5 @@
+from batch_shipyard_tpu.config.validator import (  # noqa: F401
+    ConfigType,
+    ValidationError,
+    validate_config,
+)

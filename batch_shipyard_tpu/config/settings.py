@@ -1,0 +1,584 @@
+"""Typed settings: the single choke-point between raw YAML dicts and code.
+
+Capability parity with the reference's convoy/settings.py (namedtuples at
+settings.py:154-527, pool_settings :1277, task_settings :3727,
+credentials accessors :1745+), re-designed with frozen dataclasses and a
+TPU topology oracle in place of the reference's Azure vm-size oracles
+(is_gpu_pool settings.py:717, is_sriov_rdma_pool :881).
+
+No module outside config/ should ever index into the raw config dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from batch_shipyard_tpu.parallel import topology as topo
+
+
+def _get(conf: dict | None, *path: str, default: Any = None) -> Any:
+    node: Any = conf
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return default
+        node = node[key]
+    if node is None:
+        return default
+    return node
+
+
+# -------------------------- credentials --------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GcpCredentialsSettings:
+    project: str
+    zone: Optional[str]
+    service_account_key_file: Optional[str]
+    service_account_email: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageCredentialsSettings:
+    backend: str  # gcs | localfs | memory
+    bucket: Optional[str]
+    prefix: str
+    root: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class SshCredentialsSettings:
+    username: Optional[str]
+    private_key_file: Optional[str]
+    public_key_file: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class DockerRegistrySettings:
+    server: str
+    username: Optional[str]
+    password: Optional[str]
+    password_secret_id: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class CredentialsSettings:
+    gcp: Optional[GcpCredentialsSettings]
+    storage: StorageCredentialsSettings
+    ssh: SshCredentialsSettings
+    docker_registries: tuple[DockerRegistrySettings, ...]
+
+
+def credentials_settings(config: dict) -> CredentialsSettings:
+    creds = _get(config, "credentials", default={})
+    gcp = None
+    if _get(creds, "gcp") is not None:
+        gcp = GcpCredentialsSettings(
+            project=_get(creds, "gcp", "project"),
+            zone=_get(creds, "gcp", "zone"),
+            service_account_key_file=_get(
+                creds, "gcp", "service_account_key_file"),
+            service_account_email=_get(creds, "gcp", "service_account_email"),
+        )
+    storage = StorageCredentialsSettings(
+        backend=_get(creds, "storage", "backend", default="memory"),
+        bucket=_get(creds, "storage", "bucket"),
+        prefix=_get(creds, "storage", "prefix", default="shipyardtpu"),
+        root=_get(creds, "storage", "root"),
+    )
+    ssh = SshCredentialsSettings(
+        username=_get(creds, "ssh", "username"),
+        private_key_file=_get(creds, "ssh", "private_key_file"),
+        public_key_file=_get(creds, "ssh", "public_key_file"),
+    )
+    registries = tuple(
+        DockerRegistrySettings(
+            server=reg["server"],
+            username=reg.get("username"),
+            password=reg.get("password"),
+            password_secret_id=reg.get("password_secret_id"),
+        )
+        for reg in _get(creds, "docker_registries", default=[])
+    )
+    return CredentialsSettings(
+        gcp=gcp, storage=storage, ssh=ssh, docker_registries=registries)
+
+
+# ---------------------------- global -----------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GlobalSettings:
+    storage_entity_prefix: str
+    fallback_registry: Optional[str]
+    raw_output: bool
+    docker_images: tuple[str, ...]
+    singularity_images: tuple[str, ...]
+    files: tuple[dict, ...]
+    concurrent_source_downloads: int
+
+
+def global_settings(config: dict) -> GlobalSettings:
+    return GlobalSettings(
+        storage_entity_prefix=_get(
+            config, "shipyard_tpu", "storage_entity_prefix",
+            default="shipyardtpu"),
+        fallback_registry=_get(config, "shipyard_tpu", "fallback_registry"),
+        raw_output=_get(config, "shipyard_tpu", "raw_output", default=False),
+        docker_images=tuple(
+            _get(config, "global_resources", "docker_images", default=[])),
+        singularity_images=tuple(
+            _get(config, "global_resources", "singularity_images",
+                 default=[])),
+        files=tuple(
+            _get(config, "global_resources", "files", default=[])),
+        concurrent_source_downloads=_get(
+            config, "data_replication", "concurrent_source_downloads",
+            default=10),
+    )
+
+
+# ----------------------------- pool ------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TpuPoolSettings:
+    accelerator_type: str
+    runtime_version: str
+    topology: Optional[str]
+    num_slices: int
+    provisioning_model: str
+    reservation_name: Optional[str]
+    network: Optional[str]
+    subnetwork: Optional[str]
+
+    @property
+    def info(self) -> topo.TpuTopology:
+        return topo.lookup(self.accelerator_type, self.topology)
+
+    @property
+    def workers_per_slice(self) -> int:
+        return self.info.num_workers
+
+    @property
+    def total_workers(self) -> int:
+        return self.info.num_workers * self.num_slices
+
+    @property
+    def chips_per_worker(self) -> int:
+        return self.info.chips_per_worker
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleScenarioSettings:
+    name: str
+    maximum_vm_count_dedicated: int
+    maximum_vm_count_low_priority: int
+    minimum_vm_count_dedicated: int
+    minimum_vm_count_low_priority: int
+    maximum_vm_increment_dedicated: int
+    maximum_vm_increment_low_priority: int
+    node_deallocation_option: str
+    sample_lookback_interval_minutes: int
+    required_sample_percentage: int
+    bias_last_sample: bool
+    bias_node_type: str
+    rebalance_preemption_percentage: Optional[int]
+    time_ranges: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSettings:
+    enabled: bool
+    evaluation_interval_seconds: int
+    scenario: Optional[AutoscaleScenarioSettings]
+    formula: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSshSettings:
+    username: str
+    expiry_days: int
+    generate_keypair: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class PrometheusExporterSettings:
+    enabled: bool
+    port: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSettings:
+    id: str
+    substrate: str  # tpu_vm | fake | localhost
+    tpu: Optional[TpuPoolSettings]
+    vm_size: Optional[str]
+    vm_count_dedicated: int
+    vm_count_low_priority: int
+    task_slots_per_node: int
+    inter_node_communication_enabled: bool
+    container_runtimes: tuple[str, ...]
+    jax_version: Optional[str]
+    libtpu_version: Optional[str]
+    additional_node_prep_commands: tuple[str, ...]
+    reboot_on_start_task_failed: bool
+    attempt_recovery_on_unusable: bool
+    block_until_all_global_resources_loaded: bool
+    autoscale: AutoscaleSettings
+    ssh: PoolSshSettings
+    environment_variables: dict
+    max_wait_time_seconds: int
+    node_exporter: PrometheusExporterSettings
+    cadvisor: PrometheusExporterSettings
+
+    @property
+    def is_tpu_pool(self) -> bool:
+        """TPU analog of the reference's is_gpu_pool (settings.py:717)."""
+        return self.tpu is not None
+
+    @property
+    def is_gang_capable(self) -> bool:
+        """Multi-instance tasks require inter-node communication
+        (reference batch.py:4616) — always true on a TPU pod slice whose
+        workers share an ICI mesh."""
+        return self.inter_node_communication_enabled or self.is_tpu_pool
+
+    @property
+    def current_node_count(self) -> int:
+        if self.tpu is not None:
+            return self.tpu.total_workers
+        return self.vm_count_dedicated + self.vm_count_low_priority
+
+
+def pool_settings(config: dict) -> PoolSettings:
+    spec = _get(config, "pool_specification", default=None)
+    if spec is None:
+        raise ValueError("pool_specification is missing from pool config")
+    tpu = None
+    if _get(spec, "tpu") is not None:
+        tpu = TpuPoolSettings(
+            accelerator_type=_get(spec, "tpu", "accelerator_type"),
+            runtime_version=_get(
+                spec, "tpu", "runtime_version",
+                default="tpu-ubuntu2204-base"),
+            topology=_get(spec, "tpu", "topology"),
+            num_slices=_get(spec, "tpu", "num_slices", default=1),
+            provisioning_model=_get(
+                spec, "tpu", "provisioning_model", default="on_demand"),
+            reservation_name=_get(spec, "tpu", "reservation_name"),
+            network=_get(spec, "tpu", "network"),
+            subnetwork=_get(spec, "tpu", "subnetwork"),
+        )
+    scenario = None
+    if _get(spec, "autoscale", "scenario") is not None:
+        sc = _get(spec, "autoscale", "scenario")
+        scenario = AutoscaleScenarioSettings(
+            name=_get(sc, "name", default="active_tasks"),
+            maximum_vm_count_dedicated=_get(
+                sc, "maximum_vm_count", "dedicated", default=16),
+            maximum_vm_count_low_priority=_get(
+                sc, "maximum_vm_count", "low_priority", default=0),
+            minimum_vm_count_dedicated=_get(
+                sc, "minimum_vm_count", "dedicated", default=0),
+            minimum_vm_count_low_priority=_get(
+                sc, "minimum_vm_count", "low_priority", default=0),
+            maximum_vm_increment_dedicated=_get(
+                sc, "maximum_vm_increment_per_evaluation", "dedicated",
+                default=0),
+            maximum_vm_increment_low_priority=_get(
+                sc, "maximum_vm_increment_per_evaluation", "low_priority",
+                default=0),
+            node_deallocation_option=_get(
+                sc, "node_deallocation_option", default="taskcompletion"),
+            sample_lookback_interval_minutes=_get(
+                sc, "sample_lookback_interval_minutes", default=10),
+            required_sample_percentage=_get(
+                sc, "required_sample_percentage", default=70),
+            bias_last_sample=_get(sc, "bias_last_sample", default=True),
+            bias_node_type=_get(sc, "bias_node_type", default="auto"),
+            rebalance_preemption_percentage=_get(
+                sc, "rebalance_preemption_percentage"),
+            time_ranges=_get(sc, "time_ranges", default={}),
+        )
+    autoscale = AutoscaleSettings(
+        enabled=_get(spec, "autoscale", "enabled", default=False),
+        evaluation_interval_seconds=_get(
+            spec, "autoscale", "evaluation_interval_seconds", default=900),
+        scenario=scenario,
+        formula=_get(spec, "autoscale", "formula"),
+    )
+    return PoolSettings(
+        id=spec["id"],
+        substrate=_get(spec, "substrate", default="tpu_vm"),
+        tpu=tpu,
+        vm_size=_get(spec, "vm_configuration", "vm_size"),
+        vm_count_dedicated=_get(
+            spec, "vm_configuration", "vm_count", "dedicated", default=0),
+        vm_count_low_priority=_get(
+            spec, "vm_configuration", "vm_count", "low_priority", default=0),
+        task_slots_per_node=_get(spec, "task_slots_per_node", default=1),
+        inter_node_communication_enabled=_get(
+            spec, "inter_node_communication_enabled", default=False),
+        container_runtimes=tuple(
+            _get(spec, "container_runtimes", default=["docker"])),
+        jax_version=_get(spec, "node_prep", "jax_version"),
+        libtpu_version=_get(spec, "node_prep", "libtpu_version"),
+        additional_node_prep_commands=tuple(
+            _get(spec, "node_prep", "additional_commands", default=[])),
+        reboot_on_start_task_failed=_get(
+            spec, "node_prep", "reboot_on_start_task_failed", default=False),
+        attempt_recovery_on_unusable=_get(
+            spec, "node_prep", "attempt_recovery_on_unusable", default=False),
+        block_until_all_global_resources_loaded=_get(
+            spec, "node_prep", "block_until_all_global_resources_loaded",
+            default=True),
+        autoscale=autoscale,
+        ssh=PoolSshSettings(
+            username=_get(spec, "ssh", "username", default="shipyard"),
+            expiry_days=_get(spec, "ssh", "expiry_days", default=30),
+            generate_keypair=_get(
+                spec, "ssh", "generate_keypair", default=True),
+        ),
+        environment_variables=_get(
+            spec, "environment_variables", default={}),
+        max_wait_time_seconds=_get(
+            spec, "max_wait_time_seconds", default=1800),
+        node_exporter=PrometheusExporterSettings(
+            enabled=_get(
+                spec, "prometheus", "node_exporter", "enabled",
+                default=False),
+            port=_get(
+                spec, "prometheus", "node_exporter", "port", default=9100),
+        ),
+        cadvisor=PrometheusExporterSettings(
+            enabled=_get(
+                spec, "prometheus", "cadvisor", "enabled", default=False),
+            port=_get(spec, "prometheus", "cadvisor", "port", default=8080),
+        ),
+    )
+
+
+# ----------------------------- jobs ------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RecurrenceSettings:
+    recurrence_interval_seconds: int
+    do_not_run_until: Optional[str]
+    do_not_run_after: Optional[str]
+    start_window_seconds: Optional[int]
+    monitor_task_completion: bool
+    run_exclusive: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxDistributedSettings:
+    enabled: bool
+    coordinator_port: int
+    transport: str  # ici | dcn | auto
+    heartbeat_timeout_seconds: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiInstanceSettings:
+    num_instances: Any  # int | 'pool_current_dedicated' | 'pool_specification_vm_count'
+    coordination_command: Optional[str]
+    resource_files: tuple[dict, ...]
+    jax_distributed: JaxDistributedSettings
+    pytorch_xla: bool
+
+    def resolve_num_instances(self, pool: PoolSettings) -> int:
+        if isinstance(self.num_instances, int):
+            return self.num_instances
+        if self.num_instances in (
+                "pool_current_dedicated", "pool_specification_vm_count",
+                "pool_current_low_priority"):
+            return pool.current_node_count
+        raise ValueError(
+            f"cannot resolve num_instances {self.num_instances!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskSettings:
+    id: Optional[str]
+    docker_image: Optional[str]
+    singularity_image: Optional[str]
+    runtime: str  # docker | singularity | none
+    command: str
+    environment_variables: dict
+    tpu: bool
+    gpus: int
+    depends_on: tuple[str, ...]
+    depends_on_range: Optional[tuple[int, int]]
+    max_task_retries: int
+    max_wall_time_seconds: Optional[int]
+    retention_time_seconds: Optional[int]
+    multi_instance: Optional[MultiInstanceSettings]
+    input_data: tuple[dict, ...]
+    output_data: tuple[dict, ...]
+    resource_files: tuple[dict, ...]
+    remove_container_after_exit: bool
+    shm_size: Optional[str]
+    additional_docker_run_options: tuple[str, ...]
+    additional_singularity_options: tuple[str, ...]
+    task_factory: Optional[dict]
+    merge_task: bool
+    default_exit_options: dict
+
+    @property
+    def image(self) -> Optional[str]:
+        return self.docker_image or self.singularity_image
+
+    @property
+    def is_multi_instance(self) -> bool:
+        return self.multi_instance is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSettings:
+    id: str
+    pool_id: Optional[str]
+    auto_complete: bool
+    priority: int
+    max_task_retries: int
+    max_wall_time_seconds: Optional[int]
+    allow_run_on_missing_image: bool
+    environment_variables: dict
+    recurrence: Optional[RecurrenceSettings]
+    job_preparation_command: Optional[str]
+    job_release_command: Optional[str]
+    input_data: tuple[dict, ...]
+    tasks: tuple[dict, ...]  # raw task dicts (expanded by task factories)
+    merge_task: Optional[dict]
+    federation_constraints: dict
+
+
+def job_settings_list(config: dict) -> list[JobSettings]:
+    jobs = _get(config, "job_specifications", default=None)
+    if jobs is None:
+        raise ValueError("job_specifications is missing from jobs config")
+    return [_job_settings(j) for j in jobs]
+
+
+def _job_settings(job: dict) -> JobSettings:
+    recurrence = None
+    if _get(job, "recurrence") is not None:
+        recurrence = RecurrenceSettings(
+            recurrence_interval_seconds=_get(
+                job, "recurrence", "schedule",
+                "recurrence_interval_seconds"),
+            do_not_run_until=_get(
+                job, "recurrence", "schedule", "do_not_run_until"),
+            do_not_run_after=_get(
+                job, "recurrence", "schedule", "do_not_run_after"),
+            start_window_seconds=_get(
+                job, "recurrence", "schedule", "start_window_seconds"),
+            monitor_task_completion=_get(
+                job, "recurrence", "job_manager", "monitor_task_completion",
+                default=False),
+            run_exclusive=_get(
+                job, "recurrence", "job_manager", "run_exclusive",
+                default=False),
+        )
+    return JobSettings(
+        id=job["id"],
+        pool_id=_get(job, "pool_id"),
+        auto_complete=_get(job, "auto_complete", default=False),
+        priority=_get(job, "priority", default=0),
+        max_task_retries=_get(job, "max_task_retries", default=0),
+        max_wall_time_seconds=_get(job, "max_wall_time_seconds"),
+        allow_run_on_missing_image=_get(
+            job, "allow_run_on_missing_image", default=False),
+        environment_variables=_get(
+            job, "environment_variables", default={}),
+        recurrence=recurrence,
+        job_preparation_command=_get(job, "job_preparation", "command"),
+        job_release_command=_get(job, "job_release", "command"),
+        input_data=tuple(_get(job, "input_data", default=[])),
+        tasks=tuple(_get(job, "tasks", default=[])),
+        merge_task=_get(job, "merge_task"),
+        federation_constraints=_get(
+            job, "federation_constraints", default={}),
+    )
+
+
+def task_settings(task: dict, job: JobSettings,
+                  pool: PoolSettings | None = None) -> TaskSettings:
+    """Merge pool/job/task layers into final task settings.
+
+    Reference analog: settings.task_settings (settings.py:3727) which
+    merges pool+job+task config, resolves images and run options.
+    """
+    env = dict(pool.environment_variables) if pool is not None else {}
+    env.update(job.environment_variables)
+    env.update(_get(task, "environment_variables", default={}))
+    runtime = _get(task, "runtime")
+    docker_image = _get(task, "docker_image")
+    singularity_image = _get(task, "singularity_image")
+    if runtime is None:
+        if docker_image:
+            runtime = "docker"
+        elif singularity_image:
+            runtime = "singularity"
+        else:
+            runtime = "none"
+    if docker_image and singularity_image:
+        raise ValueError(
+            "task may not specify both docker_image and singularity_image")
+    mi = None
+    if _get(task, "multi_instance") is not None:
+        raw_mi = _get(task, "multi_instance")
+        mi = MultiInstanceSettings(
+            num_instances=_get(raw_mi, "num_instances", default=1),
+            coordination_command=_get(raw_mi, "coordination_command"),
+            resource_files=tuple(
+                _get(raw_mi, "resource_files", default=[])),
+            jax_distributed=JaxDistributedSettings(
+                enabled=_get(
+                    raw_mi, "jax_distributed", "enabled", default=True),
+                coordinator_port=_get(
+                    raw_mi, "jax_distributed", "coordinator_port",
+                    default=8476),
+                transport=_get(
+                    raw_mi, "jax_distributed", "transport", default="auto"),
+                heartbeat_timeout_seconds=_get(
+                    raw_mi, "jax_distributed", "heartbeat_timeout_seconds",
+                    default=100),
+            ),
+            pytorch_xla=_get(raw_mi, "pytorch_xla", "enabled", default=False),
+        )
+    depends_on_range = None
+    if _get(task, "depends_on_range") is not None:
+        rng = _get(task, "depends_on_range")
+        depends_on_range = (rng[0], rng[1])
+    return TaskSettings(
+        id=_get(task, "id"),
+        docker_image=docker_image,
+        singularity_image=singularity_image,
+        runtime=runtime,
+        command=_get(task, "command", default=""),
+        environment_variables=env,
+        tpu=_get(task, "tpu", default=(
+            pool.is_tpu_pool if pool is not None else False)),
+        gpus=_get(task, "gpus", default=0),
+        depends_on=tuple(_get(task, "depends_on", default=[])),
+        depends_on_range=depends_on_range,
+        max_task_retries=_get(
+            task, "max_task_retries", default=job.max_task_retries),
+        max_wall_time_seconds=_get(
+            task, "max_wall_time_seconds", default=job.max_wall_time_seconds),
+        retention_time_seconds=_get(task, "retention_time_seconds"),
+        multi_instance=mi,
+        input_data=tuple(_get(task, "input_data", default=[])),
+        output_data=tuple(_get(task, "output_data", default=[])),
+        resource_files=tuple(_get(task, "resource_files", default=[])),
+        remove_container_after_exit=_get(
+            task, "remove_container_after_exit", default=True),
+        shm_size=_get(task, "shm_size"),
+        additional_docker_run_options=tuple(
+            _get(task, "additional_docker_run_options", default=[])),
+        additional_singularity_options=tuple(
+            _get(task, "additional_singularity_options", default=[])),
+        task_factory=_get(task, "task_factory"),
+        merge_task=_get(task, "merge_task", default=False),
+        default_exit_options=_get(
+            task, "exit_conditions", "default", "exit_options", default={}),
+    )

@@ -1,0 +1,1 @@
+from batch_shipyard_tpu.utils import util  # noqa: F401

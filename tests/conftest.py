@@ -1,0 +1,32 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding paths are testable without TPU hardware (SURVEY.md
+section 4: the fake-substrate test strategy the reference lacks)."""
+
+import os
+import pathlib
+import sys
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_statestore(tmp_path):
+    from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+    return LocalFSStateStore(str(tmp_path / "store"))
+
+
+@pytest.fixture()
+def mem_statestore():
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    return MemoryStateStore()

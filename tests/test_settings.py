@@ -1,0 +1,93 @@
+"""Tests for typed settings accessors (reference analog: settings.py)."""
+
+import pytest
+
+from batch_shipyard_tpu.config import settings
+
+
+POOL_CONF = {"pool_specification": {
+    "id": "tpupool",
+    "substrate": "tpu_vm",
+    "tpu": {"accelerator_type": "v5litepod-16", "num_slices": 2},
+    "task_slots_per_node": 2,
+    "environment_variables": {"POOLVAR": "1"},
+}}
+
+JOBS_CONF = {"job_specifications": [{
+    "id": "job1",
+    "environment_variables": {"JOBVAR": "2"},
+    "max_task_retries": 3,
+    "tasks": [
+        {"docker_image": "img", "command": "run",
+         "environment_variables": {"TASKVAR": "3"}},
+        {"singularity_image": "simg", "command": "run2"},
+        {"command": "bare"},
+    ],
+}]}
+
+
+def test_pool_settings_tpu():
+    pool = settings.pool_settings(POOL_CONF)
+    assert pool.id == "tpupool"
+    assert pool.is_tpu_pool
+    assert pool.tpu.workers_per_slice == 4
+    assert pool.tpu.total_workers == 8
+    assert pool.tpu.chips_per_worker == 4
+    assert pool.is_gang_capable
+    assert pool.current_node_count == 8
+
+
+def test_pool_settings_non_tpu():
+    conf = {"pool_specification": {
+        "id": "cpupool",
+        "vm_configuration": {
+            "vm_size": "n2-standard-8",
+            "vm_count": {"dedicated": 3, "low_priority": 2}},
+    }}
+    pool = settings.pool_settings(conf)
+    assert not pool.is_tpu_pool
+    assert pool.current_node_count == 5
+
+
+def test_task_env_merge_pool_job_task():
+    pool = settings.pool_settings(POOL_CONF)
+    job = settings.job_settings_list(JOBS_CONF)[0]
+    task = settings.task_settings(dict(job.tasks[0]), job, pool)
+    assert task.environment_variables == {
+        "POOLVAR": "1", "JOBVAR": "2", "TASKVAR": "3"}
+    assert task.runtime == "docker"
+    assert task.max_task_retries == 3
+    assert task.tpu  # inherits pool TPU-ness
+
+
+def test_task_runtime_inference():
+    job = settings.job_settings_list(JOBS_CONF)[0]
+    assert settings.task_settings(
+        dict(job.tasks[1]), job).runtime == "singularity"
+    assert settings.task_settings(dict(job.tasks[2]), job).runtime == "none"
+
+
+def test_task_both_images_rejected():
+    job = settings.job_settings_list(JOBS_CONF)[0]
+    with pytest.raises(ValueError):
+        settings.task_settings(
+            {"docker_image": "a", "singularity_image": "b"}, job)
+
+
+def test_multi_instance_resolution():
+    pool = settings.pool_settings(POOL_CONF)
+    job = settings.job_settings_list(JOBS_CONF)[0]
+    task = settings.task_settings(
+        {"command": "x", "multi_instance": {
+            "num_instances": "pool_current_dedicated"}}, job, pool)
+    assert task.is_multi_instance
+    assert task.multi_instance.resolve_num_instances(pool) == 8
+    assert task.multi_instance.jax_distributed.enabled
+
+
+def test_credentials_defaults():
+    creds = settings.credentials_settings({"credentials": {
+        "storage": {"backend": "memory"}}})
+    assert creds.storage.backend == "memory"
+    assert creds.storage.prefix == "shipyardtpu"
+    assert creds.gcp is None

@@ -1,0 +1,54 @@
+"""Tests for the TPU topology oracle."""
+
+import math
+
+import pytest
+
+from batch_shipyard_tpu.parallel import topology
+
+
+@pytest.mark.parametrize("atype,chips,workers,cpw", [
+    ("v5litepod-16", 16, 4, 4),
+    ("v5e-16", 16, 4, 4),
+    ("v5litepod-8", 8, 2, 4),
+    ("v5litepod-4", 4, 1, 4),
+    ("v5litepod-1", 1, 1, 1),
+    ("v5litepod-256", 256, 64, 4),
+    ("v4-32", 16, 4, 4),
+    ("v4-8", 4, 1, 4),
+    ("v3-8", 4, 1, 4),
+    ("v5p-128", 64, 16, 4),
+    ("v6e-64", 64, 16, 4),
+])
+def test_lookup_shapes(atype, chips, workers, cpw):
+    info = topology.lookup(atype)
+    assert info.num_chips == chips
+    assert info.num_workers == workers
+    assert info.chips_per_worker == cpw
+    assert math.prod(info.mesh_shape) == chips
+
+
+def test_explicit_topology():
+    info = topology.lookup("v5litepod-16", topology="2x8")
+    assert info.mesh_shape == (2, 8)
+    with pytest.raises(ValueError):
+        topology.lookup("v5litepod-16", topology="3x3")
+
+
+def test_3d_torus_for_large_v4():
+    info = topology.lookup("v4-128")  # 64 chips
+    assert len(info.mesh_shape) == 3
+    assert math.prod(info.mesh_shape) == 64
+
+
+def test_unknown_rejected():
+    with pytest.raises(ValueError):
+        topology.lookup("a100-8")
+    assert not topology.is_tpu_accelerator("a100-8")
+    assert topology.is_tpu_accelerator("v6e-8")
+
+
+def test_capability_numbers():
+    info = topology.lookup("v5litepod-16")
+    assert info.total_hbm_gib == 16 * 16
+    assert info.total_bf16_tflops > 3000

@@ -1,0 +1,104 @@
+"""Tests for the strict schema engine and the shipped config schemas."""
+
+import pytest
+
+from batch_shipyard_tpu.config import validator
+from batch_shipyard_tpu.config.validator import (
+    ConfigType, ValidationError, validate, validate_config)
+
+
+def test_scalar_types():
+    schema = {"type": "map", "mapping": {
+        "a": {"type": "str"}, "b": {"type": "int"}, "c": {"type": "bool"},
+        "d": {"type": "number"}}}
+    assert validate({"a": "x", "b": 1, "c": True, "d": 2.5}, schema) == []
+    errs = validate({"a": 1, "b": "x", "c": 2, "d": "y"}, schema)
+    assert len(errs) == 4
+
+
+def test_bool_is_not_int():
+    schema = {"type": "map", "mapping": {"n": {"type": "int"}}}
+    assert validate({"n": True}, schema)
+
+
+def test_unknown_key_rejected_strict():
+    schema = {"type": "map", "mapping": {"a": {"type": "str"}}}
+    errs = validate({"a": "x", "zz": 1}, schema)
+    assert any("unknown key" in e for e in errs)
+
+
+def test_allow_unknown():
+    schema = {"type": "map", "allow_unknown": True, "mapping": {}}
+    assert validate({"anything": 1}, schema) == []
+
+
+def test_required_key():
+    schema = {"type": "map", "mapping": {
+        "a": {"type": "str", "required": True}}}
+    errs = validate({}, schema)
+    assert any("required" in e for e in errs)
+
+
+def test_enum_pattern_range():
+    schema = {"type": "map", "mapping": {
+        "e": {"type": "str", "enum": ["x", "y"]},
+        "p": {"type": "str", "pattern": "[a-z]+"},
+        "r": {"type": "int", "range": {"min": 1, "max": 5}}}}
+    assert validate({"e": "x", "p": "abc", "r": 3}, schema) == []
+    errs = validate({"e": "z", "p": "ABC", "r": 9}, schema)
+    assert len(errs) == 3
+
+
+def test_seq_and_nullable():
+    schema = {"type": "map", "mapping": {
+        "s": {"type": "seq", "sequence": {"type": "int"}},
+        "n": {"type": "str", "nullable": True}}}
+    assert validate({"s": [1, 2], "n": None}, schema) == []
+    assert validate({"s": [1, "x"]}, schema)
+
+
+def test_pool_schema_good():
+    config = {"pool_specification": {
+        "id": "mypool",
+        "tpu": {"accelerator_type": "v5litepod-16"},
+    }}
+    assert validate_config(ConfigType.POOL, config) == []
+
+
+def test_pool_schema_bad_key():
+    config = {"pool_specification": {
+        "id": "mypool", "not_a_real_key": 1}}
+    with pytest.raises(ValidationError) as exc:
+        validate_config(ConfigType.POOL, config)
+    assert "not_a_real_key" in str(exc.value)
+
+
+def test_jobs_schema_good():
+    config = {"job_specifications": [{
+        "id": "job1",
+        "tasks": [{
+            "docker_image": "busybox",
+            "command": "echo hi",
+            "multi_instance": {
+                "num_instances": 4,
+                "jax_distributed": {"enabled": True, "transport": "ici"},
+            },
+        }],
+    }]}
+    assert validate_config(ConfigType.JOBS, config) == []
+
+
+def test_credentials_schema():
+    config = {"credentials": {
+        "gcp": {"project": "my-proj"},
+        "storage": {"backend": "localfs", "root": "/tmp/x"},
+    }}
+    assert validate_config(ConfigType.CREDENTIALS, config) == []
+    bad = {"credentials": {"storage": {"backend": "s3"}}}
+    with pytest.raises(ValidationError):
+        validate_config(ConfigType.CREDENTIALS, bad)
+
+
+def test_all_schemas_parse():
+    for ct in ConfigType:
+        assert validator._load_schema(ct.value) is not None
