@@ -1,0 +1,65 @@
+"""Node agent process entrypoint.
+
+Started by the localhost substrate (subprocess per node) and by
+nodeprep on real TPU VM workers (systemd unit). All wiring comes from
+a JSON bootstrap file to keep the exec contract trivial:
+
+    python -m batch_shipyard_tpu.agent /path/to/bootstrap.json
+
+Bootstrap schema: {
+  storage: {backend, root|bucket, prefix},
+  pool_config: <raw pool yaml dict>,
+  identity: {pool_id, node_id, node_index, hostname, internal_ip,
+             slice_index, worker_index},
+  work_dir: str, heartbeat_interval: float, poll_interval: float
+}
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+
+from batch_shipyard_tpu.agent.cascade import CascadeImageProvisioner
+from batch_shipyard_tpu.agent.node_agent import NodeAgent, NodeIdentity
+from batch_shipyard_tpu.agent.nodeprep import run_node_prep
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.config.settings import StorageCredentialsSettings
+from batch_shipyard_tpu.state.factory import create_statestore
+
+
+def main(argv: list[str]) -> int:
+    with open(argv[1], "r", encoding="utf-8") as fh:
+        boot = json.load(fh)
+    storage = StorageCredentialsSettings(
+        backend=boot["storage"]["backend"],
+        bucket=boot["storage"].get("bucket"),
+        prefix=boot["storage"].get("prefix", "shipyardtpu"),
+        root=boot["storage"].get("root"),
+    )
+    store = create_statestore(storage)
+    pool = settings_mod.pool_settings(boot["pool_config"])
+    identity = NodeIdentity(**boot["identity"])
+    provisioner = CascadeImageProvisioner(store)
+    agent = NodeAgent(
+        store, identity, pool, work_dir=boot["work_dir"],
+        heartbeat_interval=boot.get("heartbeat_interval", 10.0),
+        poll_interval=boot.get("poll_interval", 0.5),
+        node_stale_seconds=boot.get("node_stale_seconds", 30.0),
+        nodeprep=(run_node_prep if boot.get("run_nodeprep", True)
+                  else None),
+        image_provisioner=provisioner)
+
+    def _stop(signum, frame):
+        agent.stop()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    agent.start()
+    agent.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,212 @@
+"""Cascade: pool-wide container-image replication with lease gating.
+
+Reference analog: cascade/cascade.py — the on-node image replicator
+whose pool-wide concurrency gate is blob leases over per-resource lock
+blobs ``hash.{0..N}`` (_direct_download_resources_async cascade.py:574,
+60 s lease + renew at :628). Re-designed:
+
+  - global resources (images) live in TABLE_IMAGES per pool, written by
+    ``pool add`` (storage.populate_global_resource_blobs analog,
+    storage.py:476);
+  - an agent wanting image X acquires one of
+    ``grlocks/<pool>/<hash>.{0..K-1}`` leases (K =
+    concurrent_source_downloads) before pulling, renewing on a
+    background thread while the pull runs — bounding simultaneous
+    registry load across the whole pool exactly like the reference;
+  - pull happens via docker/singularity CLI with registry fallback;
+    perf events record pull start/end per image.
+
+On nodes without docker (tests, bare TPU VMs running runtime:none
+tasks) pulls are skipped but the gate/accounting logic still runs, so
+the protocol is fully unit-testable (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.agent import perf
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError, StateStore)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+LEASE_SECONDS = 60.0
+RENEW_INTERVAL = 15.0
+
+
+def populate_global_resources(store: StateStore, pool_id: str,
+                              docker_images: list[str],
+                              singularity_images: list[str] = (),
+                              concurrent_downloads: int = 10) -> None:
+    """Write the pool's image manifest (pool add path)."""
+    for image in docker_images:
+        key = util.hash_string(f"docker:{image}")[:24]
+        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+            "kind": "docker", "image": image,
+            "concurrent_downloads": concurrent_downloads})
+    for image in singularity_images:
+        key = util.hash_string(f"singularity:{image}")[:24]
+        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+            "kind": "singularity", "image": image,
+            "concurrent_downloads": concurrent_downloads})
+
+
+def global_resources_loaded(store: StateStore, pool_id: str,
+                            node_id: str) -> bool:
+    """Has this node recorded completion of all its image pulls?"""
+    wanted = {row["_rk"] for row in store.query_entities(
+        names.TABLE_IMAGES, partition_key=pool_id)}
+    if not wanted:
+        return True
+    try:
+        row = store.get_entity(names.TABLE_IMAGES + "done", pool_id,
+                               node_id)
+    except NotFoundError:
+        return False
+    return wanted <= set(row.get("loaded", []))
+
+
+class CascadeImageProvisioner:
+    """Per-node image puller with the pool-wide lease gate."""
+
+    def __init__(self, store: StateStore, fallback_registry:
+                 Optional[str] = None, pull_timeout: float = 1800.0,
+                 puller: Optional[object] = None) -> None:
+        self.store = store
+        self.fallback_registry = fallback_registry
+        self.pull_timeout = pull_timeout
+        self._puller = puller  # test hook: callable(kind, image) -> int
+        self._loaded: set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- entry points ---------------------------------------------------
+
+    def distribute_global_resources(self, agent) -> None:
+        """Pull every image in the pool manifest (nodeprep path;
+        reference cascade.py:724 distribute_global_resources)."""
+        pool_id = agent.identity.pool_id
+        rows = list(self.store.query_entities(
+            names.TABLE_IMAGES, partition_key=pool_id))
+        for row in rows:
+            self._fetch(agent, row["_rk"], row["kind"], row["image"],
+                        int(row.get("concurrent_downloads", 10)))
+        perf.emit(self.store, pool_id, agent.identity.node_id, "cascade",
+                  "global_resources_loaded")
+
+    def __call__(self, agent, images: list[str],
+                 kind: str = "docker") -> None:
+        """Agent hook: ensure specific images before running a task.
+        The key must match populate_global_resources' kind-qualified
+        hash so the pool-wide lease gate is actually shared."""
+        pool_id = agent.identity.pool_id
+        for image in images:
+            key = util.hash_string(f"{kind}:{image}")[:24]
+            try:
+                row = self.store.get_entity(
+                    names.TABLE_IMAGES, pool_id, key)
+            except NotFoundError:
+                row = {"kind": kind, "image": image,
+                       "concurrent_downloads": 10}
+            self._fetch(agent, key, row["kind"], row.get("image", image),
+                        int(row.get("concurrent_downloads", 10)))
+
+    # -- internals ------------------------------------------------------
+
+    def _fetch(self, agent, resource_hash: str, kind: str, image: str,
+               concurrent: int) -> None:
+        with self._lock:
+            if resource_hash in self._loaded:
+                return
+        pool_id = agent.identity.pool_id
+        node_id = agent.identity.node_id
+        handle = None
+        # Acquire one of the K lock slots (reference hash.{0..N} blobs).
+        while handle is None:
+            for slot in range(max(1, concurrent)):
+                lease_key = names.global_resource_lock_key(
+                    pool_id, resource_hash, slot)
+                handle = self.store.acquire_lease(
+                    lease_key, LEASE_SECONDS, node_id)
+                if handle is not None:
+                    break
+            if handle is None:
+                if getattr(agent, "stop_event", None) is not None and \
+                        agent.stop_event.is_set():
+                    return
+                time.sleep(0.1)
+        stop_renew = threading.Event()
+
+        def _renew():
+            nonlocal handle
+            while not stop_renew.wait(RENEW_INTERVAL):
+                try:
+                    handle = self.store.renew_lease(handle, LEASE_SECONDS)
+                except Exception:
+                    logger.warning("cascade lease renew lost for %s",
+                                   image)
+                    return
+
+        renewer = threading.Thread(target=_renew, daemon=True)
+        renewer.start()
+        try:
+            perf.emit(self.store, pool_id, node_id, "cascade",
+                      f"pull.start:{image}")
+            rc = self._pull(kind, image)
+            perf.emit(self.store, pool_id, node_id, "cascade",
+                      f"pull.end:{image}", message=str(rc))
+            if rc == 0:
+                with self._lock:
+                    self._loaded.add(resource_hash)
+                self._record_loaded(pool_id, node_id)
+        finally:
+            stop_renew.set()
+            renewer.join(timeout=1.0)
+            try:
+                self.store.release_lease(handle)
+            except Exception:
+                pass
+
+    def _pull(self, kind: str, image: str) -> int:
+        if self._puller is not None:
+            return self._puller(kind, image)
+        if kind == "docker":
+            if shutil.which("docker") is None:
+                logger.info("docker unavailable; skipping pull of %s",
+                            image)
+                return 0
+            rc = subprocess.call(["docker", "pull", image],
+                                 timeout=self.pull_timeout)
+            if rc != 0 and self.fallback_registry:
+                fallback = f"{self.fallback_registry}/{image}"
+                rc = subprocess.call(["docker", "pull", fallback],
+                                     timeout=self.pull_timeout)
+                if rc == 0:
+                    rc = subprocess.call(
+                        ["docker", "tag", fallback, image])
+            return rc
+        if kind == "singularity":
+            if shutil.which("singularity") is None:
+                logger.info("singularity unavailable; skipping %s", image)
+                return 0
+            return subprocess.call(
+                ["singularity", "pull", "--force", f"docker://{image}"],
+                timeout=self.pull_timeout)
+        raise ValueError(f"unknown image kind {kind!r}")
+
+    def _record_loaded(self, pool_id: str, node_id: str) -> None:
+        with self._lock:
+            loaded = sorted(self._loaded)
+        table = names.TABLE_IMAGES + "done"
+        try:
+            self.store.insert_entity(table, pool_id, node_id,
+                                     {"loaded": loaded})
+        except EntityExistsError:
+            self.store.merge_entity(table, pool_id, node_id,
+                                    {"loaded": loaded})

@@ -1,0 +1,744 @@
+"""Node agent: the per-node daemon that executes scheduled work.
+
+The reference never had to write this loop — Azure Batch's hosted agent
+did task pickup, retries, and exit-code plumbing (SURVEY.md section 7
+'hard parts'). Ours is storage-mediated like everything else: tasks
+arrive on a per-pool queue, assignment is won by optimistic-concurrency
+claims on task entities, gang (multi-instance) tasks rendezvous through
+a gang table, and results flow back through tables + object uploads.
+
+Lifecycle of a node (entity in TABLE_NODES):
+    creating -> starting (node prep) -> idle <-> running -> offline
+                 \\-> start_task_failed            \\-> unusable
+
+Lifecycle of a task (entity in TABLE_TASKS):
+    pending -> assigned -> running -> completed | failed
+         \\-> blocked (dependency permanently unsatisfiable)
+
+The agent runs identically under the fake substrate (thread per node),
+the localhost substrate (process), and on a real TPU VM worker
+(systemd unit installed by nodeprep).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from batch_shipyard_tpu.agent import task_runner
+from batch_shipyard_tpu.config.settings import (
+    JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
+from batch_shipyard_tpu.jobs import launcher
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_MAX_OUTPUT_UPLOAD_BYTES = 4 * 1024 * 1024
+
+
+class NodeUnusableError(Exception):
+    """Raised by a nodeprep callable to mark the node unusable (as
+    opposed to start-task-failed): the node finished booting but cannot
+    serve tasks — triggers attempt_recovery_on_unusable handling."""
+
+
+@dataclasses.dataclass
+class NodeIdentity:
+    pool_id: str
+    node_id: str
+    node_index: int
+    hostname: str
+    internal_ip: str
+    slice_index: int = 0
+    worker_index: int = 0
+
+
+class NodeAgent:
+    def __init__(self, store: StateStore, identity: NodeIdentity,
+                 pool: PoolSettings, work_dir: str,
+                 heartbeat_interval: float = 5.0,
+                 poll_interval: float = 0.2,
+                 gang_timeout: float = 600.0,
+                 node_stale_seconds: float = 30.0,
+                 nodeprep: Optional[Callable[["NodeAgent"], None]] = None,
+                 image_provisioner: Optional[
+                     Callable[["NodeAgent", list[str]], None]] = None,
+                 ) -> None:
+        self.store = store
+        self.identity = identity
+        self.pool = pool
+        self.work_dir = work_dir
+        self.heartbeat_interval = heartbeat_interval
+        self.poll_interval = poll_interval
+        self.gang_timeout = gang_timeout
+        self.node_stale_seconds = node_stale_seconds
+        self._nodeprep = nodeprep
+        self._image_provisioner = image_provisioner
+        self.stop_event = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._running_tasks = 0
+        self._running_lock = threading.Lock()
+
+    # ------------------------- node lifecycle --------------------------
+
+    @property
+    def _nid(self) -> tuple[str, str]:
+        return self.identity.pool_id, self.identity.node_id
+
+    def _set_node_state(self, state: str, **extra) -> None:
+        pool_id, node_id = self._nid
+        entity = {
+            "state": state,
+            "hostname": self.identity.hostname,
+            "internal_ip": self.identity.internal_ip,
+            "node_index": self.identity.node_index,
+            "slice_index": self.identity.slice_index,
+            "worker_index": self.identity.worker_index,
+            "heartbeat_at": time.time(),
+            "task_slots": self.pool.task_slots_per_node,
+        }
+        entity.update(extra)
+        self.store.upsert_entity(names.TABLE_NODES, pool_id, node_id, entity)
+
+    def _heartbeat(self, **extra) -> None:
+        pool_id, node_id = self._nid
+        try:
+            self.store.merge_entity(
+                names.TABLE_NODES, pool_id, node_id,
+                {"heartbeat_at": time.time(),
+                 "running_tasks": self._running_tasks, **extra})
+        except NotFoundError:
+            pass
+
+    def start(self) -> None:
+        """Run node prep, then start worker + heartbeat threads."""
+        self._set_node_state("starting")
+        marker = os.path.join(self.work_dir, ".nodeprep_finished")
+        try:
+            os.makedirs(self.work_dir, exist_ok=True)
+            # Idempotency marker: reboot-resume fast path (reference:
+            # $nodeprepfinished, shipyard_nodeprep.sh:1935-1970).
+            if not os.path.exists(marker):
+                if self._nodeprep is not None:
+                    self._nodeprep(self)
+                with open(marker, "w", encoding="utf-8") as fh:
+                    fh.write(util.datetime_utcnow_iso())
+        except NodeUnusableError as exc:
+            logger.warning("node %s unusable: %s",
+                           self.identity.node_id, exc)
+            self._set_node_state("unusable", error=str(exc))
+            return
+        except Exception as exc:
+            logger.exception("node prep failed on %s", self.identity.node_id)
+            self._set_node_state("start_task_failed", error=str(exc))
+            return
+        self._set_node_state("idle")
+        for slot in range(self.pool.task_slots_per_node):
+            thread = threading.Thread(
+                target=self._worker_loop, args=(slot,),
+                name=f"agent-{self.identity.node_id}-s{slot}", daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name=f"hb-{self.identity.node_id}",
+                              daemon=True)
+        hb.start()
+        self._threads.append(hb)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for thread in self._threads:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            thread.join(remaining)
+
+    def _heartbeat_loop(self) -> None:
+        while not self.stop_event.wait(self.heartbeat_interval):
+            self._heartbeat()
+        self._set_node_state("offline")
+
+    # --------------------------- work loop -----------------------------
+
+    def _worker_loop(self, slot: int) -> None:
+        pool_id, node_id = self._nid
+        taskq = names.task_queue(pool_id)
+        ctrlq = names.control_queue(pool_id, node_id)
+        while not self.stop_event.is_set():
+            # Control messages first (job release, shutdown).
+            if slot == 0:
+                for msg in self.store.get_messages(
+                        ctrlq, max_messages=4, visibility_timeout=60.0):
+                    self._handle_control(json.loads(msg.payload))
+                    self.store.delete_message(msg)
+                if self.stop_event.is_set():
+                    break
+            msgs = self.store.get_messages(
+                taskq, max_messages=1, visibility_timeout=60.0)
+            if not msgs:
+                time.sleep(self.poll_interval)
+                continue
+            msg = msgs[0]
+            try:
+                self._process_task_message(
+                    slot, json.loads(msg.payload), msg)
+            except Exception:
+                logger.exception("error processing task message; requeue")
+                try:
+                    self.store.update_message(msg, visibility_timeout=5.0)
+                except NotFoundError:
+                    pass
+
+    def _handle_control(self, control: dict) -> None:
+        kind = control.get("type")
+        if kind == "shutdown":
+            self.stop_event.set()
+        elif kind == "job_release":
+            self._run_job_release(control["job_id"])
+        elif kind == "load_images":
+            if self._image_provisioner is not None:
+                self._image_provisioner(
+                    self, control.get("images", []),
+                    kind=control.get("kind", "docker"))
+
+    # ------------------------ task processing --------------------------
+
+    def _task_entity(self, job_id: str, task_id: str) -> dict:
+        return self.store.get_entity(
+            names.TABLE_TASKS, names.task_pk(self.identity.pool_id, job_id),
+            task_id)
+
+    def _merge_task(self, job_id: str, task_id: str, patch: dict,
+                    if_match: Optional[str] = None) -> str:
+        return self.store.merge_entity(
+            names.TABLE_TASKS, names.task_pk(self.identity.pool_id, job_id),
+            task_id, patch, if_match=if_match)
+
+    def _deps_status(self, job_id: str, spec: dict) -> str:
+        """'ready' | 'wait' | 'blocked' per depends_on semantics
+        (reference: batch.py:4177-4242 + exit_conditions
+        dependency_action)."""
+        deps = list(spec.get("depends_on", []))
+        rng = spec.get("depends_on_range")
+        if rng:
+            deps.extend(str(i) for i in range(rng[0], rng[1] + 1))
+        for dep in deps:
+            try:
+                ent = self._task_entity(job_id, dep)
+            except NotFoundError:
+                return "wait"
+            state = ent.get("state")
+            if state == "completed":
+                continue
+            if state in ("failed", "blocked"):
+                dep_action = (ent.get("spec", {}).get("exit_options", {})
+                              .get("dependency_action", "block"))
+                if dep_action == "satisfy":
+                    continue
+                return "blocked"
+            return "wait"
+        return "ready"
+
+    def _process_task_message(self, slot: int, payload: dict,
+                              msg) -> None:
+        job_id = payload["job_id"]
+        task_id = payload["task_id"]
+        instance = payload.get("instance")
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            self.store.delete_message(msg)
+            return
+        if entity.get("state") in ("completed", "failed", "blocked"):
+            self.store.delete_message(msg)
+            return
+        spec = entity["spec"]
+        deps = self._deps_status(job_id, spec)
+        if deps == "blocked":
+            try:
+                self._merge_task(job_id, task_id, {"state": "blocked"},
+                                 if_match=entity["_etag"])
+            except (EtagMismatchError, NotFoundError):
+                pass
+            self.store.delete_message(msg)
+            return
+        if deps == "wait":
+            self.store.update_message(msg, visibility_timeout=1.0)
+            return
+        # Dead-node recovery: a redelivered message whose task is still
+        # assigned/running on a node with a stale heartbeat means that
+        # node died mid-task — reclaim it (the responsibility Azure
+        # Batch's hosted agent handled for the reference).
+        entity = self._maybe_reclaim_orphan(job_id, task_id, entity)
+        if entity is None:
+            self.store.update_message(msg, visibility_timeout=10.0)
+            return
+        if instance is None:
+            self._run_regular_task(slot, job_id, task_id, entity, msg)
+        else:
+            self._run_gang_instance(
+                slot, job_id, task_id, entity, instance, msg)
+
+    def _maybe_reclaim_orphan(self, job_id: str, task_id: str,
+                              entity: dict) -> Optional[dict]:
+        """Return a claimable entity, resetting orphans to pending.
+
+        None means the task is legitimately held by a live node (or we
+        lost a reset race); the caller should back off.
+        """
+        state = entity.get("state")
+        owner = entity.get("node_id")
+        if state not in ("assigned", "running") or not owner:
+            return entity
+        if owner == self.identity.node_id:
+            # Our own pre-crash claim (agent restart): take it back.
+            pass
+        else:
+            try:
+                node = self.store.get_entity(
+                    names.TABLE_NODES, self.identity.pool_id, owner)
+                alive = (node.get("state") not in ("offline",) and
+                         time.time() - float(node.get(
+                             "heartbeat_at", 0)) < self.node_stale_seconds)
+            except NotFoundError:
+                alive = False
+            if alive:
+                return None
+        logger.warning(
+            "task %s/%s orphaned by %s; resetting to pending",
+            job_id, task_id, owner)
+        try:
+            self._merge_task(
+                job_id, task_id,
+                {"state": "pending", "node_id": None},
+                if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return None
+        return self._task_entity(job_id, task_id)
+
+    def _message_keepalive(self, msg, interval: float = 20.0,
+                           visibility: float = 60.0):
+        """Keep a claimed queue message invisible while work runs.
+
+        Without this, a task running past the visibility timeout gets
+        redelivered and double-executed (on this node if it has spare
+        slots, or on another via the orphan-reclaim path)."""
+        stop = threading.Event()
+
+        def _renew() -> None:
+            while not stop.wait(interval):
+                try:
+                    self.store.update_message(
+                        msg, visibility_timeout=visibility)
+                except Exception:
+                    return
+
+        thread = threading.Thread(target=_renew, daemon=True)
+        thread.start()
+
+        class _Guard:
+            def __enter__(self_inner):
+                return self_inner
+
+            def __exit__(self_inner, *exc):
+                stop.set()
+                thread.join(timeout=1.0)
+                return False
+
+        return _Guard()
+
+    # ----------------------- regular task path -------------------------
+
+    def _claim_regular(self, job_id: str, task_id: str,
+                       entity: dict) -> Optional[str]:
+        if entity.get("state") != "pending":
+            return None
+        try:
+            return self._merge_task(
+                job_id, task_id,
+                {"state": "assigned", "node_id": self.identity.node_id},
+                if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return None
+
+    def _run_regular_task(self, slot: int, job_id: str, task_id: str,
+                          entity: dict, msg) -> None:
+        if self._claim_regular(job_id, task_id, entity) is None:
+            # Someone else claimed it; drop our copy of the message if
+            # it is now terminal, else let visibility re-deliver.
+            self.store.update_message(msg, visibility_timeout=10.0)
+            return
+        spec = entity["spec"]
+        with self._message_keepalive(msg):
+            if not self._ensure_job_prep(job_id, spec):
+                self._merge_task(job_id, task_id, {
+                    "state": "failed", "exit_code": -2,
+                    "error": "job preparation failed on node "
+                             f"{self.identity.node_id}"})
+                self.store.delete_message(msg)
+                return
+            self._ensure_images(spec)
+            execution = self._build_execution(slot, job_id, task_id, spec)
+            self._merge_task(job_id, task_id, {
+                "state": "running",
+                "started_at": util.datetime_utcnow_iso()})
+            self._heartbeat(state="running")
+            with self._running_lock:
+                self._running_tasks += 1
+            try:
+                result = task_runner.run_task(execution)
+            finally:
+                with self._running_lock:
+                    self._running_tasks -= 1
+        self._upload_outputs(job_id, task_id, execution)
+        retries = entity.get("retries", 0)
+        max_retries = spec.get("max_task_retries", 0)
+        if result.exit_code != 0 and (
+                max_retries < 0 or retries < max_retries):
+            self._merge_task(job_id, task_id, {
+                "state": "pending", "retries": retries + 1,
+                "last_exit_code": result.exit_code,
+                "node_id": None})
+            self.store.delete_message(msg)
+            self.store.put_message(
+                names.task_queue(self.identity.pool_id),
+                json.dumps({"job_id": job_id, "task_id": task_id}).encode())
+            return
+        self._finish_task(job_id, task_id, result)
+        self.store.delete_message(msg)
+        self._maybe_autocomplete_job(job_id)
+
+    def _finish_task(self, job_id: str, task_id: str,
+                     result: task_runner.TaskResult) -> None:
+        self._merge_task(job_id, task_id, {
+            "state": "completed" if result.exit_code == 0 else "failed",
+            "exit_code": result.exit_code,
+            "timed_out": result.timed_out,
+            "completed_at": result.completed_at,
+            "wall_seconds": result.wall_seconds,
+        })
+        self._heartbeat(state="idle")
+
+    # ------------------------ gang (MI) task path ----------------------
+
+    def _gang_claim(self, job_id: str, task_id: str,
+                    instance: int) -> bool:
+        """Claim gang instance k for this node. One instance per node:
+        a second claim by the same node is released and requeued."""
+        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
+        try:
+            self.store.insert_entity(
+                names.TABLE_GANGS, gang_pk, f"node${self.identity.node_id}",
+                {"instance": instance})
+        except EntityExistsError:
+            return False
+        try:
+            self.store.insert_entity(
+                names.TABLE_GANGS, gang_pk, f"i{instance}", {
+                    "node_id": self.identity.node_id,
+                    "hostname": self.identity.hostname,
+                    "internal_ip": self.identity.internal_ip,
+                    "slice_index": self.identity.slice_index,
+                    "worker_index": self.identity.worker_index,
+                    "state": "joined",
+                })
+            return True
+        except EntityExistsError:
+            # Instance already claimed elsewhere; undo node marker.
+            self.store.delete_entity(
+                names.TABLE_GANGS, gang_pk,
+                f"node${self.identity.node_id}")
+            return False
+
+    def _gang_members(self, job_id: str, task_id: str) -> list[dict]:
+        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
+        return [e for e in self.store.query_entities(
+            names.TABLE_GANGS, partition_key=gang_pk, row_key_prefix="i")]
+
+    def _run_gang_instance(self, slot: int, job_id: str, task_id: str,
+                           entity: dict, instance: int, msg) -> None:
+        spec = entity["spec"]
+        num_instances = spec["multi_instance"]["num_instances"]
+        if not self._gang_claim(job_id, task_id, instance):
+            # This node can't take this instance; make the message
+            # promptly available for other nodes.
+            self.store.update_message(msg, visibility_timeout=0.0)
+            time.sleep(self.poll_interval)
+            return
+        # Rendezvous: wait for all instances to join.
+        deadline = time.monotonic() + self.gang_timeout
+        keepalive = time.monotonic()
+        while True:
+            members = self._gang_members(job_id, task_id)
+            if len(members) >= num_instances:
+                break
+            if time.monotonic() > deadline:
+                self._merge_task(job_id, task_id, {
+                    "state": "failed", "exit_code": -1,
+                    "error": "gang rendezvous timeout"})
+                self.store.delete_message(msg)
+                return
+            if self.stop_event.is_set():
+                return
+            if time.monotonic() - keepalive > 30.0:
+                self.store.update_message(msg, visibility_timeout=60.0)
+                keepalive = time.monotonic()
+            time.sleep(self.poll_interval)
+        if instance == 0:
+            try:
+                self._merge_task(job_id, task_id, {
+                    "state": "running",
+                    "started_at": util.datetime_utcnow_iso()})
+            except NotFoundError:
+                pass
+        gang_members = [
+            launcher.GangMember(
+                instance=int(m["_rk"][1:]), node_id=m["node_id"],
+                hostname=m["hostname"], internal_ip=m["internal_ip"],
+                slice_index=m.get("slice_index", 0),
+                worker_index=m.get("worker_index", 0))
+            for m in sorted(self._gang_members(job_id, task_id),
+                            key=lambda e: int(e["_rk"][1:]))]
+        me = next(m for m in gang_members if m.instance == instance)
+        mi = _mi_settings_from_spec(spec["multi_instance"])
+        gang_env = launcher.synthesize_gang_env(
+            gang_members, me, mi, self.pool)
+        with self._message_keepalive(msg):
+            jp_ok = self._ensure_job_prep(job_id, spec)
+            self._ensure_images(spec)
+            execution = self._build_execution(
+                slot, job_id, task_id, spec, instance=instance,
+                instances=num_instances,
+                host_list=tuple(m.internal_ip for m in gang_members),
+                extra_env=gang_env)
+            with self._running_lock:
+                self._running_tasks += 1
+            try:
+                if not jp_ok:
+                    result = task_runner.TaskResult(
+                        exit_code=-2, stdout_path="", stderr_path="",
+                        started_at=util.datetime_utcnow_iso(),
+                        completed_at=util.datetime_utcnow_iso(),
+                        wall_seconds=0.0)
+                else:
+                    if spec["multi_instance"].get("coordination_command"):
+                        coordination = dataclasses.replace(
+                            execution,
+                            command=spec["multi_instance"][
+                                "coordination_command"],
+                            task_dir=os.path.join(
+                                execution.task_dir, "coord"))
+                        task_runner.run_task(coordination)
+                    result = task_runner.run_task(execution)
+            finally:
+                with self._running_lock:
+                    self._running_tasks -= 1
+        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
+        self.store.merge_entity(
+            names.TABLE_GANGS, gang_pk, f"i{instance}",
+            {"state": "done", "exit_code": result.exit_code})
+        self._upload_outputs(job_id, task_id, execution,
+                             suffix=f"i{instance}")
+        self.store.delete_message(msg)
+        self._gang_finalize(job_id, task_id, num_instances)
+        self._maybe_autocomplete_job(job_id)
+
+    def _gang_finalize(self, job_id: str, task_id: str,
+                       num_instances: int) -> None:
+        """Last instance to finish aggregates the gang exit code."""
+        members = self._gang_members(job_id, task_id)
+        done = [m for m in members if m.get("state") == "done"]
+        if len(done) < num_instances:
+            return
+        # First nonzero wins (max() would mask negative signal-kill
+        # codes behind a zero).
+        exit_code = next(
+            (m.get("exit_code", 0) for m in done
+             if m.get("exit_code", 0) != 0), 0)
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            return
+        if entity.get("state") in ("completed", "failed"):
+            return
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": "completed" if exit_code == 0 else "failed",
+                "exit_code": exit_code,
+                "completed_at": util.datetime_utcnow_iso(),
+            }, if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            pass
+
+    # --------------------------- helpers -------------------------------
+
+    def _build_execution(self, slot: int, job_id: str, task_id: str,
+                         spec: dict, instance: int = 0, instances: int = 1,
+                         host_list: tuple[str, ...] = (),
+                         extra_env: Optional[dict] = None,
+                         ) -> task_runner.TaskExecution:
+        env = dict(spec.get("environment_variables", {}))
+        if extra_env:
+            env.update(extra_env)
+        task_dir = os.path.join(
+            self.work_dir, "tasks", job_id, task_id,
+            f"i{instance}" if instances > 1 else "")
+        return task_runner.TaskExecution(
+            pool_id=self.identity.pool_id, job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            node_index=self.identity.node_index,
+            command=spec.get("command", ""),
+            runtime=spec.get("runtime", "none"),
+            image=spec.get("image"),
+            env=env, task_dir=task_dir.rstrip("/"), slot=slot,
+            instances=instances, instance=instance, host_list=host_list,
+            max_wall_time_seconds=spec.get("max_wall_time_seconds"),
+            remove_container_after_exit=spec.get(
+                "remove_container_after_exit", True),
+            shm_size=spec.get("shm_size"),
+            additional_docker_run_options=tuple(
+                spec.get("additional_docker_run_options", [])),
+            additional_singularity_options=tuple(
+                spec.get("additional_singularity_options", [])),
+        )
+
+    def _ensure_job_prep(self, job_id: str, spec: dict,
+                         wait_timeout: float = 600.0) -> bool:
+        """Run job preparation exactly once per (job, node); other slots
+        wait for it. Returns False if prep failed — the caller must not
+        run the task on this node (Azure Batch jobPreparationTask
+        semantics)."""
+        jp_command = spec.get("job_preparation_command")
+        if not jp_command:
+            return True
+        pk = names.task_pk(self.identity.pool_id, job_id)
+        try:
+            self.store.insert_entity(
+                names.TABLE_JOBPREP, pk, self.identity.node_id,
+                {"state": "running", "at": util.datetime_utcnow_iso()})
+        except EntityExistsError:
+            # Another slot owns prep: wait for completion.
+            deadline = time.monotonic() + wait_timeout
+            while time.monotonic() < deadline:
+                row = self.store.get_entity(
+                    names.TABLE_JOBPREP, pk, self.identity.node_id)
+                if row.get("state") == "done":
+                    return row.get("exit_code", 0) == 0
+                if self.stop_event.is_set():
+                    return False
+                time.sleep(self.poll_interval)
+            return False
+        execution = task_runner.TaskExecution(
+            pool_id=self.identity.pool_id, job_id=job_id, task_id="jobprep",
+            node_id=self.identity.node_id,
+            node_index=self.identity.node_index,
+            command=jp_command, runtime="none",
+            env=dict(spec.get("environment_variables", {})),
+            task_dir=os.path.join(self.work_dir, "jobprep", job_id))
+        result = task_runner.run_task(execution)
+        self.store.merge_entity(
+            names.TABLE_JOBPREP, pk, self.identity.node_id,
+            {"state": "done", "exit_code": result.exit_code})
+        return result.exit_code == 0
+
+    def _run_job_release(self, job_id: str) -> None:
+        try:
+            job = self.store.get_entity(
+                names.TABLE_JOBS, self.identity.pool_id, job_id)
+        except NotFoundError:
+            return
+        jr_command = job.get("spec", {}).get("job_release_command")
+        if not jr_command:
+            return
+        execution = task_runner.TaskExecution(
+            pool_id=self.identity.pool_id, job_id=job_id,
+            task_id="jobrelease", node_id=self.identity.node_id,
+            node_index=self.identity.node_index,
+            command=jr_command, runtime="none",
+            task_dir=os.path.join(self.work_dir, "jobrelease", job_id))
+        task_runner.run_task(execution)
+
+    def _ensure_images(self, spec: dict) -> None:
+        if self._image_provisioner is None:
+            return
+        image = spec.get("image")
+        runtime = spec.get("runtime")
+        if image and runtime in ("docker", "singularity"):
+            self._image_provisioner(self, [image], kind=runtime)
+
+    def _upload_outputs(self, job_id: str, task_id: str,
+                        execution: task_runner.TaskExecution,
+                        suffix: str = "") -> None:
+        for name in ("stdout.txt", "stderr.txt"):
+            path = os.path.join(execution.task_dir, name)
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as fh:
+                data = fh.read(_MAX_OUTPUT_UPLOAD_BYTES)
+            key = names.task_output_key(
+                self.identity.pool_id, job_id, task_id,
+                f"{suffix}/{name}" if suffix else name)
+            self.store.put_object(key, data)
+
+    def _maybe_autocomplete_job(self, job_id: str) -> None:
+        """auto_complete: when every task of the job is terminal, mark
+        the job completed and fan out job-release control messages
+        (reference: on_all_tasks_complete / jobs term semantics)."""
+        try:
+            job = self.store.get_entity(
+                names.TABLE_JOBS, self.identity.pool_id, job_id)
+        except NotFoundError:
+            return
+        if not job.get("spec", {}).get("auto_complete"):
+            return
+        if job.get("state") != "active":
+            return
+        pk = names.task_pk(self.identity.pool_id, job_id)
+        tasks = list(self.store.query_entities(
+            names.TABLE_TASKS, partition_key=pk))
+        if not tasks or any(
+                t.get("state") not in ("completed", "failed", "blocked")
+                for t in tasks):
+            return
+        try:
+            self.store.merge_entity(
+                names.TABLE_JOBS, self.identity.pool_id, job_id,
+                {"state": "completed",
+                 "completed_at": util.datetime_utcnow_iso()},
+                if_match=job["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return
+        # Fan out job release to nodes that ran job prep.
+        for row in self.store.query_entities(
+                names.TABLE_JOBPREP, partition_key=pk):
+            self.store.put_message(
+                names.control_queue(self.identity.pool_id, row["_rk"]),
+                json.dumps({
+                    "type": "job_release", "job_id": job_id}).encode())
+
+
+def _mi_settings_from_spec(mi_spec: dict) -> MultiInstanceSettings:
+    jd = mi_spec.get("jax_distributed", {})
+    return MultiInstanceSettings(
+        num_instances=mi_spec["num_instances"],
+        coordination_command=mi_spec.get("coordination_command"),
+        resource_files=tuple(mi_spec.get("resource_files", [])),
+        jax_distributed=JaxDistributedSettings(
+            enabled=jd.get("enabled", True),
+            coordinator_port=jd.get("coordinator_port", 8476),
+            transport=jd.get("transport", "auto"),
+            heartbeat_timeout_seconds=jd.get(
+                "heartbeat_timeout_seconds", 100),
+        ),
+        pytorch_xla=mi_spec.get("pytorch_xla", {}).get("enabled", False),
+    )

@@ -1,0 +1,199 @@
+"""Task execution on a node: env contract + runtime command synthesis.
+
+Reference analog: scripts/shipyard_task_runner.sh +
+shipyard_docker_exec_task_runner.sh (the SHIPYARD_RUNTIME env contract)
+and the docker/singularity exec wiring in _construct_task
+(convoy/batch.py:4640-4700). Re-designed in Python because our node
+agent is Python and because TPU tasks need structured env synthesis
+(JAX distributed vars) rather than string-templated bash.
+
+Env contract exposed to every task (the $AZ_BATCH_* analog):
+
+  SHIPYARD_POOL_ID / SHIPYARD_JOB_ID / SHIPYARD_TASK_ID
+  SHIPYARD_NODE_ID / SHIPYARD_NODE_INDEX
+  SHIPYARD_TASK_DIR        working directory for the task
+  SHIPYARD_TASK_SLOT       slot index on this node
+  SHIPYARD_HOST_LIST       comma-separated worker hostnames (gang tasks;
+                           $AZ_BATCH_HOST_LIST analog, batch.py:4378)
+  SHIPYARD_TASK_INSTANCES  gang size (1 for regular tasks)
+  SHIPYARD_TASK_INSTANCE   this instance's index
+plus, for gang tasks with jax_distributed enabled, the launcher env from
+jobs/launcher.py (JAX_COORDINATOR_ADDRESS etc.).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import signal
+import subprocess
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+@dataclasses.dataclass
+class TaskExecution:
+    """Everything needed to run one task instance on a node."""
+
+    pool_id: str
+    job_id: str
+    task_id: str
+    node_id: str
+    node_index: int
+    command: str
+    runtime: str = "none"  # none | docker | singularity
+    image: Optional[str] = None
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    task_dir: str = "."
+    slot: int = 0
+    instances: int = 1
+    instance: int = 0
+    host_list: tuple[str, ...] = ()
+    max_wall_time_seconds: Optional[float] = None
+    remove_container_after_exit: bool = True
+    shm_size: Optional[str] = None
+    additional_docker_run_options: tuple[str, ...] = ()
+    additional_singularity_options: tuple[str, ...] = ()
+    docker_exec_in: Optional[str] = None  # exec into a running container
+    interactive: bool = False
+
+
+@dataclasses.dataclass
+class TaskResult:
+    exit_code: int
+    stdout_path: str
+    stderr_path: str
+    started_at: str
+    completed_at: str
+    wall_seconds: float
+    timed_out: bool = False
+
+
+def build_task_env(execution: TaskExecution,
+                   base_env: Optional[dict[str, str]] = None,
+                   ) -> dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(execution.env)
+    env.update({
+        "SHIPYARD_POOL_ID": execution.pool_id,
+        "SHIPYARD_JOB_ID": execution.job_id,
+        "SHIPYARD_TASK_ID": execution.task_id,
+        "SHIPYARD_NODE_ID": execution.node_id,
+        "SHIPYARD_NODE_INDEX": str(execution.node_index),
+        "SHIPYARD_TASK_DIR": execution.task_dir,
+        "SHIPYARD_TASK_SLOT": str(execution.slot),
+        "SHIPYARD_TASK_INSTANCES": str(execution.instances),
+        "SHIPYARD_TASK_INSTANCE": str(execution.instance),
+    })
+    if execution.host_list:
+        env["SHIPYARD_HOST_LIST"] = ",".join(execution.host_list)
+    return env
+
+
+def synthesize_command(execution: TaskExecution) -> list[str]:
+    """Build the argv for the task's runtime.
+
+    docker/singularity lines mirror the capability surface of the
+    reference's run-option synthesis (batch.py:4640-4700) with TPU
+    device passthrough in place of --gpus.
+    """
+    if execution.runtime == "none":
+        return ["/bin/bash", "-c", execution.command]
+    if execution.runtime == "docker":
+        if execution.docker_exec_in:
+            argv = ["docker", "exec", execution.docker_exec_in,
+                    "/bin/bash", "-c", execution.command]
+            return argv
+        argv = ["docker", "run"]
+        if execution.remove_container_after_exit:
+            argv.append("--rm")
+        argv += ["--name",
+                 f"shipyard-{execution.job_id}-{execution.task_id}"
+                 f"-i{execution.instance}"]
+        if execution.interactive:
+            argv.append("-it")
+        # TPU device passthrough (the nvidia-runtime analog).
+        if os.path.exists("/dev/accel0") or os.environ.get(
+                "SHIPYARD_FORCE_TPU_PASSTHROUGH"):
+            argv += ["--privileged", "--device", "/dev/accel0",
+                     "--net", "host"]
+        if execution.shm_size:
+            argv += ["--shm-size", execution.shm_size]
+        argv += ["-w", "/shipyard/task", "-v",
+                 f"{execution.task_dir}:/shipyard/task"]
+        for key in sorted(execution.env):
+            argv += ["-e", key]
+        for var in ("SHIPYARD_POOL_ID", "SHIPYARD_JOB_ID",
+                    "SHIPYARD_TASK_ID", "SHIPYARD_NODE_ID",
+                    "SHIPYARD_NODE_INDEX", "SHIPYARD_TASK_INSTANCES",
+                    "SHIPYARD_TASK_INSTANCE", "SHIPYARD_HOST_LIST"):
+            argv += ["-e", var]
+        argv += list(execution.additional_docker_run_options)
+        argv += [execution.image or "",
+                 "/bin/bash", "-c", execution.command]
+        return argv
+    if execution.runtime == "singularity":
+        argv = ["singularity", "exec"]
+        if os.path.exists("/dev/accel0"):
+            argv += ["--bind", "/dev:/dev", "--writable-tmpfs"]
+        argv += list(execution.additional_singularity_options)
+        argv += [execution.image or "",
+                 "/bin/bash", "-c", execution.command]
+        return argv
+    raise ValueError(f"unknown runtime {execution.runtime!r}")
+
+
+def run_task(execution: TaskExecution,
+             base_env: Optional[dict[str, str]] = None) -> TaskResult:
+    """Execute the task, streaming stdout/stderr to files in task_dir.
+
+    Enforces max_wall_time by process-group kill (the agent-side analog
+    of Azure Batch maxWallClockTime task constraints).
+    """
+    os.makedirs(execution.task_dir, exist_ok=True)
+    stdout_path = os.path.join(execution.task_dir, "stdout.txt")
+    stderr_path = os.path.join(execution.task_dir, "stderr.txt")
+    env = build_task_env(execution, base_env)
+    argv = synthesize_command(execution)
+    started_at = util.datetime_utcnow_iso()
+    start = time.monotonic()
+    timed_out = False
+    with open(stdout_path, "wb") as out, open(stderr_path, "wb") as err:
+        proc = subprocess.Popen(
+            argv, stdout=out, stderr=err, env=env, cwd=execution.task_dir,
+            start_new_session=True)
+        try:
+            exit_code = proc.wait(timeout=execution.max_wall_time_seconds)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            logger.warning(
+                "task %s/%s/%s exceeded wall time %.1fs; killing",
+                execution.pool_id, execution.job_id, execution.task_id,
+                execution.max_wall_time_seconds)
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                exit_code = proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                exit_code = proc.wait()
+    wall = time.monotonic() - start
+    return TaskResult(
+        exit_code=exit_code, stdout_path=stdout_path,
+        stderr_path=stderr_path, started_at=started_at,
+        completed_at=util.datetime_utcnow_iso(), wall_seconds=wall,
+        timed_out=timed_out)
+
+
+def format_command_line(argv: list[str]) -> str:
+    return " ".join(shlex.quote(a) for a in argv)

@@ -1,0 +1,139 @@
+"""Gang-task launcher: JAX distributed env synthesis over ICI/DCN.
+
+This is the TPU-native replacement for the reference's MPI command-line
+synthesis (_construct_mpi_command, convoy/batch.py:4362-4487): where the
+reference chooses mpirun flags per runtime (IntelMPI/OpenMPI/MPICH/
+MVAPICH) and per fabric (DAPL/OFA/OFI/UCX over Infiniband), we choose
+environment variables per transport:
+
+  - ICI (single pod slice): every worker runs the same SPMD program;
+    ``jax.distributed.initialize`` gets coordinator = worker 0 of the
+    slice, num_processes = workers in the slice, process_id = worker
+    index. XLA collectives then ride the ICI torus with no further
+    configuration.
+  - DCN (multi-slice): additionally set MEGASCALE_* variables so libtpu
+    spans slices over the data-center network; the per-slice mesh stays
+    on ICI.
+  - CPU/GPU pools (federation heterogeneity): plain jax.distributed
+    over TCP.
+
+The application command runs on EVERY instance (SPMD), unlike MPI where
+mpirun on the primary spawns ranks: on TPU pods the same binary starts
+on each worker and discovers its role from this env. The optional
+coordination_command (reference: MultiInstanceSettings coordination
+command, batch.py:4616) still runs on all instances before the
+application command.
+
+Also provides PyTorch/XLA (PJRT) env synthesis as the reference's
+recipes supported PyTorch (recipes/PyTorch-GPU -> PyTorch/XLA on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import (
+    JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
+
+
+@dataclasses.dataclass(frozen=True)
+class GangMember:
+    """One task instance's placement, resolved at rendezvous time."""
+
+    instance: int           # global process index [0, num_instances)
+    node_id: str
+    hostname: str
+    internal_ip: str
+    slice_index: int = 0
+    worker_index: int = 0   # worker index within its slice
+
+
+def _coordinator(members: list[GangMember]) -> GangMember:
+    """Deterministic coordinator election: lowest (slice, worker,
+    instance). Reference analog: MI 'primary' node; ours must be stable
+    across restarts (SURVEY.md section 7 hard parts: no PMI)."""
+    return min(members,
+               key=lambda m: (m.slice_index, m.worker_index, m.instance))
+
+
+def synthesize_jax_distributed_env(
+        members: list[GangMember],
+        member: GangMember,
+        settings: JaxDistributedSettings,
+        num_slices: int = 1,
+        chips_per_worker: int = 4,
+        accelerator_type: Optional[str] = None) -> dict[str, str]:
+    """Build the distributed env for one gang member.
+
+    Multi-slice (num_slices > 1) adds MEGASCALE_* DCN config; the
+    transport setting can force ici/dcn, 'auto' infers from num_slices.
+    """
+    coord = _coordinator(members)
+    num_processes = len(members)
+    env: dict[str, str] = {
+        # jax.distributed.initialize() reads these when args omitted.
+        "JAX_COORDINATOR_ADDRESS":
+            f"{coord.internal_ip}:{settings.coordinator_port}",
+        "JAX_NUM_PROCESSES": str(num_processes),
+        "JAX_PROCESS_ID": str(member.instance),
+        # libtpu worker identity on a pod slice.
+        "TPU_WORKER_ID": str(member.worker_index),
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            m.internal_ip for m in sorted(
+                members, key=lambda x: (x.slice_index, x.worker_index))
+            if m.slice_index == member.slice_index),
+        "TPU_CHIPS_PER_HOST_BOUNDS": f"2,2,1"
+            if chips_per_worker == 4 else f"{chips_per_worker},1,1",
+        # Distributed-service client resilience knobs.
+        "JAX_DIST_HEARTBEAT_TIMEOUT_SECONDS":
+            str(settings.heartbeat_timeout_seconds),
+    }
+    if accelerator_type:
+        env["TPU_ACCELERATOR_TYPE"] = accelerator_type
+    transport = settings.transport
+    if transport == "auto":
+        transport = "dcn" if num_slices > 1 else "ici"
+    if transport == "dcn" and num_slices > 1:
+        env.update({
+            "MEGASCALE_COORDINATOR_ADDRESS": coord.internal_ip,
+            "MEGASCALE_NUM_SLICES": str(num_slices),
+            "MEGASCALE_SLICE_ID": str(member.slice_index),
+            "MEGASCALE_PORT": str(settings.coordinator_port + 1),
+        })
+    return env
+
+
+def synthesize_pytorch_xla_env(members: list[GangMember],
+                               member: GangMember,
+                               coordinator_port: int = 8476,
+                               ) -> dict[str, str]:
+    """PJRT env for PyTorch/XLA on TPU (recipes/PyTorch-GPU analog)."""
+    coord = _coordinator(members)
+    return {
+        "PJRT_DEVICE": "TPU",
+        "MASTER_ADDR": coord.internal_ip,
+        "MASTER_PORT": str(coordinator_port),
+        "WORLD_SIZE": str(len(members)),
+        "RANK": str(member.instance),
+    }
+
+
+def synthesize_gang_env(members: list[GangMember],
+                        member: GangMember,
+                        mi: MultiInstanceSettings,
+                        pool: PoolSettings) -> dict[str, str]:
+    """Full env for one gang member per the task's multi_instance
+    settings + pool topology."""
+    env: dict[str, str] = {}
+    num_slices = pool.tpu.num_slices if pool.tpu is not None else 1
+    chips = pool.tpu.chips_per_worker if pool.tpu is not None else 0
+    atype = pool.tpu.accelerator_type if pool.tpu is not None else None
+    if mi.jax_distributed.enabled:
+        env.update(synthesize_jax_distributed_env(
+            members, member, mi.jax_distributed, num_slices=num_slices,
+            chips_per_worker=chips or 4, accelerator_type=atype))
+    if mi.pytorch_xla:
+        env.update(synthesize_pytorch_xla_env(
+            members, member, mi.jax_distributed.coordinator_port))
+    return env

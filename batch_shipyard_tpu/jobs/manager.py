@@ -1,0 +1,276 @@
+"""Job/task submission and monitoring.
+
+Reference analog: convoy/batch.py add_jobs(:5056 — the 850-line loop) +
+_construct_task(:4489) + _add_task_collection(:4313). Our submission
+writes task entities + queue messages instead of Batch REST calls; the
+node agents do the rest.
+
+Task id generation follows the reference convention (task-%05d,
+batch.py:4177) so depends_on_range works identically.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Iterator, Optional
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.config.settings import (
+    JobSettings, PoolSettings, TaskSettings)
+from batch_shipyard_tpu.jobs.task_factory import expand_task_factory
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError, StateStore)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+class JobExistsError(RuntimeError):
+    pass
+
+
+class JobNotFoundError(RuntimeError):
+    pass
+
+
+def _task_spec(task: TaskSettings, job: JobSettings,
+               pool: PoolSettings) -> dict:
+    """Serializable task spec stored in the task entity and consumed by
+    the node agent (the TaskAddParameter analog)."""
+    spec = {
+        "command": task.command,
+        "runtime": task.runtime,
+        "image": task.image,
+        "environment_variables": dict(task.environment_variables),
+        "tpu": task.tpu,
+        "gpus": task.gpus,
+        "depends_on": list(task.depends_on),
+        "depends_on_range": (list(task.depends_on_range)
+                             if task.depends_on_range else None),
+        "max_task_retries": task.max_task_retries,
+        "max_wall_time_seconds": task.max_wall_time_seconds,
+        "retention_time_seconds": task.retention_time_seconds,
+        "remove_container_after_exit": task.remove_container_after_exit,
+        "shm_size": task.shm_size,
+        "additional_docker_run_options": list(
+            task.additional_docker_run_options),
+        "additional_singularity_options": list(
+            task.additional_singularity_options),
+        "input_data": list(task.input_data),
+        "output_data": list(task.output_data),
+        "resource_files": list(task.resource_files),
+        "job_preparation_command": job.job_preparation_command,
+        "exit_options": dict(task.default_exit_options),
+    }
+    if task.multi_instance is not None:
+        mi = task.multi_instance
+        spec["multi_instance"] = {
+            "num_instances": mi.resolve_num_instances(pool),
+            "coordination_command": mi.coordination_command,
+            "resource_files": list(mi.resource_files),
+            "jax_distributed": {
+                "enabled": mi.jax_distributed.enabled,
+                "coordinator_port": mi.jax_distributed.coordinator_port,
+                "transport": mi.jax_distributed.transport,
+                "heartbeat_timeout_seconds":
+                    mi.jax_distributed.heartbeat_timeout_seconds,
+            },
+            "pytorch_xla": {"enabled": mi.pytorch_xla},
+        }
+    return spec
+
+
+def add_jobs(store: StateStore, pool: PoolSettings,
+             jobs: list[JobSettings],
+             pool_id_override: Optional[str] = None) -> dict[str, int]:
+    """Submit jobs + tasks; returns {job_id: task_count}."""
+    submitted: dict[str, int] = {}
+    for job in jobs:
+        pool_id = pool_id_override or job.pool_id or pool.id
+        try:
+            store.insert_entity(names.TABLE_JOBS, pool_id, job.id, {
+                "state": "active",
+                "spec": {
+                    "auto_complete": job.auto_complete,
+                    "priority": job.priority,
+                    "job_release_command": job.job_release_command,
+                    "recurrence": (
+                        {"interval":
+                         job.recurrence.recurrence_interval_seconds}
+                        if job.recurrence else None),
+                },
+                "created_at": util.datetime_utcnow_iso(),
+            })
+        except EntityExistsError:
+            raise JobExistsError(f"job {job.id} exists on pool {pool_id}")
+        count = 0
+        task_number = 0
+        for raw_task in job.tasks:
+            for expanded in expand_task_factory(raw_task, store):
+                task = settings_mod.task_settings(expanded, job, pool)
+                task_id = task.id or f"task-{task_number:05d}"
+                task_number += 1
+                _submit_task(store, pool_id, job.id, task_id,
+                             _task_spec(task, job, pool))
+                count += 1
+        submitted[job.id] = count
+    return submitted
+
+
+def _submit_task(store: StateStore, pool_id: str, job_id: str,
+                 task_id: str, spec: dict) -> None:
+    pk = names.task_pk(pool_id, job_id)
+    num_instances = (spec.get("multi_instance") or {}).get("num_instances")
+    store.insert_entity(names.TABLE_TASKS, pk, task_id, {
+        "state": "pending",
+        "spec": spec,
+        "retries": 0,
+        "submitted_at": util.datetime_utcnow_iso(),
+    })
+    queue = names.task_queue(pool_id)
+    if num_instances:
+        for k in range(num_instances):
+            store.put_message(queue, json.dumps({
+                "job_id": job_id, "task_id": task_id,
+                "instance": k}).encode())
+    else:
+        store.put_message(queue, json.dumps({
+            "job_id": job_id, "task_id": task_id}).encode())
+
+
+def list_jobs(store: StateStore, pool_id: str) -> list[dict]:
+    return list(store.query_entities(names.TABLE_JOBS,
+                                     partition_key=pool_id))
+
+
+def get_job(store: StateStore, pool_id: str, job_id: str) -> dict:
+    try:
+        return store.get_entity(names.TABLE_JOBS, pool_id, job_id)
+    except NotFoundError:
+        raise JobNotFoundError(job_id)
+
+
+def list_tasks(store: StateStore, pool_id: str,
+               job_id: str) -> list[dict]:
+    return list(store.query_entities(
+        names.TABLE_TASKS, partition_key=names.task_pk(pool_id, job_id)))
+
+
+def get_task(store: StateStore, pool_id: str, job_id: str,
+             task_id: str) -> dict:
+    try:
+        return store.get_entity(
+            names.TABLE_TASKS, names.task_pk(pool_id, job_id), task_id)
+    except NotFoundError:
+        raise JobNotFoundError(f"{job_id}/{task_id}")
+
+
+def wait_for_tasks(store: StateStore, pool_id: str, job_id: str,
+                   timeout: float = 600.0,
+                   poll_interval: float = 0.2) -> list[dict]:
+    """Block until all tasks of a job are terminal; returns them."""
+    deadline = time.monotonic() + timeout
+    while True:
+        tasks = list_tasks(store, pool_id, job_id)
+        if tasks and all(t.get("state") in
+                         ("completed", "failed", "blocked")
+                         for t in tasks):
+            return tasks
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"tasks of {job_id} not terminal after {timeout}s: "
+                f"{ {t['_rk']: t.get('state') for t in tasks} }")
+        time.sleep(poll_interval)
+
+
+def get_task_output(store: StateStore, pool_id: str, job_id: str,
+                    task_id: str, filename: str = "stdout.txt",
+                    instance: Optional[int] = None) -> bytes:
+    name = (f"i{instance}/{filename}" if instance is not None
+            else filename)
+    key = names.task_output_key(pool_id, job_id, task_id, name)
+    return store.get_object(key)
+
+
+def stream_task_output(store: StateStore, pool_id: str, job_id: str,
+                       task_id: str, filename: str = "stdout.txt",
+                       timeout: float = 600.0,
+                       poll_interval: float = 0.5) -> Iterator[bytes]:
+    """Poll-follow a task's output until the task is terminal
+    (stream_file_and_wait_for_task analog, batch.py:3243)."""
+    offset = 0
+    deadline = time.monotonic() + timeout
+    key = names.task_output_key(pool_id, job_id, task_id, filename)
+    while True:
+        task = get_task(store, pool_id, job_id, task_id)
+        try:
+            data = store.get_object(key)
+            if len(data) > offset:
+                yield data[offset:]
+                offset = len(data)
+        except NotFoundError:
+            pass
+        if task.get("state") in ("completed", "failed", "blocked"):
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"stream of {task_id} timed out")
+        time.sleep(poll_interval)
+
+
+def terminate_job(store: StateStore, pool_id: str, job_id: str,
+                  wait: bool = False) -> None:
+    """Terminate: mark job + non-terminal tasks; fan out job-release
+    (jobs term analog, batch.py:2770 terminate_tasks +
+    del_or_term_jobs)."""
+    job = get_job(store, pool_id, job_id)
+    store.merge_entity(names.TABLE_JOBS, pool_id, job_id,
+                       {"state": "terminated",
+                        "completed_at": util.datetime_utcnow_iso()})
+    pk = names.task_pk(pool_id, job_id)
+    for task in list_tasks(store, pool_id, job_id):
+        if task.get("state") not in ("completed", "failed", "blocked"):
+            try:
+                store.merge_entity(
+                    names.TABLE_TASKS, pk, task["_rk"],
+                    {"state": "failed", "exit_code": -9,
+                     "error": "job terminated"},
+                    if_match=task["_etag"])
+            except Exception:
+                pass
+    for row in store.query_entities(names.TABLE_JOBPREP,
+                                    partition_key=pk):
+        store.put_message(
+            names.control_queue(pool_id, row["_rk"]),
+            json.dumps({"type": "job_release",
+                        "job_id": job_id}).encode())
+
+
+def delete_job(store: StateStore, pool_id: str, job_id: str) -> None:
+    get_job(store, pool_id, job_id)
+    pk = names.task_pk(pool_id, job_id)
+    for task in list(store.query_entities(names.TABLE_TASKS,
+                                          partition_key=pk)):
+        store.delete_entity(names.TABLE_TASKS, pk, task["_rk"])
+    for row in list(store.query_entities(names.TABLE_JOBPREP,
+                                         partition_key=pk)):
+        store.delete_entity(names.TABLE_JOBPREP, pk, row["_rk"])
+    store.delete_entity(names.TABLE_JOBS, pool_id, job_id)
+
+
+def job_stats(store: StateStore, pool_id: str,
+              job_id: Optional[str] = None) -> dict:
+    """jobs stats analog (batch.py:1972)."""
+    jobs = ([get_job(store, pool_id, job_id)] if job_id
+            else list_jobs(store, pool_id))
+    stats = {"jobs": len(jobs), "tasks": 0, "by_state": {},
+             "wall_seconds_total": 0.0}
+    for job in jobs:
+        for task in list_tasks(store, pool_id, job["_rk"]):
+            stats["tasks"] += 1
+            state = task.get("state", "pending")
+            stats["by_state"][state] = stats["by_state"].get(state, 0) + 1
+            stats["wall_seconds_total"] += float(
+                task.get("wall_seconds", 0.0) or 0.0)
+    return stats

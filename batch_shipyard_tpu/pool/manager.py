@@ -1,0 +1,245 @@
+"""Pool lifecycle: create, wait-ready with recovery, resize, delete.
+
+Reference analog: convoy/batch.py pool ops — create_pool(:921),
+wait_for_pool_ready(:861) and the _block_for_nodes_ready hot loop
+(:625) that classifies resize errors, reboots start-task-failed nodes
+(reboot_on_start_task_failed) and deletes+recreates unusable nodes
+(attempt_recovery_on_unusable). TPU twist: recovery granularity is the
+pod slice, not the single VM (substrate.recreate_slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.agent import cascade, perf
+from batch_shipyard_tpu.config.settings import (
+    GlobalSettings, PoolSettings)
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError, StateStore)
+from batch_shipyard_tpu.substrate.base import ComputeSubstrate, NodeInfo
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+READY_STATES = ("idle", "running")
+FAILED_STATES = ("start_task_failed", "unusable")
+
+
+class PoolExistsError(RuntimeError):
+    pass
+
+
+class PoolNotFoundError(RuntimeError):
+    pass
+
+
+class PoolAllocationError(RuntimeError):
+    pass
+
+
+def pool_exists(store: StateStore, pool_id: str) -> bool:
+    try:
+        store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+        return True
+    except NotFoundError:
+        return False
+
+
+def get_pool(store: StateStore, pool_id: str) -> dict:
+    try:
+        return store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+    except NotFoundError:
+        raise PoolNotFoundError(pool_id)
+
+
+def list_pools(store: StateStore) -> list[dict]:
+    return list(store.query_entities(names.TABLE_POOLS,
+                                     partition_key="pools"))
+
+
+def list_nodes(store: StateStore, pool_id: str) -> list[NodeInfo]:
+    out = []
+    for row in store.query_entities(names.TABLE_NODES,
+                                    partition_key=pool_id):
+        out.append(NodeInfo(
+            node_id=row["_rk"], state=row.get("state", "unknown"),
+            hostname=row.get("hostname", ""),
+            internal_ip=row.get("internal_ip", ""),
+            node_index=int(row.get("node_index", 0)),
+            slice_index=int(row.get("slice_index", 0)),
+            worker_index=int(row.get("worker_index", 0))))
+    return sorted(out, key=lambda n: n.node_index)
+
+
+def create_pool(store: StateStore, substrate: ComputeSubstrate,
+                pool: PoolSettings, global_conf: GlobalSettings,
+                pool_config_raw: Optional[dict] = None,
+                wait: bool = True) -> list[NodeInfo]:
+    """Provision a pool end-to-end (action_pool_add path,
+    fleet.py:3390)."""
+    if pool_exists(store, pool.id):
+        raise PoolExistsError(f"pool {pool.id} exists")
+    store.insert_entity(names.TABLE_POOLS, "pools", pool.id, {
+        "state": "creating",
+        "substrate": pool.substrate,
+        "spec": pool_config_raw or {},
+        "created_at": util.datetime_utcnow_iso(),
+    })
+    perf.emit(store, pool.id, "-", "pool", "create.start")
+    # Image manifest for cascade before nodes boot.
+    cascade.populate_global_resources(
+        store, pool.id, list(global_conf.docker_images),
+        list(global_conf.singularity_images),
+        global_conf.concurrent_source_downloads)
+    try:
+        substrate.allocate_pool(pool)
+    except Exception as exc:
+        store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                           {"state": "allocation_failed",
+                            "error": str(exc)})
+        raise PoolAllocationError(str(exc)) from exc
+    if not wait:
+        return []
+    nodes = wait_for_pool_ready(store, substrate, pool)
+    store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                       {"state": "ready"})
+    perf.emit(store, pool.id, "-", "pool", "create.end")
+    return nodes
+
+
+def wait_for_pool_ready(store: StateStore, substrate: ComputeSubstrate,
+                        pool: PoolSettings,
+                        poll_interval: float = 0.25) -> list[NodeInfo]:
+    """_block_for_nodes_ready analog (batch.py:625): poll node states,
+    apply recovery knobs, raise on timeout with diagnostics."""
+    deadline = time.monotonic() + pool.max_wait_time_seconds
+    expected = pool.current_node_count
+    rebooted_slices: set[int] = set()
+    recovered_slices: set[int] = set()
+    while True:
+        nodes = list_nodes(store, pool.id)
+        ready = [n for n in nodes if n.state in READY_STATES]
+        if len(ready) >= expected:
+            return nodes
+        for node in nodes:
+            if node.state == "start_task_failed":
+                if (pool.reboot_on_start_task_failed and
+                        node.slice_index not in rebooted_slices):
+                    logger.warning(
+                        "node %s start task failed; recreating slice %d",
+                        node.node_id, node.slice_index)
+                    rebooted_slices.add(node.slice_index)
+                    substrate.recreate_slice(pool, node.slice_index)
+                elif not pool.reboot_on_start_task_failed:
+                    raise PoolAllocationError(
+                        f"node {node.node_id} start task failed "
+                        f"(reboot_on_start_task_failed disabled); "
+                        f"stdout/stderr under "
+                        f"{names.node_log_key(pool.id, node.node_id, '')}")
+            elif node.state == "unusable":
+                if (pool.attempt_recovery_on_unusable and
+                        node.slice_index not in recovered_slices):
+                    logger.warning(
+                        "node %s unusable; recreating slice %d",
+                        node.node_id, node.slice_index)
+                    recovered_slices.add(node.slice_index)
+                    substrate.recreate_slice(pool, node.slice_index)
+                elif not pool.attempt_recovery_on_unusable:
+                    raise PoolAllocationError(
+                        f"node {node.node_id} unusable "
+                        f"(attempt_recovery_on_unusable disabled)")
+        # Fatal allocation errors recorded by the substrate.
+        entity = get_pool(store, pool.id)
+        if entity.get("allocation_error_fatal"):
+            raise PoolAllocationError(entity["allocation_error"])
+        if time.monotonic() > deadline:
+            states = {n.node_id: n.state for n in nodes}
+            raise PoolAllocationError(
+                f"pool {pool.id} not ready after "
+                f"{pool.max_wait_time_seconds}s: {states}")
+        time.sleep(poll_interval)
+
+
+def resize_pool(store: StateStore, substrate: ComputeSubstrate,
+                pool: PoolSettings, num_slices: int,
+                wait: bool = True) -> None:
+    store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                       {"state": "resizing"})
+    substrate.resize_pool(pool, num_slices)
+    if wait:
+        if pool.tpu is not None:
+            expected = num_slices * pool.tpu.workers_per_slice
+        else:
+            expected = num_slices
+        deadline = time.monotonic() + pool.max_wait_time_seconds
+        while True:
+            ready = [n for n in list_nodes(store, pool.id)
+                     if n.state in READY_STATES]
+            if len(ready) >= expected:
+                break
+            if time.monotonic() > deadline:
+                raise PoolAllocationError(
+                    f"resize of {pool.id} timed out")
+            time.sleep(0.25)
+    store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                       {"state": "ready"})
+
+
+def delete_pool(store: StateStore, substrate: ComputeSubstrate,
+                pool_id: str) -> None:
+    get_pool(store, pool_id)  # raises if missing
+    substrate.deallocate_pool(pool_id)
+    # Clear jobs/tasks state for the pool.
+    for job in list(store.query_entities(names.TABLE_JOBS,
+                                         partition_key=pool_id)):
+        _purge_job(store, pool_id, job["_rk"])
+    store.delete_entity(names.TABLE_POOLS, "pools", pool_id)
+
+
+def _purge_job(store: StateStore, pool_id: str, job_id: str) -> None:
+    pk = names.task_pk(pool_id, job_id)
+    for task in list(store.query_entities(names.TABLE_TASKS,
+                                          partition_key=pk)):
+        store.delete_entity(names.TABLE_TASKS, pk, task["_rk"])
+    for row in list(store.query_entities(names.TABLE_JOBPREP,
+                                         partition_key=pk)):
+        store.delete_entity(names.TABLE_JOBPREP, pk, row["_rk"])
+    try:
+        store.delete_entity(names.TABLE_JOBS, pool_id, job_id)
+    except NotFoundError:
+        pass
+
+
+def pool_stats(store: StateStore, pool_id: str) -> dict:
+    """pool stats analog (batch.py:1460)."""
+    nodes = list_nodes(store, pool_id)
+    by_state: dict[str, int] = {}
+    for node in nodes:
+        by_state[node.state] = by_state.get(node.state, 0) + 1
+    jobs = list(store.query_entities(names.TABLE_JOBS,
+                                     partition_key=pool_id))
+    task_counts = {"pending": 0, "running": 0, "completed": 0,
+                   "failed": 0, "blocked": 0, "assigned": 0}
+    for job in jobs:
+        pk = names.task_pk(pool_id, job["_rk"])
+        for task in store.query_entities(names.TABLE_TASKS,
+                                         partition_key=pk):
+            state = task.get("state", "pending")
+            task_counts[state] = task_counts.get(state, 0) + 1
+    return {
+        "pool_id": pool_id,
+        "nodes": {"total": len(nodes), "by_state": by_state},
+        "jobs": len(jobs),
+        "tasks": task_counts,
+    }
+
+
+def send_control(store: StateStore, pool_id: str, node_id: str,
+                 message: dict) -> None:
+    store.put_message(names.control_queue(pool_id, node_id),
+                      json.dumps(message).encode())

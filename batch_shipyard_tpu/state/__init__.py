@@ -1,0 +1,12 @@
+from batch_shipyard_tpu.state.base import (  # noqa: F401
+    EntityExistsError,
+    EtagMismatchError,
+    LeaseHandle,
+    LeaseLostError,
+    NotFoundError,
+    ObjectMeta,
+    PreconditionFailedError,
+    QueueMessage,
+    StateStore,
+)
+from batch_shipyard_tpu.state.factory import create_statestore  # noqa: F401

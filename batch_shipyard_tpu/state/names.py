@@ -1,0 +1,70 @@
+"""Canonical state-store naming: tables, queues, object key prefixes.
+
+Reference analog: the _STORAGE_CONTAINERS registry (convoy/storage.py:68)
+that names every blob container/table/queue. Centralized so clients,
+daemons, and node agents agree on the schema.
+"""
+
+from __future__ import annotations
+
+# Tables (partition key scheme in comments)
+TABLE_POOLS = "pools"          # pk="pools",           rk=pool_id
+TABLE_NODES = "nodes"          # pk=pool_id,           rk=node_id
+TABLE_JOBS = "jobs"            # pk=pool_id,           rk=job_id
+TABLE_TASKS = "tasks"          # pk=f"{pool}${job}",   rk=task_id
+TABLE_GANGS = "gangs"          # pk=f"{pool}${job}${task}", rk=f"i{k}"
+TABLE_JOBPREP = "jobprep"      # pk=f"{pool}${job}",   rk=node_id
+TABLE_PERF = "perf"            # pk=f"{pool}",         rk=f"{ts}${uniq}"
+TABLE_IMAGES = "images"        # pk=pool_id,           rk=image hash
+TABLE_MONITOR = "monitor"      # pk="monitor",         rk=resource id
+TABLE_FEDERATIONS = "federations"  # pk="fed",         rk=federation_id
+TABLE_FEDJOBS = "fedjobs"      # pk=federation_id,     rk=job id
+TABLE_SLURM = "slurm"          # pk=cluster_id,        rk=host/partition
+
+
+def task_pk(pool_id: str, job_id: str) -> str:
+    return f"{pool_id}${job_id}"
+
+
+def gang_pk(pool_id: str, job_id: str, task_id: str) -> str:
+    return f"{pool_id}${job_id}${task_id}"
+
+
+# Queues
+def task_queue(pool_id: str) -> str:
+    return f"taskq-{pool_id}"
+
+
+def control_queue(pool_id: str, node_id: str) -> str:
+    """Per-node control messages (job release, shutdown, reboot)."""
+    return f"ctrlq-{pool_id}-{node_id}"
+
+
+def federation_queue(federation_id: str) -> str:
+    return f"fedq-{federation_id}"
+
+
+# Object key prefixes
+def resource_file_key(pool_id: str, filename: str) -> str:
+    return f"resourcefiles/{pool_id}/{filename}"
+
+
+def task_output_key(pool_id: str, job_id: str, task_id: str,
+                    filename: str) -> str:
+    return f"taskdata/{pool_id}/{job_id}/{task_id}/{filename}"
+
+
+def node_log_key(pool_id: str, node_id: str, filename: str) -> str:
+    return f"nodelogs/{pool_id}/{node_id}/{filename}"
+
+
+def global_resource_lock_key(pool_id: str, resource_hash: str,
+                             slot: int) -> str:
+    """Cascade concurrency-gate lock names (reference: hash.{0..N} lock
+    blobs, storage.py:1946)."""
+    return f"grlocks/{pool_id}/{resource_hash}.{slot}"
+
+
+def federation_job_blob_key(federation_id: str, job_id: str,
+                            unique: str) -> str:
+    return f"fedjobs/{federation_id}/{job_id}/{unique}"

@@ -1,0 +1,5 @@
+from batch_shipyard_tpu.substrate.base import (  # noqa: F401
+    ComputeSubstrate,
+    NodeInfo,
+    create_substrate,
+)

@@ -1,0 +1,78 @@
+"""Compute substrate interface: where nodes come from.
+
+The reference's L0 is Azure Batch pool allocation (create_pool
+batch.py:921 -> service allocates VMs -> start task runs nodeprep). Our
+substrates allocate TPU pod slices (gcp_tpu), simulate them in-process
+(fake — the test substrate SURVEY.md section 4 calls for), or run agents
+as local processes (localhost — used to drive the attached real TPU
+chip end-to-end).
+
+Pool semantics note (SURVEY.md section 7 hard parts): a TPU pod slice is
+allocated atomically with N workers — 'resize' means adding/removing
+whole slices and 'reboot one node' means recreating a slice. The
+substrate interface therefore exposes slice-granular operations; the
+pool manager maps node-granular recovery requests onto them.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import (
+    CredentialsSettings, PoolSettings)
+from batch_shipyard_tpu.state.base import StateStore
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeInfo:
+    node_id: str
+    state: str
+    hostname: str
+    internal_ip: str
+    node_index: int
+    slice_index: int
+    worker_index: int
+
+
+class ComputeSubstrate(abc.ABC):
+    """Allocates and manages the machines of one or more pools."""
+
+    @abc.abstractmethod
+    def allocate_pool(self, pool: PoolSettings) -> None:
+        """Begin allocation of all slices/nodes; returns immediately.
+        Node state convergence is observed via TABLE_NODES."""
+
+    @abc.abstractmethod
+    def deallocate_pool(self, pool_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def resize_pool(self, pool: PoolSettings, num_slices: int) -> None:
+        """Grow/shrink to num_slices slices (TPU) or num nodes
+        (VM pools)."""
+
+    @abc.abstractmethod
+    def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
+        """Tear down and re-allocate one slice ('reboot' analog)."""
+
+    @abc.abstractmethod
+    def get_remote_login(self, pool_id: str,
+                         node_id: str) -> Optional[tuple[str, int]]:
+        """(ip, ssh port) for a node, if reachable."""
+
+
+def create_substrate(kind: str, store: StateStore,
+                     credentials: CredentialsSettings,
+                     **kwargs) -> ComputeSubstrate:
+    if kind == "fake":
+        from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+        return FakePodSubstrate(store, **kwargs)
+    if kind == "localhost":
+        from batch_shipyard_tpu.substrate.localhost import (
+            LocalhostSubstrate)
+        return LocalhostSubstrate(store, credentials, **kwargs)
+    if kind == "tpu_vm":
+        from batch_shipyard_tpu.substrate.gcp_tpu import GcpTpuSubstrate
+        return GcpTpuSubstrate(store, credentials, **kwargs)
+    raise ValueError(f"unknown substrate {kind!r}")

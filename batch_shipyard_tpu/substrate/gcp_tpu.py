@@ -1,0 +1,265 @@
+"""GCP TPU VM substrate: provisions real Cloud TPU pod slices.
+
+Reference analog: Azure Batch pool allocation (batch.py:921 create_pool
+-> service allocates VMs -> start task). Cloud TPU has no hosted task
+scheduler, so this substrate provisions slices with ``gcloud compute
+tpus tpu-vm`` and bootstraps our node agent on every worker — the agent
+then pulls work from the state store exactly like the fake/localhost
+substrates.
+
+Allocation model (SURVEY.md section 7 hard parts):
+  - one pool = ``num_slices`` queued-resource/TPU-VM creations, each an
+    atomic slice of ``accelerator_type``;
+  - node recovery = slice recreation (there is no per-worker reboot of
+    a slice member that preserves ICI);
+  - stockout/quota errors surface in the pool entity for
+    _block_for_nodes_ready-style classification (batch.py:661 analog).
+
+Requires the ``gcloud`` CLI and network access; constructing the
+substrate without them raises, so the rest of the framework (and all
+tests) never touch this path.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import (
+    CredentialsSettings, PoolSettings)
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+from batch_shipyard_tpu.substrate import base
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+# Fatal allocation errors (quota/stockout) vs transient — the resize
+# error classification of the reference (batch.py:661-672).
+FATAL_ALLOCATION_MARKERS = (
+    "QUOTA_EXCEEDED", "RESOURCE_EXHAUSTED", "stockout",
+    "does not have enough resources",
+)
+
+
+class GcpTpuSubstrate(base.ComputeSubstrate):
+    def __init__(self, store: StateStore,
+                 credentials: CredentialsSettings,
+                 bootstrap_bundle_key: Optional[str] = None) -> None:
+        if shutil.which("gcloud") is None:
+            raise RuntimeError(
+                "gcloud CLI is required for the tpu_vm substrate; use "
+                "substrate: fake or localhost without it")
+        if credentials.gcp is None:
+            raise ValueError(
+                "credentials.gcp is required for the tpu_vm substrate")
+        self.store = store
+        self.credentials = credentials
+        self.project = credentials.gcp.project
+        self.zone = credentials.gcp.zone
+        self.bootstrap_bundle_key = bootstrap_bundle_key
+
+    # ------------------------------ gcloud -----------------------------
+
+    def _gcloud(self, *args: str, parse_json: bool = False):
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
+               f"--project={self.project}"]
+        if self.zone:
+            cmd.append(f"--zone={self.zone}")
+        if parse_json:
+            cmd.append("--format=json")
+        rc, out, err = util.subprocess_capture(cmd)
+        if rc != 0:
+            raise RuntimeError(f"gcloud failed ({rc}): {err.strip()}")
+        return json.loads(out) if parse_json else out
+
+    @staticmethod
+    def slice_name(pool_id: str, slice_index: int) -> str:
+        return f"shipyard-{pool_id}-s{slice_index}"
+
+    # ---------------------------- interface ----------------------------
+
+    def allocate_pool(self, pool: PoolSettings) -> None:
+        assert pool.tpu is not None, "tpu_vm substrate requires tpu block"
+        for s in range(pool.tpu.num_slices):
+            self._create_slice(pool, s)
+
+    def _create_slice(self, pool: PoolSettings, slice_index: int) -> None:
+        tpu = pool.tpu
+        name = self.slice_name(pool.id, slice_index)
+        args = ["create", name,
+                f"--accelerator-type={tpu.accelerator_type}",
+                f"--version={tpu.runtime_version}"]
+        if tpu.provisioning_model == "spot":
+            args.append("--spot")
+        elif tpu.provisioning_model == "reserved":
+            args.append(f"--reserved")
+            if tpu.reservation_name:
+                args.append(f"--reservation={tpu.reservation_name}")
+        if tpu.network:
+            args.append(f"--network={tpu.network}")
+        if tpu.subnetwork:
+            args.append(f"--subnetwork={tpu.subnetwork}")
+        try:
+            self._gcloud(*args)
+        except RuntimeError as exc:
+            fatal = any(marker.lower() in str(exc).lower()
+                        for marker in FATAL_ALLOCATION_MARKERS)
+            self.store.merge_entity(
+                names.TABLE_POOLS, "pools", pool.id, {
+                    "allocation_error": str(exc),
+                    "allocation_error_fatal": fatal})
+            raise
+        self._register_workers(pool, slice_index)
+        self._bootstrap_agents(pool, slice_index)
+
+    def _register_workers(self, pool: PoolSettings,
+                          slice_index: int) -> None:
+        name = self.slice_name(pool.id, slice_index)
+        desc = self._gcloud("describe", name, parse_json=True)
+        endpoints = desc.get("networkEndpoints", [])
+        workers = pool.tpu.workers_per_slice
+        for w, endpoint in enumerate(endpoints[:workers]):
+            node_id = f"{pool.id}-s{slice_index}-w{w}"
+            self.store.upsert_entity(
+                names.TABLE_NODES, pool.id, node_id, {
+                    "state": "creating",
+                    "hostname": f"{name}-w{w}",
+                    "internal_ip": endpoint.get("ipAddress", ""),
+                    "external_ip": endpoint.get(
+                        "accessConfig", {}).get("externalIp", ""),
+                    "node_index": slice_index * workers + w,
+                    "slice_index": slice_index, "worker_index": w,
+                    "tpu_name": name})
+
+    def _bootstrap_agents(self, pool: PoolSettings,
+                          slice_index: int) -> None:
+        """Install + systemd-launch the node agent on every worker via
+        ``gcloud ... ssh --worker=all`` (the start-task analog,
+        fleet.py:1317-1437)."""
+        name = self.slice_name(pool.id, slice_index)
+        storage = self.credentials.storage
+        workers = pool.tpu.workers_per_slice
+        script = _bootstrap_script(
+            pool, storage_backend=storage.backend,
+            storage_bucket=storage.bucket or "",
+            storage_prefix=storage.prefix,
+            slice_index=slice_index, workers=workers,
+            bundle_key=self.bootstrap_bundle_key or "")
+        self._gcloud("ssh", name, "--worker=all",
+                     f"--command={script}")
+
+    def deallocate_pool(self, pool_id: str) -> None:
+        rows = list(self.store.query_entities(
+            names.TABLE_NODES, partition_key=pool_id))
+        slices = sorted({row.get("tpu_name") for row in rows
+                         if row.get("tpu_name")})
+        for name in slices:
+            try:
+                self._gcloud("delete", name, "--quiet")
+            except RuntimeError:
+                logger.exception("failed deleting %s", name)
+        for row in rows:
+            self.store.delete_entity(
+                names.TABLE_NODES, pool_id, row["_rk"])
+
+    def resize_pool(self, pool: PoolSettings, num_slices: int) -> None:
+        current = sorted({
+            int(row["slice_index"]) for row in self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool.id)})
+        have = len(current)
+        if num_slices > have:
+            for s in range(have, num_slices):
+                self._create_slice(pool, s)
+        else:
+            for s in current[num_slices:]:
+                self._delete_slice(pool.id, s)
+
+    def _delete_slice(self, pool_id: str, slice_index: int) -> None:
+        name = self.slice_name(pool_id, slice_index)
+        self._gcloud("delete", name, "--quiet")
+        for row in list(self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool_id)):
+            if int(row.get("slice_index", -1)) == slice_index:
+                self.store.delete_entity(
+                    names.TABLE_NODES, pool_id, row["_rk"])
+
+    def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
+        try:
+            self._delete_slice(pool.id, slice_index)
+        except RuntimeError:
+            logger.warning("delete of slice %d failed; recreating anyway",
+                           slice_index)
+        self._create_slice(pool, slice_index)
+
+    def get_remote_login(self, pool_id: str,
+                         node_id: str) -> Optional[tuple[str, int]]:
+        try:
+            row = self.store.get_entity(names.TABLE_NODES, pool_id,
+                                        node_id)
+        except KeyError:
+            return None
+        ip = row.get("external_ip") or row.get("internal_ip")
+        return (ip, 22) if ip else None
+
+
+def _bootstrap_script(pool: PoolSettings, storage_backend: str,
+                      storage_bucket: str, storage_prefix: str,
+                      slice_index: int, workers: int,
+                      bundle_key: str) -> str:
+    """Shell one-liner run on each worker to start the node agent.
+
+    The boot template travels base64-encoded (no quoting hazards); a
+    tiny remote python fills in the per-worker identity from
+    TPU_WORKER_ID and hostname.
+    """
+    import base64
+    template = {
+        "storage": {"backend": storage_backend,
+                    "bucket": storage_bucket,
+                    "prefix": storage_prefix},
+        "pool_config": {"pool_specification": {
+            "id": pool.id,
+            "substrate": "tpu_vm",
+            "tpu": {
+                "accelerator_type": pool.tpu.accelerator_type,
+                "num_slices": pool.tpu.num_slices,
+            },
+            "task_slots_per_node": pool.task_slots_per_node,
+        }},
+        "identity": {
+            "pool_id": pool.id,
+            "node_id": f"{pool.id}-s{slice_index}-wWORKER",
+            "node_index": slice_index * workers,  # + worker id remotely
+            "hostname": "", "internal_ip": "",
+            "slice_index": slice_index, "worker_index": 0,
+        },
+        "work_dir": "/var/shipyard",
+        "run_nodeprep": True,
+    }
+    b64 = base64.b64encode(json.dumps(template).encode()).decode()
+    fill_py = (
+        'import json,os,socket;'
+        't=json.load(open("/tmp/shipyard_boot_t.json"));'
+        'w=int(os.environ.get("TPU_WORKER_ID","0"));'
+        'i=t["identity"];'
+        'i["node_id"]=i["node_id"].replace("WORKER",str(w));'
+        'i["worker_index"]=w;i["node_index"]=i["node_index"]+w;'
+        'i["hostname"]=socket.gethostname();'
+        'i["internal_ip"]=socket.gethostbyname(socket.gethostname());'
+        'json.dump(t,open("/tmp/shipyard_boot.json","w"))')
+    lines = [
+        "sudo mkdir -p /var/shipyard",
+        "sudo chmod 777 /var/shipyard",
+        f"echo {b64} | base64 -d > /tmp/shipyard_boot_t.json",
+        f"python3 -c '{fill_py}'",
+        # Fetch the framework bundle from the state bucket if provided.
+        (f"gsutil cp gs://{storage_bucket}/{bundle_key} /tmp/bst.tar.gz "
+         "&& sudo tar xzf /tmp/bst.tar.gz -C /opt" if bundle_key else
+         "true"),
+        "sudo sh -c 'nohup python3 -m batch_shipyard_tpu.agent "
+        "/tmp/shipyard_boot.json >/var/shipyard/agent.log 2>&1 &'",
+    ]
+    return " && ".join(lines)

@@ -1,0 +1,317 @@
+"""End-to-end job execution on the FakePod substrate: the minimum
+slice of SURVEY.md section 7 step 3 plus gang scheduling (step 4),
+exercised with real subprocesses via runtime: none."""
+
+import json
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_env(accel="v5litepod-16", slices=1, slots=1):
+    conf = {"pool_specification": {
+        "id": "pool1", "substrate": "fake",
+        "tpu": {"accelerator_type": accel, "num_slices": slices},
+        "task_slots_per_node": slots,
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    return store, substrate, pool
+
+
+def submit(store, pool, jobs_conf):
+    jobs = settings_mod.job_settings_list(jobs_conf)
+    return jobs_mgr.add_jobs(store, pool, jobs)
+
+
+@pytest.fixture()
+def env():
+    store, substrate, pool = make_env()
+    yield store, substrate, pool
+    substrate.stop_all()
+
+
+def test_single_task_runs_and_streams_output(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "job1",
+        "tasks": [{"command": "echo hello from $SHIPYARD_TASK_ID"}],
+    }]})
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "job1", timeout=30)
+    assert len(tasks) == 1
+    assert tasks[0]["state"] == "completed"
+    assert tasks[0]["exit_code"] == 0
+    out = jobs_mgr.get_task_output(store, "pool1", "job1", "task-00000")
+    assert out.strip() == b"hello from task-00000"
+
+
+def test_task_env_contract(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jenv",
+        "environment_variables": {"MYVAR": "42"},
+        "tasks": [{"command":
+                   "echo $MYVAR $SHIPYARD_POOL_ID $SHIPYARD_JOB_ID"}],
+    }]})
+    jobs_mgr.wait_for_tasks(store, "pool1", "jenv", timeout=30)
+    out = jobs_mgr.get_task_output(store, "pool1", "jenv", "task-00000")
+    assert out.strip() == b"42 pool1 jenv"
+
+
+def test_failing_task_retries_then_fails(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jfail",
+        "tasks": [{"command": "exit 3", "max_task_retries": 2}],
+    }]})
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jfail", timeout=30)
+    assert tasks[0]["state"] == "failed"
+    assert tasks[0]["exit_code"] == 3
+    assert tasks[0]["retries"] == 2
+
+
+def test_task_dependencies_order(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jdep",
+        "tasks": [
+            {"id": "a", "command": "echo A"},
+            {"id": "b", "command": "echo B", "depends_on": ["a"]},
+            {"id": "c", "command": "echo C", "depends_on": ["b"]},
+        ],
+    }]})
+    tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+        store, "pool1", "jdep", timeout=30)}
+    assert all(t["state"] == "completed" for t in tasks.values())
+    assert tasks["a"]["completed_at"] <= tasks["b"]["started_at"]
+    assert tasks["b"]["completed_at"] <= tasks["c"]["started_at"]
+
+
+def test_dependency_on_failed_task_blocks(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jblock",
+        "tasks": [
+            {"id": "bad", "command": "exit 1"},
+            {"id": "child", "command": "echo never",
+             "depends_on": ["bad"]},
+        ],
+    }]})
+    tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+        store, "pool1", "jblock", timeout=30)}
+    assert tasks["bad"]["state"] == "failed"
+    assert tasks["child"]["state"] == "blocked"
+
+
+def test_dependency_action_satisfy_runs_child(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jsat",
+        "tasks": [
+            {"id": "bad", "command": "exit 1",
+             "exit_conditions": {"default": {"exit_options": {
+                 "dependency_action": "satisfy"}}}},
+            {"id": "child", "command": "echo ran",
+             "depends_on": ["bad"]},
+        ],
+    }]})
+    tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+        store, "pool1", "jsat", timeout=30)}
+    assert tasks["child"]["state"] == "completed"
+
+
+def test_wall_time_enforcement(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jwall",
+        "tasks": [{"command": "sleep 30",
+                   "max_wall_time_seconds": 1}],
+    }]})
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jwall", timeout=30)
+    assert tasks[0]["state"] == "failed"
+    assert tasks[0]["timed_out"]
+
+
+def test_job_prep_runs_once_per_node(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jp",
+        "job_preparation": {"command": "echo prep"},
+        "tasks": [{"id": f"t{i}", "command": "echo x"}
+                  for i in range(6)],
+    }]})
+    jobs_mgr.wait_for_tasks(store, "pool1", "jp", timeout=30)
+    rows = list(store.query_entities(
+        names.TABLE_JOBPREP, partition_key=names.task_pk("pool1", "jp")))
+    # At most one prep per node, and every prep is done.
+    assert 1 <= len(rows) <= 4
+    assert all(r["state"] == "done" for r in rows)
+
+
+def test_auto_complete_and_job_release(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jac", "auto_complete": True,
+        "job_preparation": {"command": "echo prep"},
+        "job_release": {"command": "echo release"},
+        "tasks": [{"command": "echo done"}],
+    }]})
+    jobs_mgr.wait_for_tasks(store, "pool1", "jac", timeout=30)
+    import time
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if jobs_mgr.get_job(store, "pool1", "jac")[
+                "state"] == "completed":
+            break
+        time.sleep(0.1)
+    assert jobs_mgr.get_job(store, "pool1", "jac")["state"] == "completed"
+
+
+def test_parametric_sweep_fanout(env):
+    store, substrate, pool = env
+    counts = submit(store, pool, {"job_specifications": [{
+        "id": "jsweep",
+        "tasks": [{
+            "command": "echo {0}-{1}",
+            "task_factory": {"parametric_sweep": {
+                "generator": "product",
+                "product": [
+                    {"start": 0, "stop": 2, "step": 1},
+                    {"values": ["x", "y", "z"]},
+                ]}},
+        }],
+    }]})
+    assert counts["jsweep"] == 6
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jsweep", timeout=30)
+    outs = set()
+    for task in tasks:
+        assert task["state"] == "completed"
+        outs.add(jobs_mgr.get_task_output(
+            store, "pool1", "jsweep", task["_rk"]).strip())
+    assert outs == {b"0-x", b"0-y", b"0-z", b"1-x", b"1-y", b"1-z"}
+
+
+def test_gang_task_rendezvous_and_jax_env():
+    store, substrate, pool = make_env()
+    try:
+        submit(store, pool, {"job_specifications": [{
+            "id": "jgang",
+            "tasks": [{
+                "command": ("echo $JAX_PROCESS_ID/$JAX_NUM_PROCESSES "
+                            "$JAX_COORDINATOR_ADDRESS "
+                            "$SHIPYARD_HOST_LIST"),
+                "multi_instance": {
+                    "num_instances": 4,
+                    "coordination_command": "echo coord",
+                    "jax_distributed": {"enabled": True,
+                                        "transport": "ici"},
+                },
+            }],
+        }]})
+        tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jgang",
+                                        timeout=60)
+        assert tasks[0]["state"] == "completed"
+        seen = set()
+        coords = set()
+        for k in range(4):
+            out = jobs_mgr.get_task_output(
+                store, "pool1", "jgang", "task-00000",
+                instance=k).decode().strip()
+            rank_part, coord, hosts = out.split(" ")
+            seen.add(rank_part)
+            coords.add(coord)
+            assert len(hosts.split(",")) == 4
+        assert seen == {"0/4", "1/4", "2/4", "3/4"}
+        assert len(coords) == 1  # everyone agrees on the coordinator
+        port = coords.pop().split(":")[1]
+        assert port == "8476"
+    finally:
+        substrate.stop_all()
+
+
+def test_gang_multislice_megascale_env():
+    store, substrate, pool = make_env(accel="v5litepod-8", slices=2)
+    try:
+        submit(store, pool, {"job_specifications": [{
+            "id": "jms",
+            "tasks": [{
+                "command": ("echo $MEGASCALE_NUM_SLICES "
+                            "$MEGASCALE_SLICE_ID $JAX_NUM_PROCESSES"),
+                "multi_instance": {
+                    "num_instances": 4,
+                    "jax_distributed": {"enabled": True,
+                                        "transport": "auto"},
+                },
+            }],
+        }]})
+        tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jms", timeout=60)
+        assert tasks[0]["state"] == "completed"
+        slice_ids = set()
+        for k in range(4):
+            out = jobs_mgr.get_task_output(
+                store, "pool1", "jms", "task-00000",
+                instance=k).decode().split()
+            assert out[0] == "2"
+            assert out[2] == "4"
+            slice_ids.add(out[1])
+        assert slice_ids == {"0", "1"}
+    finally:
+        substrate.stop_all()
+
+
+def test_terminate_job(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jterm",
+        "tasks": [{"command": "sleep 60"}],
+    }]})
+    import time
+    time.sleep(0.5)
+    jobs_mgr.terminate_job(store, "pool1", "jterm")
+    job = jobs_mgr.get_job(store, "pool1", "jterm")
+    assert job["state"] == "terminated"
+
+
+def test_job_stats(env):
+    store, substrate, pool = env
+    submit(store, pool, {"job_specifications": [{
+        "id": "jstats",
+        "tasks": [{"command": "echo 1"}, {"command": "exit 1"}],
+    }]})
+    jobs_mgr.wait_for_tasks(store, "pool1", "jstats", timeout=30)
+    stats = jobs_mgr.job_stats(store, "pool1")
+    assert stats["tasks"] == 2
+    assert stats["by_state"]["completed"] == 1
+    assert stats["by_state"]["failed"] == 1
+
+
+def test_orphaned_task_reclaimed_from_dead_node(env):
+    """A task assigned to a node that died (stale heartbeat) is reset
+    to pending and picked up by a live node on message redelivery."""
+    store, substrate, pool = env
+    pk = names.task_pk("pool1", "jorph")
+    store.insert_entity(names.TABLE_JOBS, "pool1", "jorph",
+                        {"state": "active", "spec": {}})
+    store.insert_entity(names.TABLE_TASKS, pk, "t0", {
+        "state": "running", "node_id": "ghost-node",
+        "spec": {"command": "echo reclaimed", "runtime": "none"},
+        "retries": 0})
+    # ghost node with ancient heartbeat
+    store.upsert_entity(names.TABLE_NODES, "pool1", "ghost-node", {
+        "state": "running", "heartbeat_at": 0.0})
+    store.put_message(names.task_queue("pool1"), json.dumps(
+        {"job_id": "jorph", "task_id": "t0"}).encode())
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jorph", timeout=30)
+    assert tasks[0]["state"] == "completed"
+    assert tasks[0]["node_id"] != "ghost-node"

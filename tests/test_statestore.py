@@ -1,0 +1,197 @@
+"""Contract tests over both state store implementations.
+
+Every distributed protocol in the framework (cascade lease gate,
+federation queues, gang rendezvous) sits on these semantics, so they
+are tested as a contract across backends.
+"""
+
+import concurrent.futures
+import time
+
+import pytest
+
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, EtagMismatchError, LeaseLostError, NotFoundError,
+    PreconditionFailedError)
+from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+
+@pytest.fixture(params=["memory", "localfs"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryStateStore()
+    else:
+        yield LocalFSStateStore(str(tmp_path / "store"))
+
+
+def test_object_roundtrip(store):
+    gen = store.put_object("a/b.txt", b"hello")
+    assert store.get_object("a/b.txt") == b"hello"
+    meta = store.get_object_meta("a/b.txt")
+    assert meta.size == 5
+    assert meta.generation == gen
+    assert store.list_objects("a/") == ["a/b.txt"]
+    store.delete_object("a/b.txt")
+    assert not store.object_exists("a/b.txt")
+    with pytest.raises(NotFoundError):
+        store.get_object("a/b.txt")
+
+
+def test_object_create_only_precondition(store):
+    store.put_object("x", b"1", if_generation_match=0)
+    with pytest.raises(PreconditionFailedError):
+        store.put_object("x", b"2", if_generation_match=0)
+
+
+def test_object_matched_overwrite(store):
+    gen = store.put_object("x", b"1")
+    store.put_object("x", b"2", if_generation_match=gen)
+    with pytest.raises(PreconditionFailedError):
+        store.put_object("x", b"3", if_generation_match=gen)
+    assert store.get_object("x") == b"2"
+
+
+def test_lease_mutual_exclusion(store):
+    h1 = store.acquire_lease("lock1", 30.0, "owner-a")
+    assert h1 is not None
+    assert store.acquire_lease("lock1", 30.0, "owner-b") is None
+    store.release_lease(h1)
+    h2 = store.acquire_lease("lock1", 30.0, "owner-b")
+    assert h2 is not None and h2.owner == "owner-b"
+
+
+def test_lease_expiry_steal(store):
+    h1 = store.acquire_lease("lock2", 0.05, "a")
+    assert h1 is not None
+    time.sleep(0.1)
+    h2 = store.acquire_lease("lock2", 30.0, "b")
+    assert h2 is not None
+    with pytest.raises(LeaseLostError):
+        store.renew_lease(h1, 30.0)
+
+
+def test_lease_renew(store):
+    h = store.acquire_lease("lock3", 0.2, "a")
+    h = store.renew_lease(h, 30.0)
+    time.sleep(0.25)
+    # renewed past original expiry -> still held
+    assert store.acquire_lease("lock3", 30.0, "b") is None
+    store.release_lease(h)
+
+
+def test_lease_contention_single_winner(store):
+    winners = []
+
+    def contend(idx):
+        handle = store.acquire_lease("hot", 30.0, f"w{idx}")
+        if handle is not None:
+            winners.append(idx)
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(contend, range(8)))
+    assert len(winners) == 1
+
+
+def test_entity_crud(store):
+    etag = store.insert_entity("t", "pk", "rk", {"a": 1})
+    with pytest.raises(EntityExistsError):
+        store.insert_entity("t", "pk", "rk", {"a": 2})
+    ent = store.get_entity("t", "pk", "rk")
+    assert ent["a"] == 1 and ent["_etag"] == etag
+    etag2 = store.merge_entity("t", "pk", "rk", {"b": 2}, if_match=etag)
+    with pytest.raises(EtagMismatchError):
+        store.merge_entity("t", "pk", "rk", {"c": 3}, if_match=etag)
+    ent = store.get_entity("t", "pk", "rk")
+    assert ent["a"] == 1 and ent["b"] == 2 and ent["_etag"] == etag2
+    store.delete_entity("t", "pk", "rk", if_match=etag2)
+    with pytest.raises(NotFoundError):
+        store.get_entity("t", "pk", "rk")
+
+
+def test_entity_query(store):
+    store.insert_entity("t", "p1", "a", {"v": 1})
+    store.insert_entity("t", "p1", "ab", {"v": 2})
+    store.insert_entity("t", "p2", "a", {"v": 3})
+    assert len(list(store.query_entities("t"))) == 3
+    assert len(list(store.query_entities("t", partition_key="p1"))) == 2
+    rows = list(store.query_entities("t", partition_key="p1",
+                                     row_key_prefix="ab"))
+    assert len(rows) == 1 and rows[0]["v"] == 2
+
+
+def test_entity_claim_race(store):
+    """Optimistic-concurrency claim: only one thread wins the etag swap
+    (the task-assignment primitive for the node agent)."""
+    store.insert_entity("tasks", "job", "t1", {"state": "pending"})
+    wins = []
+
+    def claim(idx):
+        ent = store.get_entity("tasks", "job", "t1")
+        if ent["state"] != "pending":
+            return
+        try:
+            store.merge_entity("tasks", "job", "t1",
+                               {"state": "assigned", "node": idx},
+                               if_match=ent["_etag"])
+            wins.append(idx)
+        except EtagMismatchError:
+            pass
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(claim, range(8)))
+    assert len(wins) == 1
+    assert store.get_entity("tasks", "job", "t1")["node"] == wins[0]
+
+
+def test_queue_visibility_and_redelivery(store):
+    store.put_message("q", b"m1")
+    msgs = store.get_messages("q", visibility_timeout=0.05)
+    assert len(msgs) == 1 and msgs[0].payload == b"m1"
+    # invisible while claimed
+    assert store.get_messages("q") == []
+    time.sleep(0.1)
+    # redelivered after visibility timeout, dequeue_count increments
+    msgs2 = store.get_messages("q", visibility_timeout=30.0)
+    assert len(msgs2) == 1 and msgs2[0].dequeue_count == 2
+    store.delete_message(msgs2[0])
+    assert store.queue_length("q") == 0
+    # stale receipt cannot delete
+    with pytest.raises(NotFoundError):
+        store.delete_message(msgs[0])
+
+
+def test_queue_delay_and_update(store):
+    store.put_message("q2", b"later", delay_seconds=0.1)
+    assert store.get_messages("q2") == []
+    time.sleep(0.15)
+    msgs = store.get_messages("q2", visibility_timeout=0.05)
+    assert len(msgs) == 1
+    store.update_message(msgs[0], visibility_timeout=30.0)
+    time.sleep(0.1)
+    assert store.get_messages("q2") == []  # visibility was extended
+
+
+def test_queue_multiple_consumers_no_double_claim(store):
+    for idx in range(20):
+        store.put_message("mq", f"m{idx}".encode())
+    claimed = []
+
+    def consume(_):
+        for msg in store.get_messages("mq", max_messages=5,
+                                      visibility_timeout=30.0):
+            claimed.append(msg.payload)
+
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        list(pool.map(consume, range(4)))
+    assert len(claimed) == len(set(claimed)) == 20
+
+
+def test_clear(store):
+    store.put_object("o", b"x")
+    store.insert_entity("t", "p", "r", {})
+    store.put_message("q", b"m")
+    store.clear()
+    assert store.list_objects() == []
+    assert list(store.query_entities("t")) == []
+    assert store.queue_length("q") == 0
