@@ -389,6 +389,17 @@ class NodeAgent:
                 return
             self._ensure_images(spec)
             execution = self._build_execution(slot, job_id, task_id, spec)
+            try:
+                self._stage_inputs(spec, execution)
+            except Exception as exc:
+                logger.exception("input staging failed for %s/%s",
+                                 job_id, task_id)
+                self._merge_task(job_id, task_id, {
+                    "state": "failed", "exit_code": -3,
+                    "error": f"input staging failed: {exc}"})
+                self.store.delete_message(msg)
+                self._maybe_autocomplete_job(job_id)
+                return
             self._merge_task(job_id, task_id, {
                 "state": "running",
                 "started_at": util.datetime_utcnow_iso()})
@@ -401,6 +412,13 @@ class NodeAgent:
                 with self._running_lock:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
+        try:
+            self._collect_outputs(spec, execution, job_id, task_id)
+        except Exception as exc:
+            logger.exception("output collection failed for %s/%s",
+                             job_id, task_id)
+            self._merge_task(job_id, task_id,
+                             {"output_error": str(exc)})
         retries = entity.get("retries", 0)
         max_retries = spec.get("max_task_retries", 0)
         if result.exit_code != 0 and (
@@ -588,6 +606,7 @@ class NodeAgent:
                          extra_env: Optional[dict] = None,
                          ) -> task_runner.TaskExecution:
         env = dict(spec.get("environment_variables", {}))
+        env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
         if extra_env:
             env.update(extra_env)
         task_dir = os.path.join(
@@ -619,7 +638,8 @@ class NodeAgent:
         run the task on this node (Azure Batch jobPreparationTask
         semantics)."""
         jp_command = spec.get("job_preparation_command")
-        if not jp_command:
+        job_inputs = spec.get("job_input_data") or []
+        if not jp_command and not job_inputs:
             return True
         pk = names.task_pk(self.identity.pool_id, job_id)
         try:
@@ -638,18 +658,42 @@ class NodeAgent:
                     return False
                 time.sleep(self.poll_interval)
             return False
-        execution = task_runner.TaskExecution(
-            pool_id=self.identity.pool_id, job_id=job_id, task_id="jobprep",
-            node_id=self.identity.node_id,
-            node_index=self.identity.node_index,
-            command=jp_command, runtime="none",
-            env=dict(spec.get("environment_variables", {})),
-            task_dir=os.path.join(self.work_dir, "jobprep", job_id))
-        result = task_runner.run_task(execution)
+        exit_code = 0
+        try:
+            # Job-level input_data lands in the job's shared dir
+            # (exposed to tasks as SHIPYARD_JOB_SHARED_DIR; the
+            # $AZ_BATCH_NODE_SHARED_DIR analog).
+            if job_inputs:
+                from batch_shipyard_tpu.data import movement
+                shared = self._job_shared_dir(job_id)
+                os.makedirs(shared, exist_ok=True)
+                movement.stage_task_inputs(self.store, job_inputs,
+                                           shared)
+            if jp_command:
+                execution = task_runner.TaskExecution(
+                    pool_id=self.identity.pool_id, job_id=job_id,
+                    task_id="jobprep",
+                    node_id=self.identity.node_id,
+                    node_index=self.identity.node_index,
+                    command=jp_command, runtime="none",
+                    env={
+                        **spec.get("environment_variables", {}),
+                        "SHIPYARD_JOB_SHARED_DIR":
+                            self._job_shared_dir(job_id),
+                    },
+                    task_dir=os.path.join(self.work_dir, "jobprep",
+                                          job_id))
+                exit_code = task_runner.run_task(execution).exit_code
+        except Exception as exc:
+            logger.exception("job prep failed for %s", job_id)
+            exit_code = -3
         self.store.merge_entity(
             names.TABLE_JOBPREP, pk, self.identity.node_id,
-            {"state": "done", "exit_code": result.exit_code})
-        return result.exit_code == 0
+            {"state": "done", "exit_code": exit_code})
+        return exit_code == 0
+
+    def _job_shared_dir(self, job_id: str) -> str:
+        return os.path.join(self.work_dir, "shared", job_id)
 
     def _run_job_release(self, job_id: str) -> None:
         try:
@@ -667,6 +711,27 @@ class NodeAgent:
             command=jr_command, runtime="none",
             task_dir=os.path.join(self.work_dir, "jobrelease", job_id))
         task_runner.run_task(execution)
+
+    def _stage_inputs(self, spec: dict,
+                      execution: task_runner.TaskExecution) -> None:
+        input_data = spec.get("input_data") or []
+        if not input_data:
+            return
+        from batch_shipyard_tpu.data import movement
+        os.makedirs(execution.task_dir, exist_ok=True)
+        movement.stage_task_inputs(self.store, input_data,
+                                   execution.task_dir)
+
+    def _collect_outputs(self, spec: dict,
+                         execution: task_runner.TaskExecution,
+                         job_id: str, task_id: str) -> None:
+        output_data = spec.get("output_data") or []
+        if not output_data:
+            return
+        from batch_shipyard_tpu.data import movement
+        movement.collect_task_outputs(
+            self.store, output_data, execution.task_dir,
+            self.identity.pool_id, job_id, task_id)
 
     def _ensure_images(self, spec: dict) -> None:
         if self._image_provisioner is None:

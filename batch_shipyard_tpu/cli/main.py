@@ -1,0 +1,291 @@
+"""shipyard-tpu CLI: the click command tree.
+
+Reference analog: shipyard.py (3136 LoC click tree: pool/jobs/data/
+storage/diag/monitor/fed/slurm groups, shipyard.py:1001-3136). Groups
+mirror the reference so a Batch Shipyard user finds the same verbs:
+
+  shipyard-tpu pool   add | list | del | resize | nodes | stats | ssh |
+                      images update | autoscale ...
+  shipyard-tpu jobs   add | list | term | del | stats | tasks list
+  shipyard-tpu data   stream | ingress
+  shipyard-tpu diag   perf
+  shipyard-tpu storage clear
+  shipyard-tpu monitor / fed / slurm (aux clusters)
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+from batch_shipyard_tpu import fleet
+from batch_shipyard_tpu.version import __version__
+
+
+@click.group(context_settings={"help_option_names": ["-h", "--help"]})
+@click.version_option(version=__version__)
+@click.option("--configdir", envvar="SHIPYARD_CONFIGDIR", default=None,
+              help="Directory holding credentials/config/pool/jobs yaml")
+@click.option("--credentials", "credentials_path", default=None,
+              help="Path to credentials yaml")
+@click.option("--config", "config_path", default=None,
+              help="Path to global config yaml")
+@click.option("--pool", "pool_path", default=None,
+              help="Path to pool yaml")
+@click.option("--jobs", "jobs_path", default=None,
+              help="Path to jobs yaml")
+@click.option("--raw", is_flag=True, default=False,
+              help="JSON output for scripting")
+@click.pass_context
+def cli(click_ctx, configdir, credentials_path, config_path, pool_path,
+        jobs_path, raw):
+    files = {}
+    if credentials_path:
+        files["credentials"] = credentials_path
+    if config_path:
+        files["config"] = config_path
+    if pool_path:
+        files["pool"] = pool_path
+    if jobs_path:
+        files["jobs"] = jobs_path
+    click_ctx.obj = {
+        "configdir": configdir, "files": files, "raw": raw, "ctx": None}
+
+
+def _ctx(click_ctx) -> fleet.Context:
+    if click_ctx.obj["ctx"] is None:
+        click_ctx.obj["ctx"] = fleet.load_context(
+            click_ctx.obj["configdir"], click_ctx.obj["files"])
+    return click_ctx.obj["ctx"]
+
+
+# ------------------------------- pool ----------------------------------
+
+@cli.group()
+def pool():
+    """Pool lifecycle (TPU pod slices / VM groups)."""
+
+
+@pool.command("add")
+@click.option("--no-wait", is_flag=True, default=False)
+@click.pass_context
+def pool_add(click_ctx, no_wait):
+    """Provision the pool from pool.yaml."""
+    fleet.action_pool_add(_ctx(click_ctx), wait=not no_wait)
+
+
+@pool.command("list")
+@click.pass_context
+def pool_list(click_ctx):
+    fleet.action_pool_list(_ctx(click_ctx), raw=click_ctx.obj["raw"])
+
+
+@pool.command("del")
+@click.option("--pool-id", default=None)
+@click.option("-y", "--yes", is_flag=True, default=False)
+@click.pass_context
+def pool_del(click_ctx, pool_id, yes):
+    ctx = _ctx(click_ctx)
+    target = pool_id or ctx.pool.id
+    if not yes and not click.confirm(
+            f"Delete pool {target} and all its jobs/tasks?"):
+        raise click.Abort()
+    fleet.action_pool_del(ctx, pool_id)
+
+
+@pool.command("resize")
+@click.argument("num_slices", type=int)
+@click.pass_context
+def pool_resize(click_ctx, num_slices):
+    fleet.action_pool_resize(_ctx(click_ctx), num_slices)
+
+
+@pool.command("stats")
+@click.pass_context
+def pool_stats(click_ctx):
+    fleet.action_pool_stats(_ctx(click_ctx), raw=click_ctx.obj["raw"])
+
+
+@pool.group()
+def nodes():
+    """Node operations."""
+
+
+@nodes.command("list")
+@click.pass_context
+def pool_nodes_list(click_ctx):
+    fleet.action_pool_nodes_list(_ctx(click_ctx),
+                                 raw=click_ctx.obj["raw"])
+
+
+@pool.command("ssh")
+@click.argument("node_id")
+@click.pass_context
+def pool_ssh(click_ctx, node_id):
+    fleet.action_pool_ssh(_ctx(click_ctx), node_id)
+
+
+@pool.group()
+def images():
+    """Container image management on pool nodes."""
+
+
+@images.command("update")
+@click.argument("image")
+@click.option("--kind", default="docker",
+              type=click.Choice(["docker", "singularity"]))
+@click.pass_context
+def pool_images_update(click_ctx, image, kind):
+    fleet.action_pool_images_update(_ctx(click_ctx), image, kind)
+
+
+@pool.group()
+def autoscale():
+    """Pool autoscale management."""
+
+
+@autoscale.command("enable")
+@click.pass_context
+def pool_autoscale_enable(click_ctx):
+    from batch_shipyard_tpu.pool import autoscale as as_mod
+    as_mod.enable_autoscale(_ctx(click_ctx).store, _ctx(click_ctx).pool)
+
+
+@autoscale.command("disable")
+@click.pass_context
+def pool_autoscale_disable(click_ctx):
+    from batch_shipyard_tpu.pool import autoscale as as_mod
+    as_mod.disable_autoscale(_ctx(click_ctx).store, _ctx(click_ctx).pool)
+
+
+@autoscale.command("evaluate")
+@click.pass_context
+def pool_autoscale_evaluate(click_ctx):
+    from batch_shipyard_tpu.pool import autoscale as as_mod
+    ctx = _ctx(click_ctx)
+    decision = as_mod.evaluate(ctx.store, ctx.pool)
+    fleet._emit(decision, click_ctx.obj["raw"])
+
+
+@autoscale.command("tick")
+@click.option("--daemon", is_flag=True, default=False,
+              help="Loop at autoscale.evaluation_interval_seconds")
+@click.option("--interval", type=float, default=None,
+              help="Override evaluation interval seconds")
+@click.pass_context
+def pool_autoscale_tick(click_ctx, daemon, interval):
+    """Evaluate AND apply the autoscale decision (the hosted
+    evaluator's job in the reference)."""
+    from batch_shipyard_tpu.pool import autoscale as as_mod
+    ctx = _ctx(click_ctx)
+    if daemon:
+        as_mod.run_daemon(ctx.store, ctx.substrate(), ctx.pool,
+                          interval=interval)
+    else:
+        decision = as_mod.autoscale_tick(ctx.store, ctx.substrate(),
+                                         ctx.pool)
+        fleet._emit(decision, click_ctx.obj["raw"])
+
+
+# ------------------------------- jobs ----------------------------------
+
+@cli.group()
+def jobs():
+    """Job and task submission."""
+
+
+@jobs.command("add")
+@click.option("--tail", default=None,
+              help="Stream this file of the last task after submit")
+@click.pass_context
+def jobs_add(click_ctx, tail):
+    fleet.action_jobs_add(_ctx(click_ctx), tail=tail)
+
+
+@jobs.command("list")
+@click.pass_context
+def jobs_list(click_ctx):
+    fleet.action_jobs_list(_ctx(click_ctx), raw=click_ctx.obj["raw"])
+
+
+@jobs.command("term")
+@click.option("--job-id", default=None)
+@click.pass_context
+def jobs_term(click_ctx, job_id):
+    fleet.action_jobs_term(_ctx(click_ctx), job_id)
+
+
+@jobs.command("del")
+@click.option("--job-id", default=None)
+@click.pass_context
+def jobs_del(click_ctx, job_id):
+    fleet.action_jobs_del(_ctx(click_ctx), job_id)
+
+
+@jobs.command("stats")
+@click.option("--job-id", default=None)
+@click.pass_context
+def jobs_stats(click_ctx, job_id):
+    fleet.action_jobs_stats(_ctx(click_ctx), job_id,
+                            raw=click_ctx.obj["raw"])
+
+
+@jobs.group()
+def tasks():
+    """Task operations."""
+
+
+@tasks.command("list")
+@click.argument("job_id")
+@click.pass_context
+def jobs_tasks_list(click_ctx, job_id):
+    fleet.action_jobs_tasks_list(_ctx(click_ctx), job_id,
+                                 raw=click_ctx.obj["raw"])
+
+
+# ------------------------------- data ----------------------------------
+
+@cli.group()
+def data():
+    """Data movement and task file access."""
+
+
+@data.command("stream")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--filename", default="stdout.txt")
+@click.pass_context
+def data_stream(click_ctx, job_id, task_id, filename):
+    fleet.action_data_stream(_ctx(click_ctx), job_id, task_id, filename)
+
+
+@data.command("ingress")
+@click.pass_context
+def data_ingress(click_ctx):
+    from batch_shipyard_tpu.data import movement
+    ctx = _ctx(click_ctx)
+    movement.ingress_data(ctx.store, ctx.global_settings,
+                          pool_id=ctx.pool.id if "pool" in
+                          ctx.configs else None)
+
+
+# ------------------------------- diag ----------------------------------
+
+@cli.group()
+def diag():
+    """Diagnostics."""
+
+
+@diag.command("perf")
+@click.pass_context
+def diag_perf(click_ctx):
+    fleet.action_perf_events(_ctx(click_ctx), raw=click_ctx.obj["raw"])
+
+
+def main():
+    return cli(prog_name="shipyard-tpu")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,236 @@
+"""Data movement: ingress/egress between local paths, the object store,
+and pool nodes.
+
+Reference analog: convoy/data.py — ingress_data(:981) dispatching to
+blobxfer (azure_storage) or scp/rsync (_singlenode_transfer :492 /
+_multinode_transfer :567 with round-robin size-balanced file sharding
+and optional byte-offset splits), plus task-level process_input_data
+(:219) and process_output_data (:447).
+
+TPU-native mapping:
+  - azure_storage/blobxfer  -> the state store's object space (GCS in
+    production) via put/get_object (whole-file transfers; objects are
+    read fully into memory — streaming is a future store API change),
+    with include/exclude globs;
+  - shared-fs scp/rsync     -> same ssh-based sharded transfer,
+    synthesized as command lines (testable dry-run; executed via
+    subprocess when live);
+  - task input_data/output_data -> handled by the node agent around
+    task execution using statestore keys (kind: statestore) or local
+    paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import GlobalSettings
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import NotFoundError, StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+# --------------------------- object ingress ----------------------------
+
+def _iter_files(source: str, include: Optional[list[str]] = None,
+                exclude: Optional[list[str]] = None):
+    if os.path.isfile(source):
+        yield source, os.path.basename(source)
+        return
+    for root, _dirs, files in os.walk(source):
+        for name in files:
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, source)
+            if include and not any(
+                    fnmatch.fnmatch(rel, pat) for pat in include):
+                continue
+            if exclude and any(
+                    fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            yield path, rel
+
+
+def ingress_to_storage(store: StateStore, source: str, dest_prefix: str,
+                       include: Optional[list[str]] = None,
+                       exclude: Optional[list[str]] = None) -> int:
+    """Upload local file(s) into the object space. Returns file count."""
+    count = 0
+    for path, rel in _iter_files(source, include, exclude):
+        key = f"{dest_prefix.rstrip('/')}/{rel}".lstrip("/")
+        with open(path, "rb") as fh:
+            store.put_object(key, fh.read())
+        count += 1
+    logger.info("ingressed %d files from %s to %s", count, source,
+                dest_prefix)
+    return count
+
+
+def egress_from_storage(store: StateStore, prefix: str,
+                        dest_dir: str) -> int:
+    """Download an object-prefix tree into a local directory."""
+    count = 0
+    for key in store.list_objects(prefix):
+        rel = key[len(prefix):].lstrip("/")
+        path = os.path.join(dest_dir, rel)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(store.get_object(key))
+        count += 1
+    return count
+
+
+def ingress_data(store: StateStore, global_conf: GlobalSettings,
+                 pool_id: Optional[str] = None) -> int:
+    """Process global_resources.files ingress specs (data ingress verb,
+    fleet.py:4496 analog)."""
+    total = 0
+    for spec in global_conf.files:
+        source = spec.get("source", {})
+        dest = spec.get("destination", {})
+        if "storage" in dest or "prefix" in dest:
+            prefix = (dest.get("storage", {}).get("prefix")
+                      or dest.get("prefix", "ingress"))
+            total += ingress_to_storage(
+                store, source.get("path", "."), prefix,
+                include=source.get("include"),
+                exclude=source.get("exclude"))
+        elif "shared_data_volume" in dest or "relative_destination_path" \
+                in dest:
+            raise NotImplementedError(
+                "direct-to-node ingress requires a live pool; use "
+                "plan_multinode_transfer + run_transfers")
+    return total
+
+
+# ------------------------ node (ssh) transfers -------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TransferCommand:
+    node_id: str
+    argv: tuple[str, ...]
+    files: tuple[str, ...]
+    total_bytes: int
+
+
+def plan_multinode_transfer(
+        files: list[tuple[str, int]], nodes: list[tuple[str, str, int]],
+        dest_path: str, method: str = "scp",
+        ssh_username: str = "shipyard",
+        ssh_private_key: Optional[str] = None,
+        ) -> list[TransferCommand]:
+    """Shard files across nodes round-robin balanced by size and emit
+    per-node transfer command lines (reference _multinode_transfer
+    data.py:567: largest-first onto least-loaded node).
+
+    files: [(local_path, size)]; nodes: [(node_id, ip, port)].
+    """
+    if method not in ("scp", "rsync"):
+        raise ValueError(f"unknown transfer method {method!r}")
+    if not nodes:
+        raise ValueError("no nodes to transfer to")
+    loads: list[int] = [0] * len(nodes)
+    shards: list[list[str]] = [[] for _ in nodes]
+    for path, size in sorted(files, key=lambda fs: -fs[1]):
+        idx = loads.index(min(loads))
+        shards[idx].append(path)
+        loads[idx] += size
+    out: list[TransferCommand] = []
+    for (node_id, ip, port), shard, load in zip(nodes, shards, loads):
+        if not shard:
+            continue
+        key_args = (("-i", ssh_private_key) if ssh_private_key else ())
+        if method == "scp":
+            argv = ("scp", "-o", "StrictHostKeyChecking=no",
+                    "-o", "UserKnownHostsFile=/dev/null",
+                    "-P", str(port), *key_args, "-p", *shard,
+                    f"{ssh_username}@{ip}:{dest_path}")
+        else:
+            ssh_cmd = " ".join((
+                "ssh", "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                *key_args, "-p", str(port)))
+            argv = ("rsync", "-az", "-e", ssh_cmd, *shard,
+                    f"{ssh_username}@{ip}:{dest_path}")
+        out.append(TransferCommand(
+            node_id=node_id, argv=argv, files=tuple(shard),
+            total_bytes=load))
+    return out
+
+
+def run_transfers(commands: list[TransferCommand],
+                  max_parallel: int = 4) -> list[int]:
+    """Execute planned transfers with bounded parallelism."""
+    results: list[int] = []
+    for batch in util.chunked(commands, max_parallel):
+        procs = [util.subprocess_nowait(list(c.argv)) for c in batch]
+        results.extend(util.subprocess_wait_all(procs))
+    return results
+
+
+# ---------------------- task-level input/output ------------------------
+
+def stage_task_inputs(store: StateStore, input_data: list[dict],
+                      task_dir: str) -> None:
+    """Materialize input_data specs into the task dir before execution
+    (process_input_data analog, data.py:219)."""
+    for spec in input_data:
+        kind = spec.get("kind", "statestore")
+        if kind == "statestore":
+            key = spec["key"]
+            rel = spec.get("file_path") or key.rsplit("/", 1)[-1]
+            dest = os.path.join(task_dir, rel)
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            try:
+                data = store.get_object(key)
+            except NotFoundError:
+                # Prefix fetch: key may name a directory-like prefix.
+                sub = store.list_objects(key)
+                if not sub:
+                    raise
+                for skey in sub:
+                    srel = skey[len(key):].lstrip("/")
+                    spath = os.path.join(dest, srel)
+                    os.makedirs(os.path.dirname(spath) or ".",
+                                exist_ok=True)
+                    with open(spath, "wb") as fh:
+                        fh.write(store.get_object(skey))
+                continue
+            with open(dest, "wb") as fh:
+                fh.write(data)
+        elif kind == "local":
+            continue  # already on the node filesystem
+        else:
+            raise ValueError(f"unknown input_data kind {kind!r}")
+
+
+def collect_task_outputs(store: StateStore, output_data: list[dict],
+                         task_dir: str, pool_id: str, job_id: str,
+                         task_id: str) -> int:
+    """Upload output_data globs after execution (process_output_data
+    analog, data.py:447). Returns uploaded count."""
+    count = 0
+    for spec in output_data:
+        pattern = spec.get("include")
+        prefix = spec.get("prefix") or names.task_output_key(
+            pool_id, job_id, task_id, "outputs")
+        for root, _dirs, files in os.walk(task_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, task_dir)
+                if rel.startswith(("stdout.txt", "stderr.txt")):
+                    continue
+                # fnmatch has no '**' semantics: treat missing/match-all
+                # patterns explicitly, else match rel then basename.
+                if pattern not in (None, "*", "**/*") and not (
+                        fnmatch.fnmatch(rel, pattern) or
+                        fnmatch.fnmatch(name, pattern)):
+                    continue
+                with open(path, "rb") as fh:
+                    store.put_object(f"{prefix}/{rel}", fh.read())
+                count += 1
+    return count

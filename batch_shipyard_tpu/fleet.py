@@ -1,0 +1,261 @@
+"""Fleet orchestration: one action_* function per CLI verb.
+
+Reference analog: convoy/fleet.py (5486 LoC, ~90 action_* functions,
+fleet.py:2974-5486). Ours is thinner because the heavy lifting lives in
+the domain services (pool/jobs managers) and on the node agents; fleet
+owns config loading/validation, wiring (state store + substrate), and
+the cross-service flows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import sys
+from typing import Any, Optional
+
+import yaml
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.config.validator import ConfigType, validate_config
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+from batch_shipyard_tpu.state.factory import create_statestore
+from batch_shipyard_tpu.substrate.base import (
+    ComputeSubstrate, create_substrate)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_CONFIG_TYPES = {
+    "credentials": ConfigType.CREDENTIALS,
+    "config": ConfigType.GLOBAL,
+    "pool": ConfigType.POOL,
+    "jobs": ConfigType.JOBS,
+    "fs": ConfigType.REMOTEFS,
+    "monitor": ConfigType.MONITOR,
+    "federation": ConfigType.FEDERATION,
+    "slurm": ConfigType.SLURM,
+}
+
+
+@dataclasses.dataclass
+class Context:
+    """CliContext analog (shipyard.py:55): loaded+validated configs and
+    lazily constructed clients."""
+
+    configs: dict[str, dict]
+    _store: Optional[StateStore] = None
+    _substrates: dict[str, ComputeSubstrate] = dataclasses.field(
+        default_factory=dict)
+    substrate_kwargs: dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+
+    # ------------------------- config access ---------------------------
+
+    @property
+    def credentials(self):
+        return settings_mod.credentials_settings(
+            self.configs.get("credentials", {}))
+
+    @property
+    def global_settings(self):
+        return settings_mod.global_settings(self.configs.get("config", {}))
+
+    @property
+    def pool(self):
+        if "pool" not in self.configs:
+            raise ValueError("pool config not loaded (pass --configdir "
+                             "with pool.yaml or --pool)")
+        return settings_mod.pool_settings(self.configs["pool"])
+
+    @property
+    def jobs(self):
+        if "jobs" not in self.configs:
+            raise ValueError("jobs config not loaded")
+        return settings_mod.job_settings_list(self.configs["jobs"])
+
+    # --------------------------- clients -------------------------------
+
+    @property
+    def store(self) -> StateStore:
+        if self._store is None:
+            self._store = create_statestore(self.credentials.storage)
+        return self._store
+
+    def substrate(self, pool=None) -> ComputeSubstrate:
+        pool = pool or self.pool
+        kind = pool.substrate
+        if kind not in self._substrates:
+            kwargs = dict(self.substrate_kwargs.get(kind, {}))
+            if kind == "localhost":
+                kwargs.setdefault("pool_config", self.configs.get("pool"))
+            self._substrates[kind] = create_substrate(
+                kind, self.store, self.credentials, **kwargs)
+        return self._substrates[kind]
+
+
+def load_context(configdir: Optional[str] = None,
+                 config_files: Optional[dict[str, str]] = None,
+                 extra: Optional[dict[str, dict]] = None) -> Context:
+    """Load + strictly validate every present config file
+    (CliContext._init_config analog, --configdir convention
+    shipyard.py:804)."""
+    configs: dict[str, dict] = {}
+    if configdir:
+        base = pathlib.Path(configdir)
+        for name in _CONFIG_TYPES:
+            for suffix in (".yaml", ".yml", ".json"):
+                path = base / f"{name}{suffix}"
+                if path.exists():
+                    with open(path, "r", encoding="utf-8") as fh:
+                        configs[name] = yaml.safe_load(fh) or {}
+                    break
+    for name, path in (config_files or {}).items():
+        with open(path, "r", encoding="utf-8") as fh:
+            configs[name] = yaml.safe_load(fh) or {}
+    for name, data in (extra or {}).items():
+        configs[name] = data
+    for name, data in configs.items():
+        validate_config(_CONFIG_TYPES[name], data)
+    return Context(configs=configs)
+
+
+def _emit(payload: Any, raw: bool = False) -> None:
+    if raw:
+        sys.stdout.write(json.dumps(payload, indent=2, default=str) + "\n")
+    else:
+        yaml.safe_dump(payload, sys.stdout, default_flow_style=False,
+                       sort_keys=False)
+
+
+# ------------------------------ pool actions ---------------------------
+
+def action_pool_add(ctx: Context, wait: bool = True) -> list:
+    """pool add (fleet.py:3390 analog)."""
+    pool = ctx.pool
+    nodes = pool_mgr.create_pool(
+        ctx.store, ctx.substrate(), pool, ctx.global_settings,
+        ctx.configs.get("pool"), wait=wait)
+    logger.info("pool %s ready with %d nodes", pool.id, len(nodes))
+    return nodes
+
+
+def action_pool_list(ctx: Context, raw: bool = False) -> None:
+    pools = [{"id": p["_rk"], "state": p.get("state"),
+              "created_at": p.get("created_at")}
+             for p in pool_mgr.list_pools(ctx.store)]
+    _emit({"pools": pools}, raw)
+
+
+def action_pool_del(ctx: Context, pool_id: Optional[str] = None) -> None:
+    pool_id = pool_id or ctx.pool.id
+    pool_mgr.delete_pool(ctx.store, ctx.substrate(), pool_id)
+    logger.info("pool %s deleted", pool_id)
+
+
+def action_pool_resize(ctx: Context, num_slices: int,
+                       wait: bool = True) -> None:
+    pool_mgr.resize_pool(ctx.store, ctx.substrate(), ctx.pool,
+                         num_slices, wait=wait)
+
+
+def action_pool_nodes_list(ctx: Context, raw: bool = False) -> None:
+    nodes = [dataclasses.asdict(n)
+             for n in pool_mgr.list_nodes(ctx.store, ctx.pool.id)]
+    _emit({"nodes": nodes}, raw)
+
+
+def action_pool_stats(ctx: Context, raw: bool = False) -> None:
+    _emit(pool_mgr.pool_stats(ctx.store, ctx.pool.id), raw)
+
+
+def action_pool_ssh(ctx: Context, node_id: str) -> Optional[tuple]:
+    login = ctx.substrate().get_remote_login(ctx.pool.id, node_id)
+    if login is None:
+        logger.error("no remote login for %s", node_id)
+        return None
+    _emit({"node": node_id, "ip": login[0], "port": login[1]})
+    return login
+
+
+def action_pool_images_update(ctx: Context, image: str,
+                              kind: str = "docker") -> None:
+    """Force image (re)load on all nodes (fleet.py:2241 analog)."""
+    for node in pool_mgr.list_nodes(ctx.store, ctx.pool.id):
+        pool_mgr.send_control(ctx.store, ctx.pool.id, node.node_id, {
+            "type": "load_images", "images": [image], "kind": kind})
+
+
+# ------------------------------ job actions ----------------------------
+
+def action_jobs_add(ctx: Context, tail: Optional[str] = None) -> dict:
+    """jobs add (fleet.py:4000 analog). tail: stream the given file of
+    the last task submitted (reference --tail)."""
+    pool = ctx.pool
+    ctx.substrate().ensure_attached(pool)
+    submitted = jobs_mgr.add_jobs(ctx.store, pool, ctx.jobs)
+    logger.info("submitted %s", submitted)
+    if tail:
+        job = ctx.jobs[-1]
+        tasks = jobs_mgr.list_tasks(ctx.store, pool.id, job.id)
+        if tasks:
+            last = sorted(t["_rk"] for t in tasks)[-1]
+            for chunk in jobs_mgr.stream_task_output(
+                    ctx.store, pool.id, job.id, last, filename=tail):
+                sys.stdout.write(chunk.decode(errors="replace"))
+                sys.stdout.flush()
+    return submitted
+
+
+def action_jobs_list(ctx: Context, raw: bool = False) -> None:
+    jobs = [{"id": j["_rk"], "state": j.get("state")}
+            for j in jobs_mgr.list_jobs(ctx.store, ctx.pool.id)]
+    _emit({"jobs": jobs}, raw)
+
+
+def action_jobs_tasks_list(ctx: Context, job_id: str,
+                           raw: bool = False) -> None:
+    tasks = [{"id": t["_rk"], "state": t.get("state"),
+              "exit_code": t.get("exit_code"),
+              "node_id": t.get("node_id")}
+             for t in jobs_mgr.list_tasks(ctx.store, ctx.pool.id, job_id)]
+    _emit({"tasks": tasks}, raw)
+
+
+def action_jobs_term(ctx: Context, job_id: Optional[str] = None,
+                     wait: bool = False) -> None:
+    for job in ([job_id] if job_id else [j.id for j in ctx.jobs]):
+        jobs_mgr.terminate_job(ctx.store, ctx.pool.id, job, wait=wait)
+
+
+def action_jobs_del(ctx: Context, job_id: Optional[str] = None) -> None:
+    for job in ([job_id] if job_id else [j.id for j in ctx.jobs]):
+        jobs_mgr.delete_job(ctx.store, ctx.pool.id, job)
+
+
+def action_jobs_stats(ctx: Context, job_id: Optional[str] = None,
+                      raw: bool = False) -> None:
+    _emit(jobs_mgr.job_stats(ctx.store, ctx.pool.id, job_id), raw)
+
+
+def action_data_stream(ctx: Context, job_id: str, task_id: str,
+                       filename: str = "stdout.txt") -> None:
+    """data files stream (fleet.py action analog of batch.py:3243)."""
+    for chunk in jobs_mgr.stream_task_output(
+            ctx.store, ctx.pool.id, job_id, task_id, filename=filename):
+        sys.stdout.write(chunk.decode(errors="replace"))
+        sys.stdout.flush()
+
+
+# ----------------------------- diagnostics -----------------------------
+
+def action_perf_events(ctx: Context, raw: bool = False) -> None:
+    from batch_shipyard_tpu.agent import perf
+    events = [{"t": e["timestamp"], "node": e["node_id"],
+               "source": e["source"], "event": e["event"]}
+              for e in perf.query(ctx.store, ctx.pool.id)]
+    _emit({"events": events}, raw)

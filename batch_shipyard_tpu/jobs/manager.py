@@ -62,6 +62,7 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "output_data": list(task.output_data),
         "resource_files": list(task.resource_files),
         "job_preparation_command": job.job_preparation_command,
+        "job_input_data": list(job.input_data),
         "exit_options": dict(task.default_exit_options),
     }
     if task.multi_instance is not None:

@@ -1,0 +1,247 @@
+"""Scenario-based pool autoscale.
+
+Reference analog: convoy/autoscale.py — generates Azure Batch autoscale
+*formula text* from scenario names (_AUTOSCALE_SCENARIOS :351:
+active_tasks, pending_tasks, workday, workday_with_offpeak_max_low_
+priority, weekday, weekend) with knobs for min/max/max-increment,
+bias_last_sample, rebalance_preemption_percentage (:92-300).
+
+TPU-native re-design: there is no hosted formula evaluator, so this
+module IS the evaluator — `evaluate` samples live task/node state from
+the state store and produces a target slice count; `autoscale_tick`
+applies it through the substrate. The same scenario names and knobs are
+honored. A user `formula` is a restricted Python expression evaluated
+over the sampled variables (the power-user escape hatch the reference
+gives via raw formulas).
+
+TPU quantization: targets are rounded to whole pod slices (a v5e-16
+cannot grow by one VM), the slice-atomicity constraint from SURVEY.md
+section 7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import math
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import (
+    AutoscaleScenarioSettings, PoolSettings)
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Samples:
+    """The $ActiveTasks/$PendingTasks/$CurrentDedicated analog."""
+
+    active_tasks: int       # running + assigned
+    pending_tasks: int      # pending (incl. waiting deps)
+    current_nodes: int
+    task_slots_per_node: int
+    now: datetime.datetime
+
+
+def sample(store: StateStore, pool: PoolSettings,
+           now: Optional[datetime.datetime] = None) -> Samples:
+    active = 0
+    pending = 0
+    for job in store.query_entities(names.TABLE_JOBS,
+                                    partition_key=pool.id):
+        if job.get("state") != "active":
+            continue
+        pk = names.task_pk(pool.id, job["_rk"])
+        for task in store.query_entities(names.TABLE_TASKS,
+                                         partition_key=pk):
+            state = task.get("state")
+            if state in ("running", "assigned"):
+                active += 1
+            elif state == "pending":
+                pending += 1
+    nodes = [n for n in pool_mgr.list_nodes(store, pool.id)
+             if n.state in pool_mgr.READY_STATES]
+    return Samples(
+        active_tasks=active, pending_tasks=pending,
+        current_nodes=len(nodes),
+        task_slots_per_node=pool.task_slots_per_node,
+        now=now or util.utcnow())
+
+
+def _clamp(value: int, scenario: AutoscaleScenarioSettings,
+           current: int) -> int:
+    lo = scenario.minimum_vm_count_dedicated
+    hi = scenario.maximum_vm_count_dedicated
+    value = max(lo, min(hi, value))
+    inc = scenario.maximum_vm_increment_dedicated
+    if inc > 0 and value > current:
+        value = min(value, current + inc)
+    return value
+
+
+def _in_time_range(now: datetime.datetime, scenario_name: str,
+                   time_ranges: dict) -> bool:
+    """Work-hours check for the workday/weekday scenarios. Defaults
+    mirror the reference: Mon-Fri, 08:00-18:00 (autoscale.py:211+)."""
+    work_days = time_ranges.get("weekdays", {"start": 0, "end": 4})
+    work_hours = time_ranges.get("work_hours", {"start": 8, "end": 17})
+    is_work_day = work_days["start"] <= now.weekday() <= work_days["end"]
+    is_work_hour = (work_hours["start"] <= now.hour
+                    <= work_hours["end"])
+    if scenario_name == "weekend":
+        return not is_work_day
+    if scenario_name == "weekday":
+        return is_work_day
+    return is_work_day and is_work_hour
+
+
+def evaluate(store: StateStore, pool: PoolSettings,
+             now: Optional[datetime.datetime] = None) -> dict:
+    """Compute the autoscale decision for a pool. Returns
+    {target_nodes, target_slices, reason} without applying it."""
+    autoscale = pool.autoscale
+    samples = sample(store, pool, now)
+    if autoscale.formula:
+        target = _eval_formula(autoscale.formula, samples)
+        reason = "user formula"
+    else:
+        scenario = autoscale.scenario
+        if scenario is None:
+            return {"target_nodes": samples.current_nodes,
+                    "target_slices": None,
+                    "reason": "no scenario configured"}
+        name = scenario.name
+        if name in ("active_tasks", "pending_tasks"):
+            backlog = (samples.active_tasks if name == "active_tasks"
+                       else samples.active_tasks + samples.pending_tasks)
+            needed = math.ceil(backlog / max(
+                1, samples.task_slots_per_node))
+            if scenario.bias_last_sample:
+                # Weight current demand 2:1 over capacity inertia.
+                needed = math.ceil(
+                    (2 * needed + samples.current_nodes) / 3)
+            target = _clamp(needed, scenario, samples.current_nodes)
+            reason = (f"{name}: backlog={backlog} "
+                      f"slots/node={samples.task_slots_per_node}")
+        elif name in ("workday", "weekday", "weekend",
+                      "workday_with_offpeak_max_low_priority"):
+            in_range = _in_time_range(samples.now, name,
+                                      scenario.time_ranges)
+            if in_range:
+                dedicated = scenario.maximum_vm_count_dedicated
+                low_priority = scenario.minimum_vm_count_low_priority
+            elif name == "workday_with_offpeak_max_low_priority":
+                # Off-peak: dedicated drops to minimum while cheap
+                # low-priority capacity rises to its maximum
+                # (reference offpeak semantics, autoscale.py:211+).
+                dedicated = scenario.minimum_vm_count_dedicated
+                low_priority = scenario.maximum_vm_count_low_priority
+            else:
+                dedicated = scenario.minimum_vm_count_dedicated
+                low_priority = scenario.minimum_vm_count_low_priority
+            target = _clamp(dedicated, scenario,
+                            samples.current_nodes) + low_priority
+            reason = f"{name}: in_range={in_range} at {samples.now}"
+        else:
+            raise ValueError(f"unknown autoscale scenario {name!r}")
+    target_slices = None
+    if pool.tpu is not None:
+        per_slice = pool.tpu.workers_per_slice
+        target_slices = max(
+            0 if target == 0 else 1,
+            math.ceil(target / per_slice))
+        target = target_slices * per_slice
+    return {"target_nodes": target, "target_slices": target_slices,
+            "current_nodes": samples.current_nodes,
+            "active_tasks": samples.active_tasks,
+            "pending_tasks": samples.pending_tasks,
+            "reason": reason}
+
+
+_FORMULA_BUILTINS = {"min": min, "max": max, "ceil": math.ceil,
+                     "floor": math.floor, "abs": abs, "round": round}
+
+
+def _eval_formula(formula: str, samples: Samples) -> int:
+    """Evaluate a user formula over sampled variables with no builtins
+    beyond a safe math subset."""
+    variables = {
+        "active_tasks": samples.active_tasks,
+        "pending_tasks": samples.pending_tasks,
+        "current_nodes": samples.current_nodes,
+        "task_slots_per_node": samples.task_slots_per_node,
+        "hour": samples.now.hour,
+        "weekday": samples.now.weekday(),
+    }
+    try:
+        result = eval(  # noqa: S307 - restricted namespace
+            formula, {"__builtins__": {}},
+            {**_FORMULA_BUILTINS, **variables})
+    except Exception as exc:
+        raise ValueError(f"autoscale formula error: {exc}") from exc
+    if not isinstance(result, (int, float)):
+        raise ValueError("autoscale formula must yield a number")
+    return int(result)
+
+
+def enable_autoscale(store: StateStore, pool: PoolSettings) -> None:
+    store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                       {"autoscale_enabled": True})
+
+
+def disable_autoscale(store: StateStore, pool: PoolSettings) -> None:
+    store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                       {"autoscale_enabled": False})
+
+
+def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
+                   now: Optional[datetime.datetime] = None) -> dict:
+    """One evaluation + application cycle (the hosted evaluator loop the
+    reference delegates to Azure Batch, batch.py:1636-1755)."""
+    entity = pool_mgr.get_pool(store, pool.id)
+    decision = evaluate(store, pool, now)
+    if not entity.get("autoscale_enabled"):
+        decision["applied"] = False
+        return decision
+    if decision["target_slices"] is not None:
+        current_slices = len({
+            n.slice_index for n in pool_mgr.list_nodes(store, pool.id)})
+        if decision["target_slices"] != current_slices:
+            logger.info("autoscale: %s slices %d -> %d (%s)", pool.id,
+                        current_slices, decision["target_slices"],
+                        decision["reason"])
+            substrate.resize_pool(pool, decision["target_slices"])
+            decision["applied"] = True
+            return decision
+    else:
+        # Non-TPU pools: resize takes a node count.
+        current = len(pool_mgr.list_nodes(store, pool.id))
+        if decision["target_nodes"] != current:
+            logger.info("autoscale: %s nodes %d -> %d (%s)", pool.id,
+                        current, decision["target_nodes"],
+                        decision["reason"])
+            substrate.resize_pool(pool, decision["target_nodes"])
+            decision["applied"] = True
+            return decision
+    decision["applied"] = False
+    return decision
+
+
+def run_daemon(store: StateStore, substrate, pool: PoolSettings,
+               stop_event=None, interval: Optional[float] = None) -> None:
+    """Periodic evaluation loop honoring
+    autoscale.evaluation_interval_seconds (the hosted evaluator's
+    cadence)."""
+    import threading
+    import time as time_mod
+    stop = stop_event or threading.Event()
+    period = interval or pool.autoscale.evaluation_interval_seconds
+    while not stop.wait(period):
+        try:
+            autoscale_tick(store, substrate, pool)
+        except Exception:
+            logger.exception("autoscale tick failed for %s", pool.id)

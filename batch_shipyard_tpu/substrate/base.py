@@ -61,6 +61,14 @@ class ComputeSubstrate(abc.ABC):
                          node_id: str) -> Optional[tuple[str, int]]:
         """(ip, ssh port) for a node, if reachable."""
 
+    def ensure_attached(self, pool: PoolSettings) -> None:
+        """Re-attach to an existing pool from a fresh process.
+
+        Real substrates are no-ops (nodes are independent machines);
+        the in-process fake substrate revives its simulated agents so
+        CLI invocations in separate processes keep working.
+        """
+
 
 def create_substrate(kind: str, store: StateStore,
                      credentials: CredentialsSettings,

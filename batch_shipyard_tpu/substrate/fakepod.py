@@ -134,6 +134,11 @@ class FakePodSubstrate(base.ComputeSubstrate):
             self.store.delete_entity(names.TABLE_NODES, pool_id, row["_rk"])
 
     def resize_pool(self, pool: PoolSettings, num_slices: int) -> None:
+        """TPU pools: num_slices is a slice count (slice-atomic);
+        non-TPU pools: num_slices is a node count."""
+        if pool.tpu is None:
+            self._resize_nodes(pool, num_slices)
+            return
         current = sorted({
             int(row["slice_index"]) for row in self.store.query_entities(
                 names.TABLE_NODES, partition_key=pool.id)})
@@ -148,6 +153,26 @@ class FakePodSubstrate(base.ComputeSubstrate):
         elif num_slices < have:
             for s in current[num_slices:]:
                 self._teardown_slice(pool.id, s)
+
+    def _resize_nodes(self, pool: PoolSettings, num_nodes: int) -> None:
+        rows = sorted(self.store.query_entities(
+            names.TABLE_NODES, partition_key=pool.id),
+            key=lambda r: int(r.get("node_index", 0)))
+        have = len(rows)
+        if num_nodes > have:
+            for idx in range(have, num_nodes):
+                self._spawn_agent(pool, 0, idx, idx)
+        elif num_nodes < have:
+            for row in rows[num_nodes:]:
+                node_id = row["_rk"]
+                with self._lock:
+                    agent = self._agents.get(pool.id, {}).pop(
+                        node_id, None)
+                if agent is not None:
+                    agent.stop()
+                    agent.join(timeout=5.0)
+                self.store.delete_entity(
+                    names.TABLE_NODES, pool.id, node_id)
 
     def _teardown_slice(self, pool_id: str, slice_index: int) -> None:
         with self._lock:
@@ -169,6 +194,20 @@ class FakePodSubstrate(base.ComputeSubstrate):
         for w in range(workers):
             self._spawn_agent(pool, slice_index, w,
                               slice_index * workers + w)
+
+    def ensure_attached(self, pool: PoolSettings) -> None:
+        """Revive simulated agents for node entities that have no live
+        in-process agent (fresh CLI process attaching to a fake pool)."""
+        rows = list(self.store.query_entities(
+            names.TABLE_NODES, partition_key=pool.id))
+        with self._lock:
+            live = set(self._agents.get(pool.id, {}))
+        for row in rows:
+            if row["_rk"] in live:
+                continue
+            self._spawn_agent(pool, int(row.get("slice_index", 0)),
+                              int(row.get("worker_index", 0)),
+                              int(row.get("node_index", 0)))
 
     def get_remote_login(self, pool_id: str,
                          node_id: str) -> Optional[tuple[str, int]]:

@@ -1,0 +1,144 @@
+"""Autoscale scenario evaluator tests (reference scenarios,
+autoscale.py:351)."""
+
+import datetime
+import json
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.pool import autoscale
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+
+def make_pool(scenario=None, formula=None, slices=1):
+    spec = {"pool_specification": {
+        "id": "ap", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16",
+                "num_slices": slices},
+        "task_slots_per_node": 2,
+        "autoscale": {"enabled": True},
+    }}
+    if scenario:
+        spec["pool_specification"]["autoscale"]["scenario"] = scenario
+    if formula:
+        spec["pool_specification"]["autoscale"]["formula"] = formula
+    return settings_mod.pool_settings(spec)
+
+
+def seed_tasks(store, pool_id, pending=0, running=0):
+    store.insert_entity(names.TABLE_JOBS, pool_id, "j",
+                        {"state": "active", "spec": {}})
+    pk = names.task_pk(pool_id, "j")
+    for idx in range(pending):
+        store.insert_entity(names.TABLE_TASKS, pk, f"p{idx}",
+                            {"state": "pending", "spec": {}})
+    for idx in range(running):
+        store.insert_entity(names.TABLE_TASKS, pk, f"r{idx}",
+                            {"state": "running", "spec": {}})
+
+
+def seed_nodes(store, pool_id, count, per_slice=4):
+    for idx in range(count):
+        store.upsert_entity(names.TABLE_NODES, pool_id, f"n{idx}", {
+            "state": "idle", "node_index": idx,
+            "slice_index": idx // per_slice, "worker_index":
+                idx % per_slice, "heartbeat_at": 1e18,
+            "hostname": f"n{idx}", "internal_ip": "10.0.0.1"})
+
+
+def test_pending_tasks_scale_up_quantized_to_slices():
+    store = MemoryStateStore()
+    pool = make_pool(scenario={
+        "name": "pending_tasks",
+        "maximum_vm_count": {"dedicated": 16},
+        "bias_last_sample": False})
+    seed_nodes(store, "ap", 4)
+    seed_tasks(store, "ap", pending=20)  # 20 tasks / 2 slots = 10 nodes
+    decision = autoscale.evaluate(store, pool)
+    assert decision["target_slices"] == 3  # ceil(10/4) slices
+    assert decision["target_nodes"] == 12
+
+
+def test_active_tasks_scale_down_to_minimum():
+    store = MemoryStateStore()
+    pool = make_pool(scenario={
+        "name": "active_tasks",
+        "minimum_vm_count": {"dedicated": 4},
+        "maximum_vm_count": {"dedicated": 16},
+        "bias_last_sample": False})
+    seed_nodes(store, "ap", 8)
+    decision = autoscale.evaluate(store, pool)  # no tasks at all
+    assert decision["target_nodes"] == 4
+
+
+def test_max_increment_limits_growth():
+    store = MemoryStateStore()
+    pool = make_pool(scenario={
+        "name": "pending_tasks",
+        "maximum_vm_count": {"dedicated": 64},
+        "maximum_vm_increment_per_evaluation": {"dedicated": 4},
+        "bias_last_sample": False})
+    seed_nodes(store, "ap", 4)
+    seed_tasks(store, "ap", pending=100)
+    decision = autoscale.evaluate(store, pool)
+    assert decision["target_nodes"] == 8  # 4 current + 4 increment
+
+
+def test_workday_scenario():
+    store = MemoryStateStore()
+    pool = make_pool(scenario={
+        "name": "workday",
+        "minimum_vm_count": {"dedicated": 0},
+        "maximum_vm_count": {"dedicated": 8}})
+    monday_noon = datetime.datetime(2026, 7, 27, 12, 0)
+    sunday = datetime.datetime(2026, 7, 26, 12, 0)
+    assert autoscale.evaluate(
+        store, pool, now=monday_noon)["target_nodes"] == 8
+    assert autoscale.evaluate(
+        store, pool, now=sunday)["target_nodes"] == 0
+
+
+def test_user_formula():
+    store = MemoryStateStore()
+    pool = make_pool(formula="min(16, pending_tasks * 2)")
+    seed_tasks(store, "ap", pending=3)
+    decision = autoscale.evaluate(store, pool)
+    assert decision["target_nodes"] == 8  # ceil(6/4)*4 slice-quantized
+
+
+def test_formula_rejects_unsafe():
+    store = MemoryStateStore()
+    pool = make_pool(formula="__import__('os').system('true')")
+    with pytest.raises(ValueError):
+        autoscale.evaluate(store, pool)
+
+
+def test_autoscale_tick_applies_via_substrate():
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    from batch_shipyard_tpu.config import settings as S
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    conf = {"pool_specification": {
+        "id": "ap", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16"},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 30,
+        "autoscale": {"enabled": True, "scenario": {
+            "name": "pending_tasks",
+            "maximum_vm_count": {"dedicated": 8},
+            "bias_last_sample": False}},
+    }}
+    pool = S.pool_settings(conf)
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             S.global_settings({}), conf)
+        autoscale.enable_autoscale(store, pool)
+        seed_tasks(store, "ap", pending=8)
+        decision = autoscale.autoscale_tick(store, substrate, pool)
+        assert decision["applied"]
+        assert len(pool_mgr.list_nodes(store, "ap")) == 8
+    finally:
+        substrate.stop_all()

@@ -1,0 +1,102 @@
+"""CLI + fleet end-to-end: the full `pool add` -> `jobs add --tail`
+flow through the click entrypoint on a fake pool (the reference's
+minimum end-to-end slice, SURVEY.md section 7 step 3)."""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from batch_shipyard_tpu import fleet
+from batch_shipyard_tpu.cli.main import cli
+from batch_shipyard_tpu.state import factory as state_factory
+
+
+@pytest.fixture()
+def configdir(tmp_path):
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "config": {"global_resources": {"docker_images": []}},
+        "pool": {"pool_specification": {
+            "id": "clipool", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-8"},
+            "max_wait_time_seconds": 30}},
+        "jobs": {"job_specifications": [{
+            "id": "clijob",
+            "tasks": [{"command": "echo cli-works"}]}]},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    return str(tmp_path)
+
+
+def test_cli_help():
+    result = CliRunner().invoke(cli, ["--help"])
+    assert result.exit_code == 0
+    for group in ("pool", "jobs", "data", "diag"):
+        assert group in result.output
+
+
+def test_cli_pool_jobs_flow(configdir):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "pool", "add"],
+        catch_exceptions=False)
+    assert result.exit_code == 0
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "--raw", "pool", "list"],
+        catch_exceptions=False)
+    assert result.exit_code == 0
+    assert json.loads(result.output)["pools"][0]["id"] == "clipool"
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "jobs", "add",
+              "--tail", "stdout.txt"], catch_exceptions=False)
+    assert result.exit_code == 0
+    assert "cli-works" in result.output
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "--raw", "jobs", "tasks",
+              "list", "clijob"], catch_exceptions=False)
+    tasks = json.loads(result.output)["tasks"]
+    assert tasks[0]["state"] == "completed"
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "--raw", "pool", "stats"],
+        catch_exceptions=False)
+    stats = json.loads(result.output)
+    assert stats["tasks"]["completed"] == 1
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "--raw", "diag", "perf"],
+        catch_exceptions=False)
+    events = json.loads(result.output)["events"]
+    assert any(e["event"] == "create.end" for e in events)
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "data", "stream", "clijob",
+              "task-00000"], catch_exceptions=False)
+    assert "cli-works" in result.output
+
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "pool", "del", "-y"],
+        catch_exceptions=False)
+    assert result.exit_code == 0
+
+
+def test_cli_rejects_bad_config(tmp_path):
+    with open(tmp_path / "pool.yaml", "w") as fh:
+        yaml.safe_dump({"pool_specification": {"id": "x",
+                                               "bogus": True}}, fh)
+    with open(tmp_path / "credentials.yaml", "w") as fh:
+        yaml.safe_dump({"credentials": {
+            "storage": {"backend": "memory"}}}, fh)
+    result = CliRunner().invoke(
+        cli, ["--configdir", str(tmp_path), "pool", "add"])
+    assert result.exit_code != 0
+    assert "bogus" in str(result.exception or result.output)
