@@ -539,6 +539,12 @@ class NodeAgent:
                 instances=num_instances,
                 host_list=tuple(m.internal_ip for m in gang_members),
                 extra_env=gang_env)
+            try:
+                self._stage_inputs(spec, execution)
+            except Exception as exc:
+                logger.exception("gang input staging failed for %s/%s",
+                                 job_id, task_id)
+                jp_ok = False
             with self._running_lock:
                 self._running_tasks += 1
             try:
@@ -567,6 +573,13 @@ class NodeAgent:
             {"state": "done", "exit_code": result.exit_code})
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
+        try:
+            self._collect_outputs(spec, execution, job_id, task_id)
+        except Exception as exc:
+            logger.exception("gang output collection failed for %s/%s",
+                             job_id, task_id)
+            self._merge_task(job_id, task_id,
+                             {"output_error": str(exc)})
         self.store.delete_message(msg)
         self._gang_finalize(job_id, task_id, num_instances)
         self._maybe_autocomplete_job(job_id)
@@ -729,9 +742,12 @@ class NodeAgent:
         if not output_data:
             return
         from batch_shipyard_tpu.data import movement
+        exclude = movement.staged_input_rels(
+            self.store, spec.get("input_data") or [])
         movement.collect_task_outputs(
             self.store, output_data, execution.task_dir,
-            self.identity.pool_id, job_id, task_id)
+            self.identity.pool_id, job_id, task_id,
+            exclude_rels=exclude)
 
     def _ensure_images(self, spec: dict) -> None:
         if self._image_provisioner is None:

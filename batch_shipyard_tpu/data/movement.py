@@ -70,12 +70,23 @@ def ingress_to_storage(store: StateStore, source: str, dest_prefix: str,
     return count
 
 
+def _prefix_children(store: StateStore, prefix: str) -> list[str]:
+    """Keys strictly under prefix treated as a directory (never keys
+    that merely share a string prefix, e.g. 'v10' under 'v1')."""
+    base = prefix.rstrip("/")
+    return [k for k in store.list_objects(base)
+            if k == base or k.startswith(base + "/")]
+
+
 def egress_from_storage(store: StateStore, prefix: str,
                         dest_dir: str) -> int:
     """Download an object-prefix tree into a local directory."""
     count = 0
-    for key in store.list_objects(prefix):
-        rel = key[len(prefix):].lstrip("/")
+    base = prefix.rstrip("/")
+    for key in _prefix_children(store, base):
+        rel = key[len(base):].lstrip("/")
+        if not rel:
+            rel = os.path.basename(base)
         path = os.path.join(dest_dir, rel)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "wb") as fh:
@@ -189,11 +200,12 @@ def stage_task_inputs(store: StateStore, input_data: list[dict],
                 data = store.get_object(key)
             except NotFoundError:
                 # Prefix fetch: key may name a directory-like prefix.
-                sub = store.list_objects(key)
+                base = key.rstrip("/")
+                sub = _prefix_children(store, base)
                 if not sub:
                     raise
                 for skey in sub:
-                    srel = skey[len(key):].lstrip("/")
+                    srel = skey[len(base):].lstrip("/")
                     spath = os.path.join(dest, srel)
                     os.makedirs(os.path.dirname(spath) or ".",
                                 exist_ok=True)
@@ -210,10 +222,13 @@ def stage_task_inputs(store: StateStore, input_data: list[dict],
 
 def collect_task_outputs(store: StateStore, output_data: list[dict],
                          task_dir: str, pool_id: str, job_id: str,
-                         task_id: str) -> int:
+                         task_id: str,
+                         exclude_rels: Optional[set[str]] = None) -> int:
     """Upload output_data globs after execution (process_output_data
-    analog, data.py:447). Returns uploaded count."""
+    analog, data.py:447). exclude_rels: relative paths staged as
+    inputs, which must not be re-uploaded as outputs. Returns count."""
     count = 0
+    exclude_rels = exclude_rels or set()
     for spec in output_data:
         pattern = spec.get("include")
         prefix = spec.get("prefix") or names.task_output_key(
@@ -222,7 +237,9 @@ def collect_task_outputs(store: StateStore, output_data: list[dict],
             for name in files:
                 path = os.path.join(root, name)
                 rel = os.path.relpath(path, task_dir)
-                if rel.startswith(("stdout.txt", "stderr.txt")):
+                if rel in ("stdout.txt", "stderr.txt"):
+                    continue
+                if rel in exclude_rels:
                     continue
                 # fnmatch has no '**' semantics: treat missing/match-all
                 # patterns explicitly, else match rel then basename.
@@ -234,3 +251,23 @@ def collect_task_outputs(store: StateStore, output_data: list[dict],
                     store.put_object(f"{prefix}/{rel}", fh.read())
                 count += 1
     return count
+
+
+def staged_input_rels(store: StateStore,
+                      input_data: list[dict]) -> set[str]:
+    """Relative paths that stage_task_inputs materializes, for output
+    exclusion."""
+    rels: set[str] = set()
+    for spec in input_data:
+        if spec.get("kind", "statestore") != "statestore":
+            continue
+        key = spec["key"]
+        rel = spec.get("file_path") or key.rsplit("/", 1)[-1]
+        if store.object_exists(key):
+            rels.add(rel)
+        else:
+            base = key.rstrip("/")
+            for skey in _prefix_children(store, base):
+                srel = skey[len(base):].lstrip("/")
+                rels.add(os.path.join(rel, srel) if srel else rel)
+    return rels

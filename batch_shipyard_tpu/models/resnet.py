@@ -1,0 +1,97 @@
+"""ResNet-50 in Flax: the BASELINE.md headline workload
+(TensorFlow-Distributed recipe's ResNet-50/ImageNet, re-built
+TPU-first).
+
+TPU-first choices: bfloat16 convs/matmuls (MXU), float32 batch-norm
+statistics, NHWC layout (XLA TPU's native conv layout), and a
+fuse-friendly residual structure (XLA fuses the BN+ReLU chains into
+the conv epilogues).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, scale_init=nn.initializers.zeros,
+                         name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides),
+                use_bias=False, dtype=self.dtype, name="proj_conv")(x)
+            residual = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9,
+                dtype=self.dtype, name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        """images: [B, H, W, 3] -> logits [B, num_classes]."""
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=cfg.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=cfg.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    cfg.width * (2 ** stage), strides, cfg.dtype,
+                    name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                          name="classifier")(x)
+        return logits
+
+
+def resnet50(num_classes: int = 1000,
+             dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(ResNetConfig(num_classes=num_classes, dtype=dtype))
+
+
+def cross_entropy_loss(logits, labels):
+    logprobs = nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jnp.eye(logits.shape[-1], dtype=jnp.float32)[labels]
+    return -jnp.mean(jnp.sum(onehot * logprobs, axis=-1))

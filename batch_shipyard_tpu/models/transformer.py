@@ -1,0 +1,166 @@
+"""Decoder-only transformer LM: the framework's flagship distributed
+workload (the TensorFlow-Distributed/ResNet recipe analog for the
+long-context era).
+
+TPU-first design decisions:
+  - bfloat16 activations/params with float32 RMSNorm statistics and
+    attention accumulation (MXU-friendly, HBM-light);
+  - attention is pluggable via config.attention_fn so the same module
+    runs single-chip flash (Pallas), blockwise (XLA scan), or ring
+    attention over the sp mesh axis (ops/ring_attention.py);
+  - rotary position embeddings computed from *global* positions so
+    sequence-parallel shards agree;
+  - SwiGLU MLP sized to keep matmuls MXU-tiled (multiples of 128);
+  - optional per-layer remat (jax.checkpoint) to trade FLOPs for HBM.
+
+Tensor-parallel sharding is applied from outside via parameter
+PartitionSpec rules (parallel/sharding.py) — the module itself stays
+sharding-agnostic, which is what lets XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from batch_shipyard_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 1408          # SwiGLU hidden (multiple of 128)
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_fn: Optional[Callable] = None  # (q,k,v,causal)->out
+    rope_theta: float = 10000.0
+
+
+def rotary_embedding(x, positions, theta: float):
+    """Apply RoPE. x: [B, T, H, D]; positions: [T] global positions."""
+    depth = x.shape[-1]
+    freqs = jnp.exp(
+        -jnp.log(theta) *
+        jnp.arange(0, depth, 2, dtype=jnp.float32) / depth)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        norm = jnp.asarray(x, jnp.float32)
+        norm = norm * jax.lax.rsqrt(
+            jnp.mean(norm * norm, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        features = cfg.n_heads * cfg.d_head
+        dense = functools_partial_dense(cfg)
+        q = dense(features, "q_proj")(x)
+        k = dense(features, "k_proj")(x)
+        v = dense(features, "v_proj")(x)
+        batch, seq = x.shape[0], x.shape[1]
+        q = q.reshape(batch, seq, cfg.n_heads, cfg.d_head)
+        k = k.reshape(batch, seq, cfg.n_heads, cfg.d_head)
+        v = v.reshape(batch, seq, cfg.n_heads, cfg.d_head)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        attention_fn = cfg.attention_fn or (
+            lambda q_, k_, v_, causal: attn_ops.attention(
+                q_, k_, v_, causal=causal))
+        out = attention_fn(q, k, v, causal=True)
+        out = out.reshape(batch, seq, features)
+        return dense(cfg.d_model, "o_proj")(out)
+
+
+def functools_partial_dense(cfg: TransformerConfig):
+    def make(features: int, name: str):
+        return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name=name)
+    return make
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = functools_partial_dense(cfg)
+        gate = dense(cfg.d_ff, "gate_proj")(x)
+        up = dense(cfg.d_ff, "up_proj")(x)
+        return dense(cfg.d_model, "down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions)
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens: [B, T] int32 -> logits [B, T, vocab]."""
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="embed")
+        x = embed(tokens)
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for idx in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{idx}")(x, positions)
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        # Tied output projection via attend (embedding transpose).
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+def lm_loss(logits, targets, ignore_id: int = -1):
+    """Causal LM cross-entropy (next-token prediction is the caller's
+    responsibility via target shifting)."""
+    vocab = logits.shape[-1]
+    mask = (targets != ignore_id)
+    onehot = jax.nn.one_hot(targets, vocab, dtype=logits.dtype)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(onehot * logprobs, axis=-1)
+    return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1)

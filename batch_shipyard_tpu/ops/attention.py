@@ -1,0 +1,261 @@
+"""Attention kernels: reference, blockwise (memory-efficient), and a
+Pallas flash-attention forward for the TPU MXU.
+
+Layout convention throughout: q/k/v are [batch, seq, heads, head_dim]
+(bfloat16 on TPU; accumulation in float32).
+
+  - ``mha_reference``: O(T^2) materialized-scores attention, the
+    correctness oracle.
+  - ``blockwise_mha``: lax.scan over KV blocks with online softmax —
+    O(T) memory, fully differentiable (the building block ring
+    attention runs per step). This is the XLA-friendly formulation:
+    static shapes, no data-dependent control flow.
+  - ``flash_attention``: Pallas TPU kernel for the forward pass (grid
+    over batch*heads x q-blocks, KV streamed through VMEM); backward
+    falls back to the blockwise formulation via custom_vjp, keeping
+    training end-to-end differentiable while the hot inference path
+    uses the hand kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(q_positions, k_positions):
+    """[Tq, Tk] True where attention is allowed (k <= q)."""
+    return q_positions[:, None] >= k_positions[None, :]
+
+
+def mha_reference(q, k, v, causal: bool = True,
+                  q_offset: int = 0, kv_offset: int = 0):
+    """Plain attention; the numerics oracle for the fast paths."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(depth)
+    if causal:
+        q_pos = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[1], 1), 0)[:, 0]
+        k_pos = kv_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (k.shape[1], 1), 0)[:, 0]
+        mask = _causal_mask(q_pos, k_pos)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ----------------------- online-softmax accumulation -------------------
+
+def attention_block_update(q, k_blk, v_blk, o, m, l, *, causal: bool,
+                           q_offset, kv_offset, scale: float):
+    """One online-softmax accumulation step against a KV block.
+
+    q: [B, Tq, H, D]; k_blk/v_blk: [B, Tk, H, D]
+    o: [B, Tq, H, D] float32 numerator
+    m: [B, H, Tq] running max; l: [B, H, Tq] running denominator.
+    q_offset/kv_offset: global positions (ints or traced scalars).
+    """
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[1], 1), 0)[:, 0]
+        k_pos = kv_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (k_blk.shape[1], 1), 0)[:, 0]
+        mask = _causal_mask(q_pos, k_pos)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # exp with stable max; rows with no valid keys stay at -inf max and
+    # contribute nothing (exp(-inf - -inf) handled via where).
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                    preferred_element_type=jnp.float32)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def attention_init(q):
+    batch, t_q, heads, depth = q.shape
+    o = jnp.zeros((batch, t_q, heads, depth), dtype=jnp.float32)
+    m = jnp.full((batch, heads, t_q), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((batch, heads, t_q), dtype=jnp.float32)
+    return o, m, l
+
+
+def attention_finalize(q, o, m, l):
+    denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def blockwise_mha(q, k, v, causal: bool = True, block_size: int = 512,
+                  q_offset: int = 0, kv_offset: int = 0):
+    """Memory-efficient attention: scan KV blocks with online softmax."""
+    batch, t_kv = k.shape[0], k.shape[1]
+    block_size = min(block_size, t_kv)
+    if t_kv % block_size:
+        raise ValueError(
+            f"kv length {t_kv} not divisible by block {block_size}")
+    num_blocks = t_kv // block_size
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    k_blocks = k.reshape(batch, num_blocks, block_size, *k.shape[2:])
+    v_blocks = v.reshape(batch, num_blocks, block_size, *v.shape[2:])
+
+    def step(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, blk_idx = blk
+        o, m, l = attention_block_update(
+            q, k_blk, v_blk, o, m, l, causal=causal,
+            q_offset=q_offset,
+            kv_offset=kv_offset + blk_idx * block_size, scale=scale)
+        return (o, m, l), None
+
+    carry = attention_init(q)
+    (o, m, l), _ = jax.lax.scan(
+        step, carry,
+        (k_blocks.transpose(1, 0, 2, 3, 4),
+         v_blocks.transpose(1, 0, 2, 3, 4),
+         jnp.arange(num_blocks)))
+    return attention_finalize(q, o, m, l)
+
+
+# --------------------------- pallas forward ----------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float, q_block: int):
+    """One (batch*head, q-block) program: stream KV blocks via the
+    grid-blocked refs and accumulate with online softmax in VMEM."""
+    qi = pl.program_id(1)
+    q_tile = q_ref[...].astype(jnp.float32)  # [q_block, D]
+    t_kv = k_ref.shape[0]
+    num_kb = t_kv // block_k
+
+    def body(kb, carry):
+        o, m, l = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        scores = jax.lax.dot_general(
+            q_tile, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [qb, kb]
+        if causal:
+            q_pos = (qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, block_k), 0))
+            k_pos = (kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, block_k), 1))
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * correction[:, None] + pv
+        return o_new, m_new, l_new
+
+    o = jnp.zeros((q_block, q_ref.shape[-1]), dtype=jnp.float32)
+    m = jnp.full((q_block,), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((q_block,), dtype=jnp.float32)
+    if causal:
+        # Only blocks up to (and including) the diagonal contribute.
+        upper = jnp.minimum(
+            num_kb, (qi + 1) * q_block // block_k + 1)
+    else:
+        upper = num_kb
+    o, m, l = jax.lax.fori_loop(0, upper, body, (o, m, l))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (o / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int):
+    batch, t_q, heads, depth = q.shape
+    t_kv = k.shape[1]
+    scale = 1.0 / math.sqrt(depth)
+    # Collapse batch/heads into the grid's first dimension.
+    q_r = q.transpose(0, 2, 1, 3).reshape(batch * heads, t_q, depth)
+    k_r = k.transpose(0, 2, 1, 3).reshape(batch * heads, t_kv, depth)
+    v_r = v.transpose(0, 2, 1, 3).reshape(batch * heads, t_kv, depth)
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    if t_q % block_q or t_kv % block_k:
+        raise ValueError(
+            f"flash attention requires seq lengths divisible by block "
+            f"sizes: t_q={t_q} block_q={block_q}, t_kv={t_kv} "
+            f"block_k={block_k}")
+    grid = (batch * heads, t_q // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k,
+                          causal=causal, scale=scale, q_block=block_q),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, t_q, depth),
+                                       q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, depth),
+                         lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, t_kv, depth), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, t_kv, depth), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, depth),
+                               lambda bh, qi: (bh, qi, 0)),
+    )(q_r, k_r, v_r)
+    return out.reshape(batch, heads, t_q, depth).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                    block_k: int = 512):
+    """Pallas forward; blockwise-recompute backward."""
+    return _flash_forward(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    return _flash_forward(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_mha(q_, k_, v_, causal=causal,
+                                         block_size=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def attention(q, k, v, causal: bool = True,
+              impl: Optional[str] = None, block_size: int = 512):
+    """Dispatch: 'flash' (pallas fwd), 'blockwise', or 'reference'.
+    Default: flash on TPU (falling back to blockwise for shapes the
+    kernel can't tile), blockwise elsewhere."""
+    if impl is None:
+        impl = ("flash" if jax.default_backend() == "tpu"
+                else "blockwise")
+        if impl == "flash" and (q.shape[1] % 256 or k.shape[1] % 512):
+            impl = "blockwise"
+            block_size = math.gcd(k.shape[1], block_size) or k.shape[1]
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    if impl == "blockwise":
+        return blockwise_mha(q, k, v, causal, block_size=block_size)
+    if impl == "reference":
+        return mha_reference(q, k, v, causal)
+    raise ValueError(f"unknown attention impl {impl!r}")

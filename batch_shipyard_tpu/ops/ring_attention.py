@@ -1,0 +1,84 @@
+"""Ring attention: exact attention over sequence shards via an ICI
+ppermute ring.
+
+Long-context mechanism (SURVEY.md section 5.7 net-new design space):
+the sequence is sharded over the ``sp`` mesh axis; each device holds
+its Q shard permanently and rotates KV shards around the ring,
+accumulating exact attention with the online-softmax update from
+ops/attention.py. After sp steps every Q position has attended to the
+full global sequence — memory per device stays O(T/sp), and the KV
+rotation (lax.ppermute, riding adjacent-neighbor ICI links) overlaps
+with the per-block attention compute under XLA's scheduler.
+
+Differentiable end-to-end (scan + ppermute have transposable rules),
+so the same code path serves training — this is how the framework runs
+contexts larger than one chip's HBM.
+
+Use under shard_map with q/k/v sharded as P(('dp','fsdp'), 'sp', None,
+None); models/transformer.py wires this automatically when the mesh
+has sp > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from batch_shipyard_tpu.ops import attention as attn_ops
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body (runs inside shard_map). q/k/v: [B, Tl, H, D]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        o, m, l, k_cur, v_cur = carry
+        # After t rotations we hold the KV shard originally on
+        # (my_idx - t) mod axis_size.
+        src = (my_idx - t) % axis_size
+        o, m, l = attn_ops.attention_block_update(
+            q, k_cur, v_cur, o, m, l, causal=causal,
+            q_offset=my_idx * t_local, kv_offset=src * t_local,
+            scale=scale)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o, m, l = attn_ops.attention_init(q)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(axis_size))
+    return attn_ops.attention_finalize(q, o, m, l)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True,
+                   batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+                   head_axis: str = "tp"):
+    """Global-view entry: q/k/v are [B, T, H, D] global arrays; returns
+    the exact attention output with T sharded over axis_name."""
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # The online-softmax carry is initialized from constants
+        # (attention_init zeros), which varying-manual-axes tracking
+        # would reject against the per-step varying update.
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention_inside_shard_map(q, k, v, axis_name: str = "sp",
+                                    causal: bool = True):
+    """For callers already inside a shard_map (e.g. a fully shard_mapped
+    train step): per-shard inputs, per-shard output."""
+    return _ring_attention_local(q, k, v, axis_name, causal)

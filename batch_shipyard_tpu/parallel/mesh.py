@@ -1,0 +1,66 @@
+"""Device mesh construction for dp/fsdp/sp/tp parallelism.
+
+This is the compute-side counterpart of the orchestrator's topology
+oracle: recipes ask for logical parallelism axes and this module maps
+them onto the physical device list (one pod slice's ICI torus, a
+multi-slice DCN super-mesh, or the virtual CPU devices used in tests).
+
+Axis convention (orderings chosen so the innermost, most
+communication-hungry axis lands on adjacent ICI neighbors):
+
+  dp    data parallel (gradient psum; outermost, cheapest)
+  fsdp  fully-sharded data parallel (param all-gather + reduce-scatter)
+  sp    sequence/context parallel (ring attention ppermute ring)
+  tp    tensor parallel (activation all-reduce; innermost)
+
+Reference analog: none — the reference has no compute path (SURVEY.md
+section 2.3); this is the net-new TPU-native design space.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+def make_mesh(axis_sizes: dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with the canonical axis order.
+
+    Missing axes get size 1; the product must equal the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = tuple(int(axis_sizes.get(a, 1)) for a in AXES)
+    total = math.prod(sizes)
+    if total != len(devices):
+        raise ValueError(
+            f"axis sizes {dict(zip(AXES, sizes))} produce {total} "
+            f"devices but {len(devices)} are available")
+    grid = np.array(devices, dtype=object).reshape(sizes)
+    return Mesh(grid, AXES)
+
+
+def auto_axis_sizes(n_devices: int, tp: int = 1, sp: int = 1,
+                    fsdp: int = 1) -> dict[str, int]:
+    """Fill dp with whatever remains after the requested inner axes."""
+    inner = tp * sp * fsdp
+    if n_devices % inner:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp*sp*fsdp={inner}")
+    return {"dp": n_devices // inner, "fsdp": fsdp, "sp": sp, "tp": tp}
+
+
+def batch_spec() -> P:
+    """Activation batch sharding: batch over dp+fsdp, sequence over
+    sp."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

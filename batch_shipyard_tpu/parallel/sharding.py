@@ -1,0 +1,72 @@
+"""Parameter/activation sharding rules: how models map onto the mesh.
+
+The scaling-book recipe: pick a mesh (parallel/mesh.py), annotate
+shardings (this module), let XLA insert the collectives. Rules are
+path-pattern based so the model code stays sharding-agnostic.
+
+Transformer (Megatron-style tensor parallel over 'tp', optional fsdp
+over 'fsdp'):
+  - q/k/v/gate/up projections: columns over tp  -> P(fsdp?, 'tp')
+  - o/down projections:        rows over tp     -> P('tp', fsdp?)
+  - embedding:                 vocab over tp    -> P('tp', fsdp?)
+  - norms/scales: replicated
+Activations: batch over (dp, fsdp), sequence over sp.
+
+ResNet: pure data parallel (convs don't tensor-parallelize profitably
+at this scale) — all params replicated, batch over every mesh axis.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_TRANSFORMER_RULES: list[tuple[str, P]] = [
+    (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$",
+     P("fsdp", "tp")),
+    (r".*(o_proj|down_proj)/kernel$", P("tp", "fsdp")),
+    (r".*embed/embedding$", P("tp", "fsdp")),
+    (r".*(scale|bias)$", P()),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for key in path:
+        if hasattr(key, "key"):
+            parts.append(str(key.key))
+        elif hasattr(key, "idx"):
+            parts.append(str(key.idx))
+        else:
+            parts.append(str(key))
+    return "/".join(parts)
+
+
+def transformer_param_specs(params) -> Any:
+    """PartitionSpec pytree for TransformerLM params."""
+    def rule(path, leaf):
+        path_s = _path_str(path)
+        for pattern, spec in _TRANSFORMER_RULES:
+            if re.match(pattern, path_s):
+                return spec
+        return P()
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def replicated_specs(params) -> Any:
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def to_shardings(mesh: Mesh, spec_tree) -> Any:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), spec_tree,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def place(mesh: Mesh, tree, spec_tree):
+    """Device-put a pytree according to a spec tree."""
+    shardings = to_shardings(mesh, spec_tree)
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,161 @@
+"""Distributed train-step builders: mesh + model -> jitted SPMD step.
+
+The compute-path capstone: these are what the -TPU recipes and the
+benchmark run. Everything is jit-compiled global-view SPMD — shardings
+annotated via in_shardings/with_sharding_constraint, collectives
+inserted by XLA, ring attention dropped in through the model's
+attention_fn when the mesh has an sp axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from batch_shipyard_tpu.models import resnet as resnet_mod
+from batch_shipyard_tpu.models import transformer as tfm
+from batch_shipyard_tpu.ops import ring_attention as ring
+from batch_shipyard_tpu.parallel import sharding as shard_rules
+
+
+@dataclasses.dataclass
+class TrainHarness:
+    """A compiled training setup: params/opt state live sharded on the
+    mesh; step(params, opt_state, batch) -> (params, opt_state,
+    metrics)."""
+
+    mesh: Mesh
+    params: Any
+    opt_state: Any
+    step: Callable
+    batch_sharding: Any
+
+
+def make_transformer_config(mesh: Optional[Mesh] = None,
+                            **overrides) -> tfm.TransformerConfig:
+    """Build a config whose attention_fn matches the mesh: ring
+    attention when sp > 1, flash/blockwise otherwise."""
+    attention_fn = overrides.pop("attention_fn", None)
+    if attention_fn is None and mesh is not None and \
+            mesh.shape.get("sp", 1) > 1:
+        def attention_fn(q, k, v, causal):
+            return ring.ring_attention(q, k, v, mesh, axis_name="sp",
+                                       causal=causal)
+    return tfm.TransformerConfig(attention_fn=attention_fn, **overrides)
+
+
+def build_transformer_train(
+        mesh: Mesh, config: tfm.TransformerConfig,
+        batch_size: int, seq_len: int,
+        learning_rate: float = 3e-4,
+        seed: int = 0) -> TrainHarness:
+    model = tfm.TransformerLM(config)
+    optimizer = optax.adamw(learning_rate, weight_decay=0.01)
+
+    tokens_shape = (batch_size, seq_len)
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+    def init_fn(rng):
+        tokens = jnp.zeros(tokens_shape, dtype=jnp.int32)
+        params = model.init(rng, tokens)["params"]
+        return params
+
+    rng = jax.random.PRNGKey(seed)
+    abstract = jax.eval_shape(init_fn, rng)
+    param_specs = shard_rules.transformer_param_specs(abstract)
+    param_shardings = shard_rules.to_shardings(mesh, param_specs)
+    params = jax.jit(init_fn, out_shardings=param_shardings)(rng)
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=None)(params)
+
+    def loss_fn(params, tokens, targets):
+        logits = model.apply({"params": params}, tokens)
+        return tfm.lm_loss(logits, targets)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=(param_shardings, None, batch_sharding,
+                      batch_sharding),
+        out_shardings=(param_shardings, None, None))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                  targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    def step_wrapper(params, opt_state, batch):
+        params, opt_state, metrics = step(
+            params, opt_state, batch["tokens"], batch["targets"])
+        return params, opt_state, metrics
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)
+
+
+def build_resnet_train(mesh: Mesh,
+                       config: Optional[resnet_mod.ResNetConfig] = None,
+                       batch_size: int = 256, image_size: int = 224,
+                       learning_rate: float = 0.1,
+                       seed: int = 0) -> TrainHarness:
+    """Data-parallel ResNet-50 training (the baseline workload)."""
+    config = config or resnet_mod.ResNetConfig()
+    model = resnet_mod.ResNet(config)
+    optimizer = optax.sgd(learning_rate, momentum=0.9, nesterov=True)
+    data_spec = P(("dp", "fsdp", "sp", "tp"))
+    batch_sharding = NamedSharding(mesh, data_spec)
+
+    def init_fn(rng):
+        images = jnp.zeros((batch_size, image_size, image_size, 3),
+                           dtype=jnp.float32)
+        variables = model.init(rng, images, train=True)
+        return variables["params"], variables["batch_stats"]
+
+    rng = jax.random.PRNGKey(seed)
+    abstract_params, abstract_stats = jax.eval_shape(init_fn, rng)
+    replicated = shard_rules.to_shardings(
+        mesh, shard_rules.replicated_specs(abstract_params))
+    stats_sharding = shard_rules.to_shardings(
+        mesh, shard_rules.replicated_specs(abstract_stats))
+    params, batch_stats = jax.jit(
+        init_fn, out_shardings=(replicated, stats_sharding))(rng)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        return resnet_mod.cross_entropy_loss(logits, labels), updates
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1, 2),
+        in_shardings=(replicated, stats_sharding, None, batch_sharding,
+                      batch_sharding),
+        out_shardings=(replicated, stats_sharding, None, None))
+    def step(params, batch_stats, opt_state, images, labels):
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        new_updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+        params = optax.apply_updates(params, new_updates)
+        return params, updates["batch_stats"], opt_state, {"loss": loss}
+
+    state = {"batch_stats": batch_stats}
+
+    def step_wrapper(params, opt_state, batch):
+        params, state["batch_stats"], opt_state, metrics = step(
+            params, state["batch_stats"], opt_state, batch["images"],
+            batch["labels"])
+        return params, opt_state, metrics
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)

@@ -165,10 +165,44 @@ def evaluate(store: StateStore, pool: PoolSettings,
 _FORMULA_BUILTINS = {"min": min, "max": max, "ceil": math.ceil,
                      "floor": math.floor, "abs": abs, "round": round}
 
+_ALLOWED_AST_NODES = (
+    "Expression", "BinOp", "UnaryOp", "BoolOp", "Compare", "IfExp",
+    "Call", "Name", "Load", "Constant", "Add", "Sub", "Mult", "Div",
+    "FloorDiv", "Mod", "Pow", "USub", "UAdd", "And", "Or", "Not",
+    "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "Tuple", "List",
+)
+
+
+def _validate_formula_ast(formula: str, allowed_names: set[str]) -> None:
+    """AST allowlist: arithmetic/comparison expressions over known
+    names only. No attribute access, subscripts, lambdas, or
+    comprehensions — which closes the empty-__builtins__ escape chains
+    (().__class__... style)."""
+    import ast
+    try:
+        tree = ast.parse(formula, mode="eval")
+    except SyntaxError as exc:
+        raise ValueError(f"autoscale formula syntax error: {exc}")
+    for node in ast.walk(tree):
+        kind = type(node).__name__
+        if kind not in _ALLOWED_AST_NODES:
+            raise ValueError(
+                f"autoscale formula: disallowed construct {kind}")
+        if isinstance(node, __import__("ast").Name) and (
+                node.id not in allowed_names):
+            raise ValueError(
+                f"autoscale formula: unknown name {node.id!r}")
+        if isinstance(node, __import__("ast").Call):
+            func = node.func
+            if type(func).__name__ != "Name":
+                raise ValueError(
+                    "autoscale formula: only direct function calls "
+                    "to the math subset are allowed")
+
 
 def _eval_formula(formula: str, samples: Samples) -> int:
-    """Evaluate a user formula over sampled variables with no builtins
-    beyond a safe math subset."""
+    """Evaluate a user formula over sampled variables; AST-validated
+    against an allowlist before eval."""
     variables = {
         "active_tasks": samples.active_tasks,
         "pending_tasks": samples.pending_tasks,
@@ -177,8 +211,10 @@ def _eval_formula(formula: str, samples: Samples) -> int:
         "hour": samples.now.hour,
         "weekday": samples.now.weekday(),
     }
+    _validate_formula_ast(
+        formula, set(_FORMULA_BUILTINS) | set(variables))
     try:
-        result = eval(  # noqa: S307 - restricted namespace
+        result = eval(  # noqa: S307 - AST-allowlisted above
             formula, {"__builtins__": {}},
             {**_FORMULA_BUILTINS, **variables})
     except Exception as exc:

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Headline benchmark: ResNet-50 training images/sec/chip (bfloat16,
+synthetic ImageNet shapes) on the attached TPU, via the framework's
+compute path (models/resnet.py + parallel/train.py).
+
+This is the BASELINE.md metric: the reference's TensorFlow-Distributed
+recipe (ResNet-50/ImageNet) on 16xV100 — per-chip parity means one TPU
+chip matching one V100. Published V100 reference throughput for TF
+ResNet-50 (fp32, synthetic): ~405 images/sec (NVIDIA DGX-1 numbers);
+vs_baseline is measured/405.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip",
+   "vs_baseline": N}
+Detailed sub-metrics (transformer tokens/sec, orchestration latency)
+land in BENCH_DETAILS.json next to this file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+V100_BASELINE_IMG_PER_SEC = 405.0
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def bench_resnet(batch_size: int = 256, image_size: int = 224,
+                 warmup: int = 3, iters: int = 10) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from batch_shipyard_tpu.models import resnet as resnet_mod
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+    from batch_shipyard_tpu.parallel import train as train_mod
+
+    n_dev = len(jax.devices())
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
+    config = resnet_mod.ResNetConfig(dtype=jnp.bfloat16)
+    harness = train_mod.build_resnet_train(
+        mesh, config, batch_size=batch_size, image_size=image_size,
+        learning_rate=0.1)
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": jnp.asarray(
+            rng.randn(batch_size, image_size, image_size, 3),
+            jnp.bfloat16),
+        "labels": jnp.asarray(rng.randint(0, 1000, (batch_size,)),
+                              jnp.int32),
+    }
+    params, opt_state = harness.params, harness.opt_state
+    for _ in range(warmup):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+    float(metrics["loss"])  # host transfer = hard sync (the axon
+    # platform's block_until_ready returns before execution finishes)
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+    final_loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    images_per_sec = batch_size * iters / elapsed
+    return {
+        "images_per_sec": images_per_sec,
+        "images_per_sec_per_chip": images_per_sec / n_dev,
+        "chips": n_dev,
+        "batch_size": batch_size,
+        "step_seconds": elapsed / iters,
+        "final_loss": final_loss,
+    }
+
+
+def bench_transformer(batch_size: int = 8, seq_len: int = 2048,
+                      warmup: int = 2, iters: int = 5) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+    from batch_shipyard_tpu.parallel import train as train_mod
+
+    n_dev = len(jax.devices())
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
+    config = train_mod.make_transformer_config(
+        mesh, vocab_size=32000, d_model=1024, n_layers=12, n_heads=16,
+        d_head=64, d_ff=2816, max_seq_len=seq_len,
+        dtype=jnp.bfloat16, remat=True)
+    harness = train_mod.build_transformer_train(
+        mesh, config, batch_size=batch_size, seq_len=seq_len)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(
+            rng.randint(0, 32000, (batch_size, seq_len)), jnp.int32),
+        "targets": jnp.asarray(
+            rng.randint(0, 32000, (batch_size, seq_len)), jnp.int32),
+    }
+    params, opt_state = harness.params, harness.opt_state
+    for _ in range(warmup):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+    float(metrics["loss"])  # hard sync (see bench_resnet)
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+    final_loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    tokens_per_sec = batch_size * seq_len * iters / elapsed
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "tokens_per_sec_per_chip": tokens_per_sec / n_dev,
+        "chips": n_dev,
+        "step_seconds": elapsed / iters,
+        "final_loss": final_loss,
+    }
+
+
+def bench_orchestration_latency() -> dict:
+    """pool-add -> task-start latency through the framework (the
+    second BASELINE.md metric), on the fake substrate so it measures
+    OUR scheduling overhead, not cloud allocation."""
+    from batch_shipyard_tpu.config import settings as S
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    from batch_shipyard_tpu.pool import manager as pool_mgr
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    conf = {"pool_specification": {
+        "id": "benchpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16"},
+        "max_wait_time_seconds": 60}}
+    pool = S.pool_settings(conf)
+    try:
+        t0 = time.perf_counter()
+        pool_mgr.create_pool(store, substrate, pool,
+                             S.global_settings({}), conf)
+        pool_ready = time.perf_counter() - t0
+        jobs = S.job_settings_list({"job_specifications": [{
+            "id": "benchjob",
+            "tasks": [{"command": "true"}]}]})
+        t1 = time.perf_counter()
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "benchpool", "benchjob",
+                                        timeout=60)
+        task_done = time.perf_counter() - t1
+        started = tasks[0].get("started_at")
+        return {
+            "pool_add_to_ready_seconds": pool_ready,
+            "submit_to_task_complete_seconds": task_done,
+            "task_started_at": started,
+        }
+    finally:
+        substrate.stop_all()
+
+
+def main() -> int:
+    details: dict = {"platform": None}
+    import jax
+    details["platform"] = jax.default_backend()
+    details["devices"] = [str(d) for d in jax.devices()]
+    resnet = bench_resnet()
+    details["resnet50"] = resnet
+    try:
+        details["transformer"] = bench_transformer()
+    except Exception as exc:  # noqa: BLE001 - secondary metric
+        details["transformer"] = {"error": str(exc)}
+    try:
+        details["orchestration"] = bench_orchestration_latency()
+    except Exception as exc:  # noqa: BLE001 - secondary metric
+        details["orchestration"] = {"error": str(exc)}
+    with open(REPO_ROOT / "BENCH_DETAILS.json", "w",
+              encoding="utf-8") as fh:
+        json.dump(details, fh, indent=2)
+    print(json.dumps({
+        "metric": "ResNet-50 train images/sec/chip (bf16, b=256, "
+                  "synthetic)",
+        "value": round(resnet["images_per_sec_per_chip"], 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            resnet["images_per_sec_per_chip"] /
+            V100_BASELINE_IMG_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

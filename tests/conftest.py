@@ -10,12 +10,21 @@ _REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests must run on a virtual 8-device CPU mesh. The TPU environment's
+# sitecustomize imports jax and registers the real TPU backend plugin
+# at interpreter startup, so plain env vars are too late — but backend
+# *initialization* is lazy, so flipping jax_platforms before the first
+# device query still wins.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -142,3 +142,14 @@ def test_autoscale_tick_applies_via_substrate():
         assert len(pool_mgr.list_nodes(store, "ap")) == 8
     finally:
         substrate.stop_all()
+
+
+def test_formula_rejects_attribute_escape():
+    store = MemoryStateStore()
+    pool = make_pool(
+        formula="().__class__.__bases__[0].__subclasses__()")
+    with pytest.raises(ValueError):
+        autoscale.evaluate(store, pool)
+    pool2 = make_pool(formula="[x for x in (1,)][0]")
+    with pytest.raises(ValueError):
+        autoscale.evaluate(store, pool2)
