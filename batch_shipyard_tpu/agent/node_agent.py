@@ -68,6 +68,7 @@ class NodeAgent:
                  poll_interval: float = 0.2,
                  gang_timeout: float = 600.0,
                  node_stale_seconds: float = 30.0,
+                 job_state_ttl: float = 5.0,
                  nodeprep: Optional[Callable[["NodeAgent"], None]] = None,
                  image_provisioner: Optional[
                      Callable[["NodeAgent", list[str]], None]] = None,
@@ -86,6 +87,11 @@ class NodeAgent:
         self._threads: list[threading.Thread] = []
         self._running_tasks = 0
         self._running_lock = threading.Lock()
+        # Short-TTL job-state cache: the disabled/terminated check runs
+        # on every queue poll and must not cost a store round trip each
+        # time on cloud backends.
+        self._job_state_cache: dict[str, tuple[str, float]] = {}
+        self._job_state_ttl = job_state_ttl
 
     # ------------------------- node lifecycle --------------------------
 
@@ -210,6 +216,8 @@ class NodeAgent:
                 self._image_provisioner(
                     self, control.get("images", []),
                     kind=control.get("kind", "docker"))
+        elif kind == "cleanup_mi":
+            self._cleanup_mi_containers()
 
     # ------------------------ task processing --------------------------
 
@@ -262,6 +270,15 @@ class NodeAgent:
         if entity.get("state") in ("completed", "failed", "blocked"):
             self.store.delete_message(msg)
             return
+        # Disabled jobs keep their tasks queued but unscheduled
+        # (jobs disable --requeue semantics).
+        job_state = self._cached_job_state(job_id)
+        if job_state == "disabled":
+            self.store.update_message(msg, visibility_timeout=5.0)
+            return
+        if job_state in ("terminated", "deleted"):
+            self.store.delete_message(msg)
+            return
         spec = entity["spec"]
         deps = self._deps_status(job_id, spec)
         if deps == "blocked":
@@ -288,6 +305,20 @@ class NodeAgent:
         else:
             self._run_gang_instance(
                 slot, job_id, task_id, entity, instance, msg)
+
+    def _cached_job_state(self, job_id: str) -> Optional[str]:
+        now = time.monotonic()
+        cached = self._job_state_cache.get(job_id)
+        if cached is not None and now - cached[1] < self._job_state_ttl:
+            return cached[0]
+        try:
+            job = self.store.get_entity(
+                names.TABLE_JOBS, self.identity.pool_id, job_id)
+            state = job.get("state")
+        except NotFoundError:
+            state = None
+        self._job_state_cache[job_id] = (state, now)
+        return state
 
     def _maybe_reclaim_orphan(self, job_id: str, task_id: str,
                               entity: dict) -> Optional[dict]:
@@ -708,6 +739,28 @@ class NodeAgent:
     def _job_shared_dir(self, job_id: str) -> str:
         return os.path.join(self.work_dir, "shared", job_id)
 
+    def _cleanup_mi_containers(self) -> None:
+        """Remove orphaned (exited/created, NOT running) shipyard-*
+        containers (jobs cmi analog; reference reaps leftover MI
+        coordination containers, batch.py:2322). Running task
+        containers are never touched."""
+        import shutil
+        import subprocess
+        if shutil.which("docker") is None:
+            return
+        names_seen: set[str] = set()
+        for status in ("exited", "created", "dead"):
+            rc, out, _err = util.subprocess_capture(
+                ["docker", "ps", "-a", "--filter", "name=shipyard-",
+                 "--filter", f"status={status}",
+                 "--format", "{{.Names}}"])
+            if rc == 0:
+                names_seen.update(out.split())
+        for name in names_seen:
+            subprocess.call(["docker", "rm", "-f", name],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
     def _run_job_release(self, job_id: str) -> None:
         try:
             job = self.store.get_entity(
@@ -725,9 +778,19 @@ class NodeAgent:
             task_dir=os.path.join(self.work_dir, "jobrelease", job_id))
         task_runner.run_task(execution)
 
+    def _resolved_inputs(self, spec: dict, job_id: str) -> list[dict]:
+        resolved = []
+        for item in spec.get("input_data") or []:
+            if item.get("kind") == "task_output":
+                item = dict(item)
+                item.setdefault("pool_id", self.identity.pool_id)
+                item.setdefault("job_id", job_id)
+            resolved.append(item)
+        return resolved
+
     def _stage_inputs(self, spec: dict,
                       execution: task_runner.TaskExecution) -> None:
-        input_data = spec.get("input_data") or []
+        input_data = self._resolved_inputs(spec, execution.job_id)
         if not input_data:
             return
         from batch_shipyard_tpu.data import movement
@@ -743,7 +806,7 @@ class NodeAgent:
             return
         from batch_shipyard_tpu.data import movement
         exclude = movement.staged_input_rels(
-            self.store, spec.get("input_data") or [])
+            self.store, self._resolved_inputs(spec, job_id))
         movement.collect_task_outputs(
             self.store, output_data, execution.task_dir,
             self.identity.pool_id, job_id, task_id,

@@ -231,6 +231,65 @@ def jobs_stats(click_ctx, job_id):
                             raw=click_ctx.obj["raw"])
 
 
+@jobs.command("disable")
+@click.option("--job-id", required=True)
+@click.pass_context
+def jobs_disable(click_ctx, job_id):
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    jobs_mgr.disable_job(ctx.store, ctx.pool.id, job_id)
+
+
+@jobs.command("enable")
+@click.option("--job-id", required=True)
+@click.pass_context
+def jobs_enable(click_ctx, job_id):
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    jobs_mgr.enable_job(ctx.store, ctx.pool.id, job_id)
+
+
+@jobs.command("migrate")
+@click.option("--job-id", required=True)
+@click.option("--dst-pool-id", required=True)
+@click.pass_context
+def jobs_migrate(click_ctx, job_id, dst_pool_id):
+    """Move a job's pending tasks to another pool."""
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    moved = jobs_mgr.migrate_job(ctx.store, ctx.pool.id, job_id,
+                                 dst_pool_id)
+    click.echo(f"migrated {moved} tasks of {job_id} to {dst_pool_id}")
+
+
+@jobs.command("cmi")
+@click.pass_context
+def jobs_cmi(click_ctx):
+    """Clean up orphaned multi-instance containers on all nodes."""
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    count = jobs_mgr.cleanup_mi_containers(ctx.store, ctx.pool.id)
+    click.echo(f"cleanup fanned out to {count} nodes")
+
+
+@jobs.command("schedule")
+@click.option("--once", is_flag=True, default=False,
+              help="Evaluate due schedules once and exit")
+@click.option("--poll-interval", type=float, default=5.0)
+@click.pass_context
+def jobs_schedule(click_ctx, once, poll_interval):
+    """Run the recurrence scheduler for jobs with a recurrence block."""
+    from batch_shipyard_tpu.jobs import schedules
+    ctx = _ctx(click_ctx)
+    if once:
+        launched = schedules.run_due_schedules(ctx.store, ctx.pool,
+                                               ctx.jobs)
+        click.echo(f"launched: {launched}")
+    else:
+        schedules.run_schedule_daemon(ctx.store, ctx.pool, ctx.jobs,
+                                      poll_interval=poll_interval)
+
+
 @jobs.group()
 def tasks():
     """Task operations."""
@@ -281,6 +340,36 @@ def diag():
 @click.pass_context
 def diag_perf(click_ctx):
     fleet.action_perf_events(_ctx(click_ctx), raw=click_ctx.obj["raw"])
+
+
+@diag.command("gantt")
+@click.option("--output", default=None,
+              help="PNG output path (requires matplotlib)")
+@click.pass_context
+def diag_gantt(click_ctx, output):
+    """Render the pool's perf-event timeline."""
+    from batch_shipyard_tpu.graph import perf_graph
+    ctx = _ctx(click_ctx)
+    click.echo(perf_graph.graph_data(ctx.store, ctx.pool.id, output))
+
+
+# ------------------------------ storage --------------------------------
+
+@cli.group()
+def storage():
+    """State store management."""
+
+
+@storage.command("clear")
+@click.option("-y", "--yes", is_flag=True, default=False)
+@click.pass_context
+def storage_clear(click_ctx, yes):
+    """Clear ALL framework state (containers/tables/queues analog)."""
+    ctx = _ctx(click_ctx)
+    if not yes and not click.confirm(
+            "Clear ALL state in the configured store?"):
+        raise click.Abort()
+    ctx.store.clear()
 
 
 def main():

@@ -191,6 +191,17 @@ def stage_task_inputs(store: StateStore, input_data: list[dict],
     (process_input_data analog, data.py:219)."""
     for spec in input_data:
         kind = spec.get("kind", "statestore")
+        if kind == "task_output":
+            # Pull another task's uploaded outputs (the reference's
+            # cargo/task_file_mover.py input_data:azure_batch path,
+            # trivially storage-mediated here).
+            key = names.task_output_key(
+                spec["pool_id"], spec["job_id"], spec["task_id"],
+                spec.get("filename", "outputs"))
+            spec = {"kind": "statestore", "key": key,
+                    "file_path": spec.get("file_path",
+                                          spec["task_id"])}
+            kind = "statestore"
         if kind == "statestore":
             key = spec["key"]
             rel = spec.get("file_path") or key.rsplit("/", 1)[-1]
@@ -259,10 +270,17 @@ def staged_input_rels(store: StateStore,
     exclusion."""
     rels: set[str] = set()
     for spec in input_data:
-        if spec.get("kind", "statestore") != "statestore":
+        kind = spec.get("kind", "statestore")
+        if kind == "task_output":
+            key = names.task_output_key(
+                spec["pool_id"], spec["job_id"], spec["task_id"],
+                spec.get("filename", "outputs"))
+            rel = spec.get("file_path", spec["task_id"])
+        elif kind == "statestore":
+            key = spec["key"]
+            rel = spec.get("file_path") or key.rsplit("/", 1)[-1]
+        else:
             continue
-        key = spec["key"]
-        rel = spec.get("file_path") or key.rsplit("/", 1)[-1]
         if store.object_exists(key):
             rels.add(rel)
         else:

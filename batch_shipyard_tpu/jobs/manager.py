@@ -108,6 +108,7 @@ def add_jobs(store: StateStore, pool: PoolSettings,
             raise JobExistsError(f"job {job.id} exists on pool {pool_id}")
         count = 0
         task_number = 0
+        all_task_ids: list[str] = []
         for raw_task in job.tasks:
             for expanded in expand_task_factory(raw_task, store):
                 task = settings_mod.task_settings(expanded, job, pool)
@@ -115,7 +116,18 @@ def add_jobs(store: StateStore, pool: PoolSettings,
                 task_number += 1
                 _submit_task(store, pool_id, job.id, task_id,
                              _task_spec(task, job, pool))
+                all_task_ids.append(task_id)
                 count += 1
+        if job.merge_task is not None:
+            # Merge task: runs after every other task of the job
+            # (reference batch.py merge_task handling :4177-4242).
+            merge_raw = dict(job.merge_task)
+            merge_raw["depends_on"] = all_task_ids
+            task = settings_mod.task_settings(merge_raw, job, pool)
+            merge_id = task.id or "merge-task"
+            _submit_task(store, pool_id, job.id, merge_id,
+                         _task_spec(task, job, pool))
+            count += 1
         submitted[job.id] = count
     return submitted
 
@@ -246,6 +258,98 @@ def terminate_job(store: StateStore, pool_id: str, job_id: str,
             names.control_queue(pool_id, row["_rk"]),
             json.dumps({"type": "job_release",
                         "job_id": job_id}).encode())
+
+
+def disable_job(store: StateStore, pool_id: str, job_id: str) -> None:
+    """Disable: pending tasks stay queued but agents will not start
+    them until re-enabled (jobs disable --requeue analog,
+    batch.py:2102)."""
+    get_job(store, pool_id, job_id)
+    store.merge_entity(names.TABLE_JOBS, pool_id, job_id,
+                       {"state": "disabled"})
+
+
+def enable_job(store: StateStore, pool_id: str, job_id: str) -> None:
+    job = get_job(store, pool_id, job_id)
+    if job.get("state") != "disabled":
+        raise ValueError(f"job {job_id} is not disabled")
+    store.merge_entity(names.TABLE_JOBS, pool_id, job_id,
+                       {"state": "active"})
+
+
+def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
+                dst_pool_id: str) -> int:
+    """Live job migration between pools: move the job entity and
+    re-enqueue all non-terminal tasks on the destination pool's queue
+    (jobs migrate analog, batch.py:1855 check_pool_for_job_migration +
+    :1911 update_job_with_pool). Returns moved task count."""
+    job = get_job(store, src_pool_id, job_id)
+    try:
+        get_job(store, dst_pool_id, job_id)
+        raise JobExistsError(
+            f"job {job_id} already exists on pool {dst_pool_id}")
+    except JobNotFoundError:
+        pass
+    try:
+        store.get_entity(names.TABLE_POOLS, "pools", dst_pool_id)
+    except NotFoundError:
+        raise ValueError(
+            f"destination pool {dst_pool_id} does not exist")
+    src_pk = names.task_pk(src_pool_id, job_id)
+    dst_pk = names.task_pk(dst_pool_id, job_id)
+    tasks = list(store.query_entities(names.TABLE_TASKS,
+                                      partition_key=src_pk))
+    # Validate BEFORE any mutation: a half-migrated job is
+    # unrecoverable without manual store surgery.
+    running = [t["_rk"] for t in tasks
+               if t.get("state") in ("assigned", "running")]
+    if running:
+        raise RuntimeError(
+            f"tasks {running} are running; disable the job and wait "
+            f"before migrating")
+    moved = 0
+    store.insert_entity(names.TABLE_JOBS, dst_pool_id, job_id, {
+        "state": job.get("state", "active"), "spec": job.get("spec", {}),
+        "created_at": job.get("created_at"),
+        "migrated_from": src_pool_id,
+    })
+    for task in tasks:
+        entity = {k: v for k, v in task.items()
+                  if not k.startswith("_")}
+        store.insert_entity(names.TABLE_TASKS, dst_pk, task["_rk"],
+                            entity)
+        store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
+        if entity.get("state") == "pending":
+            num_instances = (entity.get("spec", {}).get(
+                "multi_instance") or {}).get("num_instances")
+            if num_instances:
+                for k in range(num_instances):
+                    store.put_message(
+                        names.task_queue(dst_pool_id),
+                        json.dumps({"job_id": job_id,
+                                    "task_id": task["_rk"],
+                                    "instance": k}).encode())
+            else:
+                store.put_message(
+                    names.task_queue(dst_pool_id),
+                    json.dumps({"job_id": job_id,
+                                "task_id": task["_rk"]}).encode())
+            moved += 1
+    store.delete_entity(names.TABLE_JOBS, src_pool_id, job_id)
+    return moved
+
+
+def cleanup_mi_containers(store: StateStore, pool_id: str) -> int:
+    """Fan out orphaned multi-instance container cleanup to every node
+    (jobs cmi analog, batch.py:2322). Returns node count."""
+    count = 0
+    for node in store.query_entities(names.TABLE_NODES,
+                                     partition_key=pool_id):
+        store.put_message(
+            names.control_queue(pool_id, node["_rk"]),
+            json.dumps({"type": "cleanup_mi"}).encode())
+        count += 1
+    return count
 
 
 def delete_job(store: StateStore, pool_id: str, job_id: str) -> None:

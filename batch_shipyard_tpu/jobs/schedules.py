@@ -1,0 +1,121 @@
+"""Job schedules: recurrence-driven job instantiation.
+
+Reference analog: job schedules with the recurrent job manager task
+(batch.py:5392+ recurrence -> JobScheduleAddParameter;
+cargo/recurrent_job_manager.py regenerating the task collection each
+recurrence and optionally terminating the job when tasks complete).
+
+Ours is a storage-mediated scheduler loop: schedule state (next run
+number, timestamps) lives in a table row, each recurrence submits a
+fresh job ``<job-id>:NNNNN`` with the template's tasks, and an optional
+monitor waits for completion and terminates the instance (the
+monitor_task_completion knob). Runs in-process (tests), as a CLI
+daemon verb, or on a service VM.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.config.settings import JobSettings, PoolSettings
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import NotFoundError, StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_SCHED_TABLE = "jobschedules"
+
+
+def _parse_ts(value: Optional[str]) -> Optional[float]:
+    if not value:
+        return None
+    return datetime.datetime.fromisoformat(
+        value.replace("Z", "+00:00")).timestamp()
+
+
+def instance_id(job_id: str, run_number: int) -> str:
+    return f"{job_id}-r{run_number:05d}"
+
+
+def schedule_state(store: StateStore, pool_id: str,
+                   job_id: str) -> dict:
+    try:
+        return store.get_entity(_SCHED_TABLE, pool_id, job_id)
+    except NotFoundError:
+        return {"run_number": 0, "last_run_at": None}
+
+
+def run_due_schedules(store: StateStore, pool: PoolSettings,
+                      jobs: list[JobSettings],
+                      now: Optional[float] = None) -> list[str]:
+    """One evaluation pass: submit an instance for every schedule whose
+    interval has elapsed. Returns new job instance ids."""
+    now = now if now is not None else time.time()
+    launched: list[str] = []
+    for job in jobs:
+        rec = job.recurrence
+        if rec is None:
+            continue
+        not_before = _parse_ts(rec.do_not_run_until)
+        not_after = _parse_ts(rec.do_not_run_after)
+        if not_before and now < not_before:
+            continue
+        if not_after and now > not_after:
+            continue
+        state = schedule_state(store, pool.id, job.id)
+        last = state.get("last_run_at")
+        if last is not None and now - last < (
+                rec.recurrence_interval_seconds):
+            continue
+        if rec.run_exclusive and state.get("active_instance"):
+            active = state["active_instance"]
+            try:
+                entity = jobs_mgr.get_job(store, pool.id, active)
+                if entity.get("state") == "active":
+                    continue  # previous recurrence still running
+            except jobs_mgr.JobNotFoundError:
+                pass
+        run_number = int(state.get("run_number", 0))
+        inst = instance_id(job.id, run_number)
+        instance_settings = _instantiate(job, inst)
+        jobs_mgr.add_jobs(store, pool, [instance_settings])
+        store.upsert_entity(_SCHED_TABLE, pool.id, job.id, {
+            "run_number": run_number + 1,
+            "last_run_at": now,
+            "active_instance": inst,
+        })
+        launched.append(inst)
+        logger.info("schedule %s launched instance %s", job.id, inst)
+    return launched
+
+
+def _instantiate(job: JobSettings, inst_id: str) -> JobSettings:
+    import dataclasses
+    return dataclasses.replace(
+        job, id=inst_id, recurrence=None,
+        auto_complete=(job.auto_complete or
+                       job.recurrence.monitor_task_completion))
+
+
+def run_schedule_daemon(store: StateStore, pool: PoolSettings,
+                        jobs: list[JobSettings],
+                        stop_event: Optional[threading.Event] = None,
+                        poll_interval: float = 1.0,
+                        max_recurrences: Optional[int] = None) -> int:
+    """Scheduler loop (the recurrent-job-manager daemon). Returns the
+    number of instances launched."""
+    stop = stop_event or threading.Event()
+    total = 0
+    while not stop.is_set():
+        launched = run_due_schedules(store, pool, jobs)
+        total += len(launched)
+        if max_recurrences is not None and total >= max_recurrences:
+            break
+        if stop.wait(poll_interval):
+            break
+    return total

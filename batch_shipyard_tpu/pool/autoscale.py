@@ -188,16 +188,14 @@ def _validate_formula_ast(formula: str, allowed_names: set[str]) -> None:
         if kind not in _ALLOWED_AST_NODES:
             raise ValueError(
                 f"autoscale formula: disallowed construct {kind}")
-        if isinstance(node, __import__("ast").Name) and (
-                node.id not in allowed_names):
+        if isinstance(node, ast.Name) and node.id not in allowed_names:
             raise ValueError(
                 f"autoscale formula: unknown name {node.id!r}")
-        if isinstance(node, __import__("ast").Call):
-            func = node.func
-            if type(func).__name__ != "Name":
-                raise ValueError(
-                    "autoscale formula: only direct function calls "
-                    "to the math subset are allowed")
+        if isinstance(node, ast.Call) and not isinstance(
+                node.func, ast.Name):
+            raise ValueError(
+                "autoscale formula: only direct function calls to "
+                "the math subset are allowed")
 
 
 def _eval_formula(formula: str, samples: Samples) -> int:

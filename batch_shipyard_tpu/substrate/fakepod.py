@@ -86,6 +86,7 @@ class FakePodSubstrate(base.ComputeSubstrate):
             work_dir=os.path.join(self.work_root, pool.id, node_id),
             heartbeat_interval=self.heartbeat_interval,
             poll_interval=0.05, gang_timeout=60.0,
+            job_state_ttl=0.2,
             nodeprep=self._nodeprep)
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {

@@ -1,0 +1,120 @@
+"""Cascade lease-gated replication + perf pipeline tests (reference:
+cascade/cascade.py lease gate :574-635, perf.py, graph.py)."""
+
+import concurrent.futures
+import threading
+import time
+
+from batch_shipyard_tpu.agent import perf
+from batch_shipyard_tpu.agent.cascade import (
+    CascadeImageProvisioner, global_resources_loaded,
+    populate_global_resources)
+from batch_shipyard_tpu.agent.node_agent import NodeIdentity
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.graph import perf_graph
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+
+class FakeAgent:
+    """Just enough agent surface for the provisioner."""
+
+    def __init__(self, store, pool_id, node_id):
+        self.store = store
+        self.identity = NodeIdentity(
+            pool_id=pool_id, node_id=node_id, node_index=0,
+            hostname=node_id, internal_ip="10.0.0.1")
+        self.stop_event = threading.Event()
+
+
+def test_populate_and_loaded_flag():
+    store = MemoryStateStore()
+    populate_global_resources(store, "p", ["img1:latest", "img2:v2"],
+                              concurrent_downloads=2)
+    agent = FakeAgent(store, "p", "n0")
+    assert not global_resources_loaded(store, "p", "n0")
+    prov = CascadeImageProvisioner(store, puller=lambda kind, img: 0)
+    prov.distribute_global_resources(agent)
+    assert global_resources_loaded(store, "p", "n0")
+
+
+def test_concurrency_gate_bounds_parallel_pulls():
+    """With K lock slots, at most K nodes pull the same image at
+    once (the reference's hash.{0..N} blob-lease gate)."""
+    store = MemoryStateStore()
+    populate_global_resources(store, "p", ["big:latest"],
+                              concurrent_downloads=2)
+    active = []
+    max_active = []
+    lock = threading.Lock()
+
+    def slow_pull(kind, image):
+        with lock:
+            active.append(1)
+            max_active.append(len(active))
+        time.sleep(0.1)
+        with lock:
+            active.pop()
+        return 0
+
+    def node_run(idx):
+        agent = FakeAgent(store, "p", f"n{idx}")
+        prov = CascadeImageProvisioner(store, puller=slow_pull)
+        prov.distribute_global_resources(agent)
+
+    with concurrent.futures.ThreadPoolExecutor(6) as pool:
+        list(pool.map(node_run, range(6)))
+    assert max(max_active) <= 2
+    # every node finished its pull
+    for idx in range(6):
+        assert global_resources_loaded(store, "p", f"n{idx}")
+
+
+def test_failed_pull_not_recorded_loaded():
+    store = MemoryStateStore()
+    populate_global_resources(store, "p", ["bad:latest"])
+    agent = FakeAgent(store, "p", "n0")
+    prov = CascadeImageProvisioner(store, puller=lambda k, i: 1)
+    prov.distribute_global_resources(agent)
+    assert not global_resources_loaded(store, "p", "n0")
+
+
+def test_kind_qualified_keys_shared_between_paths():
+    """__call__ with kind must hit the same manifest rows as
+    populate_global_resources."""
+    store = MemoryStateStore()
+    populate_global_resources(store, "p", [],
+                              singularity_images=["simg:1"])
+    pulls = []
+    prov = CascadeImageProvisioner(
+        store, puller=lambda kind, img: pulls.append((kind, img)) or 0)
+    agent = FakeAgent(store, "p", "n0")
+    prov(agent, ["simg:1"], kind="singularity")
+    assert pulls == [("singularity", "simg:1")]
+
+
+def test_perf_pipeline_and_gantt():
+    store = MemoryStateStore()
+    t0 = time.time()
+    perf.emit(store, "p", "n0", "nodeprep", "start", timestamp=t0)
+    perf.emit(store, "p", "n0", "cascade", "pull.start:img",
+              timestamp=t0 + 0.5)
+    perf.emit(store, "p", "n0", "cascade", "pull.end:img",
+              timestamp=t0 + 2.0)
+    perf.emit(store, "p", "n0", "cascade", "global_resources_loaded",
+              timestamp=t0 + 2.1)
+    perf.emit(store, "p", "n0", "nodeprep", "end", timestamp=t0 + 2.5)
+    data = perf_graph.coalesce_data(store, "p")
+    assert abs(data["nodes"]["n0"]["nodeprep"]["seconds"] - 2.5) < 1e-6
+    assert abs(data["images"]["n0"]["img"] - 1.5) < 1e-6
+    assert abs(data["nodes"]["n0"]["global_resources_loaded"][
+        "seconds"] - 2.1) < 1e-6
+    text = perf_graph.render_text_gantt(data)
+    assert "nodeprep" in text and "#" in text
+
+
+def test_perf_event_collision_bump():
+    store = MemoryStateStore()
+    ts = time.time()
+    for _ in range(5):
+        perf.emit(store, "p", "n0", "s", "same_event", timestamp=ts)
+    assert len(perf.query(store, "p")) == 5

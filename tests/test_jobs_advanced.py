@@ -1,0 +1,179 @@
+"""Advanced job features: merge tasks, schedules/recurrence, migrate,
+disable/enable, cross-task input data."""
+
+import json
+import time
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.jobs import schedules
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_env(pool_id="pool1"):
+    conf = {"pool_specification": {
+        "id": pool_id, "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16"},
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    return store, substrate, pool
+
+
+def test_merge_task_runs_last():
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "jm",
+            "tasks": [
+                {"id": "a", "command": "echo a"},
+                {"id": "b", "command": "echo b"},
+            ],
+            "merge_task": {"id": "merge", "command": "echo merged"},
+        }]})
+        counts = jobs_mgr.add_jobs(store, pool, jobs)
+        assert counts["jm"] == 3
+        tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+            store, "pool1", "jm", timeout=30)}
+        assert tasks["merge"]["state"] == "completed"
+        assert tasks["merge"]["started_at"] >= tasks["a"]["completed_at"]
+        assert tasks["merge"]["started_at"] >= tasks["b"]["completed_at"]
+    finally:
+        substrate.stop_all()
+
+
+def test_task_output_input_data_cross_task():
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "jx",
+            "tasks": [
+                {"id": "producer",
+                 "command": "echo payload > result.txt",
+                 "output_data": [{"include": "*.txt"}]},
+                {"id": "consumer",
+                 "command": "cat producer/result.txt",
+                 "depends_on": ["producer"],
+                 "input_data": [{"kind": "task_output",
+                                 "task_id": "producer"}]},
+            ],
+        }]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+            store, "pool1", "jx", timeout=30)}
+        assert tasks["consumer"]["state"] == "completed"
+        out = jobs_mgr.get_task_output(store, "pool1", "jx", "consumer")
+        assert out.strip() == b"payload"
+    finally:
+        substrate.stop_all()
+
+
+def test_disable_enable_job():
+    store, substrate, pool = make_env()
+    try:
+        # Seed the job already-disabled (deterministic: no race with
+        # agents picking the task up before disable lands).
+        store.insert_entity(names.TABLE_JOBS, "pool1", "jd",
+                            {"state": "disabled", "spec": {}})
+        store.insert_entity(
+            names.TABLE_TASKS, names.task_pk("pool1", "jd"),
+            "task-00000", {"state": "pending", "retries": 0,
+                           "spec": {"command": "echo hi",
+                                    "runtime": "none"}})
+        store.put_message(names.task_queue("pool1"), json.dumps(
+            {"job_id": "jd", "task_id": "task-00000"}).encode())
+        time.sleep(1.0)
+        task = jobs_mgr.get_task(store, "pool1", "jd", "task-00000")
+        assert task["state"] == "pending"  # not scheduled while disabled
+        jobs_mgr.enable_job(store, "pool1", "jd")
+        tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jd", timeout=30)
+        assert tasks[0]["state"] == "completed"
+    finally:
+        substrate.stop_all()
+
+
+def test_migrate_job_between_pools():
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        conf1 = {"pool_specification": {
+            "id": "src", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}}
+        conf2 = {"pool_specification": {
+            "id": "dst", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}}
+        src = settings_mod.pool_settings(conf1)
+        dst = settings_mod.pool_settings(conf2)
+        pool_mgr.create_pool(store, substrate, dst, GLOBAL, conf2)
+        # Source pool never allocated: its tasks stay pending.
+        store.insert_entity(names.TABLE_POOLS, "pools", "src",
+                            {"state": "ready", "spec": {}})
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "jmig", "tasks": [{"command": "echo migrated"}]}]})
+        jobs_mgr.add_jobs(store, src, jobs)
+        moved = jobs_mgr.migrate_job(store, "src", "jmig", "dst")
+        assert moved == 1
+        with pytest.raises(jobs_mgr.JobNotFoundError):
+            jobs_mgr.get_job(store, "src", "jmig")
+        tasks = jobs_mgr.wait_for_tasks(store, "dst", "jmig",
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed"
+    finally:
+        substrate.stop_all()
+
+
+def test_schedule_launches_instances():
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "sched",
+            "recurrence": {"schedule": {
+                "recurrence_interval_seconds": 1}},
+            "tasks": [{"command": "echo tick"}],
+        }]})
+        t0 = time.time()
+        launched = schedules.run_due_schedules(store, pool, jobs,
+                                               now=t0)
+        assert launched == ["sched-r00000"]
+        # Immediately re-evaluating does nothing (interval not passed).
+        assert schedules.run_due_schedules(store, pool, jobs,
+                                           now=t0 + 0.2) == []
+        assert schedules.run_due_schedules(
+            store, pool, jobs, now=t0 + 1.5) == ["sched-r00001"]
+        tasks = jobs_mgr.wait_for_tasks(store, "pool1", "sched-r00000",
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed"
+    finally:
+        substrate.stop_all()
+
+
+def test_schedule_run_exclusive_waits():
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "sx",
+            "recurrence": {
+                "schedule": {"recurrence_interval_seconds": 1},
+                "job_manager": {"run_exclusive": True,
+                                "monitor_task_completion": True}},
+            "tasks": [{"command": "sleep 30"}],
+        }]})
+        t0 = time.time()
+        assert schedules.run_due_schedules(store, pool, jobs, now=t0)
+        # Interval elapsed but previous instance still active.
+        assert schedules.run_due_schedules(
+            store, pool, jobs, now=t0 + 2.0) == []
+    finally:
+        substrate.stop_all()
