@@ -711,8 +711,11 @@ class NodeAgent:
                 from batch_shipyard_tpu.data import movement
                 shared = self._job_shared_dir(job_id)
                 os.makedirs(shared, exist_ok=True)
-                movement.stage_task_inputs(self.store, job_inputs,
-                                           shared)
+                movement.stage_task_inputs(
+                    self.store,
+                    self._resolved_inputs(
+                        {"input_data": job_inputs}, job_id),
+                    shared)
             if jp_command:
                 execution = task_runner.TaskExecution(
                     pool_id=self.identity.pool_id, job_id=job_id,

@@ -372,6 +372,305 @@ def storage_clear(click_ctx, yes):
     ctx.store.clear()
 
 
+# ------------------------------ monitor --------------------------------
+
+@cli.group()
+def monitor():
+    """Monitoring resource (Prometheus/Grafana + heimdall)."""
+
+
+@monitor.command("create")
+@click.option("--output-dir", default="./monitoring",
+              help="Where to generate the deployment bundle")
+@click.option("--start", is_flag=True, default=False,
+              help="docker compose up the bundle locally")
+@click.pass_context
+def monitor_create(click_ctx, output_dir, start):
+    from batch_shipyard_tpu.monitor import provision
+    ctx = _ctx(click_ctx)
+    mon = ctx.configs.get("monitor", {}).get("monitoring", {})
+    bundle = provision.generate_monitoring_bundle(
+        output_dir,
+        prometheus_port=mon.get("prometheus", {}).get("port", 9090),
+        grafana_port=mon.get("grafana", {}).get("port", 3000))
+    if start:
+        provision.start_local(bundle)
+    click.echo(f"monitoring bundle: {bundle}")
+
+
+@monitor.command("add")
+@click.option("--pool-id", "pool_id", default=None)
+@click.pass_context
+def monitor_add(click_ctx, pool_id):
+    """Register the pool for monitoring discovery."""
+    from batch_shipyard_tpu.monitor import heimdall
+    ctx = _ctx(click_ctx)
+    pool = ctx.pool
+    heimdall.add_pool_to_monitor(
+        ctx.store, pool_id or pool.id,
+        node_exporter_port=pool.node_exporter.port,
+        cadvisor_port=(pool.cadvisor.port if pool.cadvisor.enabled
+                       else None))
+
+
+@monitor.command("remove")
+@click.argument("resource_key")
+@click.pass_context
+def monitor_remove(click_ctx, resource_key):
+    from batch_shipyard_tpu.monitor import heimdall
+    heimdall.remove_resource_from_monitor(_ctx(click_ctx).store,
+                                          resource_key)
+
+
+@monitor.command("list")
+@click.pass_context
+def monitor_list(click_ctx):
+    from batch_shipyard_tpu.monitor import heimdall
+    fleet._emit({"resources": heimdall.list_monitored_resources(
+        _ctx(click_ctx).store)}, click_ctx.obj["raw"])
+
+
+@monitor.command("heimdall")
+@click.option("--output-dir", default="./monitoring/file_sd")
+@click.option("--once", is_flag=True, default=False)
+@click.option("--poll-interval", type=float, default=15.0)
+@click.pass_context
+def monitor_heimdall(click_ctx, output_dir, once, poll_interval):
+    """Run the service-discovery daemon (writes prometheus file_sd)."""
+    from batch_shipyard_tpu.monitor import heimdall
+    ctx = _ctx(click_ctx)
+    if once:
+        click.echo(heimdall.write_file_sd(ctx.store, output_dir))
+    else:
+        heimdall.run_daemon(ctx.store, output_dir, poll_interval)
+
+
+# -------------------------------- fed ----------------------------------
+
+@cli.group()
+def fed():
+    """Heterogeneous-pool federation."""
+
+
+@fed.command("create")
+@click.argument("federation_id")
+@click.option("--force", is_flag=True, default=False)
+@click.pass_context
+def fed_create(click_ctx, federation_id, force):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    fed_mod.create_federation(_ctx(click_ctx).store, federation_id,
+                              force=force)
+
+
+@fed.command("destroy")
+@click.argument("federation_id")
+@click.pass_context
+def fed_destroy(click_ctx, federation_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    fed_mod.destroy_federation(_ctx(click_ctx).store, federation_id)
+
+
+@fed.command("list")
+@click.pass_context
+def fed_list(click_ctx):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    fleet._emit({"federations": fed_mod.list_federations(
+        _ctx(click_ctx).store)}, click_ctx.obj["raw"])
+
+
+@fed.group("pool")
+def fed_pool():
+    """Federation pool membership."""
+
+
+@fed_pool.command("add")
+@click.argument("federation_id")
+@click.option("--pool-id", default=None)
+@click.pass_context
+def fed_pool_add(click_ctx, federation_id, pool_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    ctx = _ctx(click_ctx)
+    fed_mod.add_pool_to_federation(ctx.store, federation_id,
+                                   pool_id or ctx.pool.id)
+
+
+@fed_pool.command("remove")
+@click.argument("federation_id")
+@click.option("--pool-id", default=None)
+@click.pass_context
+def fed_pool_remove(click_ctx, federation_id, pool_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    ctx = _ctx(click_ctx)
+    fed_mod.remove_pool_from_federation(ctx.store, federation_id,
+                                        pool_id or ctx.pool.id)
+
+
+@fed.group("jobs")
+def fed_jobs():
+    """Federated job submission."""
+
+
+@fed_jobs.command("add")
+@click.argument("federation_id")
+@click.pass_context
+def fed_jobs_add(click_ctx, federation_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    ctx = _ctx(click_ctx)
+    action = fed_mod.submit_job_to_federation(
+        ctx.store, federation_id, ctx.configs["jobs"])
+    click.echo(f"submitted action {action}")
+
+
+@fed_jobs.command("list")
+@click.argument("federation_id")
+@click.pass_context
+def fed_jobs_list(click_ctx, federation_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    fleet._emit({"jobs": fed_mod.list_federation_jobs(
+        _ctx(click_ctx).store, federation_id)}, click_ctx.obj["raw"])
+
+
+@fed_jobs.command("zap")
+@click.argument("federation_id")
+@click.argument("action_id")
+@click.pass_context
+def fed_jobs_zap(click_ctx, federation_id, action_id):
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    fed_mod.zap_action(_ctx(click_ctx).store, federation_id, action_id)
+
+
+@fed.command("proxy")
+@click.option("--poll-interval", type=float, default=1.0)
+@click.pass_context
+def fed_proxy(click_ctx, poll_interval):
+    """Run the federation scheduler daemon."""
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    proc = fed_mod.FederationProcessor(
+        _ctx(click_ctx).store, poll_interval=poll_interval)
+    proc.run()
+
+
+# ------------------------------- slurm ---------------------------------
+
+@cli.group()
+def slurm():
+    """Slurm elastic burst."""
+
+
+@slurm.command("conf")
+@click.pass_context
+def slurm_conf(click_ctx):
+    """Generate slurm.conf for the configured elastic partitions."""
+    from batch_shipyard_tpu.slurm import burst
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    partitions = sconf.get("slurm_options", {}).get(
+        "elastic_partitions", {})
+    click.echo(burst.generate_slurm_conf(cluster_id, partitions))
+
+
+@slurm.command("resume")
+@click.argument("hostlist")
+@click.pass_context
+def slurm_resume(click_ctx, hostlist):
+    """Slurm ResumeProgram entry: bind hosts to pool nodes."""
+    from batch_shipyard_tpu.slurm import burst
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    hosts = burst.expand_hostlist(hostlist)
+    partition = hosts[0].rsplit("-", 1)[0] if hosts else "default"
+    assignments = burst.process_resume(
+        ctx.store, ctx.substrate(), ctx.pool, cluster_id, partition,
+        hosts)
+    fleet._emit({"assignments": assignments}, click_ctx.obj["raw"])
+
+
+@slurm.command("suspend")
+@click.argument("hostlist")
+@click.pass_context
+def slurm_suspend(click_ctx, hostlist):
+    """Slurm SuspendProgram entry: release host bindings."""
+    from batch_shipyard_tpu.slurm import burst
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    hosts = burst.expand_hostlist(hostlist)
+    partition = hosts[0].rsplit("-", 1)[0] if hosts else "default"
+    released = burst.process_suspend(
+        ctx.store, ctx.substrate(), ctx.pool, cluster_id, partition,
+        hosts)
+    click.echo(f"released {released} hosts")
+
+
+# --------------------------------- fs ----------------------------------
+
+@cli.group()
+def fs():
+    """Remote filesystem clusters."""
+
+
+@fs.group("cluster")
+def fs_cluster():
+    """Storage cluster lifecycle."""
+
+
+@fs_cluster.command("add")
+@click.argument("cluster_id")
+@click.option("--disk-count", type=int, default=2)
+@click.option("--disk-size-gb", type=int, default=256)
+@click.option("--vm-size", default="n2-standard-8")
+@click.pass_context
+def fs_cluster_add(click_ctx, cluster_id, disk_count, disk_size_gb,
+                   vm_size):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.create_storage_cluster_record(
+        _ctx(click_ctx).store, cluster_id, disk_count=disk_count,
+        disk_size_gb=disk_size_gb, vm_size=vm_size)
+
+
+@fs_cluster.command("del")
+@click.argument("cluster_id")
+@click.pass_context
+def fs_cluster_del(click_ctx, cluster_id):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.delete_storage_cluster(_ctx(click_ctx).store, cluster_id)
+
+
+@fs_cluster.command("mount-args")
+@click.argument("cluster_id")
+@click.pass_context
+def fs_cluster_mount_args(click_ctx, cluster_id):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    for line in remotefs.create_storage_cluster_mount_args(
+            _ctx(click_ctx).store, cluster_id):
+        click.echo(line)
+
+
+# -------------------------------- misc ---------------------------------
+
+@cli.group()
+def misc():
+    """Miscellaneous utilities."""
+
+
+@misc.command("tensorboard")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--logdir", default=None)
+@click.option("--local-port", type=int, default=16006)
+@click.pass_context
+def misc_tensorboard(click_ctx, job_id, task_id, logdir, local_port):
+    """Plan a TensorBoard ssh tunnel to a task's node."""
+    from batch_shipyard_tpu.utils import misc as misc_mod
+    ctx = _ctx(click_ctx)
+    plan = misc_mod.plan_tensorboard_tunnel(
+        ctx.store, ctx.substrate(), ctx.pool.id, job_id, task_id,
+        logdir=logdir, local_port=local_port)
+    fleet._emit(plan, click_ctx.obj["raw"])
+
+
 def main():
     return cli(prog_name="shipyard-tpu")
 

@@ -1,0 +1,366 @@
+"""Federation: constraint-based meta-scheduling across heterogeneous
+pools (TPU pods of different shapes + CPU/GPU VM pools).
+
+Reference analog: federation/federation.py (3237 LoC) — a daemon VM
+holding a global-lock blob lease (:962), polling per-federation action
+queues (:3135), filtering candidate pools with hard constraints (:1709:
+pool state, vm size, location, registries, max active task backlog),
+then greedy best-fit matching (:2084) with blacklisting/retry (:2786)
+and poison-message zapping (fleet.py:5209).
+
+TPU-native redesign, same architecture:
+  - federations + member pools in TABLE_FEDERATIONS;
+  - job actions as JSON blobs + queue messages on the federation
+    queue (storage.py:1276 analog);
+  - the daemon is HA via a state-store lease; constraints understand
+    TPU shapes (accelerator generation, minimum chips/slices) instead
+    of Azure vm sizes;
+  - scheduling = hard-constraint filter -> greedy best fit by idle
+    slot count -> submit through the ordinary jobs manager onto the
+    chosen pool.
+
+Job-level constraints (jobs.yaml federation_constraints block):
+  pool_ids: [..]            explicit allowlist
+  accelerator_generation:   e.g. 'v5litepod' / 'v6e'
+  min_chips: int            total chips in the pool's slices
+  min_idle_nodes: int
+  max_active_task_backlog:  float ratio of queued tasks to slots
+  substrate: tpu_vm|fake|localhost
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Optional
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError, StateStore)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+GLOBAL_LOCK_KEY = "federation/global-lock"
+LOCK_SECONDS = 30.0
+
+
+# ----------------------------- client side -----------------------------
+
+def create_federation(store: StateStore, federation_id: str,
+                      force: bool = False) -> None:
+    entity = {"created_at": util.datetime_utcnow_iso(), "pools": []}
+    if force:
+        store.upsert_entity(names.TABLE_FEDERATIONS, "fed",
+                            federation_id, entity)
+    else:
+        try:
+            store.insert_entity(names.TABLE_FEDERATIONS, "fed",
+                                federation_id, entity)
+        except EntityExistsError:
+            raise ValueError(f"federation {federation_id} exists")
+
+
+def destroy_federation(store: StateStore, federation_id: str) -> None:
+    try:
+        store.delete_entity(names.TABLE_FEDERATIONS, "fed",
+                            federation_id)
+    except NotFoundError:
+        pass
+
+
+def get_federation(store: StateStore, federation_id: str) -> dict:
+    try:
+        return store.get_entity(names.TABLE_FEDERATIONS, "fed",
+                                federation_id)
+    except NotFoundError:
+        raise ValueError(f"federation {federation_id} does not exist")
+
+
+def list_federations(store: StateStore) -> list[dict]:
+    return list(store.query_entities(names.TABLE_FEDERATIONS,
+                                     partition_key="fed"))
+
+
+def add_pool_to_federation(store: StateStore, federation_id: str,
+                           pool_id: str) -> None:
+    fed = get_federation(store, federation_id)
+    pools = set(fed.get("pools", []))
+    pools.add(pool_id)
+    store.merge_entity(names.TABLE_FEDERATIONS, "fed", federation_id,
+                       {"pools": sorted(pools)},
+                       if_match=fed["_etag"])
+
+
+def remove_pool_from_federation(store: StateStore, federation_id: str,
+                                pool_id: str) -> None:
+    fed = get_federation(store, federation_id)
+    pools = set(fed.get("pools", []))
+    pools.discard(pool_id)
+    store.merge_entity(names.TABLE_FEDERATIONS, "fed", federation_id,
+                       {"pools": sorted(pools)},
+                       if_match=fed["_etag"])
+
+
+def submit_job_to_federation(store: StateStore, federation_id: str,
+                             jobs_config: dict) -> str:
+    """fed jobs add: serialize the job spec as a blob + queue message
+    (batch.py:5900 generate_info_metadata + storage.py:1959 analog)."""
+    get_federation(store, federation_id)
+    action_id = uuid.uuid4().hex[:12]
+    job_ids = [j["id"] for j in
+               jobs_config.get("job_specifications", [])]
+    blob_key = names.federation_job_blob_key(
+        federation_id, "-".join(job_ids) or "job", action_id)
+    store.put_object(blob_key, json.dumps(jobs_config).encode())
+    store.put_message(names.federation_queue(federation_id),
+                      json.dumps({
+                          "action": "add_job", "action_id": action_id,
+                          "blob_key": blob_key,
+                      }).encode())
+    return action_id
+
+
+def zap_action(store: StateStore, federation_id: str,
+               action_id: str) -> None:
+    """fed jobs zap: mark a poison action so the daemon drops it
+    (fleet.py:5209 analog)."""
+    store.upsert_entity(names.TABLE_FEDJOBS, federation_id,
+                        f"zap${action_id}", {"zapped": True})
+
+
+def list_federation_jobs(store: StateStore,
+                         federation_id: str) -> list[dict]:
+    return [row for row in store.query_entities(
+        names.TABLE_FEDJOBS, partition_key=federation_id)
+        if not row["_rk"].startswith("zap$")]
+
+
+# --------------------------- constraint match --------------------------
+
+def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
+    """Assemble the scheduling facts for one member pool."""
+    try:
+        entity = pool_mgr.get_pool(store, pool_id)
+    except pool_mgr.PoolNotFoundError:
+        return None
+    spec_raw = entity.get("spec") or {}
+    try:
+        pool = settings_mod.pool_settings(spec_raw)
+    except (ValueError, KeyError):
+        return None
+    nodes = pool_mgr.list_nodes(store, pool_id)
+    idle = [n for n in nodes if n.state == "idle"]
+    ready = [n for n in nodes if n.state in pool_mgr.READY_STATES]
+    backlog = store.queue_length(names.task_queue(pool_id))
+    slots = max(1, len(ready) * pool.task_slots_per_node)
+    return {
+        "pool_id": pool_id,
+        "pool": pool,
+        "state": entity.get("state"),
+        "nodes_total": len(nodes),
+        "nodes_idle": len(idle),
+        "nodes_ready": len(ready),
+        "backlog": backlog,
+        "backlog_ratio": backlog / slots,
+        "chips": (pool.tpu.info.num_chips * pool.tpu.num_slices
+                  if pool.tpu else 0),
+    }
+
+
+def filter_pools_hard_constraints(
+        facts: list[dict], constraints: dict) -> list[dict]:
+    """Hard-constraint pool filter (:1709 analog)."""
+    out = []
+    allow = constraints.get("pool_ids")
+    for fact in facts:
+        pool = fact["pool"]
+        if fact["state"] not in ("ready",):
+            continue
+        if allow and fact["pool_id"] not in allow:
+            continue
+        if constraints.get("substrate") and (
+                pool.substrate != constraints["substrate"]):
+            continue
+        gen = constraints.get("accelerator_generation")
+        if gen:
+            if pool.tpu is None:
+                continue
+            if not pool.tpu.accelerator_type.startswith(gen) and \
+                    pool.tpu.info.generation.name != gen:
+                continue
+        if constraints.get("min_chips") and (
+                fact["chips"] < constraints["min_chips"]):
+            continue
+        if constraints.get("min_idle_nodes") and (
+                fact["nodes_idle"] < constraints["min_idle_nodes"]):
+            continue
+        max_backlog = constraints.get("max_active_task_backlog")
+        if max_backlog is not None and (
+                fact["backlog_ratio"] > float(max_backlog)):
+            continue
+        out.append(fact)
+    return out
+
+
+def greedy_best_fit(facts: list[dict]) -> Optional[dict]:
+    """Greedy best-fit pool choice (:2084 analog): most idle nodes,
+    then lowest backlog ratio, then largest pool."""
+    if not facts:
+        return None
+    return sorted(facts, key=lambda f: (
+        -f["nodes_idle"], f["backlog_ratio"], -f["nodes_total"]))[0]
+
+
+# ----------------------------- daemon side -----------------------------
+
+class FederationProcessor:
+    """The HA scheduler daemon (FederationProcessor :2727 analog)."""
+
+    def __init__(self, store: StateStore, owner: Optional[str] = None,
+                 poll_interval: float = 1.0,
+                 action_retry_delay: float = 5.0) -> None:
+        self.store = store
+        self.owner = owner or f"fedproc-{uuid.uuid4().hex[:8]}"
+        self.poll_interval = poll_interval
+        self.action_retry_delay = action_retry_delay
+        self.stop_event = threading.Event()
+        self._lease = None
+
+    # -- lock ----------------------------------------------------------
+
+    def _hold_global_lock(self) -> bool:
+        if self._lease is not None:
+            try:
+                self._lease = self.store.renew_lease(self._lease,
+                                                     LOCK_SECONDS)
+                return True
+            except Exception:
+                self._lease = None
+        self._lease = self.store.acquire_lease(
+            GLOBAL_LOCK_KEY, LOCK_SECONDS, self.owner)
+        return self._lease is not None
+
+    # -- processing ----------------------------------------------------
+
+    def process_once(self) -> int:
+        """One poll cycle over all federations; returns actions
+        processed. Only the lock holder schedules (HA :962)."""
+        if not self._hold_global_lock():
+            return 0
+        processed = 0
+        for fed in list_federations(self.store):
+            processed += self._process_federation_queue(fed["_rk"], fed)
+        return processed
+
+    def _is_zapped(self, federation_id: str, action_id: str) -> bool:
+        try:
+            self.store.get_entity(names.TABLE_FEDJOBS, federation_id,
+                                  f"zap${action_id}")
+            return True
+        except NotFoundError:
+            return False
+
+    def _process_federation_queue(self, federation_id: str,
+                                  fed: dict) -> int:
+        queue = names.federation_queue(federation_id)
+        processed = 0
+        for msg in self.store.get_messages(
+                queue, max_messages=8, visibility_timeout=60.0):
+            action = json.loads(msg.payload)
+            action_id = action.get("action_id", "?")
+            if self._is_zapped(federation_id, action_id):
+                logger.warning("dropping zapped action %s", action_id)
+                self.store.delete_message(msg)
+                continue
+            if action.get("action") == "add_job":
+                done = self._schedule_add_job(federation_id, fed,
+                                              action)
+                if done:
+                    self.store.delete_message(msg)
+                    processed += 1
+                else:
+                    # No eligible pool now: back off and retry
+                    # (blocked-action requeue, storage.py:1331).
+                    self.store.update_message(
+                        msg,
+                        visibility_timeout=self.action_retry_delay)
+            else:
+                logger.error("unknown federation action %r", action)
+                self.store.delete_message(msg)
+        return processed
+
+    def _schedule_add_job(self, federation_id: str, fed: dict,
+                          action: dict) -> bool:
+        try:
+            jobs_config = json.loads(
+                self.store.get_object(action["blob_key"]))
+        except NotFoundError:
+            logger.error("federation action blob missing: %s",
+                         action.get("blob_key"))
+            return True  # unrecoverable; drop
+        jobs = settings_mod.job_settings_list(jobs_config)
+        facts = [f for f in (
+            _pool_facts(self.store, pid) for pid in fed.get("pools", []))
+            if f is not None]
+        all_ok = True
+        for job in jobs:
+            # Idempotent retry: a job already placed by a previous
+            # attempt of this (or another) action is never re-placed —
+            # the placement record is insert-only.
+            try:
+                placed = self.store.get_entity(
+                    names.TABLE_FEDJOBS, federation_id, job.id)
+                logger.info(
+                    "federation %s: job %s already on pool %s",
+                    federation_id, job.id, placed.get("pool_id"))
+                continue
+            except NotFoundError:
+                pass
+            constraints = dict(job.federation_constraints)
+            eligible = filter_pools_hard_constraints(facts, constraints)
+            choice = greedy_best_fit(eligible)
+            if choice is None:
+                logger.info(
+                    "federation %s: no eligible pool for job %s "
+                    "(constraints=%s)", federation_id, job.id,
+                    constraints)
+                all_ok = False
+                continue
+            pool = choice["pool"]
+            try:
+                self.store.insert_entity(
+                    names.TABLE_FEDJOBS, federation_id, job.id, {
+                        "pool_id": pool.id,
+                        "action_id": action.get("action_id"),
+                        "scheduled_at": util.datetime_utcnow_iso(),
+                    })
+            except EntityExistsError:
+                continue  # lost a race with another scheduler pass
+            try:
+                jobs_mgr.add_jobs(self.store, pool, [job],
+                                  pool_id_override=pool.id)
+            except jobs_mgr.JobExistsError:
+                pass  # already scheduled by a previous attempt
+            logger.info("federation %s: job %s -> pool %s",
+                        federation_id, job.id, pool.id)
+        return all_ok
+
+    def run(self) -> None:
+        while not self.stop_event.is_set():
+            try:
+                self.process_once()
+            except Exception:
+                logger.exception("federation processing error")
+            if self.stop_event.wait(self.poll_interval):
+                break
+        if self._lease is not None:
+            try:
+                self.store.release_lease(self._lease)
+            except Exception:
+                pass

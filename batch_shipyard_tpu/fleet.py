@@ -53,13 +53,27 @@ class Context:
         default_factory=dict)
     substrate_kwargs: dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    _resolved_credentials: Optional[dict] = None
 
     # ------------------------- config access ---------------------------
 
     @property
     def credentials(self):
+        # Secret indirection resolves lazily, on first credential use:
+        # commands that never touch credentials must not fail (or pay
+        # gcloud round trips) on secret:// values
+        # (keyvault.parse_secret_ids analog).
+        if self._resolved_credentials is None:
+            raw = self.configs.get("credentials", {})
+            from batch_shipyard_tpu.utils import secrets
+            creds = raw.get("credentials", {})
+            secrets_file = (creds.get("secrets") or {}).get("file")
+            project = (creds.get("gcp") or {}).get("project")
+            self._resolved_credentials = (
+                secrets.resolve_config_secrets(raw, secrets_file,
+                                               project))
         return settings_mod.credentials_settings(
-            self.configs.get("credentials", {}))
+            self._resolved_credentials)
 
     @property
     def global_settings(self):
@@ -245,6 +259,7 @@ def action_jobs_stats(ctx: Context, job_id: Optional[str] = None,
 def action_data_stream(ctx: Context, job_id: str, task_id: str,
                        filename: str = "stdout.txt") -> None:
     """data files stream (fleet.py action analog of batch.py:3243)."""
+    ctx.substrate().ensure_attached(ctx.pool)
     for chunk in jobs_mgr.stream_task_output(
             ctx.store, ctx.pool.id, job_id, task_id, filename=filename):
         sys.stdout.write(chunk.decode(errors="replace"))

@@ -116,5 +116,8 @@ def graph_data(store: StateStore, pool_id: str,
             fig.savefig(output_path, dpi=120)
             plt.close(fig)
         except ImportError:
-            pass
+            import logging
+            logging.getLogger(__name__).warning(
+                "matplotlib not available; %s not written (ASCII "
+                "gantt returned instead)", output_path)
     return text

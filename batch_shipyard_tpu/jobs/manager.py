@@ -263,10 +263,15 @@ def terminate_job(store: StateStore, pool_id: str, job_id: str,
 def disable_job(store: StateStore, pool_id: str, job_id: str) -> None:
     """Disable: pending tasks stay queued but agents will not start
     them until re-enabled (jobs disable --requeue analog,
-    batch.py:2102)."""
-    get_job(store, pool_id, job_id)
+    batch.py:2102). Only active jobs can be disabled — a terminated/
+    completed job must not be resurrectable via disable+enable."""
+    job = get_job(store, pool_id, job_id)
+    if job.get("state") != "active":
+        raise ValueError(
+            f"job {job_id} is {job.get('state')}; only active jobs "
+            f"can be disabled")
     store.merge_entity(names.TABLE_JOBS, pool_id, job_id,
-                       {"state": "disabled"})
+                       {"state": "disabled"}, if_match=job["_etag"])
 
 
 def enable_job(store: StateStore, pool_id: str, job_id: str) -> None:
@@ -297,16 +302,22 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
             f"destination pool {dst_pool_id} does not exist")
     src_pk = names.task_pk(src_pool_id, job_id)
     dst_pk = names.task_pk(dst_pool_id, job_id)
+    # Validate BEFORE any mutation: a half-migrated job is
+    # unrecoverable without manual store surgery. Requiring the job to
+    # be disabled (not merely no-running-tasks) closes the race where
+    # a source-pool agent claims a pending task mid-migration.
+    if job.get("state") == "active":
+        raise RuntimeError(
+            f"job {job_id} is active; run jobs disable first, wait "
+            f"for running tasks to drain, then migrate")
     tasks = list(store.query_entities(names.TABLE_TASKS,
                                       partition_key=src_pk))
-    # Validate BEFORE any mutation: a half-migrated job is
-    # unrecoverable without manual store surgery.
     running = [t["_rk"] for t in tasks
                if t.get("state") in ("assigned", "running")]
     if running:
         raise RuntimeError(
-            f"tasks {running} are running; disable the job and wait "
-            f"before migrating")
+            f"tasks {running} are still running; wait for them to "
+            f"drain before migrating")
     moved = 0
     store.insert_entity(names.TABLE_JOBS, dst_pool_id, job_id, {
         "state": job.get("state", "active"), "spec": job.get("spec", {}),

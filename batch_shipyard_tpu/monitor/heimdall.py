@@ -1,0 +1,133 @@
+"""Heimdall: monitoring service discovery daemon.
+
+Reference analog: heimdall/heimdall.py — polls the monitoring table
+for registered pools/fs-clusters, resolves node IPs via Batch/ARM
+APIs (:292/:461), and writes Prometheus file_sd target JSON
+(:416/:562). Ours resolves from TABLE_NODES/TABLE_MONITOR in the state
+store and writes the same file_sd format, so a stock Prometheus
+pointed at the output directory scrapes every registered resource's
+node_exporter/cadvisor endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import NotFoundError, StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+def add_pool_to_monitor(store: StateStore, pool_id: str,
+                        node_exporter_port: int = 9100,
+                        cadvisor_port: Optional[int] = None) -> None:
+    """Register a pool for monitoring (monitor add analog,
+    storage.add_resources_to_monitor storage.py:491)."""
+    store.upsert_entity(names.TABLE_MONITOR, "monitor",
+                        f"pool${pool_id}", {
+                            "kind": "pool", "pool_id": pool_id,
+                            "node_exporter_port": node_exporter_port,
+                            "cadvisor_port": cadvisor_port,
+                            "registered_at": util.datetime_utcnow_iso(),
+                        })
+
+
+def add_remotefs_to_monitor(store: StateStore, cluster_id: str,
+                            node_exporter_port: int = 9100) -> None:
+    store.upsert_entity(names.TABLE_MONITOR, "monitor",
+                        f"remotefs${cluster_id}", {
+                            "kind": "remotefs",
+                            "cluster_id": cluster_id,
+                            "node_exporter_port": node_exporter_port,
+                            "registered_at": util.datetime_utcnow_iso(),
+                        })
+
+
+def remove_resource_from_monitor(store: StateStore,
+                                 resource_key: str) -> None:
+    try:
+        store.delete_entity(names.TABLE_MONITOR, "monitor",
+                            resource_key)
+    except NotFoundError:
+        pass
+
+
+def list_monitored_resources(store: StateStore) -> list[dict]:
+    return list(store.query_entities(names.TABLE_MONITOR,
+                                     partition_key="monitor"))
+
+
+def build_file_sd_targets(store: StateStore) -> list[dict]:
+    """Resolve every registered resource into Prometheus file_sd
+    target groups (heimdall.py:416 analog)."""
+    groups: list[dict] = []
+    for resource in list_monitored_resources(store):
+        if resource["kind"] == "pool":
+            pool_id = resource["pool_id"]
+            ne_targets, ca_targets = [], []
+            for node in store.query_entities(names.TABLE_NODES,
+                                             partition_key=pool_id):
+                ip = node.get("internal_ip")
+                if not ip:
+                    continue
+                if resource.get("node_exporter_port"):
+                    ne_targets.append(
+                        f"{ip}:{resource['node_exporter_port']}")
+                if resource.get("cadvisor_port"):
+                    ca_targets.append(
+                        f"{ip}:{resource['cadvisor_port']}")
+            if ne_targets:
+                groups.append({
+                    "targets": sorted(ne_targets),
+                    "labels": {"job": "node_exporter",
+                               "shipyard_pool": pool_id}})
+            if ca_targets:
+                groups.append({
+                    "targets": sorted(ca_targets),
+                    "labels": {"job": "cadvisor",
+                               "shipyard_pool": pool_id}})
+        elif resource["kind"] == "remotefs":
+            cluster_id = resource["cluster_id"]
+            targets = []
+            for row in store.query_entities(
+                    names.TABLE_REMOTEFS_NODES, partition_key=cluster_id):
+                ip = row.get("internal_ip")
+                if ip:
+                    targets.append(
+                        f"{ip}:{resource['node_exporter_port']}")
+            if targets:
+                groups.append({
+                    "targets": sorted(targets),
+                    "labels": {"job": "node_exporter",
+                               "shipyard_remotefs": cluster_id}})
+    return groups
+
+
+def write_file_sd(store: StateStore, output_dir: str) -> str:
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, "shipyard_targets.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(build_file_sd_targets(store), fh, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+def run_daemon(store: StateStore, output_dir: str,
+               poll_interval: float = 15.0,
+               stop_event: Optional[threading.Event] = None) -> None:
+    """Discovery loop: refresh file_sd targets until stopped."""
+    stop = stop_event or threading.Event()
+    while True:
+        try:
+            write_file_sd(store, output_dir)
+        except Exception:
+            logger.exception("heimdall refresh failed")
+        if stop.wait(poll_interval):
+            return

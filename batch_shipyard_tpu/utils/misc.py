@@ -1,0 +1,71 @@
+"""Misc helpers: TensorBoard tunnel, image mirroring.
+
+Reference analog: convoy/misc.py — tunnel_tensorboard(:62: pick the
+logdir from a running task, start a TensorBoard container on its node,
+local ssh port-forward) and image mirroring (:250).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+from batch_shipyard_tpu.utils import crypto, util
+
+logger = util.get_logger(__name__)
+
+TENSORBOARD_PORT = 6006
+
+
+def plan_tensorboard_tunnel(
+        store: StateStore, substrate, pool_id: str, job_id: str,
+        task_id: str, logdir: Optional[str] = None,
+        local_port: int = 16006,
+        ssh_username: str = "shipyard",
+        ssh_private_key: Optional[str] = None,
+        output_dir: str = ".") -> dict:
+    """Resolve the task's node, synthesize the remote TensorBoard
+    launch command and the local tunnel script (tunnel_tensorboard
+    analog). Returns the plan; execution is the caller's choice."""
+    task = jobs_mgr.get_task(store, pool_id, job_id, task_id)
+    node_id = task.get("node_id")
+    if not node_id:
+        raise ValueError(f"task {task_id} has no assigned node yet")
+    login = substrate.get_remote_login(pool_id, node_id)
+    if login is None:
+        raise ValueError(f"no remote login for node {node_id}")
+    ip, port = login
+    node = store.get_entity(names.TABLE_NODES, pool_id, node_id)
+    if logdir is None:
+        # Default: the task's working directory on the node.
+        logdir = f"/var/shipyard/tasks/{job_id}/{task_id}"
+    remote_cmd = (
+        f"python3 -m tensorboard.main --logdir {logdir} "
+        f"--port {TENSORBOARD_PORT} --bind_all")
+    script_path = crypto.ssh_tunnel_script(
+        ip, port, local_port, TENSORBOARD_PORT, ssh_username,
+        ssh_private_key,
+        os.path.join(output_dir, f"tunnel-tb-{task_id}.sh"))
+    return {
+        "node_id": node_id, "node_ip": ip, "ssh_port": port,
+        "hostname": node.get("hostname"),
+        "remote_command": remote_cmd,
+        "tunnel_script": script_path,
+        "local_url": f"http://localhost:{local_port}",
+    }
+
+
+def mirror_images_plan(images: list[str],
+                       dest_registry: str) -> list[list[str]]:
+    """Command plan to mirror images into a private registry
+    (misc.py:250 analog)."""
+    plan: list[list[str]] = []
+    for image in images:
+        target = f"{dest_registry}/{image.split('/')[-1]}"
+        plan.append(["docker", "pull", image])
+        plan.append(["docker", "tag", image, target])
+        plan.append(["docker", "push", target])
+    return plan

@@ -1,0 +1,222 @@
+"""Monitoring (heimdall/file_sd + bundle), slurm burst, remotefs,
+crypto, secrets, misc tests."""
+
+import json
+import os
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.monitor import heimdall, provision
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.remotefs import manager as remotefs
+from batch_shipyard_tpu.slurm import burst
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+from batch_shipyard_tpu.utils import crypto, misc, secrets
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_pool(store, substrate, pool_id="mp", accel="v5litepod-8"):
+    conf = {"pool_specification": {
+        "id": pool_id, "substrate": "fake",
+        "tpu": {"accelerator_type": accel},
+        "max_wait_time_seconds": 30,
+        "prometheus": {"node_exporter": {"enabled": True}}}}
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    return pool
+
+
+# ------------------------------ monitoring -----------------------------
+
+def test_heimdall_file_sd_targets(tmp_path):
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        make_pool(store, substrate)
+        heimdall.add_pool_to_monitor(store, "mp",
+                                     node_exporter_port=9100,
+                                     cadvisor_port=8080)
+        path = heimdall.write_file_sd(store, str(tmp_path))
+        groups = json.loads(open(path).read())
+        ne = [g for g in groups
+              if g["labels"]["job"] == "node_exporter"][0]
+        assert len(ne["targets"]) == 2  # v5e-8 = 2 workers
+        assert all(t.endswith(":9100") for t in ne["targets"])
+        ca = [g for g in groups if g["labels"]["job"] == "cadvisor"][0]
+        assert all(t.endswith(":8080") for t in ca["targets"])
+        # removal empties the target list
+        heimdall.remove_resource_from_monitor(store, "pool$mp")
+        heimdall.write_file_sd(store, str(tmp_path))
+        assert json.loads(open(path).read()) == []
+    finally:
+        substrate.stop_all()
+
+
+def test_monitoring_bundle_generation(tmp_path):
+    out = provision.generate_monitoring_bundle(
+        str(tmp_path / "mon"), grafana_password="s3cret")
+    assert os.path.exists(os.path.join(out, "prometheus.yml"))
+    compose = open(os.path.join(out, "docker-compose.yml")).read()
+    assert "s3cret" in compose and "prom/prometheus" in compose
+    dash = json.load(open(os.path.join(
+        out, "grafana", "dashboards", "shipyard.json")))
+    assert dash["panels"]
+    assert os.path.exists(os.path.join(
+        out, "shipyard-monitoring.service"))
+
+
+# ------------------------------- slurm ---------------------------------
+
+def test_hostlist_expansion():
+    assert burst.expand_hostlist("tpu-[0-2,5]") == [
+        "tpu-0", "tpu-1", "tpu-2", "tpu-5"]
+    assert burst.expand_hostlist("a,b") == ["a", "b"]
+    assert burst.expand_hostlist("single") == ["single"]
+
+
+def test_slurm_resume_suspend_cycle():
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        pool = make_pool(store, substrate, "sp", "v5litepod-4")
+        hosts = ["tpu-0"]
+        assignments = burst.process_resume(
+            store, substrate, pool, "clus", "part", hosts,
+            wait_timeout=30)
+        assert set(assignments) == {"tpu-0"}
+        # resume more hosts than capacity -> pool grows by slices
+        assignments = burst.process_resume(
+            store, substrate, pool, "clus", "part",
+            ["tpu-0", "tpu-1"], wait_timeout=60)
+        assert set(assignments) == {"tpu-0", "tpu-1"}
+        assert len(pool_mgr.list_nodes(store, "sp")) >= 2
+        released = burst.process_suspend(
+            store, substrate, pool, "clus", "part", ["tpu-1"])
+        assert released == 1
+        assert set(burst.host_assignments(
+            store, "clus", "part")) == {"tpu-0"}
+    finally:
+        substrate.stop_all()
+
+
+def test_slurm_idle_reaper():
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        pool = make_pool(store, substrate, "rp", "v5litepod-4")
+        burst.process_resume(store, substrate, pool, "c", "p",
+                             ["h0"], wait_timeout=30)
+        # Nothing reaped inside the window.
+        assert burst.idle_reaper(store, substrate, pool, "c", "p",
+                                 idle_reclaim_seconds=3600) == 0
+        import time
+        assert burst.idle_reaper(
+            store, substrate, pool, "c", "p",
+            idle_reclaim_seconds=0.0, now=time.time() + 10) == 1
+        assert burst.host_assignments(store, "c", "p") == {}
+    finally:
+        substrate.stop_all()
+
+
+def test_slurm_conf_generation():
+    conf = burst.generate_slurm_conf("clus", {
+        "tpu": {"max_nodes": 4, "cpus": 96, "default": True}})
+    assert "NodeName=tpu-[0-3] State=CLOUD" in conf
+    assert "ResumeProgram=" in conf
+    assert "PartitionName=tpu" in conf
+
+
+# ------------------------------ remotefs -------------------------------
+
+def test_remotefs_record_and_mount_args():
+    store = MemoryStateStore()
+    remotefs.create_storage_cluster_record(store, "fs1", disk_count=4)
+    with pytest.raises(ValueError):
+        remotefs.create_storage_cluster_record(store, "fs1")
+    with pytest.raises(ValueError):
+        remotefs.create_storage_cluster_mount_args(store, "fs1")
+    remotefs.register_server_node(store, "fs1", "srv0", "10.9.9.9")
+    args = remotefs.create_storage_cluster_mount_args(store, "fs1")
+    assert args[0].startswith("10.9.9.9:/export/shipyard ")
+    assert "nfs4" in args[0]
+    cluster = remotefs.expand_storage_cluster(store, "fs1", 2)
+    assert cluster["disk_count"] == 6
+    script = remotefs.generate_nfs_bootstrap_script(cluster)
+    assert "mdadm --create" in script and "raid-devices=6" in script
+    remotefs.delete_storage_cluster(store, "fs1")
+    with pytest.raises(ValueError):
+        remotefs.get_storage_cluster(store, "fs1")
+
+
+def test_gcsfuse_mount_args():
+    args = remotefs.gcsfuse_mount_args("my-bucket")
+    assert args[0].startswith("my-bucket /mnt/gcs gcsfuse ")
+
+
+# ------------------------------- crypto --------------------------------
+
+def test_ssh_keypair_and_credential_roundtrip(tmp_path):
+    private_path, public_path = crypto.generate_ssh_keypair(
+        str(tmp_path))
+    assert open(public_path).read().startswith("ssh-rsa ")
+    assert oct(os.stat(private_path).st_mode & 0o777) == "0o600"
+    private_pem, public_pem = crypto.generate_rsa_keypair_pem()
+    token = crypto.encrypt_credential(public_pem, "hunter2")
+    assert crypto.decrypt_credential(private_pem, token) == "hunter2"
+
+
+def test_ssh_command_shape():
+    argv = crypto.ssh_command("1.2.3.4", 2222, "me", "/key", "ls")
+    assert argv[0] == "ssh" and argv[-1] == "ls"
+    assert "me@1.2.3.4" in argv and "-i" in argv
+
+
+# ------------------------------- secrets -------------------------------
+
+def test_secret_env_and_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("MY_TOKEN", "tok123")
+    assert secrets.resolve_secret("secret://env/MY_TOKEN") == "tok123"
+    sf = tmp_path / "secrets.yaml"
+    sf.write_text("regpass: hunter2\n")
+    assert secrets.resolve_secret("secret://file/regpass",
+                                  secrets_file=str(sf)) == "hunter2"
+    config = {"credentials": {"docker_registries": [
+        {"server": "r", "password": "secret://env/MY_TOKEN"}]}}
+    resolved = secrets.resolve_config_secrets(config)
+    assert resolved["credentials"]["docker_registries"][0][
+        "password"] == "tok123"
+    with pytest.raises(secrets.SecretResolutionError):
+        secrets.resolve_secret("secret://env/NOPE")
+    assert not secrets.is_secret_id("plain-value")
+
+
+# -------------------------------- misc ---------------------------------
+
+def test_tensorboard_tunnel_plan(tmp_path):
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        pool = make_pool(store, substrate, "tbp", "v5litepod-4")
+        from batch_shipyard_tpu.jobs import manager as jobs_mgr
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "tbjob", "tasks": [{"command": "echo training"}]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        jobs_mgr.wait_for_tasks(store, "tbp", "tbjob", timeout=30)
+        plan = misc.plan_tensorboard_tunnel(
+            store, substrate, "tbp", "tbjob", "task-00000",
+            output_dir=str(tmp_path))
+        assert plan["local_url"] == "http://localhost:16006"
+        assert "--logdir" in plan["remote_command"]
+        assert os.path.exists(plan["tunnel_script"])
+    finally:
+        substrate.stop_all()
+
+
+def test_mirror_images_plan():
+    plan = misc.mirror_images_plan(["busybox:latest"], "my.registry")
+    assert ["docker", "pull", "busybox:latest"] in plan
+    assert ["docker", "push", "my.registry/busybox:latest"] in plan

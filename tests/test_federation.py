@@ -1,0 +1,129 @@
+"""Federation meta-scheduler tests: constraint filtering, greedy
+best-fit, end-to-end scheduling onto fake pools, HA lock, zap."""
+
+import json
+import time
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.federation import federation as fed
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_pool(store, substrate, pool_id, accel="v5litepod-4"):
+    conf = {"pool_specification": {
+        "id": pool_id, "substrate": "fake",
+        "tpu": {"accelerator_type": accel},
+        "max_wait_time_seconds": 30}}
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    return pool
+
+
+@pytest.fixture()
+def env():
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    yield store, substrate
+    substrate.stop_all()
+
+
+def test_federation_crud(env):
+    store, _ = env
+    fed.create_federation(store, "f1")
+    with pytest.raises(ValueError):
+        fed.create_federation(store, "f1")
+    fed.add_pool_to_federation(store, "f1", "pa")
+    fed.add_pool_to_federation(store, "f1", "pb")
+    assert fed.get_federation(store, "f1")["pools"] == ["pa", "pb"]
+    fed.remove_pool_from_federation(store, "f1", "pa")
+    assert fed.get_federation(store, "f1")["pools"] == ["pb"]
+    fed.destroy_federation(store, "f1")
+    with pytest.raises(ValueError):
+        fed.get_federation(store, "f1")
+
+
+def test_constraint_filter_and_best_fit(env):
+    store, substrate = env
+    make_pool(store, substrate, "small", "v5litepod-4")
+    make_pool(store, substrate, "big", "v5litepod-16")
+    facts = [f for f in (fed._pool_facts(store, p)
+                         for p in ("small", "big")) if f]
+    assert len(facts) == 2
+    eligible = fed.filter_pools_hard_constraints(
+        facts, {"min_chips": 8})
+    assert [f["pool_id"] for f in eligible] == ["big"]
+    # No constraints: best fit prefers most idle nodes (big pool).
+    choice = fed.greedy_best_fit(
+        fed.filter_pools_hard_constraints(facts, {}))
+    assert choice["pool_id"] == "big"
+    # Generation mismatch filters everything.
+    assert fed.filter_pools_hard_constraints(
+        facts, {"accelerator_generation": "v6e"}) == []
+
+
+def test_end_to_end_federated_job(env):
+    store, substrate = env
+    make_pool(store, substrate, "cpuish", "v5litepod-4")
+    make_pool(store, substrate, "podpool", "v5litepod-16")
+    fed.create_federation(store, "fed1")
+    fed.add_pool_to_federation(store, "fed1", "cpuish")
+    fed.add_pool_to_federation(store, "fed1", "podpool")
+    jobs_config = {"job_specifications": [{
+        "id": "fj",
+        "federation_constraints": {"min_chips": 16},
+        "tasks": [{"command": "echo federated"}],
+    }]}
+    fed.submit_job_to_federation(store, "fed1", jobs_config)
+    proc = fed.FederationProcessor(store)
+    assert proc.process_once() == 1
+    rows = fed.list_federation_jobs(store, "fed1")
+    assert rows[0]["pool_id"] == "podpool"
+    tasks = jobs_mgr.wait_for_tasks(store, "podpool", "fj", timeout=30)
+    assert tasks[0]["state"] == "completed"
+
+
+def test_unschedulable_job_requeues_then_schedules(env):
+    store, substrate = env
+    fed.create_federation(store, "fed2")
+    jobs_config = {"job_specifications": [{
+        "id": "fq", "tasks": [{"command": "echo late"}]}]}
+    fed.submit_job_to_federation(store, "fed2", jobs_config)
+    proc = fed.FederationProcessor(store, action_retry_delay=0.1)
+    assert proc.process_once() == 0  # no pools yet -> backoff
+    make_pool(store, substrate, "late-pool", "v5litepod-4")
+    fed.add_pool_to_federation(store, "fed2", "late-pool")
+    time.sleep(0.2)  # let the action become visible again
+    assert proc.process_once() == 1
+    jobs_mgr.wait_for_tasks(store, "late-pool", "fq", timeout=30)
+
+
+def test_zap_drops_action(env):
+    store, substrate = env
+    fed.create_federation(store, "fed3")
+    action_id = fed.submit_job_to_federation(
+        store, "fed3", {"job_specifications": [{
+            "id": "poison", "tasks": [{"command": "echo x"}]}]})
+    fed.zap_action(store, "fed3", action_id)
+    proc = fed.FederationProcessor(store)
+    proc.process_once()
+    from batch_shipyard_tpu.state import names
+    assert store.queue_length(names.federation_queue("fed3")) == 0
+
+
+def test_ha_single_scheduler(env):
+    store, _ = env
+    fed.create_federation(store, "fed4")
+    proc_a = fed.FederationProcessor(store, owner="a")
+    proc_b = fed.FederationProcessor(store, owner="b")
+    assert proc_a._hold_global_lock()
+    assert not proc_b._hold_global_lock()
+    # a renews fine; b still locked out
+    assert proc_a._hold_global_lock()
+    assert not proc_b._hold_global_lock()

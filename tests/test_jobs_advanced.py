@@ -123,8 +123,15 @@ def test_migrate_job_between_pools():
         jobs = settings_mod.job_settings_list({"job_specifications": [{
             "id": "jmig", "tasks": [{"command": "echo migrated"}]}]})
         jobs_mgr.add_jobs(store, src, jobs)
+        # Active jobs must be disabled first.
+        with pytest.raises(RuntimeError):
+            jobs_mgr.migrate_job(store, "src", "jmig", "dst")
+        jobs_mgr.disable_job(store, "src", "jmig")
+        with pytest.raises(ValueError):
+            jobs_mgr.migrate_job(store, "src", "jmig", "nopool")
         moved = jobs_mgr.migrate_job(store, "src", "jmig", "dst")
         assert moved == 1
+        jobs_mgr.enable_job(store, "dst", "jmig")
         with pytest.raises(jobs_mgr.JobNotFoundError):
             jobs_mgr.get_job(store, "src", "jmig")
         tasks = jobs_mgr.wait_for_tasks(store, "dst", "jmig",
