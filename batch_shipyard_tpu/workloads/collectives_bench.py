@@ -1,0 +1,53 @@
+"""Collective microbenchmark payload: the mpiBench/OSU recipe analog.
+
+Times psum/all_gather/ppermute/reduce_scatter over the device mesh and
+prints per-size bus bandwidth. Over a pod slice this measures the ICI
+fabric the way mpiBench measured Infiniband.
+
+Usage (recipe command):
+    python -m batch_shipyard_tpu.workloads.collectives_bench \
+        --sizes 65536,1048576,16777216
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+
+from batch_shipyard_tpu.ops import collectives
+from batch_shipyard_tpu.parallel import mesh as mesh_mod
+from batch_shipyard_tpu.workloads import distributed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes", default="65536,1048576,16777216",
+                        help="comma-separated message sizes in bytes")
+    parser.add_argument("--ops",
+                        default="psum,all_gather,ppermute,"
+                                "reduce_scatter")
+    parser.add_argument("--dtype", default="bfloat16")
+    args = parser.parse_args()
+
+    ctx = distributed.setup()
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        distributed.log(ctx, "single device: collective bench is a "
+                             "no-op loopback")
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
+    rows = collectives.run_collective_bench(
+        mesh, axis="dp",
+        ops=tuple(args.ops.split(",")),
+        sizes_bytes=tuple(int(s) for s in args.sizes.split(",")),
+        dtype=getattr(jnp, args.dtype))
+    if jax.process_index() == 0:
+        for row in rows:
+            print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

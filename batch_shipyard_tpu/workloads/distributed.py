@@ -1,0 +1,37 @@
+"""Shared bootstrap for distributed workload payloads.
+
+Reads the gang env synthesized by jobs/launcher.py (the mpirun-env
+analog) and initializes jax.distributed accordingly; single-instance
+runs skip initialization. Every recipe payload calls setup() first.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def setup() -> dict:
+    """Initialize jax.distributed from the SHIPYARD/JAX env contract;
+    returns a context dict with process/topology info."""
+    instances = int(os.environ.get("SHIPYARD_TASK_INSTANCES", "1"))
+    instance = int(os.environ.get("SHIPYARD_TASK_INSTANCE", "0"))
+    if instances > 1 and os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # jax.distributed.initialize reads JAX_COORDINATOR_ADDRESS,
+        # JAX_NUM_PROCESSES, JAX_PROCESS_ID from the env our launcher
+        # synthesized (batch.py:4362 _construct_mpi_command analog).
+        jax.distributed.initialize()
+    return {
+        "instances": instances,
+        "instance": instance,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def log(ctx: dict, message: str) -> None:
+    print(f"[proc {ctx['process_index']}/{ctx['process_count']}] "
+          f"{message}", flush=True)

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Install batch-shipyard-tpu into a venv (reference analog: install.sh).
+set -euo pipefail
+VENV="${1:-.shipyard-tpu-venv}"
+python3 -m venv "$VENV"
+# shellcheck disable=SC1091
+source "$VENV/bin/activate"
+pip install --upgrade pip
+pip install -e "$(cd "$(dirname "$0")" && pwd)"
+echo "Installed. Activate with: source $VENV/bin/activate"
+echo "Then: shipyard-tpu --help"
