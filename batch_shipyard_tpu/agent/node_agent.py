@@ -172,7 +172,10 @@ class NodeAgent:
     def _heartbeat_loop(self) -> None:
         while not self.stop_event.wait(self.heartbeat_interval):
             self._heartbeat()
-        self._set_node_state("offline")
+        # Final state write must NOT resurrect a node entity the
+        # substrate already deleted (teardown race) — _heartbeat
+        # merges and tolerates a missing row.
+        self._heartbeat(state="offline")
 
     # --------------------------- work loop -----------------------------
 

@@ -115,6 +115,12 @@ def blockwise_mha(q, k, v, causal: bool = True, block_size: int = 512,
     k_blocks = k.reshape(batch, num_blocks, block_size, *k.shape[2:])
     v_blocks = v.reshape(batch, num_blocks, block_size, *v.shape[2:])
 
+    # Rematerialize each block update: without this, the scan's
+    # backward saves every block's score/probability matrices
+    # ([B,H,Tq,block] fp32 per step — gigabytes per layer), defeating
+    # the whole point of blockwise attention. With it, the backward
+    # recomputes scores per block (the flash-attention property).
+    @jax.checkpoint
     def step(carry, blk):
         o, m, l = carry
         k_blk, v_blk, blk_idx = blk

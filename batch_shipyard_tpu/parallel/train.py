@@ -76,8 +76,12 @@ def build_transformer_train(
         out_shardings=None)(params)
 
     def loss_fn(params, tokens, targets):
-        logits = model.apply({"params": params}, tokens)
-        return tfm.lm_loss(logits, targets)
+        # Chunked tied-embedding loss: the full [B, T, vocab] fp32
+        # logits tensor never materializes (see lm_loss_chunked).
+        hidden = model.apply({"params": params}, tokens,
+                             return_hidden=True)
+        return tfm.lm_loss_chunked(
+            hidden, params["embed"]["embedding"], targets)
 
     @functools.partial(
         jax.jit, donate_argnums=(0, 1),

@@ -43,6 +43,7 @@ class FakePodSubstrate(base.ComputeSubstrate):
         # node_id -> failure mode
         self.inject: dict[str, str] = {}
         self._agents: dict[str, dict[str, NodeAgent]] = {}
+        self._boot_threads: dict[str, threading.Thread] = {}
         self._boot_counts: dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -94,11 +95,14 @@ class FakePodSubstrate(base.ComputeSubstrate):
                 "internal_ip": identity.internal_ip,
                 "node_index": node_index, "slice_index": slice_index,
                 "worker_index": worker_index})
-        with self._lock:
-            self._agents.setdefault(pool.id, {})[node_id] = agent
         thread = threading.Thread(
             target=self._boot_agent, args=(agent,),
             name=f"fakepod-boot-{node_id}", daemon=True)
+        # Agent + boot thread register atomically so teardown always
+        # sees (and joins) the boot thread of any agent it stops.
+        with self._lock:
+            self._agents.setdefault(pool.id, {})[node_id] = agent
+            self._boot_threads[node_id] = thread
         thread.start()
 
     def _boot_agent(self, agent: NodeAgent) -> None:
@@ -129,6 +133,11 @@ class FakePodSubstrate(base.ComputeSubstrate):
         for agent in agents.values():
             agent.stop()
         for agent in agents.values():
+            with self._lock:
+                boot = self._boot_threads.pop(
+                    agent.identity.node_id, None)
+            if boot is not None:
+                boot.join(timeout=10.0)
             agent.join(timeout=5.0)
         for row in list(self.store.query_entities(
                 names.TABLE_NODES, partition_key=pool_id)):
@@ -169,8 +178,11 @@ class FakePodSubstrate(base.ComputeSubstrate):
                 with self._lock:
                     agent = self._agents.get(pool.id, {}).pop(
                         node_id, None)
+                    boot = self._boot_threads.pop(node_id, None)
                 if agent is not None:
                     agent.stop()
+                    if boot is not None:
+                        boot.join(timeout=10.0)
                     agent.join(timeout=5.0)
                 self.store.delete_entity(
                     names.TABLE_NODES, pool.id, node_id)
@@ -183,11 +195,20 @@ class FakePodSubstrate(base.ComputeSubstrate):
         for agent in victims:
             agent.stop()
         for agent in victims:
+            node_id = agent.identity.node_id
+            # Join the boot thread first: an agent still inside
+            # start() has not registered its worker/heartbeat threads
+            # yet, and a late state write from it would clobber the
+            # replacement agent's row.
+            with self._lock:
+                boot = self._boot_threads.pop(node_id, None)
+            if boot is not None:
+                boot.join(timeout=10.0)
             agent.join(timeout=5.0)
             with self._lock:
-                agents.pop(agent.identity.node_id, None)
-            self.store.delete_entity(
-                names.TABLE_NODES, pool_id, agent.identity.node_id)
+                agents.pop(node_id, None)
+            self.store.delete_entity(names.TABLE_NODES, pool_id,
+                                     node_id)
 
     def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
         self._teardown_slice(pool.id, slice_index)
