@@ -221,6 +221,13 @@ class NodeAgent:
                     kind=control.get("kind", "docker"))
         elif kind == "cleanup_mi":
             self._cleanup_mi_containers()
+        elif kind == "upload_logs":
+            self._upload_node_logs()
+        elif kind == "install_ssh_key":
+            self._install_ssh_key(control.get("username", "shipyard"),
+                                  control.get("public_key", ""))
+        elif kind == "remove_ssh_user":
+            self._remove_ssh_user(control.get("username", "shipyard"))
 
     # ------------------------ task processing --------------------------
 
@@ -744,6 +751,63 @@ class NodeAgent:
 
     def _job_shared_dir(self, job_id: str) -> str:
         return os.path.join(self.work_dir, "shared", job_id)
+
+    def _upload_node_logs(self, max_bytes: int = 8 * 1024 * 1024
+                          ) -> None:
+        """Ship node-side logs to the object store (diag logs upload
+        analog, batch.py:3151). Uploads the agent log (if present)
+        and the nodeprep marker."""
+        candidates = [
+            os.path.join(self.work_dir, "agent.log"),
+            os.path.join(os.path.dirname(self.work_dir), "agent.log"),
+            os.path.join(self.work_dir, ".nodeprep_finished"),
+        ]
+        for path in candidates:
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as fh:
+                data = fh.read(max_bytes)
+            self.store.put_object(
+                names.node_log_key(self.identity.pool_id,
+                                   self.identity.node_id,
+                                   os.path.basename(path)), data)
+
+    def _install_ssh_key(self, username: str, public_key: str) -> None:
+        """Append a public key to the agent user's authorized_keys
+        (pool user add analog, batch.py:1045 add_ssh_user). On real
+        nodes this manages ~username; under fake/localhost substrates
+        it records into the work dir for inspection."""
+        if not public_key:
+            return
+        ssh_dir = os.path.expanduser(f"~{username}/.ssh")
+        if ssh_dir.startswith("~"):
+            # User does not exist on this host (expanduser returned
+            # the literal): record under the work dir instead.
+            ssh_dir = os.path.join(self.work_dir, "ssh", username)
+        try:
+            os.makedirs(ssh_dir, mode=0o700, exist_ok=True)
+        except (PermissionError, OSError):
+            ssh_dir = os.path.join(self.work_dir, "ssh", username)
+            os.makedirs(ssh_dir, exist_ok=True)
+        auth = os.path.join(ssh_dir, "authorized_keys")
+        existing = ""
+        if os.path.exists(auth):
+            with open(auth, "r", encoding="utf-8") as fh:
+                existing = fh.read()
+        if public_key.strip() not in existing:
+            with open(auth, "a", encoding="utf-8") as fh:
+                fh.write(public_key.strip() + "\n")
+            os.chmod(auth, 0o600)
+
+    def _remove_ssh_user(self, username: str) -> None:
+        for base in (os.path.expanduser(f"~{username}/.ssh"),
+                     os.path.join(self.work_dir, "ssh", username)):
+            auth = os.path.join(base, "authorized_keys")
+            if os.path.exists(auth):
+                try:
+                    os.remove(auth)
+                except OSError:
+                    pass
 
     def _cleanup_mi_containers(self) -> None:
         """Remove orphaned (exited/created, NOT running) shipyard-*

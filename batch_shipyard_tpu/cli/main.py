@@ -126,6 +126,42 @@ def pool_ssh(click_ctx, node_id):
     fleet.action_pool_ssh(_ctx(click_ctx), node_id)
 
 
+@pool.command("suspend")
+@click.pass_context
+def pool_suspend(click_ctx):
+    """Stop the pool's machines without deleting the pool."""
+    fleet.action_pool_suspend(_ctx(click_ctx))
+
+
+@pool.command("start")
+@click.pass_context
+def pool_start(click_ctx):
+    """Restart a suspended pool."""
+    fleet.action_pool_start(_ctx(click_ctx))
+
+
+@pool.group()
+def user():
+    """SSH user management on pool nodes."""
+
+
+@user.command("add")
+@click.option("--username", default="shipyard")
+@click.option("--output-dir", default=".")
+@click.pass_context
+def pool_user_add(click_ctx, username, output_dir):
+    private_path, _public = fleet.action_pool_user_add(
+        _ctx(click_ctx), username, output_dir)
+    click.echo(f"private key: {private_path}")
+
+
+@user.command("del")
+@click.option("--username", default="shipyard")
+@click.pass_context
+def pool_user_del(click_ctx, username):
+    fleet.action_pool_user_del(_ctx(click_ctx), username)
+
+
 @pool.group()
 def images():
     """Container image management on pool nodes."""
@@ -342,6 +378,19 @@ def diag_perf(click_ctx):
     fleet.action_perf_events(_ctx(click_ctx), raw=click_ctx.obj["raw"])
 
 
+@diag.group("logs")
+def diag_logs():
+    """Node log management."""
+
+
+@diag_logs.command("upload")
+@click.pass_context
+def diag_logs_upload(click_ctx):
+    """Ask every node to ship its logs to the object store."""
+    count = fleet.action_diag_logs_upload(_ctx(click_ctx))
+    click.echo(f"log upload requested on {count} nodes")
+
+
 @diag.command("gantt")
 @click.option("--output", default=None,
               help="PNG output path (requires matplotlib)")
@@ -351,6 +400,20 @@ def diag_gantt(click_ctx, output):
     from batch_shipyard_tpu.graph import perf_graph
     ctx = _ctx(click_ctx)
     click.echo(perf_graph.graph_data(ctx.store, ctx.pool.id, output))
+
+
+# ------------------------------ account --------------------------------
+
+@cli.group()
+def account():
+    """Account / environment information."""
+
+
+@account.command("info")
+@click.pass_context
+def account_info(click_ctx):
+    fleet.action_account_info(_ctx(click_ctx),
+                              raw=click_ctx.obj["raw"])
 
 
 # ------------------------------ storage --------------------------------

@@ -204,6 +204,77 @@ def action_pool_images_update(ctx: Context, image: str,
             "type": "load_images", "images": [image], "kind": kind})
 
 
+def action_pool_suspend(ctx: Context) -> None:
+    pool = ctx.pool
+    ctx.substrate().suspend_pool(pool)
+    ctx.store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                           {"state": "suspended"})
+    logger.info("pool %s suspended", pool.id)
+
+
+def action_pool_start(ctx: Context) -> None:
+    pool = ctx.pool
+    ctx.substrate().start_pool(pool)
+    nodes = pool_mgr.wait_for_pool_ready(ctx.store, ctx.substrate(),
+                                         pool)
+    ctx.store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
+                           {"state": "ready"})
+    logger.info("pool %s started with %d nodes", pool.id, len(nodes))
+
+
+def action_pool_user_add(ctx: Context, username: str,
+                         output_dir: str = ".") -> tuple[str, str]:
+    """Generate a keypair and install the public key on every node
+    (pool user add analog, batch.py:1045)."""
+    from batch_shipyard_tpu.utils import crypto
+    private_path, public_path = crypto.generate_ssh_keypair(
+        output_dir, name=f"id_rsa_shipyard_{ctx.pool.id}")
+    with open(public_path, "r", encoding="utf-8") as fh:
+        public_key = fh.read().strip()
+    for node in pool_mgr.list_nodes(ctx.store, ctx.pool.id):
+        pool_mgr.send_control(ctx.store, ctx.pool.id, node.node_id, {
+            "type": "install_ssh_key", "username": username,
+            "public_key": public_key})
+    logger.info("ssh key %s fanned out to pool %s", public_path,
+                ctx.pool.id)
+    return private_path, public_path
+
+
+def action_pool_user_del(ctx: Context, username: str) -> None:
+    for node in pool_mgr.list_nodes(ctx.store, ctx.pool.id):
+        pool_mgr.send_control(ctx.store, ctx.pool.id, node.node_id, {
+            "type": "remove_ssh_user", "username": username})
+
+
+def action_diag_logs_upload(ctx: Context) -> int:
+    """Ask every node to ship its logs to the object store
+    (diag logs upload analog, batch.py:3151)."""
+    count = 0
+    for node in pool_mgr.list_nodes(ctx.store, ctx.pool.id):
+        pool_mgr.send_control(ctx.store, ctx.pool.id, node.node_id,
+                              {"type": "upload_logs"})
+        count += 1
+    return count
+
+
+def action_account_info(ctx: Context, raw: bool = False) -> None:
+    """Account/environment summary (account info/quota analog,
+    shipyard.py:1009)."""
+    creds = ctx.credentials
+    info: dict = {
+        "storage_backend": creds.storage.backend,
+        "storage_prefix": creds.storage.prefix,
+        "gcp_project": creds.gcp.project if creds.gcp else None,
+        "pools": [p["_rk"] for p in pool_mgr.list_pools(ctx.store)],
+    }
+    try:
+        import jax
+        info["local_accelerators"] = [str(d) for d in jax.devices()]
+    except Exception:
+        info["local_accelerators"] = []
+    _emit(info, raw)
+
+
 # ------------------------------ job actions ----------------------------
 
 def action_jobs_add(ctx: Context, tail: Optional[str] = None) -> dict:

@@ -61,6 +61,18 @@ class ComputeSubstrate(abc.ABC):
                          node_id: str) -> Optional[tuple[str, int]]:
         """(ip, ssh port) for a node, if reachable."""
 
+    def suspend_pool(self, pool: PoolSettings) -> None:
+        """Stop the pool's machines without losing its definition
+        (suspend/start parity: reference fleet.py:3203+ for fs/monitor/
+        fed/slurm resources; TPU VMs support stop/start)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support suspend")
+
+    def start_pool(self, pool: PoolSettings) -> None:
+        """Restart a suspended pool's machines."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support start")
+
     def ensure_attached(self, pool: PoolSettings) -> None:
         """Re-attach to an existing pool from a fresh process.
 

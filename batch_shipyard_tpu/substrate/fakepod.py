@@ -217,6 +217,35 @@ class FakePodSubstrate(base.ComputeSubstrate):
             self._spawn_agent(pool, slice_index, w,
                               slice_index * workers + w)
 
+    def suspend_pool(self, pool: PoolSettings) -> None:
+        """Stop agents but keep node entities (marked suspended)."""
+        with self._lock:
+            agents = list(self._agents.get(pool.id, {}).values())
+        for agent in agents:
+            agent.stop()
+        for agent in agents:
+            node_id = agent.identity.node_id
+            with self._lock:
+                boot = self._boot_threads.pop(node_id, None)
+            if boot is not None:
+                boot.join(timeout=10.0)
+            agent.join(timeout=5.0)
+            with self._lock:
+                self._agents.get(pool.id, {}).pop(node_id, None)
+            try:
+                self.store.merge_entity(names.TABLE_NODES, pool.id,
+                                        node_id, {"state": "suspended"})
+            except Exception:
+                pass
+
+    def start_pool(self, pool: PoolSettings) -> None:
+        """Respawn agents for suspended node entities."""
+        for row in list(self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool.id)):
+            self._spawn_agent(pool, int(row.get("slice_index", 0)),
+                              int(row.get("worker_index", 0)),
+                              int(row.get("node_index", 0)))
+
     def ensure_attached(self, pool: PoolSettings) -> None:
         """Revive simulated agents for node entities that have no live
         in-process agent (fresh CLI process attaching to a fake pool)."""

@@ -194,6 +194,20 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
                            slice_index)
         self._create_slice(pool, slice_index)
 
+    def suspend_pool(self, pool: PoolSettings) -> None:
+        """gcloud tpu-vm stop on every slice (billing pause)."""
+        for s in range(pool.tpu.num_slices):
+            self._gcloud("stop", self.slice_name(pool.id, s))
+        for row in list(self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool.id)):
+            self.store.merge_entity(names.TABLE_NODES, pool.id,
+                                    row["_rk"], {"state": "suspended"})
+
+    def start_pool(self, pool: PoolSettings) -> None:
+        for s in range(pool.tpu.num_slices):
+            self._gcloud("start", self.slice_name(pool.id, s))
+            self._bootstrap_agents(pool, s)
+
     def get_remote_login(self, pool_id: str,
                          node_id: str) -> Optional[tuple[str, int]]:
         try:

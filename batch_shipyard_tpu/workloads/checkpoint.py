@@ -1,0 +1,76 @@
+"""Training checkpoint/resume via Orbax.
+
+Reference context (SURVEY.md section 5.4): the reference has no
+application checkpointing (it is an orchestrator); for the TPU build,
+app-level checkpointing is a workload concern — this module gives the
+recipe payloads a save/restore surface over Orbax so preempted or
+migrated jobs resume instead of restarting. Orchestrator-level
+suspend/resume and job migration live in pool/jobs managers.
+
+Checkpoints go to a local path or, in a pool, typically the job's
+shared directory (SHIPYARD_JOB_SHARED_DIR) or a gcsfuse mount so every
+worker sees them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save(checkpoint_dir: str, step: int, params: Any,
+         opt_state: Any) -> str:
+    """Write checkpoint step N; returns its path."""
+    import jax
+    path = os.path.join(os.path.abspath(checkpoint_dir),
+                        f"step_{step:08d}")
+    state = {"params": params, "opt_state": opt_state,
+             "step": step}
+    if jax.process_index() == 0:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+    _checkpointer().save(path, state, force=True)
+    logger.info("checkpoint saved: %s", path)
+    return path
+
+
+def latest_step(checkpoint_dir: str) -> Optional[int]:
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    steps = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name.split("_", 1)[1]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def restore(checkpoint_dir: str, params_template: Any,
+            opt_state_template: Any) -> Optional[tuple]:
+    """Restore the latest checkpoint matching the given pytree
+    structure (shardings preserved from the templates); returns
+    (params, opt_state, step) or None when no checkpoint exists."""
+    step = latest_step(checkpoint_dir)
+    if step is None:
+        return None
+    path = os.path.join(os.path.abspath(checkpoint_dir),
+                        f"step_{step:08d}")
+    template = {"params": params_template,
+                "opt_state": opt_state_template, "step": step}
+    import orbax.checkpoint as ocp
+    restored = _checkpointer().restore(
+        path, item=template,
+        restore_args=ocp.checkpoint_utils.construct_restore_args(
+            template))
+    logger.info("checkpoint restored: %s", path)
+    return restored["params"], restored["opt_state"], restored["step"]

@@ -39,6 +39,11 @@ def main() -> int:
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--no-remat", action="store_true")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="Orbax checkpoint dir (use the job "
+                             "shared dir or a gcsfuse mount on pools)")
+    parser.add_argument("--checkpoint-every", type=int, default=0,
+                        help="Save every N steps (0 = only at end)")
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -63,16 +68,33 @@ def main() -> int:
             jnp.int32),
     }
     params, opt_state = harness.params, harness.opt_state
+    start_step = 0
+    if args.checkpoint_dir:
+        from batch_shipyard_tpu.workloads import checkpoint
+        restored = checkpoint.restore(args.checkpoint_dir, params,
+                                      opt_state)
+        if restored is not None:
+            params, opt_state, start_step = restored
+            distributed.log(ctx, f"resumed from step {start_step}")
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)
     float(metrics["loss"])  # hard sync
     start = time.perf_counter()
-    for _ in range(args.steps):
+    for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)
+        if args.checkpoint_dir and args.checkpoint_every and (
+                (step_num + 1) % args.checkpoint_every == 0):
+            from batch_shipyard_tpu.workloads import checkpoint
+            checkpoint.save(args.checkpoint_dir, step_num + 1, params,
+                            opt_state)
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
+    if args.checkpoint_dir:
+        from batch_shipyard_tpu.workloads import checkpoint
+        checkpoint.save(args.checkpoint_dir, start_step + args.steps,
+                        params, opt_state)
     tokens_per_sec = args.batch * args.seq_len * args.steps / elapsed
     distributed.log(ctx, (
         f"transformer: mesh={dict(mesh.shape)} "

@@ -1,0 +1,144 @@
+"""Pool suspend/start, ssh user fan-out, diag logs, account info, and
+workload checkpoint/resume tests."""
+
+import json
+import os
+import time
+
+import pytest
+
+from batch_shipyard_tpu import fleet
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_ctx(tmp_path, pool_id="op"):
+    creds = {"credentials": {"storage": {
+        "backend": "localfs", "root": str(tmp_path / "store")}}}
+    pool_conf = {"pool_specification": {
+        "id": pool_id, "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30}}
+    ctx = fleet.load_context(extra={"credentials": creds,
+                                    "pool": pool_conf})
+    fleet.action_pool_add(ctx)
+    return ctx
+
+
+def test_pool_suspend_start(tmp_path):
+    ctx = make_ctx(tmp_path)
+    try:
+        fleet.action_pool_suspend(ctx)
+        nodes = pool_mgr.list_nodes(ctx.store, "op")
+        assert all(n.state in ("suspended", "offline") for n in nodes)
+        assert pool_mgr.get_pool(ctx.store, "op")[
+            "state"] == "suspended"
+        fleet.action_pool_start(ctx)
+        nodes = pool_mgr.list_nodes(ctx.store, "op")
+        assert all(n.state == "idle" for n in nodes)
+        # Pool is functional again.
+        jobs = settings_mod.job_settings_list({"job_specifications": [
+            {"id": "after", "tasks": [{"command": "echo back"}]}]})
+        jobs_mgr.add_jobs(ctx.store, ctx.pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(ctx.store, "op", "after",
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed"
+    finally:
+        ctx.substrate().stop_all()
+
+
+def test_pool_user_add_del(tmp_path):
+    ctx = make_ctx(tmp_path)
+    try:
+        private_path, public_path = fleet.action_pool_user_add(
+            ctx, "tester", str(tmp_path))
+        assert os.path.exists(private_path)
+        substrate = ctx.substrate()
+        deadline = time.monotonic() + 10
+        found = False
+        while time.monotonic() < deadline and not found:
+            for node in pool_mgr.list_nodes(ctx.store, "op"):
+                agent = substrate.agent("op", node.node_id)
+                if agent is None:
+                    continue
+                auth = os.path.join(agent.work_dir, "ssh", "tester",
+                                    "authorized_keys")
+                if os.path.exists(auth):
+                    found = True
+                    break
+            time.sleep(0.1)
+        assert found, "public key never landed on any node"
+        fleet.action_pool_user_del(ctx, "tester")
+    finally:
+        ctx.substrate().stop_all()
+
+
+def test_diag_logs_upload(tmp_path):
+    ctx = make_ctx(tmp_path)
+    try:
+        count = fleet.action_diag_logs_upload(ctx)
+        assert count == 1  # v5e-4 = 1 worker
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            keys = ctx.store.list_objects("nodelogs/op/")
+            if keys:
+                break
+            time.sleep(0.1)
+        assert any(k.endswith(".nodeprep_finished") for k in keys)
+    finally:
+        ctx.substrate().stop_all()
+
+
+def test_account_info(tmp_path, capsys):
+    ctx = make_ctx(tmp_path)
+    try:
+        fleet.action_account_info(ctx, raw=True)
+        out = json.loads(capsys.readouterr().out)
+        assert out["storage_backend"] == "localfs"
+        assert "op" in out["pools"]
+    finally:
+        ctx.substrate().stop_all()
+
+
+def test_workload_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+    from batch_shipyard_tpu.parallel import train as train_mod
+    from batch_shipyard_tpu.workloads import checkpoint
+
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
+    config = train_mod.make_transformer_config(
+        mesh, vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq_len=32, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    harness = train_mod.build_transformer_train(
+        mesh, config, batch_size=8, seq_len=32)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(rng.randint(0, 128, (8, 32)), jnp.int32),
+        "targets": jnp.asarray(rng.randint(0, 128, (8, 32)),
+                               jnp.int32)}
+    params, opt_state, _ = harness.step(harness.params,
+                                        harness.opt_state, batch)
+    ckpt_dir = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt_dir, 1, params, opt_state)
+    assert checkpoint.latest_step(ckpt_dir) == 1
+    restored = checkpoint.restore(ckpt_dir, params, opt_state)
+    assert restored is not None
+    r_params, _r_opt, step = restored
+    assert step == 1
+    leaf = jax.tree_util.tree_leaves(r_params)[0]
+    orig = jax.tree_util.tree_leaves(params)[0]
+    np.testing.assert_allclose(np.asarray(leaf), np.asarray(orig))
+    assert checkpoint.restore(str(tmp_path / "empty"), params,
+                              opt_state) is None
+
+
+import jax  # noqa: E402
