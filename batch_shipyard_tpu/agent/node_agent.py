@@ -92,6 +92,8 @@ class NodeAgent:
         # time on cloud backends.
         self._job_state_cache: dict[str, tuple[str, float]] = {}
         self._job_state_ttl = job_state_ttl
+        # (job_id, task_id) -> live Popen, for task termination relay.
+        self._live_procs: dict[tuple[str, str], object] = {}
 
     # ------------------------- node lifecycle --------------------------
 
@@ -158,6 +160,14 @@ class NodeAgent:
                               daemon=True)
         hb.start()
         self._threads.append(hb)
+        # Control messages get their own thread: worker slots block
+        # while running tasks, and controls (task termination,
+        # shutdown) must still be honored.
+        ctrl = threading.Thread(target=self._control_loop,
+                                name=f"ctrl-{self.identity.node_id}",
+                                daemon=True)
+        ctrl.start()
+        self._threads.append(ctrl)
 
     def stop(self) -> None:
         self.stop_event.set()
@@ -179,19 +189,25 @@ class NodeAgent:
 
     # --------------------------- work loop -----------------------------
 
+    def _control_loop(self) -> None:
+        pool_id, node_id = self._nid
+        ctrlq = names.control_queue(pool_id, node_id)
+        while not self.stop_event.is_set():
+            msgs = self.store.get_messages(
+                ctrlq, max_messages=4, visibility_timeout=60.0)
+            for msg in msgs:
+                try:
+                    self._handle_control(json.loads(msg.payload))
+                except Exception:
+                    logger.exception("control message failed")
+                self.store.delete_message(msg)
+            if not msgs:
+                time.sleep(self.poll_interval)
+
     def _worker_loop(self, slot: int) -> None:
         pool_id, node_id = self._nid
         taskq = names.task_queue(pool_id)
-        ctrlq = names.control_queue(pool_id, node_id)
         while not self.stop_event.is_set():
-            # Control messages first (job release, shutdown).
-            if slot == 0:
-                for msg in self.store.get_messages(
-                        ctrlq, max_messages=4, visibility_timeout=60.0):
-                    self._handle_control(json.loads(msg.payload))
-                    self.store.delete_message(msg)
-                if self.stop_event.is_set():
-                    break
             msgs = self.store.get_messages(
                 taskq, max_messages=1, visibility_timeout=60.0)
             if not msgs:
@@ -228,6 +244,9 @@ class NodeAgent:
                                   control.get("public_key", ""))
         elif kind == "remove_ssh_user":
             self._remove_ssh_user(control.get("username", "shipyard"))
+        elif kind == "term_task":
+            self._terminate_running_task(control["job_id"],
+                                         control["task_id"])
 
     # ------------------------ task processing --------------------------
 
@@ -447,9 +466,14 @@ class NodeAgent:
             self._heartbeat(state="running")
             with self._running_lock:
                 self._running_tasks += 1
+            key = (job_id, task_id)
             try:
-                result = task_runner.run_task(execution)
+                result = task_runner.run_task(
+                    execution,
+                    on_start=lambda proc: self._live_procs.__setitem__(
+                        key, proc))
             finally:
+                self._live_procs.pop(key, None)
                 with self._running_lock:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
@@ -751,6 +775,20 @@ class NodeAgent:
 
     def _job_shared_dir(self, job_id: str) -> str:
         return os.path.join(self.work_dir, "shared", job_id)
+
+    def _terminate_running_task(self, job_id: str,
+                                task_id: str) -> None:
+        """Kill a task's live process group (tasks term analog incl.
+        the docker kill signal relay, batch.py:2630 — docker run
+        processes are killed through their process group here)."""
+        proc = self._live_procs.get((job_id, task_id))
+        if proc is None:
+            return
+        import signal as signal_mod
+        try:
+            os.killpg(os.getpgid(proc.pid), signal_mod.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def _upload_node_logs(self, max_bytes: int = 8 * 1024 * 1024
                           ) -> None:

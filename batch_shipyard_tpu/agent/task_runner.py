@@ -149,11 +149,14 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
 
 
 def run_task(execution: TaskExecution,
-             base_env: Optional[dict[str, str]] = None) -> TaskResult:
+             base_env: Optional[dict[str, str]] = None,
+             on_start=None) -> TaskResult:
     """Execute the task, streaming stdout/stderr to files in task_dir.
 
     Enforces max_wall_time by process-group kill (the agent-side analog
-    of Azure Batch maxWallClockTime task constraints).
+    of Azure Batch maxWallClockTime task constraints). ``on_start`` is
+    called with the Popen handle once the process exists (used by the
+    agent to support task termination).
     """
     os.makedirs(execution.task_dir, exist_ok=True)
     stdout_path = os.path.join(execution.task_dir, "stdout.txt")
@@ -167,6 +170,8 @@ def run_task(execution: TaskExecution,
         proc = subprocess.Popen(
             argv, stdout=out, stderr=err, env=env, cwd=execution.task_dir,
             start_new_session=True)
+        if on_start is not None:
+            on_start(proc)
         try:
             exit_code = proc.wait(timeout=execution.max_wall_time_seconds)
         except subprocess.TimeoutExpired:

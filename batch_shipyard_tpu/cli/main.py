@@ -339,6 +339,19 @@ def jobs_tasks_list(click_ctx, job_id):
                                  raw=click_ctx.obj["raw"])
 
 
+@tasks.command("term")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--wait", is_flag=True, default=False)
+@click.pass_context
+def jobs_tasks_term(click_ctx, job_id, task_id, wait):
+    """Terminate a single task (kills its process on the node)."""
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    jobs_mgr.terminate_task(ctx.store, ctx.pool.id, job_id, task_id,
+                            wait=wait)
+
+
 # ------------------------------- data ----------------------------------
 
 @cli.group()
@@ -353,6 +366,40 @@ def data():
 @click.pass_context
 def data_stream(click_ctx, job_id, task_id, filename):
     fleet.action_data_stream(_ctx(click_ctx), job_id, task_id, filename)
+
+
+@data.group("files")
+def data_files():
+    """Task file access."""
+
+
+@data_files.command("list")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.pass_context
+def data_files_list(click_ctx, job_id, task_id):
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    for name in jobs_mgr.list_task_files(ctx.store, ctx.pool.id,
+                                         job_id, task_id):
+        click.echo(name)
+
+
+@data_files.command("get")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--dest", default=".")
+@click.pass_context
+def data_files_get(click_ctx, job_id, task_id, dest):
+    """Download all of a task's uploaded files."""
+    from batch_shipyard_tpu.data import movement
+    from batch_shipyard_tpu.state import names as names_mod
+    ctx = _ctx(click_ctx)
+    prefix = names_mod.task_output_key(ctx.pool.id, job_id, task_id,
+                                       "")
+    count = movement.egress_from_storage(ctx.store,
+                                         prefix.rstrip("/"), dest)
+    click.echo(f"downloaded {count} files to {dest}")
 
 
 @data.command("ingress")

@@ -21,7 +21,7 @@ from batch_shipyard_tpu.config.settings import (
 from batch_shipyard_tpu.jobs.task_factory import expand_task_factory
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
-    EntityExistsError, NotFoundError, StateStore)
+    EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -361,6 +361,49 @@ def cleanup_mi_containers(store: StateStore, pool_id: str) -> int:
             json.dumps({"type": "cleanup_mi"}).encode())
         count += 1
     return count
+
+
+def terminate_task(store: StateStore, pool_id: str, job_id: str,
+                   task_id: str, wait: bool = False,
+                   timeout: float = 60.0) -> None:
+    """Terminate one task (tasks term analog, batch.py:2770): pending
+    tasks are marked failed; running tasks get a kill relayed to their
+    node's agent."""
+    task = get_task(store, pool_id, job_id, task_id)
+    state = task.get("state")
+    if state in ("completed", "failed", "blocked"):
+        return
+    if state == "pending":
+        try:
+            store.merge_entity(
+                names.TABLE_TASKS, names.task_pk(pool_id, job_id),
+                task_id, {"state": "failed", "exit_code": -9,
+                          "error": "terminated by user"},
+                if_match=task["_etag"])
+            return
+        except EtagMismatchError:
+            task = get_task(store, pool_id, job_id, task_id)
+    node_id = task.get("node_id")
+    if node_id:
+        store.put_message(
+            names.control_queue(pool_id, node_id),
+            json.dumps({"type": "term_task", "job_id": job_id,
+                        "task_id": task_id}).encode())
+    if wait:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            task = get_task(store, pool_id, job_id, task_id)
+            if task.get("state") in ("completed", "failed", "blocked"):
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"task {task_id} did not terminate")
+
+
+def list_task_files(store: StateStore, pool_id: str, job_id: str,
+                    task_id: str) -> list[str]:
+    """List a task's uploaded files (data files list analog)."""
+    prefix = names.task_output_key(pool_id, job_id, task_id, "")
+    return [k[len(prefix):] for k in store.list_objects(prefix)]
 
 
 def delete_job(store: StateStore, pool_id: str, job_id: str) -> None:
