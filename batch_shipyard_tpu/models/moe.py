@@ -1,0 +1,136 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+GShard-style top-1 routing with capacity limits, expressed as dense
+one-hot dispatch/combine einsums — the XLA-native formulation: the
+dispatch tensor contraction becomes an all-to-all over the ``ep`` mesh
+axis when the expert dimension of the parameters is sharded
+P('ep', ...), with no manual collectives.
+
+Pieces:
+  - Router: softmax gate, top-1 expert per token, position-in-expert
+    via a cumulative sum, tokens beyond capacity dropped (their
+    contribution is the residual path).
+  - Dispatch: one-hot [tokens, experts, capacity] einsum packs token
+    activations into per-expert buffers.
+  - Experts: batched SwiGLU MLPs, parameters [E, ...] (ep-sharded).
+  - Combine: the same tensor weighted by gate probabilities unpacks
+    expert outputs back to token order.
+
+Auxiliary load-balancing loss per GShard/Switch: mean(fraction of
+tokens per expert * mean gate prob per expert) * num_experts^2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    d_model: int = 512
+    d_ff: int = 1408
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    router_noise: float = 0.0
+
+
+def top1_routing(logits, capacity: int):
+    """logits: [G, E] (G = flattened tokens). Returns
+    (dispatch [G, E, C] bool-ish, combine [G, E, C] float,
+    aux_loss scalar)."""
+    groups, num_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)            # [G]
+    expert_mask = jax.nn.one_hot(expert_index, num_experts,
+                                 dtype=jnp.float32)      # [G, E]
+    # Position of each token within its chosen expert's buffer.
+    position_in_expert = (jnp.cumsum(expert_mask, axis=0) *
+                          expert_mask) - expert_mask      # [G, E]
+    keep = position_in_expert < capacity
+    expert_mask = expert_mask * keep
+    gate = jnp.sum(probs * expert_mask, axis=-1)          # [G]
+    pos = jnp.sum(position_in_expert * expert_mask,
+                  axis=-1).astype(jnp.int32)              # [G]
+    pos_onehot = jax.nn.one_hot(pos, capacity,
+                                dtype=jnp.float32)        # [G, C]
+    dispatch = expert_mask[:, :, None] * pos_onehot[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    # Load-balancing auxiliary loss (Switch Transformer eq. 4).
+    density = jnp.mean(expert_mask, axis=0)               # [E]
+    density_proxy = jnp.mean(probs, axis=0)               # [E]
+    aux = jnp.sum(density * density_proxy) * (num_experts ** 2) / (
+        num_experts)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: top-1 routed SwiGLU experts."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [B, T, D] -> ([B, T, D], aux_loss)."""
+        cfg = self.config
+        batch, t_len, d_model = x.shape
+        groups = batch * t_len
+        capacity = max(1, int(cfg.capacity_factor * groups /
+                              cfg.num_experts))
+        router = nn.Dense(cfg.num_experts, use_bias=False,
+                          dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype, name="router")
+        flat = x.reshape(groups, d_model)
+        logits = router(flat.astype(jnp.float32))
+        if cfg.router_noise > 0.0:
+            noise = jax.random.uniform(
+                self.make_rng("router"), logits.shape,
+                minval=1.0 - cfg.router_noise,
+                maxval=1.0 + cfg.router_noise)
+            logits = logits * noise
+        dispatch, combine, aux = top1_routing(logits, capacity)
+        # Expert parameters: leading E dim is the ep-sharded axis.
+        w_gate = self.param(
+            "w_gate", nn.initializers.lecun_normal(),
+            (cfg.num_experts, d_model, cfg.d_ff), cfg.param_dtype)
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(),
+            (cfg.num_experts, d_model, cfg.d_ff), cfg.param_dtype)
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(),
+            (cfg.num_experts, cfg.d_ff, d_model), cfg.param_dtype)
+        # Dispatch tokens into per-expert buffers: [E, C, D]. With
+        # dispatch replicated and experts ep-sharded, XLA lowers the
+        # downstream per-expert compute to an all-to-all exchange.
+        expert_in = jnp.einsum(
+            "gec,gd->ecd", dispatch.astype(cfg.dtype),
+            flat.astype(cfg.dtype))
+        gate_act = jnp.einsum("ecd,edf->ecf", expert_in,
+                              w_gate.astype(cfg.dtype))
+        up_act = jnp.einsum("ecd,edf->ecf", expert_in,
+                            w_up.astype(cfg.dtype))
+        expert_out = jnp.einsum(
+            "ecf,efd->ecd", nn.silu(gate_act) * up_act,
+            w_down.astype(cfg.dtype))
+        out = jnp.einsum("gec,ecd->gd", combine.astype(cfg.dtype),
+                         expert_out)
+        return out.reshape(batch, t_len, d_model), aux.astype(
+            jnp.float32)
+
+
+def moe_param_specs():
+    """PartitionSpec patterns for MoE params (merged into the
+    transformer rules): experts over ep, expert-internal dims over
+    tp/fsdp."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r".*moe/router/kernel$", P(None, None)),
+        (r".*moe/(w_gate|w_up)$", P("ep", "fsdp", "tp")),
+        (r".*moe/w_down$", P("ep", "tp", "fsdp")),
+    ]

@@ -44,6 +44,11 @@ class TransformerConfig:
     remat: bool = False
     attention_fn: Optional[Callable] = None  # (q,k,v,causal)->out
     rope_theta: float = 10000.0
+    # Mixture-of-experts: replace the MLP of every `moe_every`-th
+    # block with routed experts (ep-shardable). None = dense.
+    moe: Optional[Any] = None        # models.moe.MoEConfig
+    moe_every: int = 2
+    moe_aux_weight: float = 0.01
 
 
 def rotary_embedding(x, positions, theta: float):
@@ -121,14 +126,21 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
             RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions)
-        x = x + MLP(cfg, name="mlp")(
-            RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x))
+        normed = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        if self.use_moe:
+            from batch_shipyard_tpu.models.moe import MoEMLP
+            out, aux = MoEMLP(cfg.moe, name="moe")(normed)
+            self.sow("losses", "moe_aux", aux)
+            x = x + out
+        else:
+            x = x + MLP(cfg, name="mlp")(normed)
         return x
 
 
@@ -151,7 +163,10 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
         for idx in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{idx}")(x, positions)
+            use_moe = (cfg.moe is not None and
+                       idx % max(cfg.moe_every, 1) == (
+                           max(cfg.moe_every, 1) - 1))
+            x = block(cfg, use_moe, name=f"layer_{idx}")(x, positions)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if return_hidden:
             return x

@@ -10,6 +10,7 @@ communication-hungry axis lands on adjacent ICI neighbors):
 
   dp    data parallel (gradient psum; outermost, cheapest)
   fsdp  fully-sharded data parallel (param all-gather + reduce-scatter)
+  ep    expert parallel (MoE all-to-all dispatch)
   sp    sequence/context parallel (ring attention ppermute ring)
   tp    tensor parallel (activation all-reduce; innermost)
 
@@ -26,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 def make_mesh(axis_sizes: dict[str, int],
@@ -47,13 +48,15 @@ def make_mesh(axis_sizes: dict[str, int],
 
 
 def auto_axis_sizes(n_devices: int, tp: int = 1, sp: int = 1,
-                    fsdp: int = 1) -> dict[str, int]:
+                    fsdp: int = 1, ep: int = 1) -> dict[str, int]:
     """Fill dp with whatever remains after the requested inner axes."""
-    inner = tp * sp * fsdp
+    inner = tp * sp * fsdp * ep
     if n_devices % inner:
         raise ValueError(
-            f"{n_devices} devices not divisible by tp*sp*fsdp={inner}")
-    return {"dp": n_devices // inner, "fsdp": fsdp, "sp": sp, "tp": tp}
+            f"{n_devices} devices not divisible by "
+            f"tp*sp*fsdp*ep={inner}")
+    return {"dp": n_devices // inner, "fsdp": fsdp, "ep": ep,
+            "sp": sp, "tp": tp}
 
 
 def batch_spec() -> P:

@@ -29,6 +29,10 @@ _TRANSFORMER_RULES: list[tuple[str, P]] = [
      P("fsdp", "tp")),
     (r".*(o_proj|down_proj)/kernel$", P("tp", "fsdp")),
     (r".*embed/embedding$", P("tp", "fsdp")),
+    # MoE: experts over ep, expert-internal dims over fsdp/tp.
+    (r".*moe/router/kernel$", P()),
+    (r".*moe/(w_gate|w_up)$", P("ep", "fsdp", "tp")),
+    (r".*moe/w_down$", P("ep", "tp", "fsdp")),
     (r".*(scale|bias)$", P()),
 ]
 

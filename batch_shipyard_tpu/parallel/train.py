@@ -78,10 +78,18 @@ def build_transformer_train(
     def loss_fn(params, tokens, targets):
         # Chunked tied-embedding loss: the full [B, T, vocab] fp32
         # logits tensor never materializes (see lm_loss_chunked).
-        hidden = model.apply({"params": params}, tokens,
-                             return_hidden=True)
-        return tfm.lm_loss_chunked(
+        hidden, variables = model.apply(
+            {"params": params}, tokens, return_hidden=True,
+            mutable=["losses"])
+        loss = tfm.lm_loss_chunked(
             hidden, params["embed"]["embedding"], targets)
+        # MoE load-balancing auxiliary losses (if any blocks sowed).
+        aux_leaves = jax.tree_util.tree_leaves(
+            variables.get("losses", {}))
+        if aux_leaves:
+            loss = loss + config.moe_aux_weight * sum(
+                jnp.mean(a) for a in aux_leaves)
+        return loss
 
     @functools.partial(
         jax.jit, donate_argnums=(0, 1),
