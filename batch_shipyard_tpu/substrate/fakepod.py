@@ -35,11 +35,13 @@ logger = util.get_logger(__name__)
 class FakePodSubstrate(base.ComputeSubstrate):
     def __init__(self, store: StateStore, work_root: Optional[str] = None,
                  nodeprep_delay: float = 0.0,
-                 heartbeat_interval: float = 0.5) -> None:
+                 heartbeat_interval: float = 0.5,
+                 node_stale_seconds: float = 30.0) -> None:
         self.store = store
         self.work_root = work_root or tempfile.mkdtemp(prefix="fakepod-")
         self.nodeprep_delay = nodeprep_delay
         self.heartbeat_interval = heartbeat_interval
+        self.node_stale_seconds = node_stale_seconds
         # node_id -> failure mode
         self.inject: dict[str, str] = {}
         self._agents: dict[str, dict[str, NodeAgent]] = {}
@@ -88,6 +90,7 @@ class FakePodSubstrate(base.ComputeSubstrate):
             heartbeat_interval=self.heartbeat_interval,
             poll_interval=0.05, gang_timeout=60.0,
             job_state_ttl=0.2,
+            node_stale_seconds=self.node_stale_seconds,
             nodeprep=self._nodeprep)
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {
@@ -273,6 +276,59 @@ class FakePodSubstrate(base.ComputeSubstrate):
     def agent(self, pool_id: str, node_id: str) -> Optional[NodeAgent]:
         with self._lock:
             return self._agents.get(pool_id, {}).get(node_id)
+
+    def start_chaos(self, pool_id: str, kill_interval: float = 1.0,
+                    revive_after: float = 0.5,
+                    seed: int = 0) -> threading.Event:
+        """Fault injection: periodically hard-kill a random node's
+        agent (stop without cleanup — simulating a crash) and revive
+        it shortly after. Returns a stop event. Exercises orphan
+        reclaim, message redelivery, and heartbeat staleness under
+        continuous failure (the fault-injection capability SURVEY.md
+        5.3 notes the reference lacks entirely)."""
+        import random
+        stop = threading.Event()
+        rng = random.Random(seed)
+
+        def _chaos_loop():
+            while not stop.wait(kill_interval):
+                with self._lock:
+                    agents = list(self._agents.get(pool_id, {}).items())
+                if not agents:
+                    continue
+                node_id, agent = rng.choice(agents)
+                identity = agent.identity
+                pool = agent.pool
+                work_dir = agent.work_dir
+                # Crash: stop threads abruptly; do NOT write offline
+                # state (a real crash wouldn't).
+                agent.stop_event.set()
+                agent.join(timeout=5.0)
+                with self._lock:
+                    self._agents.get(pool_id, {}).pop(node_id, None)
+                    self._boot_threads.pop(node_id, None)
+                if stop.wait(revive_after):
+                    return
+                # Revive with the same identity (reboot).
+                revived = NodeAgent(
+                    self.store, identity, pool, work_dir=work_dir,
+                    heartbeat_interval=self.heartbeat_interval,
+                    poll_interval=0.05, gang_timeout=60.0,
+                    job_state_ttl=0.2, node_stale_seconds=3.0,
+                    nodeprep=None)
+                thread = threading.Thread(
+                    target=self._boot_agent, args=(revived,),
+                    daemon=True)
+                with self._lock:
+                    self._agents.setdefault(pool_id, {})[
+                        node_id] = revived
+                    self._boot_threads[node_id] = thread
+                thread.start()
+
+        thread = threading.Thread(target=_chaos_loop, daemon=True,
+                                  name=f"chaos-{pool_id}")
+        thread.start()
+        return stop
 
     def stop_all(self) -> None:
         with self._lock:
