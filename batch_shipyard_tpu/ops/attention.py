@@ -441,6 +441,65 @@ def _flash_bwd_rule(causal, block_q, block_k, residuals, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int = 256, block_k: int = 512):
+    """flash_attention variant that also returns the logsumexp rows
+    ([B*H, T, 1] fp32) — the ring-attention building block (block
+    results are merged across rotations in logsumexp space)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k,
+                          with_lse=True)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              with_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd_rule(causal, block_q, block_k, residuals, grads):
+    q, k, v, out, lse = residuals
+    g, _g_lse = grads  # lse cotangent unused: merge treats it as aux
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q,
+                           block_k)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd_rule,
+                                _flash_lse_bwd_rule)
+
+
+def merge_attention_blocks(o1, lse1, o2, lse2):
+    """Merge two normalized attention partials in logsumexp space.
+
+    o_i: [B, T, H, D] (any float dtype); lse_i: [B*H, T, 1] fp32 with
+    -inf marking fully-masked rows. Returns (o, lse) of the combined
+    attention over the union of the two key sets.
+    """
+    batch, t_len, heads, depth = o1.shape
+    l1 = lse1.reshape(batch, heads, t_len).transpose(0, 2, 1)
+    l2 = lse2.reshape(batch, heads, t_len).transpose(0, 2, 1)
+    m = jnp.maximum(l1, l2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(l1 > _NEG_INF / 2, jnp.exp(l1 - m_safe), 0.0)
+    w2 = jnp.where(l2 > _NEG_INF / 2, jnp.exp(l2 - m_safe), 0.0)
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o1.astype(jnp.float32) * (w1 / denom_safe)[..., None] +
+         o2.astype(jnp.float32) * (w2 / denom_safe)[..., None])
+    lse = jnp.where(denom > 0.0, m_safe + jnp.log(denom_safe),
+                    _NEG_INF)
+    lse = lse.transpose(0, 2, 1).reshape(batch * heads, t_len, 1)
+    return o.astype(o1.dtype), lse
+
+
+def masked_attention_block(q):
+    """The identity element for merge_attention_blocks: zero output,
+    -inf logsumexp (no keys visible)."""
+    batch, t_len, heads, _depth = q.shape
+    return (jnp.zeros_like(q),
+            jnp.full((batch * heads, t_len, 1), _NEG_INF, jnp.float32))
+
+
 def attention(q, k, v, causal: bool = True,
               impl: Optional[str] = None, block_size: int = 512):
     """Dispatch: 'flash' (pallas fwd), 'blockwise', or 'reference'.

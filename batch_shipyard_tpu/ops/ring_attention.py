@@ -32,6 +32,52 @@ from jax.sharding import Mesh, PartitionSpec as P
 from batch_shipyard_tpu.ops import attention as attn_ops
 
 
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
+    """Per-shard ring body using the Pallas flash kernels.
+
+    Each rotation's masking regime is one of exactly three static
+    cases — fully masked (KV from a later shard), diagonal (own
+    shard: causal), fully visible (earlier shard) — selected with
+    lax.switch, so the offset-free flash kernels apply unchanged and
+    partials merge in logsumexp space.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    @jax.checkpoint
+    def step(carry, t):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        src = (my_idx - t) % axis_size
+
+        def masked(_q, _k, _v):
+            return attn_ops.masked_attention_block(_q)
+
+        def diagonal(_q, _k, _v):
+            return attn_ops.flash_attention_with_lse(_q, _k, _v, True)
+
+        def full(_q, _k, _v):
+            return attn_ops.flash_attention_with_lse(_q, _k, _v, False)
+
+        if causal:
+            case = jnp.where(src > my_idx, 0,
+                             jnp.where(src == my_idx, 1, 2))
+            o_s, lse_s = jax.lax.switch(
+                case, (masked, diagonal, full), q, k_cur, v_cur)
+        else:
+            o_s, lse_s = full(q, k_cur, v_cur)
+        o_acc, lse_acc = attn_ops.merge_attention_blocks(
+            o_acc, lse_acc, o_s, lse_s)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, lse_acc, k_nxt, v_nxt), None
+
+    o0, lse0 = attn_ops.masked_attention_block(q)
+    (o, _lse, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(axis_size))
+    return o
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     """Per-shard body (runs inside shard_map). q/k/v: [B, Tl, H, D]."""
     axis_size = jax.lax.psum(1, axis_name)
@@ -40,6 +86,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    # Rematerialize each step: without this the scan's backward saves
+    # every rotation's score/probability matrices (O(T_local^2) fp32
+    # per step x sp steps), defeating ring attention's O(T/sp) memory
+    # promise — the entire point of sequence parallelism.
+    @jax.checkpoint
     def step(carry, t):
         o, m, l, k_cur, v_cur = carry
         # After t rotations we hold the KV shard originally on
@@ -62,13 +113,33 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True,
                    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
-                   head_axis: str = "tp"):
+                   head_axis: str = "tp",
+                   impl: str = "auto"):
     """Global-view entry: q/k/v are [B, T, H, D] global arrays; returns
-    the exact attention output with T sharded over axis_name."""
+    the exact attention output with T sharded over axis_name.
+
+    impl: 'flash' (Pallas kernels per rotation — the TPU fast path;
+    its building blocks are oracle-tested but the in-shard_map
+    composition awaits multi-chip pod validation, see ROADMAP.md),
+    'xla' (pure-XLA online softmax — runs anywhere; the default), or
+    'auto' (currently 'xla'; flips to flash once pod-validated).
+    """
+    if impl == "auto":
+        impl = "xla"
+    if impl == "flash":
+        t_local = q.shape[1] // mesh.shape[axis_name]
+        # Default flash blocks are (256, 512): a local shard tiles if
+        # it fits in one block (<=256, 128-aligned) or divides both.
+        if not ((t_local <= 256 and t_local % 128 == 0) or
+                t_local % 512 == 0):
+            raise ValueError(
+                f"local shard length {t_local} does not tile the "
+                f"flash blocks; use impl='xla'")
+    body = (_ring_attention_local_flash if impl == "flash"
+            else _ring_attention_local)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal),
+        functools.partial(body, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         # The online-softmax carry is initialized from constants
         # (attention_init zeros), which varying-manual-axes tracking
