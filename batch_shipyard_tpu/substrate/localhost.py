@@ -81,11 +81,17 @@ class LocalhostSubstrate(base.ComputeSubstrate):
                 "internal_ip": "127.0.0.1", "node_index": node_index,
                 "slice_index": slice_index, "worker_index": worker_index})
         log = open(os.path.join(work_dir, "agent.log"), "ab")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        # Tasks run with cwd=task_dir; make the framework importable
+        # there even when not pip-installed (dev/offline hosts).
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
         proc = subprocess.Popen(
             [sys.executable, "-m", "batch_shipyard_tpu.agent", boot_path],
-            stdout=log, stderr=log,
-            cwd=os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))))
+            stdout=log, stderr=log, cwd=repo_root, env=env)
         self._procs.setdefault(pool.id, {})[node_id] = proc
         logger.info("spawned local node agent %s (pid %d)", node_id,
                     proc.pid)

@@ -1,0 +1,51 @@
+"""Localhost substrate: real subprocess node agents over the localfs
+store (the path that drives locally attached TPU hardware)."""
+
+import pytest
+
+from batch_shipyard_tpu import fleet
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    creds = {"credentials": {"storage": {
+        "backend": "localfs", "root": str(tmp_path / "store")}}}
+    pool_conf = {"pool_specification": {
+        "id": "lh", "substrate": "localhost",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "max_wait_time_seconds": 60}}
+    context = fleet.load_context(extra={"credentials": creds,
+                                        "pool": pool_conf})
+    yield context
+    try:
+        pool_mgr.delete_pool(context.store, context.substrate(), "lh")
+    except Exception:
+        pass
+
+
+def test_localhost_end_to_end_and_module_import(ctx):
+    nodes = fleet.action_pool_add(ctx)
+    assert len(nodes) == 2
+    jobs_conf = {"job_specifications": [{
+        "id": "lhjob",
+        "tasks": [
+            {"id": "echo", "command": "echo subprocess-agent"},
+            # Tasks run with cwd=task_dir: the framework package must
+            # still be importable (PYTHONPATH injected by the
+            # substrate — this is what lets tasks launch
+            # batch_shipyard_tpu.workloads.* on dev hosts).
+            {"id": "mod", "command":
+             "python -c 'import batch_shipyard_tpu; print(\"mod-ok\")'"},
+        ],
+    }]}
+    import yaml
+    ctx.configs["jobs"] = yaml.safe_load(yaml.safe_dump(jobs_conf))
+    fleet.action_jobs_add(ctx)
+    tasks = {t["_rk"]: t for t in jobs_mgr.wait_for_tasks(
+        ctx.store, "lh", "lhjob", timeout=90)}
+    assert tasks["echo"]["state"] == "completed", tasks
+    assert tasks["mod"]["state"] == "completed", tasks
+    out = jobs_mgr.get_task_output(ctx.store, "lh", "lhjob", "mod")
+    assert out.strip() == b"mod-ok"
