@@ -113,6 +113,104 @@ def build_transformer_train(
                         batch_sharding=batch_sharding)
 
 
+def build_transformer_train_pp(
+        mesh: Mesh, config: tfm.TransformerConfig,
+        batch_size: int, seq_len: int,
+        num_microbatches: int = 4,
+        learning_rate: float = 3e-4,
+        seed: int = 0) -> TrainHarness:
+    """Pipeline-parallel transformer training: blocks are split into
+    pp stages (mesh must have a 'pp' axis; n_layers divisible by its
+    size), microbatches flow through the GPipe wavefront
+    (parallel/pipeline.py), embedding + final norm + chunked loss run
+    outside the pipelined middle, and data parallelism rides the
+    mesh's 'dp' axis.
+    """
+    from batch_shipyard_tpu.parallel import pipeline as pipe
+    num_stages = mesh.shape["pp"]
+    if config.n_layers % num_stages:
+        raise ValueError(
+            f"n_layers {config.n_layers} not divisible by pp "
+            f"{num_stages}")
+    layers_per_stage = config.n_layers // num_stages
+    block = tfm.Block(config)
+    embed = __import__("flax.linen", fromlist=["linen"]).Embed(
+        config.vocab_size, config.d_model, dtype=config.dtype,
+        param_dtype=config.param_dtype)
+    norm = tfm.RMSNorm(dtype=config.dtype)
+    positions = jnp.arange(seq_len, dtype=jnp.int32)
+
+    rng = jax.random.PRNGKey(seed)
+    rngs = jax.random.split(rng, config.n_layers + 2)
+    x0 = jnp.zeros((1, seq_len, config.d_model), config.dtype)
+    per_layer = [block.init(rngs[i], x0, positions)["params"]
+                 for i in range(config.n_layers)]
+    # Leaves become [S, Lp, ...]: stage-major stack of layer stacks.
+    per_stage = [
+        pipe.stack_stage_params(
+            per_layer[s * layers_per_stage:(s + 1) * layers_per_stage])
+        for s in range(num_stages)]
+    stage_params = pipe.stack_stage_params(per_stage)
+    params = {
+        "embed": embed.init(rngs[-2],
+                            jnp.zeros((1, seq_len), jnp.int32))[
+                                "params"],
+        "stages": stage_params,
+        "final_norm": norm.init(rngs[-1], x0)["params"],
+    }
+    optimizer = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def stage_fn(stage_p, x):
+        # stage_p leaves: [Lp, ...]; scan the stage's layers.
+        def layer_step(h, layer_p):
+            return block.apply({"params": layer_p}, h, positions), None
+        out, _ = jax.lax.scan(layer_step, x, stage_p)
+        return out
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    param_specs = {
+        "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+        "stages": jax.tree_util.tree_map(
+            lambda p: P("pp", *([None] * (p.ndim - 1))),
+            params["stages"]),
+        "final_norm": jax.tree_util.tree_map(
+            lambda _: P(), params["final_norm"]),
+    }
+    param_shardings = shard_rules.to_shardings(mesh, param_specs)
+    params = jax.device_put(params, param_shardings)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, tokens, targets):
+        h = embed.apply({"params": params["embed"]}, tokens)
+        h = pipe.pipeline_apply(
+            params["stages"], h, mesh=mesh, stage_fn=stage_fn,
+            num_microbatches=num_microbatches, batch_axes=("dp",))
+        h = norm.apply({"params": params["final_norm"]}, h)
+        return tfm.lm_loss_chunked(
+            h, params["embed"]["embedding"], targets)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=(param_shardings, None, batch_sharding,
+                      batch_sharding),
+        out_shardings=(param_shardings, None, None))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                  targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    def step_wrapper(params, opt_state, batch):
+        params, opt_state, metrics = step(
+            params, opt_state, batch["tokens"], batch["targets"])
+        return params, opt_state, metrics
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)
+
+
 def build_resnet_train(mesh: Mesh,
                        config: Optional[resnet_mod.ResNetConfig] = None,
                        batch_size: int = 256, image_size: int = 224,

@@ -119,3 +119,81 @@ def test_pipeline_rejects_bad_microbatch():
                                 stage_fn=mlp_stage,
                                 num_microbatches=2,
                                 batch_axes=("dp",))
+
+
+def test_pipeline_transformer_training():
+    """Full pipeline-parallel training: pp=4 x dp=2 mesh, loss
+    decreases, and the pipelined forward equals a sequential pass
+    over the same stage parameters."""
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.parallel import train as train_mod
+    mesh = make_mesh_pp(pp=4, dp=2)
+    config = tfm.TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=4, n_heads=2, d_head=16,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    harness = train_mod.build_transformer_train_pp(
+        mesh, config, batch_size=8, seq_len=32, num_microbatches=4,
+        seed=0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(rng.randint(0, 128, (8, 32)), jnp.int32),
+        "targets": jnp.asarray(rng.randint(0, 128, (8, 32)),
+                               jnp.int32)}
+    params, opt_state = harness.params, harness.opt_state
+    first = None
+    for _ in range(5):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first
+
+
+def test_pipeline_transformer_matches_nonpipelined():
+    """The pp=4 pipelined forward loss equals running the same blocks
+    sequentially (no pipeline) with identical parameters."""
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.parallel import train as train_mod
+    mesh = make_mesh_pp(pp=4, dp=1)
+    config = tfm.TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=4, n_heads=2, d_head=16,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    harness = train_mod.build_transformer_train_pp(
+        mesh, config, batch_size=4, seq_len=32, num_microbatches=2,
+        seed=3)
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 128, (4, 32)), jnp.int32)
+    targets = jnp.asarray(rng.randint(0, 128, (4, 32)), jnp.int32)
+
+    from flax import linen as nn
+    embed = nn.Embed(128, 32, dtype=jnp.float32,
+                     param_dtype=jnp.float32)
+    norm = tfm.RMSNorm(dtype=jnp.float32)
+    block = tfm.Block(config)
+    positions = jnp.arange(32, dtype=jnp.int32)
+    params = jax.device_get(harness.params)
+
+    def sequential_loss():
+        h = embed.apply({"params": params["embed"]}, tokens)
+        stages = params["stages"]
+        num_stages = jax.tree_util.tree_leaves(stages)[0].shape[0]
+        for s in range(num_stages):
+            stage_p = jax.tree_util.tree_map(lambda p: p[s], stages)
+            layers = jax.tree_util.tree_leaves(stage_p)[0].shape[0]
+            for li in range(layers):
+                layer_p = jax.tree_util.tree_map(
+                    lambda p: p[li], stage_p)
+                h = block.apply({"params": layer_p}, h, positions)
+        h = norm.apply({"params": params["final_norm"]}, h)
+        return tfm.lm_loss_chunked(
+            h, params["embed"]["embedding"], targets)
+
+    # One pipelined step on fresh params reports the pre-update loss.
+    _p, _o, metrics = harness.step(harness.params, harness.opt_state,
+                                   {"tokens": tokens,
+                                    "targets": targets})
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(sequential_loss()), rtol=1e-5)
