@@ -187,7 +187,7 @@ def lm_loss(logits, targets, ignore_id: int = -1):
 
 
 def lm_loss_chunked(hidden, embedding, targets, ignore_id: int = -1,
-                    chunk_size: int = 256):
+                    chunk_size: int = 128):
     """Memory-efficient tied-embedding cross-entropy.
 
     Computes logits = hidden @ embedding.T per sequence chunk inside a

@@ -419,8 +419,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
-                    block_k: int = 512):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024):
     """Pallas flash attention: hand kernels for forward AND backward
     (dq + dkv kernels over saved logsumexp rows)."""
     return _flash_forward(q, k, v, causal, block_q, block_k)
@@ -443,7 +443,7 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_with_lse(q, k, v, causal: bool = True,
-                             block_q: int = 256, block_k: int = 512):
+                             block_q: int = 512, block_k: int = 1024):
     """flash_attention variant that also returns the logsumexp rows
     ([B*H, T, 1] fp32) — the ring-attention building block (block
     results are merged across rotations in logsumexp space)."""
@@ -508,7 +508,9 @@ def attention(q, k, v, causal: bool = True,
     if impl is None:
         impl = ("flash" if jax.default_backend() == "tpu"
                 else "blockwise")
-        if impl == "flash" and (q.shape[1] % 256 or k.shape[1] % 512):
+        if impl == "flash" and (
+                q.shape[1] % min(512, q.shape[1]) or
+                k.shape[1] % min(1024, k.shape[1])):
             impl = "blockwise"
             block_size = math.gcd(k.shape[1], block_size) or k.shape[1]
     if impl == "flash":
