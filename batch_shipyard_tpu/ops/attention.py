@@ -31,6 +31,23 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
 
+# Default flash kernel tiles (tuned on v5e; see bench history). The
+# dispatcher guard and ring_attention's tiling check both derive from
+# these — change them in one place only.
+FLASH_BLOCK_Q = 512
+FLASH_BLOCK_K = 1024
+
+
+def flash_shapes_ok(t_q: int, t_kv: int) -> bool:
+    """Can the default flash blocks tile these sequence lengths?
+    Blocks clamp to the sequence, so short sequences are fine only if
+    they are themselves MXU-tileable (128-aligned)."""
+    def ok(t, block):
+        if t < block:
+            return t % 128 == 0
+        return t % block == 0
+    return ok(t_q, FLASH_BLOCK_Q) and ok(t_kv, FLASH_BLOCK_K)
+
 
 def _causal_mask(q_positions, k_positions):
     """[Tq, Tk] True where attention is allowed (k <= q)."""
@@ -419,8 +436,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 1024):
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = FLASH_BLOCK_Q,
+                    block_k: int = FLASH_BLOCK_K):
     """Pallas flash attention: hand kernels for forward AND backward
     (dq + dkv kernels over saved logsumexp rows)."""
     return _flash_forward(q, k, v, causal, block_q, block_k)
@@ -443,7 +461,8 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_with_lse(q, k, v, causal: bool = True,
-                             block_q: int = 512, block_k: int = 1024):
+                             block_q: int = FLASH_BLOCK_Q,
+                             block_k: int = FLASH_BLOCK_K):
     """flash_attention variant that also returns the logsumexp rows
     ([B*H, T, 1] fp32) — the ring-attention building block (block
     results are merged across rotations in logsumexp space)."""
@@ -508,9 +527,8 @@ def attention(q, k, v, causal: bool = True,
     if impl is None:
         impl = ("flash" if jax.default_backend() == "tpu"
                 else "blockwise")
-        if impl == "flash" and (
-                q.shape[1] % min(512, q.shape[1]) or
-                k.shape[1] % min(1024, k.shape[1])):
+        if impl == "flash" and not flash_shapes_ok(q.shape[1],
+                                                   k.shape[1]):
             impl = "blockwise"
             block_size = math.gcd(k.shape[1], block_size) or k.shape[1]
     if impl == "flash":

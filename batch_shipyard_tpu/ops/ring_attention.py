@@ -128,10 +128,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         impl = "xla"
     if impl == "flash":
         t_local = q.shape[1] // mesh.shape[axis_name]
-        # Default flash blocks are (256, 512): a local shard tiles if
-        # it fits in one block (<=256, 128-aligned) or divides both.
-        if not ((t_local <= 256 and t_local % 128 == 0) or
-                t_local % 512 == 0):
+        if not attn_ops.flash_shapes_ok(t_local, t_local):
             raise ValueError(
                 f"local shard length {t_local} does not tile the "
                 f"flash blocks; use impl='xla'")
