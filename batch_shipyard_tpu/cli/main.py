@@ -167,6 +167,18 @@ def images():
     """Container image management on pool nodes."""
 
 
+@images.command("list")
+@click.pass_context
+def pool_images_list(click_ctx):
+    """List the pool's replicated image manifest."""
+    ctx = _ctx(click_ctx)
+    from batch_shipyard_tpu.state import names as names_mod
+    rows = [{"kind": r.get("kind"), "image": r.get("image")}
+            for r in ctx.store.query_entities(
+                names_mod.TABLE_IMAGES, partition_key=ctx.pool.id)]
+    fleet._emit({"images": rows}, click_ctx.obj["raw"])
+
+
 @images.command("update")
 @click.argument("image")
 @click.option("--kind", default="docker",
@@ -337,6 +349,20 @@ def tasks():
 def jobs_tasks_list(click_ctx, job_id):
     fleet.action_jobs_tasks_list(_ctx(click_ctx), job_id,
                                  raw=click_ctx.obj["raw"])
+
+
+@tasks.command("del")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.pass_context
+def jobs_tasks_del(click_ctx, job_id, task_id):
+    """Delete a terminal task's entity and uploaded files."""
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    try:
+        jobs_mgr.delete_task(ctx.store, ctx.pool.id, job_id, task_id)
+    except (jobs_mgr.JobNotFoundError, ValueError) as exc:
+        raise click.ClickException(str(exc))
 
 
 @tasks.command("term")

@@ -5,10 +5,11 @@ SURVEY.md section 7 flags input-pipeline parity as a hard part of the
 ResNet/ImageNet baseline ("orchestrator must make data locality
 configurable"). This loader covers the workload side:
 
-  - ``ShardedDataset``: enumerate .npy/.npz shard files from a local
-    directory or the state store (staged by input_data/gcsfuse),
-    partitioned across jax processes (each pod worker reads only its
-    slice — data parallel by construction);
+  - ``ShardedDataset``: enumerate shard files from a local directory
+    (staged by input_data/gcsfuse), partitioned across jax processes
+    (each pod worker reads only its slice — data parallel by
+    construction). .npz shards yield their named arrays (e.g.
+    images/labels); bare .npy shards yield under the key ``data``;
   - ``prefetch_to_device``: a background thread that stages the next
     batches onto the device (with the mesh sharding applied) while the
     current step computes, hiding host->HBM transfer latency — the
@@ -105,39 +106,72 @@ def synthetic_batches(make_batch: Callable[[int], dict],
         step += 1
 
 
+def place_global(batch: dict, sharding) -> dict:
+    """Place one host-LOCAL batch as a (possibly multi-host) global
+    array. Single process: plain device_put. Multi-process (gang task
+    across a pod): each process contributes its local slice of the
+    global batch via make_array_from_process_local_data — the batch
+    dim of the global array is process_count * local rows."""
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return {
+        key: jax.make_array_from_process_local_data(
+            sharding if not isinstance(sharding, dict)
+            else sharding[key], np.asarray(arr))
+        for key, arr in batch.items()
+    }
+
+
 def prefetch_to_device(batches: Iterator[dict], sharding,
                        depth: int = 2) -> Iterator[dict]:
     """Stage upcoming batches onto device(s) on a background thread.
 
-    sharding: a jax Sharding (or pytree of them matching the batch
-    dict) applied via device_put — on a mesh this lands each host's
-    slice directly in the right HBM shards.
+    batches yield host-local arrays; sharding is a jax Sharding (or a
+    dict of them per batch key). On a mesh each host's slice lands
+    directly in the right HBM shards (multi-host aware via
+    place_global). The producer thread shuts down when the consumer
+    abandons or closes the generator (no leaked device batches).
     """
     if depth < 1:
         raise ValueError("prefetch depth must be >= 1")
     q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
     _SENTINEL = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for batch in batches:
-                placed = jax.device_put(batch, sharding)
-                q.put(placed)
+                if stop.is_set():
+                    return
+                if not _put(place_global(batch, sharding)):
+                    return
         except Exception as exc:  # noqa: BLE001
-            q.put(exc)
+            _put(exc)
             return
-        q.put(_SENTINEL)
+        _put(_SENTINEL)
 
     thread = threading.Thread(target=producer, daemon=True,
                               name="prefetch")
     thread.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            return
-        if isinstance(item, Exception):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 def write_synthetic_imagenet_shards(

@@ -406,12 +406,29 @@ def list_task_files(store: StateStore, pool_id: str, job_id: str,
     return [k[len(prefix):] for k in store.list_objects(prefix)]
 
 
+def delete_task(store: StateStore, pool_id: str, job_id: str,
+                task_id: str, require_terminal: bool = True) -> None:
+    """Delete a task's entity and its uploaded objects (tasks del
+    analog). Non-terminal tasks must be terminated first."""
+    task = get_task(store, pool_id, job_id, task_id)
+    if require_terminal and task.get("state") not in (
+            "completed", "failed", "blocked"):
+        raise ValueError(
+            f"task {task_id} is {task.get('state')}; terminate first")
+    prefix = names.task_output_key(pool_id, job_id, task_id, "")
+    for key in store.list_objects(prefix):
+        store.delete_object(key)
+    store.delete_entity(names.TABLE_TASKS,
+                        names.task_pk(pool_id, job_id), task_id)
+
+
 def delete_job(store: StateStore, pool_id: str, job_id: str) -> None:
     get_job(store, pool_id, job_id)
     pk = names.task_pk(pool_id, job_id)
     for task in list(store.query_entities(names.TABLE_TASKS,
                                           partition_key=pk)):
-        store.delete_entity(names.TABLE_TASKS, pk, task["_rk"])
+        delete_task(store, pool_id, job_id, task["_rk"],
+                    require_terminal=False)
     for row in list(store.query_entities(names.TABLE_JOBPREP,
                                          partition_key=pk)):
         store.delete_entity(names.TABLE_JOBPREP, pk, row["_rk"])

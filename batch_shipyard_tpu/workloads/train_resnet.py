@@ -33,9 +33,9 @@ def main() -> int:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--data-dir", default=None,
-                        help=".npy/.npz shard directory (staged via "
-                             "input_data or a gcsfuse mount); "
-                             "synthetic data when omitted")
+                        help=".npz shard directory with images/labels "
+                             "arrays (staged via input_data or a "
+                             "gcsfuse mount); synthetic when omitted")
     parser.add_argument("--prefetch", type=int, default=2)
     args = parser.parse_args()
 
@@ -51,8 +51,11 @@ def main() -> int:
     from batch_shipyard_tpu.data import loader
 
     rng = np.random.RandomState(jax.process_index())
+    # Each process loads only its slice of the global batch; the
+    # prefetcher assembles the global array (multi-host aware).
+    local_batch = batch_size // jax.process_count()
     if args.data_dir:
-        dataset = loader.ShardedDataset(args.data_dir, batch_size)
+        dataset = loader.ShardedDataset(args.data_dir, local_batch)
         # Transfer compact uint8 and normalize ON DEVICE: host-side
         # float conversion made the pipeline the bottleneck (~4x
         # fewer bytes over PCIe and the VPU does the cast for free).
@@ -67,20 +70,21 @@ def main() -> int:
                     "labels": b["labels"].astype(jnp.int32)}
                    for b in raw)
     else:
-        synthetic = {
-            "images": jnp.asarray(
-                rng.randn(batch_size, args.image_size,
-                          args.image_size, 3), jnp.bfloat16),
-            "labels": jnp.asarray(
-                rng.randint(0, args.num_classes, (batch_size,)),
-                jnp.int32),
-        }
+        synthetic = loader.place_global({
+            "images": np.asarray(
+                rng.randn(local_batch, args.image_size,
+                          args.image_size, 3), np.float32
+            ).astype(jnp.bfloat16),
+            "labels": np.asarray(
+                rng.randint(0, args.num_classes, (local_batch,)),
+                np.int32),
+        }, harness.batch_sharding)
         batches = loader.synthetic_batches(lambda step: synthetic)
     params, opt_state = harness.params, harness.opt_state
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   next(batches))
-    float(metrics["loss"])  # hard sync
+        float(metrics["loss"])  # hard sync
     start = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,

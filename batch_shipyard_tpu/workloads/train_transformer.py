@@ -58,15 +58,17 @@ def main() -> int:
         remat=not args.no_remat)
     harness = train_mod.build_transformer_train(
         mesh, config, batch_size=args.batch, seq_len=args.seq_len)
-    rng = np.random.RandomState(0)
-    batch = {
-        "tokens": jnp.asarray(
-            rng.randint(0, args.vocab, (args.batch, args.seq_len)),
-            jnp.int32),
-        "targets": jnp.asarray(
-            rng.randint(0, args.vocab, (args.batch, args.seq_len)),
-            jnp.int32),
-    }
+    from batch_shipyard_tpu.data import loader
+    rng = np.random.RandomState(jax.process_index())
+    local_batch = args.batch // jax.process_count()
+    batch = loader.place_global({
+        "tokens": np.asarray(
+            rng.randint(0, args.vocab, (local_batch, args.seq_len)),
+            np.int32),
+        "targets": np.asarray(
+            rng.randint(0, args.vocab, (local_batch, args.seq_len)),
+            np.int32),
+    }, harness.batch_sharding)
     params, opt_state = harness.params, harness.opt_state
     start_step = 0
     if args.checkpoint_dir:
@@ -79,7 +81,7 @@ def main() -> int:
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)
-    float(metrics["loss"])  # hard sync
+        float(metrics["loss"])  # hard sync
     start = time.perf_counter()
     for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,
