@@ -184,3 +184,52 @@ def test_schedule_run_exclusive_waits():
             store, pool, jobs, now=t0 + 2.0) == []
     finally:
         substrate.stop_all()
+
+
+def test_schedule_daemon_loop():
+    """The scheduler daemon launches instances over time and stops at
+    max_recurrences."""
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "dsched",
+            "recurrence": {"schedule": {
+                "recurrence_interval_seconds": 1}},
+            "tasks": [{"command": "echo tick"}],
+        }]})
+        launched = schedules.run_schedule_daemon(
+            store, pool, jobs, poll_interval=0.2, max_recurrences=2)
+        assert launched >= 2
+        assert jobs_mgr.get_job(store, "pool1", "dsched-r00000")
+        assert jobs_mgr.get_job(store, "pool1", "dsched-r00001")
+    finally:
+        substrate.stop_all()
+
+
+def test_heimdall_daemon_loop(tmp_path):
+    """heimdall.run_daemon refreshes file_sd until stopped."""
+    import os
+    import threading
+    import time
+    from batch_shipyard_tpu.monitor import heimdall
+    store, substrate, pool = make_env()
+    try:
+        heimdall.add_pool_to_monitor(store, "pool1")
+        stop = threading.Event()
+        thread = threading.Thread(
+            target=heimdall.run_daemon,
+            args=(store, str(tmp_path / "sd")),
+            kwargs={"poll_interval": 0.1, "stop_event": stop},
+            daemon=True)
+        thread.start()
+        deadline = time.monotonic() + 10
+        path = tmp_path / "sd" / "shipyard_targets.json"
+        while time.monotonic() < deadline and not path.exists():
+            time.sleep(0.05)
+        stop.set()
+        thread.join(timeout=5)
+        assert path.exists()
+        import json as json_mod
+        assert json_mod.loads(path.read_text())
+    finally:
+        substrate.stop_all()
