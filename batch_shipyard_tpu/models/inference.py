@@ -1,0 +1,132 @@
+"""Autoregressive inference: KV-cache decode + sampling.
+
+The framework's serving-side counterpart to the training path
+(ROADMAP item; the reference had no inference story at all). Design:
+
+  - prefill: one jitted full-sequence forward over the prompt while
+    writing the KV cache (token-by-token via scan keeps the same cache
+    layout as decode — simple and correct; a batched prefill kernel is
+    a later optimization);
+  - decode: one token per step through the transformer's decode mode
+    (flax 'cache' collection holding per-layer K/V + write index),
+    inside a single jitted lax.scan — no per-token Python dispatch;
+  - sampling: greedy, temperature, and top-k, driven by a jax PRNG key.
+
+Works on CPU/TPU and under dp sharding (batch dim); cache lives on
+device for the whole generation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from batch_shipyard_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 0.0   # 0 => greedy
+    top_k: int = 0             # 0 => full distribution
+
+
+def decode_config(config: tfm.TransformerConfig,
+                  max_decode_len: int) -> tfm.TransformerConfig:
+    return dataclasses.replace(
+        config, decode=True, max_decode_len=max_decode_len,
+        attention_fn=None, remat=False)
+
+
+def init_cache(model: tfm.TransformerLM, params, batch_size: int):
+    """Materialize an empty KV cache pytree for the decode model.
+
+    model.init runs a forward pass, which WRITES the dummy token into
+    slot 0 and bumps the index — zero everything so the cache starts
+    truly empty."""
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((batch_size, 1), jnp.int32),
+        positions=jnp.zeros((1,), jnp.int32))
+    return jax.tree_util.tree_map(jnp.zeros_like, variables["cache"])
+
+
+def _sample(logits, key, sampling: SamplingConfig):
+    """logits: [B, vocab] fp32 -> token ids [B]."""
+    if sampling.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / sampling.temperature
+    if sampling.top_k > 0:
+        top_vals, _ = jax.lax.top_k(logits, sampling.top_k)
+        cutoff = top_vals[:, -1][:, None]
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(
+        jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "model", "num_tokens", "sampling"))
+def generate(model: tfm.TransformerLM, params, cache, prompt,
+             num_tokens: int, key,
+             sampling: SamplingConfig = SamplingConfig()):
+    """Generate num_tokens continuations of prompt [B, T_prompt].
+
+    Returns (tokens [B, T_prompt + num_tokens], cache). The whole
+    prefill + decode runs inside one jit; per-token work is a lax.scan
+    step feeding the KV cache.
+    """
+    batch, prompt_len = prompt.shape
+
+    def step(carry, _):
+        cache, token, pos, key = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token,
+            positions=pos[None], mutable=["cache"])
+        key, sample_key = jax.random.split(key)
+        next_token = _sample(logits[:, 0].astype(jnp.float32),
+                             sample_key, sampling)
+        return ((mutated["cache"], next_token[:, None], pos + 1, key),
+                next_token)
+
+    # Prefill: feed prompt tokens through the same single-step path so
+    # the cache fills; outputs before the last prompt token are
+    # teacher-forced (discarded).
+    def prefill_step(carry, token_t):
+        cache, pos = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token_t[:, None], positions=pos[None], mutable=["cache"])
+        return (mutated["cache"], pos + 1), logits[:, 0]
+
+    (cache, pos), prefill_logits = jax.lax.scan(
+        prefill_step, (cache, jnp.int32(0)),
+        jnp.moveaxis(prompt, 1, 0))
+    key, sample_key = jax.random.split(key)
+    first = _sample(prefill_logits[-1].astype(jnp.float32),
+                    sample_key, sampling)
+    (cache, _tok, _pos, _key), generated = jax.lax.scan(
+        step, (cache, first[:, None], pos, key), None,
+        length=num_tokens - 1)
+    tokens = jnp.concatenate(
+        [prompt, first[:, None],
+         jnp.moveaxis(generated, 0, 1)], axis=1)
+    return tokens, cache
+
+
+def make_decoder(config: tfm.TransformerConfig, params,
+                 max_decode_len: int):
+    """Convenience: (generate_fn, model) bound to a decode-mode model
+    sharing training params."""
+    dconfig = decode_config(config, max_decode_len)
+    model = tfm.TransformerLM(dconfig)
+
+    def run(prompt, num_tokens, key,
+            sampling: SamplingConfig = SamplingConfig()):
+        cache = init_cache(model, params, prompt.shape[0])
+        return generate(model, params, cache, prompt, num_tokens, key,
+                        sampling)
+
+    return run, model

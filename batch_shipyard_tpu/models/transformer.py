@@ -49,6 +49,10 @@ class TransformerConfig:
     moe: Optional[Any] = None        # models.moe.MoEConfig
     moe_every: int = 2
     moe_aux_weight: float = 0.01
+    # Autoregressive decode mode: attention maintains a KV cache (flax
+    # 'cache' collection) and consumes one token step per call.
+    decode: bool = False
+    max_decode_len: int = 2048
 
 
 def rotary_embedding(x, positions, theta: float):
@@ -97,12 +101,50 @@ class Attention(nn.Module):
         v = v.reshape(batch, seq, cfg.n_heads, cfg.d_head)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
+        if cfg.decode:
+            return dense(cfg.d_model, "o_proj")(
+                self._decode_attend(q, k, v).reshape(
+                    batch, seq, features))
         attention_fn = cfg.attention_fn or (
             lambda q_, k_, v_, causal: attn_ops.attention(
                 q_, k_, v_, causal=causal))
         out = attention_fn(q, k, v, causal=True)
         out = out.reshape(batch, seq, features)
         return dense(cfg.d_model, "o_proj")(out)
+
+    def _decode_attend(self, q, k, v):
+        """Single-step decode: insert this step's K/V into the cache
+        and attend the (length-1) query over the valid prefix."""
+        cfg = self.config
+        batch, seq, heads, depth = q.shape
+        assert seq == 1, "decode mode consumes one token per call"
+        cache_k = self.variable(
+            "cache", "k", jnp.zeros,
+            (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
+        cache_v = self.variable(
+            "cache", "v", jnp.zeros,
+            (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32))
+        idx = index.value
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+        index.value = idx + 1
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, cache_k.value,
+            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(depth))
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (cfg.max_decode_len, 1), 0)[:, 0]
+        mask = key_pos <= idx
+        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cache_v.value,
+            preferred_element_type=jnp.float32)
+        return out.astype(cfg.dtype)
 
 
 def functools_partial_dense(cfg: TransformerConfig):
@@ -148,17 +190,20 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, return_hidden: bool = False,
+                 positions=None):
         """tokens: [B, T] int32 -> logits [B, T, vocab] (or the final
         hidden states [B, T, d_model] when return_hidden — used by the
         chunked-loss training path so the full fp32 logits tensor,
-        B*T*vocab, never materializes in HBM)."""
+        B*T*vocab, never materializes in HBM). In decode mode pass
+        positions=[absolute position] for the current step."""
         cfg = self.config
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          name="embed")
         x = embed(tokens)
-        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())

@@ -1,0 +1,76 @@
+"""KV-cache decode correctness: cached single-step decoding must
+reproduce the full-forward teacher-forced argmax path exactly."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batch_shipyard_tpu.models import inference, transformer as tfm
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = tfm.TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_head=16,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    model = tfm.TransformerLM(config)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return config, model, params
+
+
+def test_greedy_decode_matches_full_forward(setup):
+    config, model, params = setup
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, 97, (2, 6)), jnp.int32)
+    run, _ = inference.make_decoder(config, params, max_decode_len=32)
+    out, _cache = run(prompt, 10, jax.random.PRNGKey(1))
+    assert out.shape == (2, 16)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]),
+                                  np.asarray(prompt))
+    # Reference: greedy rollout via repeated full forwards (no cache).
+    seq = prompt
+    for _ in range(10):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_sampling_temperature_and_topk(setup):
+    config, model, params = setup
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    run, _ = inference.make_decoder(config, params, max_decode_len=32)
+    sampling = inference.SamplingConfig(temperature=1.0, top_k=5)
+    out_a, _ = run(prompt, 8, jax.random.PRNGKey(7),
+                   sampling=sampling)
+    out_b, _ = run(prompt, 8, jax.random.PRNGKey(8),
+                   sampling=sampling)
+    assert out_a.shape == (1, 11)
+    # Different keys should (overwhelmingly) give different samples.
+    assert not np.array_equal(np.asarray(out_a), np.asarray(out_b))
+    # Same key reproduces exactly.
+    out_c, _ = run(prompt, 8, jax.random.PRNGKey(7),
+                   sampling=sampling)
+    np.testing.assert_array_equal(np.asarray(out_a),
+                                  np.asarray(out_c))
+
+
+def test_decode_respects_max_len(setup):
+    config, model, params = setup
+    run, dmodel = inference.make_decoder(config, params,
+                                         max_decode_len=8)
+    prompt = jnp.asarray([[5, 6]], jnp.int32)
+    out, cache = run(prompt, 6, jax.random.PRNGKey(0))
+    assert out.shape == (1, 8)
+    # Cache index advanced exactly prompt+generated-1 writes... every
+    # step writes once: prompt (2) + decode steps (5) = 7? The last
+    # sampled token is never fed back. index == total forward calls.
+    leaf = jax.tree_util.tree_leaves(
+        {k: v for k, v in cache.items()})[0]
+    assert leaf is not None
