@@ -39,6 +39,7 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     router_noise: float = 0.0
+    num_selected: int = 1    # 1 = Switch-style, 2 = GShard top-2
 
 
 def top1_routing(logits, capacity: int):
@@ -70,6 +71,42 @@ def top1_routing(logits, capacity: int):
     return dispatch, combine, aux
 
 
+def topk_routing(logits, capacity: int, num_selected: int = 2):
+    """GShard-style top-k routing. logits: [G, E]. Returns (dispatch
+    [G, E, C], combine [G, E, C], aux). First choices get buffer
+    priority; second choices fill remaining capacity; gates of the
+    selected experts are renormalized per token."""
+    groups, num_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    dispatch = jnp.zeros((groups, num_experts, capacity),
+                         dtype=jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    used = jnp.zeros((num_experts,), dtype=jnp.float32)
+    first_mask = None
+    for choice in range(num_selected):
+        mask = jax.nn.one_hot(expert_idx[:, choice], num_experts,
+                              dtype=jnp.float32)
+        if first_mask is None:
+            first_mask = mask
+        position = (jnp.cumsum(mask, axis=0) - 1.0 +
+                    used[None, :]) * mask
+        keep = (position < capacity) & (mask > 0)
+        mask = mask * keep
+        pos = jnp.sum(position * mask, axis=-1).astype(jnp.int32)
+        pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        sel = mask[:, :, None] * pos_onehot[:, None, :]
+        dispatch = dispatch + sel
+        combine = combine + sel * gate_vals[:, choice][:, None, None]
+        used = used + jnp.sum(mask, axis=0)
+    density = jnp.mean(first_mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux
+
+
 class MoEMLP(nn.Module):
     """Drop-in MLP replacement: top-1 routed SwiGLU experts."""
 
@@ -94,7 +131,11 @@ class MoEMLP(nn.Module):
                 minval=1.0 - cfg.router_noise,
                 maxval=1.0 + cfg.router_noise)
             logits = logits * noise
-        dispatch, combine, aux = top1_routing(logits, capacity)
+        if cfg.num_selected > 1:
+            dispatch, combine, aux = topk_routing(
+                logits, capacity, cfg.num_selected)
+        else:
+            dispatch, combine, aux = top1_routing(logits, capacity)
         # Expert parameters: leading E dim is the ep-sharded axis.
         w_gate = self.param(
             "w_gate", nn.initializers.lecun_normal(),

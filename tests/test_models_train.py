@@ -194,3 +194,58 @@ def test_moe_transformer_trains_with_ep():
             first = float(metrics["loss"])
     assert np.isfinite(float(metrics["loss"]))
     assert float(metrics["loss"]) < first
+
+
+def test_moe_top2_routing_properties():
+    from batch_shipyard_tpu.models import moe as moe_mod
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(64, 8), jnp.float32)
+    dispatch, combine, aux = moe_mod.topk_routing(logits, capacity=64,
+                                                  num_selected=2)
+    # Each token lands in at most 2 slots; combine weights sum <= 1.
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert per_token.max() <= 2.0 + 1e-6
+    weights = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    assert weights.max() <= 1.0 + 1e-5
+    # With ample capacity every token gets both choices.
+    assert per_token.min() == 2.0
+    # No slot double-booked.
+    assert np.asarray(jnp.sum(dispatch, axis=0)).max() <= 1.0 + 1e-6
+    assert float(aux) > 0
+
+
+def test_moe_top2_capacity_priority():
+    from batch_shipyard_tpu.models import moe as moe_mod
+    # Everyone's top-1 is expert 0, top-2 is expert 1; capacity 4.
+    logits = jnp.tile(jnp.asarray([[5.0, 3.0] + [-5.0] * 6]), (16, 1))
+    dispatch, _c, _a = moe_mod.topk_routing(logits, capacity=4,
+                                            num_selected=2)
+    per_expert = np.asarray(jnp.sum(dispatch, axis=(0, 2)))
+    assert per_expert[0] == 4.0  # first choices filled to capacity
+    assert per_expert[1] == 4.0  # second choices too
+    assert per_expert[2:].sum() == 0
+
+
+def test_moe_top2_transformer_trains():
+    from batch_shipyard_tpu.models.moe import MoEConfig
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8, ep=2))
+    config = train_mod.make_transformer_config(
+        mesh, moe=MoEConfig(num_experts=4, d_model=64, d_ff=128,
+                            num_selected=2, dtype=jnp.float32,
+                            param_dtype=jnp.float32),
+        **small_config())
+    harness = train_mod.build_transformer_train(
+        mesh, config, batch_size=8, seq_len=64, seed=0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(rng.randint(0, 256, (8, 64)), jnp.int32),
+        "targets": jnp.asarray(rng.randint(0, 256, (8, 64)),
+                               jnp.int32)}
+    params, opt_state = harness.params, harness.opt_state
+    first = None
+    for _ in range(4):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
