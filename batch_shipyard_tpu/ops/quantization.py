@@ -4,9 +4,9 @@ int8 MXU matmul.
 The v5e MXU runs int8 at 2x the bf16 rate; these kernels provide the
 building blocks for int8 serving and quantized training experiments:
 
-  - ``quantize_int8``: per-row absmax scaling with stochastic rounding
-    (pltpu.prng_random_bits + pltpu.stochastic_round — unbiased, the
-    requirement for using quantized grads/weights in training);
+  - ``quantize_int8``: per-row absmax scaling with unbiased
+    stochastic rounding (floor(x+u) against jax-PRNG random bits —
+    the requirement for using quantized grads/weights in training);
   - ``int8_matmul``: [M,K]i8 x [K,N]i8 -> f32 with int32 MXU
     accumulation and per-row/per-column scale application;
   - ``quantized_linear``: x @ w with both sides quantized on the fly;
@@ -24,20 +24,28 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _quantize_kernel(x_ref, seed_ref, values_ref, scales_ref):
-    pltpu.prng_seed(seed_ref[0])
+def _largest_divisor_block(dim: int, preferred: int) -> int:
+    """Largest divisor of dim that is <= preferred: grid blocks stay
+    VMEM-bounded for ANY dim (a non-divisible dim never silently falls
+    back to one whole-array block)."""
+    block = min(preferred, dim)
+    while dim % block:
+        block -= 1
+    return block
+
+
+def _quantize_kernel(x_ref, bits_ref, values_ref, scales_ref):
     x = x_ref[...].astype(jnp.float32)
     absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     scale = jnp.maximum(absmax, 1e-8) / 127.0
     scaled = x / scale
-    random_bits = pltpu.bitcast(
-        pltpu.prng_random_bits(scaled.shape), jnp.int32)
-    # Unbiased stochastic rounding: floor(x + u), u ~ U[0,1) from the
-    # hardware PRNG. 24 low bits -> f32 (Mosaic supports int32->f32;
-    # uint32->f32 it does not; pltpu.stochastic_round has no
-    # interpreter lowering).
+    # Unbiased stochastic rounding: floor(x + u), u ~ U[0,1) from
+    # caller-supplied random bits (an explicit input so the kernel is
+    # identical under the interpreter, where pltpu's in-kernel PRNG
+    # yields constant bits; also keeps randomness keyed by jax PRNG
+    # semantics). 24 low bits -> f32 (Mosaic lacks uint32->f32).
     u = jax.lax.bitwise_and(
-        random_bits, jnp.int32((1 << 24) - 1)
+        bits_ref[...], jnp.int32((1 << 24) - 1)
     ).astype(jnp.float32) * (1.0 / (1 << 24))
     rounded = jnp.floor(scaled + u)
     values_ref[...] = jnp.clip(rounded, -127.0, 127.0).astype(jnp.int8)
@@ -49,10 +57,10 @@ def quantize_int8(x, seed: int = 0, block_m: int = 256):
     x: [M, K] float -> (values [M, K] int8, scales [M, 1] f32).
     Row-blocked grid keeps VMEM bounded for large M."""
     m, k = x.shape
-    block_m = min(block_m, m)
-    if m % block_m:
-        block_m = m  # small/odd sizes: single block
-    seed_arr = jnp.asarray([seed], jnp.int32)
+    block_m = _largest_divisor_block(m, block_m)
+    bits = jax.lax.bitcast_convert_type(
+        jax.random.bits(jax.random.PRNGKey(seed), (m, k),
+                        jnp.uint32), jnp.int32)
     return pl.pallas_call(
         _quantize_kernel,
         out_shape=(
@@ -63,7 +71,8 @@ def quantize_int8(x, seed: int = 0, block_m: int = 256):
         in_specs=[
             pl.BlockSpec((block_m, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_m, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((block_m, k), lambda i: (i, 0),
@@ -71,7 +80,7 @@ def quantize_int8(x, seed: int = 0, block_m: int = 256):
             pl.BlockSpec((block_m, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ),
-    )(x, seed_arr)
+    )(x, bits)
 
 
 def dequantize_int8(values, scales):
@@ -95,8 +104,8 @@ def int8_matmul(x_q, x_scales, w_q, w_scales,
     Grid over (M, N) tiles with K resident per program."""
     m, k = x_q.shape
     _, n = w_q.shape
-    block_m = m if m % min(block_m, m) else min(block_m, m)
-    block_n = n if n % min(block_n, n) else min(block_n, n)
+    block_m = _largest_divisor_block(m, block_m)
+    block_n = _largest_divisor_block(n, block_n)
     return pl.pallas_call(
         _int8_matmul_kernel,
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),

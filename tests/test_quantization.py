@@ -30,15 +30,33 @@ def test_quantize_roundtrip_error_bound():
 
 
 def test_stochastic_rounding_unbiased():
-    # A constant halfway between two int8 steps: the mean of many
-    # stochastic roundings approaches the true value.
-    x = jnp.full((8, 128), 0.5, jnp.float32)
+    # One element pins the scale at 1.0/127 per step; the rest sit at
+    # a non-integer multiple of the step (25.4 steps), so rounding IS
+    # stochastic — the mean over seeds must approach the true value
+    # (a nearest-rounding implementation would be off by a fixed
+    # ~0.4 steps).
+    x = jnp.full((8, 128), 0.2, jnp.float32).at[:, 0].set(1.0)
+    step = 1.0 / 127.0
     totals = []
-    for seed in range(20):
+    for seed in range(30):
         values, scales = q.quantize_int8(x, seed=seed)
-        totals.append(float(jnp.mean(q.dequantize_int8(values,
-                                                       scales))))
-    assert abs(np.mean(totals) - 0.5) < 0.02
+        recon = q.dequantize_int8(values, scales)
+        totals.append(float(jnp.mean(recon[:, 1:])))
+    assert abs(np.mean(totals) - 0.2) < 0.15 * step
+    # And individual draws really do differ (stochastic, not nearest).
+    assert np.std(totals) > 0
+
+
+def test_blocking_handles_non_divisible_dims():
+    # 300 rows with preferred block 256 -> divisor blocks, never a
+    # whole-array fallback.
+    x = jnp.asarray(np.random.RandomState(3).randn(300, 128),
+                    jnp.float32)
+    values, scales = q.quantize_int8(x, seed=0)
+    assert values.shape == (300, 128)
+    recon = q.dequantize_int8(values, scales)
+    assert (np.abs(np.asarray(recon - x)) <=
+            np.asarray(scales) + 1e-6).all()
 
 
 def test_int8_matmul_accuracy():
