@@ -167,6 +167,21 @@ class StateStore(abc.ABC):
     def put_message(self, queue: str, payload: bytes,
                     delay_seconds: float = 0.0) -> str: ...
 
+    def put_messages(self, queue: str, payloads: list[bytes],
+                     delay_seconds: float = 0.0) -> list[str]:
+        """Batch enqueue (the TaskAddCollection-chunking analog,
+        reference batch.py:4313). Default loops; backends override to
+        amortize locking/round trips."""
+        return [self.put_message(queue, p, delay_seconds)
+                for p in payloads]
+
+    def insert_entities(self, table: str,
+                        rows: list[tuple[str, str, dict]]) -> list[str]:
+        """Batch insert [(pk, rk, entity)]; all-or-error semantics are
+        per-row (EntityExistsError aborts at the failing row)."""
+        return [self.insert_entity(table, pk, rk, entity)
+                for pk, rk, entity in rows]
+
     @abc.abstractmethod
     def get_messages(self, queue: str, max_messages: int = 1,
                      visibility_timeout: float = 30.0,

@@ -290,6 +290,40 @@ class LocalFSStateStore(base.StateStore):
             self._save_db(f"queue_{queue}", db)
             return message_id
 
+    def put_messages(self, queue: str, payloads: list[bytes],
+                     delay_seconds: float = 0.0) -> list[str]:
+        """Single lock/load/save for the whole batch (one fsync
+        instead of N — the dominant cost of per-message puts)."""
+        with self._locked():
+            db = self._load_db(f"queue_{queue}")
+            msgs = db.setdefault("messages", [])
+            ids = []
+            visible = time.time() + delay_seconds
+            for payload in payloads:
+                message_id = uuid.uuid4().hex
+                msgs.append({
+                    "id": message_id, "payload": payload.hex(),
+                    "visible_at": visible, "dequeue_count": 0,
+                    "receipt": None})
+                ids.append(message_id)
+            self._save_db(f"queue_{queue}", db)
+            return ids
+
+    def insert_entities(self, table: str,
+                        rows: list[tuple[str, str, dict]]) -> list[str]:
+        with self._locked():
+            db = self._load_db(f"table_{table}")
+            etags = []
+            for pk, rk, entity in rows:
+                key = self._ekey(pk, rk)
+                if key in db:
+                    raise EntityExistsError(f"{table}:{pk}:{rk}")
+                etag = uuid.uuid4().hex
+                db[key] = {"entity": entity, "etag": etag}
+                etags.append(etag)
+            self._save_db(f"table_{table}", db)
+            return etags
+
     def get_messages(self, queue: str, max_messages: int = 1,
                      visibility_timeout: float = 30.0,
                      ) -> list[QueueMessage]:

@@ -195,3 +195,20 @@ def test_clear(store):
     assert store.list_objects() == []
     assert list(store.query_entities("t")) == []
     assert store.queue_length("q") == 0
+
+
+def test_batch_put_messages_and_insert_entities(store):
+    ids = store.put_messages("bq", [f"m{i}".encode()
+                                    for i in range(25)])
+    assert len(ids) == len(set(ids)) == 25
+    assert store.queue_length("bq") == 25
+    got = {m.payload for m in store.get_messages(
+        "bq", max_messages=25, visibility_timeout=30.0)}
+    assert got == {f"m{i}".encode() for i in range(25)}
+    etags = store.insert_entities("bt", [
+        ("p", f"r{i}", {"v": i}) for i in range(10)])
+    assert len(etags) == 10
+    assert len(list(store.query_entities("bt"))) == 10
+    with pytest.raises(EntityExistsError):
+        store.insert_entities("bt", [("p", "new", {}),
+                                     ("p", "r3", {})])
