@@ -1,0 +1,142 @@
+"""GcpTpuSubstrate logic tests against a mocked gcloud: allocation,
+worker registration, bootstrap, fatal-error classification,
+resize/suspend/delete — the cloud-path logic verified hermetically."""
+
+import json
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+
+def make_pool(slices=1):
+    return settings_mod.pool_settings({"pool_specification": {
+        "id": "gp", "substrate": "tpu_vm",
+        "tpu": {"accelerator_type": "v5litepod-16",
+                "num_slices": slices}}})
+
+
+CREDS = settings_mod.credentials_settings({"credentials": {
+    "gcp": {"project": "proj", "zone": "us-central1-a"},
+    "storage": {"backend": "memory"}}})
+
+
+class FakeGcloud:
+    """Records gcloud invocations and scripts responses."""
+
+    def __init__(self):
+        self.calls = []
+        self.fail_create_with = None
+
+    def __call__(self, substrate, *args, parse_json=False):
+        self.calls.append(args)
+        verb = args[0]
+        if verb == "create" and self.fail_create_with:
+            raise RuntimeError(self.fail_create_with)
+        if verb == "describe" and parse_json:
+            return {"networkEndpoints": [
+                {"ipAddress": f"10.1.0.{i+1}",
+                 "accessConfig": {"externalIp": f"34.0.0.{i+1}"}}
+                for i in range(4)]}
+        return ""
+
+
+@pytest.fixture()
+def substrate(monkeypatch):
+    from batch_shipyard_tpu.substrate import gcp_tpu
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/gcloud")
+    store = MemoryStateStore()
+    sub = gcp_tpu.GcpTpuSubstrate(store, CREDS)
+    fake = FakeGcloud()
+    monkeypatch.setattr(
+        sub, "_gcloud",
+        lambda *a, **kw: fake(sub, *a, **kw))
+    return store, sub, fake
+
+
+def test_allocate_registers_workers_and_bootstraps(substrate):
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "creating", "spec": {}})
+    sub.allocate_pool(pool)
+    nodes = pool_mgr.list_nodes(store, "gp")
+    assert len(nodes) == 4
+    assert {n.internal_ip for n in nodes} == {
+        "10.1.0.1", "10.1.0.2", "10.1.0.3", "10.1.0.4"}
+    verbs = [c[0] for c in fake.calls]
+    assert verbs.count("create") == 1
+    assert verbs.count("ssh") == 1  # --worker=all bootstrap
+    ssh_call = [c for c in fake.calls if c[0] == "ssh"][0]
+    assert "--worker=all" in ssh_call
+    command = [a for a in ssh_call if str(a).startswith("--command=")]
+    assert "batch_shipyard_tpu.agent" in command[0]
+
+
+def test_fatal_allocation_error_classified(substrate):
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "creating", "spec": {}})
+    fake.fail_create_with = "gcloud failed (1): QUOTA_EXCEEDED for TPU"
+    with pytest.raises(RuntimeError):
+        sub.allocate_pool(pool)
+    entity = store.get_entity(names.TABLE_POOLS, "pools", "gp")
+    assert entity["allocation_error_fatal"] is True
+
+
+def test_transient_allocation_error_not_fatal(substrate):
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "creating", "spec": {}})
+    fake.fail_create_with = "gcloud failed (1): deadline exceeded"
+    with pytest.raises(RuntimeError):
+        sub.allocate_pool(pool)
+    entity = store.get_entity(names.TABLE_POOLS, "pools", "gp")
+    assert entity["allocation_error_fatal"] is False
+
+
+def test_resize_and_delete_slices(substrate):
+    store, sub, fake = substrate
+    pool = make_pool(slices=1)
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "ready", "spec": {}})
+    sub.allocate_pool(pool)
+    sub.resize_pool(pool, 2)
+    assert len(pool_mgr.list_nodes(store, "gp")) == 8
+    sub.resize_pool(pool, 1)
+    assert len(pool_mgr.list_nodes(store, "gp")) == 4
+    delete_calls = [c for c in fake.calls if c[0] == "delete"]
+    assert len(delete_calls) == 1
+    sub.deallocate_pool("gp")
+    assert pool_mgr.list_nodes(store, "gp") == []
+
+
+def test_suspend_and_start(substrate):
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "ready", "spec": {}})
+    sub.allocate_pool(pool)
+    sub.suspend_pool(pool)
+    assert all(n.state == "suspended"
+               for n in pool_mgr.list_nodes(store, "gp"))
+    sub.start_pool(pool)
+    verbs = [c[0] for c in fake.calls]
+    assert "stop" in verbs and "start" in verbs
+    # start re-bootstraps agents
+    assert verbs.count("ssh") == 2
+
+
+def test_remote_login_prefers_external_ip(substrate):
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "ready", "spec": {}})
+    sub.allocate_pool(pool)
+    ip, port = sub.get_remote_login("gp", "gp-s0-w0")
+    assert ip == "34.0.0.1" and port == 22
