@@ -1,0 +1,119 @@
+"""A minimal in-memory fake of the google.cloud.storage surface that
+state/gcs.py uses, with faithful generation-precondition semantics —
+lets the full state-store contract suite execute the real GCSStateStore
+logic (lease steal via matched-generation swap, claim races) without a
+network or credentials."""
+
+from __future__ import annotations
+
+import datetime
+import threading
+
+
+class PreconditionFailed(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class FakeExceptionsModule:
+    PreconditionFailed = PreconditionFailed
+    NotFound = NotFound
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.RLock()
+        # name -> (bytes, generation)
+        self.blobs: dict[str, tuple[bytes, int]] = {}
+        self.counter = 0
+
+
+class FakeBlob:
+    def __init__(self, store: _Store, name: str):
+        self._store = store
+        self.name = name
+        self.generation = None
+        self.size = None
+        self.updated = None
+
+    def upload_from_string(self, data, if_generation_match=None):
+        if isinstance(data, str):
+            data = data.encode()
+        with self._store.lock:
+            current = self._store.blobs.get(self.name)
+            if if_generation_match is not None:
+                cur_gen = current[1] if current else 0
+                if cur_gen != if_generation_match:
+                    raise PreconditionFailed(self.name)
+            self._store.counter += 1
+            self._store.blobs[self.name] = (bytes(data),
+                                            self._store.counter)
+            self.generation = self._store.counter
+            self.size = len(data)
+            self.updated = datetime.datetime.now(datetime.timezone.utc)
+
+    def download_as_bytes(self):
+        with self._store.lock:
+            if self.name not in self._store.blobs:
+                raise NotFound(self.name)
+            return self._store.blobs[self.name][0]
+
+    def reload(self):
+        with self._store.lock:
+            if self.name not in self._store.blobs:
+                raise NotFound(self.name)
+            data, gen = self._store.blobs[self.name]
+            self.generation = gen
+            self.size = len(data)
+            self.updated = datetime.datetime.now(
+                datetime.timezone.utc)
+
+    def delete(self, if_generation_match=None):
+        with self._store.lock:
+            if self.name not in self._store.blobs:
+                raise NotFound(self.name)
+            if if_generation_match is not None and (
+                    self._store.blobs[self.name][1] !=
+                    if_generation_match):
+                raise PreconditionFailed(self.name)
+            del self._store.blobs[self.name]
+
+
+class FakeBucket:
+    def __init__(self, store: _Store):
+        self._store = store
+
+    def blob(self, name: str) -> FakeBlob:
+        return FakeBlob(self._store, name)
+
+
+class FakeClient:
+    def __init__(self):
+        self._store = _Store()
+        self._bucket = FakeBucket(self._store)
+
+    def bucket(self, _name: str) -> FakeBucket:
+        return self._bucket
+
+    def list_blobs(self, _bucket, prefix: str = ""):
+        with self._store.lock:
+            names = sorted(n for n in self._store.blobs
+                           if n.startswith(prefix))
+        for name in names:
+            blob = self._bucket.blob(name)
+            blob.reload()
+            yield blob
+
+
+def make_fake_gcs_store(prefix: str = "t"):
+    """Construct a real GCSStateStore wired to the fake client."""
+    from batch_shipyard_tpu.state.gcs import GCSStateStore
+    store = GCSStateStore.__new__(GCSStateStore)
+    store._client = FakeClient()
+    store._bucket = store._client.bucket("fake")
+    store._prefix = prefix
+    store._exceptions = FakeExceptionsModule
+    return store

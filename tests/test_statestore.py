@@ -17,10 +17,15 @@ from batch_shipyard_tpu.state.localfs import LocalFSStateStore
 from batch_shipyard_tpu.state.memory import MemoryStateStore
 
 
-@pytest.fixture(params=["memory", "localfs"])
+@pytest.fixture(params=["memory", "localfs", "gcs"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryStateStore()
+    elif request.param == "gcs":
+        # The REAL GCSStateStore logic over an in-memory fake of the
+        # google.cloud.storage API (generation preconditions etc.).
+        from tests.fake_gcs import make_fake_gcs_store
+        yield make_fake_gcs_store()
     else:
         yield LocalFSStateStore(str(tmp_path / "store"))
 
