@@ -35,23 +35,31 @@ from batch_shipyard_tpu.state.base import (
 class GCSStateStore(base.StateStore):
     def __init__(self, bucket: str, prefix: str = "shipyardtpu",
                  project: Optional[str] = None,
-                 credentials_file: Optional[str] = None) -> None:
-        try:
-            from google.cloud import storage as gcs  # noqa: PLC0415
-        except ImportError as exc:  # pragma: no cover
-            raise RuntimeError(
-                "google-cloud-storage is required for the gcs state "
-                "backend; use backend: localfs or memory otherwise"
-            ) from exc
-        if credentials_file:
-            self._client = gcs.Client.from_service_account_json(
-                credentials_file, project=project)
+                 credentials_file: Optional[str] = None,
+                 client=None, exceptions_module=None) -> None:
+        """client/exceptions_module: injectable for tests (a faithful
+        fake runs the whole contract suite against this class without
+        a cloud account — tests/fake_gcs.py)."""
+        if client is not None:
+            self._client = client
+            self._exceptions = exceptions_module
         else:
-            self._client = gcs.Client(project=project)
+            try:
+                from google.cloud import storage as gcs  # noqa: PLC0415
+            except ImportError as exc:  # pragma: no cover
+                raise RuntimeError(
+                    "google-cloud-storage is required for the gcs "
+                    "state backend; use backend: localfs or memory "
+                    "otherwise") from exc
+            if credentials_file:
+                self._client = gcs.Client.from_service_account_json(
+                    credentials_file, project=project)
+            else:
+                self._client = gcs.Client(project=project)
+            self._exceptions = __import__(
+                "google.api_core.exceptions", fromlist=["exceptions"])
         self._bucket = self._client.bucket(bucket)
         self._prefix = prefix.rstrip("/")
-        self._exceptions = __import__(
-            "google.api_core.exceptions", fromlist=["exceptions"])
 
     # ------------------------------ helpers ----------------------------
 

@@ -99,21 +99,25 @@ class FakeClient:
         return self._bucket
 
     def list_blobs(self, _bucket, prefix: str = ""):
+        # Metadata snapshot under the lock, like a real GCS listing:
+        # iteration never raises for blobs deleted concurrently.
         with self._store.lock:
-            names = sorted(n for n in self._store.blobs
-                           if n.startswith(prefix))
-        for name in names:
+            snapshot = sorted(
+                (name, data, gen)
+                for name, (data, gen) in self._store.blobs.items()
+                if name.startswith(prefix))
+        for name, data, gen in snapshot:
             blob = self._bucket.blob(name)
-            blob.reload()
+            blob.generation = gen
+            blob.size = len(data)
+            blob.updated = datetime.datetime.now(
+                datetime.timezone.utc)
             yield blob
 
 
 def make_fake_gcs_store(prefix: str = "t"):
-    """Construct a real GCSStateStore wired to the fake client."""
+    """Construct a real GCSStateStore (through its real constructor)
+    wired to the fake client."""
     from batch_shipyard_tpu.state.gcs import GCSStateStore
-    store = GCSStateStore.__new__(GCSStateStore)
-    store._client = FakeClient()
-    store._bucket = store._client.bucket("fake")
-    store._prefix = prefix
-    store._exceptions = FakeExceptionsModule
-    return store
+    return GCSStateStore("fake", prefix=prefix, client=FakeClient(),
+                         exceptions_module=FakeExceptionsModule)
