@@ -548,23 +548,80 @@ class NodeAgent:
         return [e for e in self.store.query_entities(
             names.TABLE_GANGS, partition_key=gang_pk, row_key_prefix="i")]
 
+    def _stale_gang_members(self, job_id: str,
+                            task_id: str) -> list[dict]:
+        """Joined members whose node heartbeat has gone stale — a
+        crashed/preempted gang participant. A broken gang cannot
+        produce a correct collective result; the observer fails the
+        task fast instead of letting the rendezvous (or the job) hang.
+        Critical for gangs on preemptible TPU slices."""
+        stale = []
+        now = time.time()
+        for member in self._gang_members(job_id, task_id):
+            if member.get("state") == "done":
+                continue
+            node_id = member.get("node_id")
+            if node_id == self.identity.node_id:
+                continue
+            try:
+                node = self.store.get_entity(
+                    names.TABLE_NODES, self.identity.pool_id, node_id)
+                alive = (node.get("state") not in ("offline",) and
+                         now - float(node.get("heartbeat_at", 0)) <
+                         self.node_stale_seconds)
+            except NotFoundError:
+                alive = False
+            if not alive:
+                stale.append(member)
+        return stale
+
+    def _fail_broken_gang(self, job_id: str, task_id: str,
+                          stale: list[dict], msg) -> None:
+        dead = sorted(m.get("node_id", "?") for m in stale)
+        logger.warning("gang %s/%s lost member(s) %s; failing task",
+                       job_id, task_id, dead)
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": "failed", "exit_code": -4,
+                "error": f"gang member(s) lost: {dead}"})
+        except NotFoundError:
+            pass
+        self.store.delete_message(msg)
+        self._maybe_autocomplete_job(job_id)
+
     def _run_gang_instance(self, slot: int, job_id: str, task_id: str,
                            entity: dict, instance: int, msg) -> None:
         spec = entity["spec"]
         num_instances = spec["multi_instance"]["num_instances"]
         if not self._gang_claim(job_id, task_id, instance):
-            # This node can't take this instance; make the message
-            # promptly available for other nodes.
+            # This node can't take this instance. If the holder of an
+            # instance is a dead node, the gang is broken — fail fast
+            # instead of bouncing the message forever.
+            stale = self._stale_gang_members(job_id, task_id)
+            if stale:
+                self._fail_broken_gang(job_id, task_id, stale, msg)
+                return
+            # Otherwise make the message promptly available for other
+            # nodes.
             self.store.update_message(msg, visibility_timeout=0.0)
             time.sleep(self.poll_interval)
             return
-        # Rendezvous: wait for all instances to join.
+        # Rendezvous: wait for all instances to join, watching for
+        # members dying underneath us (preemption/crash).
         deadline = time.monotonic() + self.gang_timeout
         keepalive = time.monotonic()
+        last_stale_check = 0.0
         while True:
             members = self._gang_members(job_id, task_id)
             if len(members) >= num_instances:
                 break
+            if time.monotonic() - last_stale_check > max(
+                    1.0, self.heartbeat_interval):
+                stale = self._stale_gang_members(job_id, task_id)
+                if stale:
+                    self._fail_broken_gang(job_id, task_id, stale, msg)
+                    return
+                last_stale_check = time.monotonic()
             if time.monotonic() > deadline:
                 self._merge_task(job_id, task_id, {
                     "state": "failed", "exit_code": -1,

@@ -315,3 +315,42 @@ def test_orphaned_task_reclaimed_from_dead_node(env):
     tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jorph", timeout=30)
     assert tasks[0]["state"] == "completed"
     assert tasks[0]["node_id"] != "ghost-node"
+
+
+def test_broken_gang_fails_fast(env):
+    """A gang whose member died (stale node heartbeat) is failed
+    promptly by the surviving participants instead of hanging until
+    the rendezvous timeout (preempted-slice semantics)."""
+    store, substrate, pool = env
+    pk = names.task_pk("pool1", "jghost")
+    store.insert_entity(names.TABLE_JOBS, "pool1", "jghost",
+                        {"state": "active", "spec": {}})
+    spec = {"command": "echo never", "runtime": "none",
+            "multi_instance": {"num_instances": 4,
+                               "jax_distributed": {"enabled": True}}}
+    store.insert_entity(names.TABLE_TASKS, pk, "g0",
+                        {"state": "pending", "spec": spec,
+                         "retries": 0})
+    # Ghost member already holds instance 0 with a dead node.
+    gang_pk = names.gang_pk("pool1", "jghost", "g0")
+    store.insert_entity(names.TABLE_GANGS, gang_pk, "i0", {
+        "node_id": "ghost-node", "hostname": "ghost",
+        "internal_ip": "10.9.9.9", "slice_index": 0,
+        "worker_index": 0, "state": "joined"})
+    store.insert_entity(names.TABLE_GANGS, gang_pk, "node$ghost-node",
+                        {"instance": 0})
+    store.upsert_entity(names.TABLE_NODES, "pool1", "ghost-node", {
+        "state": "running", "heartbeat_at": 0.0})
+    for k in range(4):
+        store.put_message(names.task_queue("pool1"), json.dumps(
+            {"job_id": "jghost", "task_id": "g0",
+             "instance": k}).encode())
+    import time as time_mod
+    deadline = time_mod.monotonic() + 30
+    while time_mod.monotonic() < deadline:
+        task = jobs_mgr.get_task(store, "pool1", "jghost", "g0")
+        if task.get("state") == "failed":
+            break
+        time_mod.sleep(0.2)
+    assert task["state"] == "failed"
+    assert "gang member" in task.get("error", "")
