@@ -94,6 +94,9 @@ class NodeAgent:
         self._job_state_ttl = job_state_ttl
         # (job_id, task_id) -> live Popen, for task termination relay.
         self._live_procs: dict[tuple[str, str], object] = {}
+        # (job_id, task_id) -> last gang-health probe (rate limiting
+        # the claim-failure bounce path).
+        self._gang_probe_at: dict[tuple[str, str], float] = {}
 
     # ------------------------- node lifecycle --------------------------
 
@@ -364,15 +367,7 @@ class NodeAgent:
             # Our own pre-crash claim (agent restart): take it back.
             pass
         else:
-            try:
-                node = self.store.get_entity(
-                    names.TABLE_NODES, self.identity.pool_id, owner)
-                alive = (node.get("state") not in ("offline",) and
-                         time.time() - float(node.get(
-                             "heartbeat_at", 0)) < self.node_stale_seconds)
-            except NotFoundError:
-                alive = False
-            if alive:
+            if self._node_alive(owner):
                 return None
         logger.warning(
             "task %s/%s orphaned by %s; resetting to pending",
@@ -548,30 +543,32 @@ class NodeAgent:
         return [e for e in self.store.query_entities(
             names.TABLE_GANGS, partition_key=gang_pk, row_key_prefix="i")]
 
-    def _stale_gang_members(self, job_id: str,
-                            task_id: str) -> list[dict]:
-        """Joined members whose node heartbeat has gone stale — a
+    def _node_alive(self, node_id: str) -> bool:
+        """THE liveness predicate (shared by orphan reclaim and gang
+        health): node entity present, not offline, heartbeat fresh."""
+        try:
+            node = self.store.get_entity(
+                names.TABLE_NODES, self.identity.pool_id, node_id)
+        except NotFoundError:
+            return False
+        return (node.get("state") not in ("offline",) and
+                time.time() - float(node.get("heartbeat_at", 0)) <
+                self.node_stale_seconds)
+
+    def _stale_gang_members(self, members: list[dict]) -> list[dict]:
+        """Joined (not yet done) members whose node died — a
         crashed/preempted gang participant. A broken gang cannot
         produce a correct collective result; the observer fails the
         task fast instead of letting the rendezvous (or the job) hang.
         Critical for gangs on preemptible TPU slices."""
         stale = []
-        now = time.time()
-        for member in self._gang_members(job_id, task_id):
+        for member in members:
             if member.get("state") == "done":
                 continue
             node_id = member.get("node_id")
             if node_id == self.identity.node_id:
                 continue
-            try:
-                node = self.store.get_entity(
-                    names.TABLE_NODES, self.identity.pool_id, node_id)
-                alive = (node.get("state") not in ("offline",) and
-                         now - float(node.get("heartbeat_at", 0)) <
-                         self.node_stale_seconds)
-            except NotFoundError:
-                alive = False
-            if not alive:
+            if not self._node_alive(node_id):
                 stale.append(member)
         return stale
 
@@ -594,13 +591,28 @@ class NodeAgent:
         spec = entity["spec"]
         num_instances = spec["multi_instance"]["num_instances"]
         if not self._gang_claim(job_id, task_id, instance):
-            # This node can't take this instance. If the holder of an
-            # instance is a dead node, the gang is broken — fail fast
-            # instead of bouncing the message forever.
-            stale = self._stale_gang_members(job_id, task_id)
-            if stale:
-                self._fail_broken_gang(job_id, task_id, stale, msg)
-                return
+            # This node can't take this instance. Probe gang health at
+            # most once per heartbeat interval per gang — the bounce
+            # path spins during normal formation on large pools.
+            probe_key = (job_id, task_id)
+            now = time.monotonic()
+            if now - self._gang_probe_at.get(probe_key, 0.0) > max(
+                    1.0, self.heartbeat_interval):
+                self._gang_probe_at[probe_key] = now
+                members = self._gang_members(job_id, task_id)
+                if (len(members) >= num_instances and all(
+                        m.get("state") == "done" for m in members)):
+                    # Whole gang finished but the last member crashed
+                    # between marking done and finalizing: finish the
+                    # aggregation on its behalf.
+                    self._gang_finalize(job_id, task_id, num_instances)
+                    self.store.delete_message(msg)
+                    self._maybe_autocomplete_job(job_id)
+                    return
+                stale = self._stale_gang_members(members)
+                if stale:
+                    self._fail_broken_gang(job_id, task_id, stale, msg)
+                    return
             # Otherwise make the message promptly available for other
             # nodes.
             self.store.update_message(msg, visibility_timeout=0.0)
@@ -617,7 +629,7 @@ class NodeAgent:
                 break
             if time.monotonic() - last_stale_check > max(
                     1.0, self.heartbeat_interval):
-                stale = self._stale_gang_members(job_id, task_id)
+                stale = self._stale_gang_members(members)
                 if stale:
                     self._fail_broken_gang(job_id, task_id, stale, msg)
                     return

@@ -354,3 +354,39 @@ def test_broken_gang_fails_fast(env):
         time_mod.sleep(0.2)
     assert task["state"] == "failed"
     assert "gang member" in task.get("error", "")
+
+
+def test_gang_done_member_crash_finalized_by_peer(env):
+    """A gang whose last member marked itself done but crashed before
+    finalizing is finalized by whichever live node receives the
+    redelivered message."""
+    store, substrate, pool = env
+    pk = names.task_pk("pool1", "jdone")
+    store.insert_entity(names.TABLE_JOBS, "pool1", "jdone",
+                        {"state": "active", "spec": {}})
+    spec = {"command": "echo x", "runtime": "none",
+            "multi_instance": {"num_instances": 2,
+                               "jax_distributed": {"enabled": False}}}
+    store.insert_entity(names.TABLE_TASKS, pk, "g1",
+                        {"state": "running", "spec": spec,
+                         "retries": 0})
+    gang_pk = names.gang_pk("pool1", "jdone", "g1")
+    for k, node in ((0, "ghost-a"), (1, "ghost-b")):
+        store.insert_entity(names.TABLE_GANGS, gang_pk, f"i{k}", {
+            "node_id": node, "hostname": node,
+            "internal_ip": "10.0.0.9", "slice_index": 0,
+            "worker_index": k, "state": "done", "exit_code": 0})
+        store.insert_entity(names.TABLE_GANGS, gang_pk,
+                            f"node${node}", {"instance": k})
+    # The crashed member's message redelivers:
+    store.put_message(names.task_queue("pool1"), json.dumps(
+        {"job_id": "jdone", "task_id": "g1", "instance": 1}).encode())
+    import time as time_mod
+    deadline = time_mod.monotonic() + 30
+    while time_mod.monotonic() < deadline:
+        task = jobs_mgr.get_task(store, "pool1", "jdone", "g1")
+        if task.get("state") == "completed":
+            break
+        time_mod.sleep(0.2)
+    assert task["state"] == "completed"
+    assert task["exit_code"] == 0
