@@ -525,10 +525,14 @@ def monitor_create(click_ctx, output_dir, start):
     from batch_shipyard_tpu.monitor import provision
     ctx = _ctx(click_ctx)
     mon = ctx.configs.get("monitor", {}).get("monitoring", {})
+    le = (mon.get("services", {}) or {}).get("lets_encrypt", {}) or {}
     bundle = provision.generate_monitoring_bundle(
         output_dir,
         prometheus_port=mon.get("prometheus", {}).get("port", 9090),
-        grafana_port=mon.get("grafana", {}).get("port", 3000))
+        grafana_port=mon.get("grafana", {}).get("port", 3000),
+        lets_encrypt_fqdn=(le.get("fqdn")
+                           if le.get("enabled") else None),
+        lets_encrypt_staging=le.get("use_staging_environment", False))
     if start:
         provision.start_local(bundle)
     click.echo(f"monitoring bundle: {bundle}")
@@ -664,6 +668,30 @@ def fed_jobs_list(click_ctx, federation_id):
     from batch_shipyard_tpu.federation import federation as fed_mod
     fleet._emit({"jobs": fed_mod.list_federation_jobs(
         _ctx(click_ctx).store, federation_id)}, click_ctx.obj["raw"])
+
+
+@fed_jobs.command("term")
+@click.argument("federation_id")
+@click.argument("job_id")
+@click.pass_context
+def fed_jobs_term(click_ctx, federation_id, job_id):
+    """Terminate a federated job on whichever pool it landed on."""
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    pool_id = fed_mod.terminate_federation_job(
+        _ctx(click_ctx).store, federation_id, job_id)
+    click.echo(f"terminated {job_id} on pool {pool_id}")
+
+
+@fed_jobs.command("del")
+@click.argument("federation_id")
+@click.argument("job_id")
+@click.pass_context
+def fed_jobs_del(click_ctx, federation_id, job_id):
+    """Delete a federated job on whichever pool it landed on."""
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    pool_id = fed_mod.delete_federation_job(
+        _ctx(click_ctx).store, federation_id, job_id)
+    click.echo(f"deleted {job_id} from pool {pool_id}")
 
 
 @fed_jobs.command("zap")

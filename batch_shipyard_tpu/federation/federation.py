@@ -134,6 +134,38 @@ def zap_action(store: StateStore, federation_id: str,
                         f"zap${action_id}", {"zapped": True})
 
 
+def locate_federation_job(store: StateStore, federation_id: str,
+                          job_id: str) -> str:
+    """Which pool did the scheduler place this job on? (job locator
+    table analog, storage.py:1276)."""
+    try:
+        row = store.get_entity(names.TABLE_FEDJOBS, federation_id,
+                               job_id)
+    except NotFoundError:
+        raise ValueError(
+            f"job {job_id} is not scheduled in federation "
+            f"{federation_id}")
+    return row["pool_id"]
+
+
+def terminate_federation_job(store: StateStore, federation_id: str,
+                             job_id: str) -> str:
+    """fed jobs term: route the terminate to the pool the job landed
+    on. Returns that pool id."""
+    pool_id = locate_federation_job(store, federation_id, job_id)
+    jobs_mgr.terminate_job(store, pool_id, job_id)
+    return pool_id
+
+
+def delete_federation_job(store: StateStore, federation_id: str,
+                          job_id: str) -> str:
+    """fed jobs del: route the delete and drop the locator row."""
+    pool_id = locate_federation_job(store, federation_id, job_id)
+    jobs_mgr.delete_job(store, pool_id, job_id)
+    store.delete_entity(names.TABLE_FEDJOBS, federation_id, job_id)
+    return pool_id
+
+
 def list_federation_jobs(store: StateStore,
                          federation_id: str) -> list[dict]:
     return [row for row in store.query_entities(

@@ -58,6 +58,56 @@ services:
     restart: unless-stopped
 """
 
+_NGINX_CONF = """\
+events {}
+http {
+  server {
+    listen 443 ssl;
+    server_name {fqdn};
+    ssl_certificate /etc/letsencrypt/live/{fqdn}/fullchain.pem;
+    ssl_certificate_key /etc/letsencrypt/live/{fqdn}/privkey.pem;
+    location / {
+      proxy_pass http://grafana:3000;
+      proxy_set_header Host $host;
+    }
+  }
+  server {
+    listen 80;
+    server_name {fqdn};
+    location /.well-known/acme-challenge/ { root /var/www/certbot; }
+    location / { return 301 https://$host$request_uri; }
+  }
+}
+"""
+
+_NGINX_COMPOSE_SERVICES = """\
+  nginx:
+    image: nginx:stable
+    ports:
+      - "80:80"
+      - "443:443"
+    volumes:
+      - ./nginx.conf:/etc/nginx/nginx.conf:ro
+      - certbot-etc:/etc/letsencrypt
+      - certbot-www:/var/www/certbot
+    depends_on:
+      - grafana
+    restart: unless-stopped
+  certbot:
+    image: certbot/certbot:latest
+    volumes:
+      - certbot-etc:/etc/letsencrypt
+      - certbot-www:/var/www/certbot
+    entrypoint: >-
+      /bin/sh -c 'certbot certonly --webroot -w /var/www/certbot
+      -d {fqdn} --agree-tos -m {email} -n {staging}
+      && trap exit TERM;
+      while :; do certbot renew; sleep 12h & wait $${{!}}; done'
+volumes:
+  certbot-etc:
+  certbot-www:
+"""
+
 _GRAFANA_DATASOURCE = """\
 apiVersion: 1
 datasources:
@@ -129,8 +179,15 @@ def generate_monitoring_bundle(
         output_dir: str, prometheus_port: int = 9090,
         grafana_port: int = 3000,
         grafana_password: str = "admin",
-        scrape_interval: int = 15) -> str:
-    """Write the full monitoring deployment bundle; returns its dir."""
+        scrape_interval: int = 15,
+        lets_encrypt_fqdn: Optional[str] = None,
+        lets_encrypt_email: str = "admin@example.com",
+        lets_encrypt_staging: bool = False) -> str:
+    """Write the full monitoring deployment bundle; returns its dir.
+
+    With lets_encrypt_fqdn set, an nginx + certbot pair fronts Grafana
+    over TLS (reference: heimdall/nginx.conf + the lets_encrypt knobs
+    in monitor.yaml, monitoring_bootstrap.sh:307-345)."""
     os.makedirs(os.path.join(output_dir, "file_sd"), exist_ok=True)
     os.makedirs(os.path.join(output_dir, "grafana", "provisioning",
                              "datasources"), exist_ok=True)
@@ -142,11 +199,19 @@ def generate_monitoring_bundle(
               encoding="utf-8") as fh:
         fh.write(_PROMETHEUS_YML.format(
             scrape_interval=scrape_interval, prom_port=prometheus_port))
+    compose = _DOCKER_COMPOSE_YML.format(
+        prom_port=prometheus_port, grafana_port=grafana_port,
+        grafana_password=grafana_password)
+    if lets_encrypt_fqdn:
+        compose += _NGINX_COMPOSE_SERVICES.format(
+            fqdn=lets_encrypt_fqdn, email=lets_encrypt_email,
+            staging="--staging" if lets_encrypt_staging else "")
+        with open(os.path.join(output_dir, "nginx.conf"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(_NGINX_CONF.replace("{fqdn}", lets_encrypt_fqdn))
     with open(os.path.join(output_dir, "docker-compose.yml"), "w",
               encoding="utf-8") as fh:
-        fh.write(_DOCKER_COMPOSE_YML.format(
-            prom_port=prometheus_port, grafana_port=grafana_port,
-            grafana_password=grafana_password))
+        fh.write(compose)
     with open(os.path.join(output_dir, "grafana", "provisioning",
                            "datasources", "prometheus.yaml"), "w",
               encoding="utf-8") as fh:

@@ -220,3 +220,14 @@ def test_mirror_images_plan():
     plan = misc.mirror_images_plan(["busybox:latest"], "my.registry")
     assert ["docker", "pull", "busybox:latest"] in plan
     assert ["docker", "push", "my.registry/busybox:latest"] in plan
+
+
+def test_monitoring_bundle_with_lets_encrypt(tmp_path):
+    out = provision.generate_monitoring_bundle(
+        str(tmp_path / "tls"), lets_encrypt_fqdn="mon.example.com",
+        lets_encrypt_staging=True)
+    compose = open(os.path.join(out, "docker-compose.yml")).read()
+    assert "nginx" in compose and "certbot" in compose
+    assert "mon.example.com" in compose and "--staging" in compose
+    nginx = open(os.path.join(out, "nginx.conf")).read()
+    assert "mon.example.com" in nginx and "443 ssl" in nginx

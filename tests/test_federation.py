@@ -127,3 +127,26 @@ def test_ha_single_scheduler(env):
     # a renews fine; b still locked out
     assert proc_a._hold_global_lock()
     assert not proc_b._hold_global_lock()
+
+
+def test_federated_job_term_and_del_routing(env):
+    store, substrate = env
+    make_pool(store, substrate, "routed", "v5litepod-4")
+    fed.create_federation(store, "fedr")
+    fed.add_pool_to_federation(store, "fedr", "routed")
+    fed.submit_job_to_federation(store, "fedr", {
+        "job_specifications": [{
+            "id": "rjob", "tasks": [{"command": "sleep 60"}]}]})
+    fed.FederationProcessor(store).process_once()
+    assert fed.locate_federation_job(store, "fedr",
+                                     "rjob") == "routed"
+    pool_id = fed.terminate_federation_job(store, "fedr", "rjob")
+    assert pool_id == "routed"
+    assert jobs_mgr.get_job(store, "routed", "rjob")[
+        "state"] == "terminated"
+    assert fed.delete_federation_job(store, "fedr",
+                                     "rjob") == "routed"
+    with pytest.raises(jobs_mgr.JobNotFoundError):
+        jobs_mgr.get_job(store, "routed", "rjob")
+    with pytest.raises(ValueError):
+        fed.locate_federation_job(store, "fedr", "rjob")
