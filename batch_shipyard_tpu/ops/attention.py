@@ -364,7 +364,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
-                    block_k: int):
+                    block_k: int, g_lse=None):
     batch, t_q, heads, depth = q.shape
     t_kv = k.shape[1]
     scale = 1.0 / math.sqrt(depth)
@@ -379,6 +379,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     # delta = rowsum(dO * O), the softmax-normalizer correction term.
     delta = jnp.sum(do_r.astype(jnp.float32) * o_r.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if g_lse is not None:
+        # Cotangent on the lse output enters the score gradient as
+        # ds_j = p_j * (dP_j - delta + g_lse)  — because d lse/d s_j
+        # = p_j — i.e. exactly a correction to delta. This is what
+        # makes the ring merge (whose weights depend on each block's
+        # lse) differentiate correctly through the per-block kernels.
+        delta = delta - g_lse.astype(jnp.float32)
     seq_spec = pl.BlockSpec((None, t_kv, depth),
                             lambda b, i: (b, 0, 0))
     row_full = pl.BlockSpec((None, t_q, 1), lambda b, i: (b, 0, 0))
@@ -478,9 +485,9 @@ def _flash_lse_fwd_rule(q, k, v, causal, block_q, block_k):
 
 def _flash_lse_bwd_rule(causal, block_q, block_k, residuals, grads):
     q, k, v, out, lse = residuals
-    g, _g_lse = grads  # lse cotangent unused: merge treats it as aux
+    g, g_lse = grads
     return _flash_backward(q, k, v, out, lse, g, causal, block_q,
-                           block_k)
+                           block_k, g_lse=g_lse)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_fwd_rule,

@@ -32,15 +32,37 @@ from jax.sharding import Mesh, PartitionSpec as P
 from batch_shipyard_tpu.ops import attention as attn_ops
 
 
-def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
-    """Per-shard ring body using the Pallas flash kernels.
+def _flash_ring_rotation(q, k_cur, v_cur, my_idx, src, causal: bool):
+    """One ring rotation's partial attention with the flash kernels.
 
     Each rotation's masking regime is one of exactly three static
     cases — fully masked (KV from a later shard), diagonal (own
     shard: causal), fully visible (earlier shard) — selected with
     lax.switch, so the offset-free flash kernels apply unchanged and
-    partials merge in logsumexp space.
+    partials merge in logsumexp space. my_idx/src may be traced (ring
+    body) or concrete (single-device virtual-shard simulation).
     """
+
+    def masked(_q, _k, _v):
+        return attn_ops.masked_attention_block(_q)
+
+    def diagonal(_q, _k, _v):
+        return attn_ops.flash_attention_with_lse(_q, _k, _v, True)
+
+    def full(_q, _k, _v):
+        return attn_ops.flash_attention_with_lse(_q, _k, _v, False)
+
+    if not causal:
+        return full(q, k_cur, v_cur)
+    case = jnp.where(src > my_idx, 0,
+                     jnp.where(src == my_idx, 1, 2))
+    return jax.lax.switch(case, (masked, diagonal, full),
+                          q, k_cur, v_cur)
+
+
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
+    """Per-shard ring body using the Pallas flash kernels (see
+    _flash_ring_rotation for the 3-case selection)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -49,23 +71,8 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
     def step(carry, t):
         o_acc, lse_acc, k_cur, v_cur = carry
         src = (my_idx - t) % axis_size
-
-        def masked(_q, _k, _v):
-            return attn_ops.masked_attention_block(_q)
-
-        def diagonal(_q, _k, _v):
-            return attn_ops.flash_attention_with_lse(_q, _k, _v, True)
-
-        def full(_q, _k, _v):
-            return attn_ops.flash_attention_with_lse(_q, _k, _v, False)
-
-        if causal:
-            case = jnp.where(src > my_idx, 0,
-                             jnp.where(src == my_idx, 1, 2))
-            o_s, lse_s = jax.lax.switch(
-                case, (masked, diagonal, full), q, k_cur, v_cur)
-        else:
-            o_s, lse_s = full(q, k_cur, v_cur)
+        o_s, lse_s = _flash_ring_rotation(q, k_cur, v_cur, my_idx,
+                                          src, causal)
         o_acc, lse_acc = attn_ops.merge_attention_blocks(
             o_acc, lse_acc, o_s, lse_s)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -76,6 +83,41 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
     (o, _lse, _, _), _ = jax.lax.scan(
         step, (o0, lse0, k, v), jnp.arange(axis_size))
     return o
+
+
+def ring_attention_virtual_shards(q, k, v, sp: int, causal: bool = True):
+    """Run the flash-ring algorithm — the SAME 3-case rotation +
+    logsumexp merge the shard_map body uses — over sp virtual sequence
+    shards on a single device.
+
+    This exists so the flash ring path is exercisable on one real TPU
+    chip (pallas interpret mode aborts inside shard_map on CPU, and
+    multi-chip hardware is not always at hand): tools/tpu_checks.py
+    runs it against the oracle, forward and backward, on the chip.
+    """
+    if q.shape[1] % sp or k.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"sequence length {q.shape[1]} (kv {k.shape[1]}) must be "
+            f"equal and divisible by sp={sp}")
+    t_local = q.shape[1] // sp
+    outs = []
+    for my_idx in range(sp):
+        q_s = jax.lax.dynamic_slice_in_dim(q, my_idx * t_local,
+                                           t_local, axis=1)
+        o_acc, lse_acc = attn_ops.masked_attention_block(q_s)
+        for t in range(sp):
+            src = (my_idx - t) % sp
+            k_s = jax.lax.dynamic_slice_in_dim(k, src * t_local,
+                                               t_local, axis=1)
+            v_s = jax.lax.dynamic_slice_in_dim(v, src * t_local,
+                                               t_local, axis=1)
+            o_s, lse_s = _flash_ring_rotation(
+                q_s, k_s, v_s, jnp.int32(my_idx), jnp.int32(src),
+                causal)
+            o_acc, lse_acc = attn_ops.merge_attention_blocks(
+                o_acc, lse_acc, o_s, lse_s)
+        outs.append(o_acc)
+    return jnp.concatenate(outs, axis=1)
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
