@@ -49,7 +49,8 @@ def main(argv: list[str]) -> int:
         node_stale_seconds=boot.get("node_stale_seconds", 30.0),
         nodeprep=(run_node_prep if boot.get("run_nodeprep", True)
                   else None),
-        image_provisioner=provisioner)
+        image_provisioner=provisioner,
+        output_upload_cap_bytes=boot.get("output_upload_cap_bytes"))
 
     def _stop(signum, frame):
         agent.stop()

@@ -41,7 +41,7 @@ from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
 
-_MAX_OUTPUT_UPLOAD_BYTES = 4 * 1024 * 1024
+_OUTPUT_STREAM_CHUNK = 4 * 1024 * 1024
 
 
 class NodeUnusableError(Exception):
@@ -72,6 +72,7 @@ class NodeAgent:
                  nodeprep: Optional[Callable[["NodeAgent"], None]] = None,
                  image_provisioner: Optional[
                      Callable[["NodeAgent", list[str]], None]] = None,
+                 output_upload_cap_bytes: Optional[int] = None,
                  ) -> None:
         self.store = store
         self.identity = identity
@@ -83,6 +84,9 @@ class NodeAgent:
         self.node_stale_seconds = node_stale_seconds
         self._nodeprep = nodeprep
         self._image_provisioner = image_provisioner
+        # None = upload task outputs in full (streamed). A configured
+        # cap keeps head+tail around an explicit truncation marker.
+        self.output_upload_cap_bytes = output_upload_cap_bytes
         self.stop_event = threading.Event()
         self._threads: list[threading.Thread] = []
         self._running_tasks = 0
@@ -1004,12 +1008,35 @@ class NodeAgent:
             path = os.path.join(execution.task_dir, name)
             if not os.path.exists(path):
                 continue
-            with open(path, "rb") as fh:
-                data = fh.read(_MAX_OUTPUT_UPLOAD_BYTES)
             key = names.task_output_key(
                 self.identity.pool_id, job_id, task_id,
                 f"{suffix}/{name}" if suffix else name)
-            self.store.put_object(key, data)
+            size = os.path.getsize(path)
+            cap = self.output_upload_cap_bytes
+            if cap is None or size <= cap:
+                # Full upload, streamed — no whole-buffer read, no
+                # silent 4MB truncation (round-1 weak #6).
+                def chunks(p=path):
+                    with open(p, "rb") as fh:
+                        while True:
+                            block = fh.read(_OUTPUT_STREAM_CHUNK)
+                            if not block:
+                                return
+                            yield block
+                self.store.put_object_stream(key, chunks())
+            else:
+                # Explicitly configured cap: keep head + tail around
+                # an unmistakable marker instead of a silent cut.
+                half = cap // 2
+                with open(path, "rb") as fh:
+                    head = fh.read(half)
+                    fh.seek(max(size - half, half))
+                    tail = fh.read()
+                marker = (f"\n...[shipyard: output truncated, "
+                          f"{size} bytes total, cap {cap}]...\n"
+                          ).encode()
+                self.store.put_object_stream(
+                    key, iter([head, marker, tail]))
 
     def _maybe_autocomplete_job(self, job_id: str) -> None:
         """auto_complete: when every task of the job is terminal, mark

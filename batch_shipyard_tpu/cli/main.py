@@ -538,6 +538,39 @@ def monitor_create(click_ctx, output_dir, start):
     click.echo(f"monitoring bundle: {bundle}")
 
 
+@monitor.command("create-vm")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.option("--vm-size", default="e2-standard-2")
+@click.pass_context
+def monitor_create_vm(click_ctx, project, zone, vm_size):
+    """Provision a GCE VM running the monitoring bundle (reference
+    `shipyard monitor create` provisions the monitoring VM)."""
+    from batch_shipyard_tpu.monitor import provision
+    ctx = _ctx(click_ctx)
+    mon = ctx.configs.get("monitor", {}).get("monitoring", {})
+    le = (mon.get("services", {}) or {}).get("lets_encrypt", {}) or {}
+    ip = provision.provision_monitoring_vm(
+        ctx.store, project, zone=zone, vm_size=vm_size,
+        prometheus_port=mon.get("prometheus", {}).get("port", 9090),
+        grafana_port=mon.get("grafana", {}).get("port", 3000),
+        lets_encrypt_fqdn=(le.get("fqdn")
+                           if le.get("enabled") else None),
+        lets_encrypt_staging=le.get("use_staging_environment", False))
+    click.echo(f"monitoring VM provisioned: {ip}")
+
+
+@monitor.command("destroy-vm")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def monitor_destroy_vm(click_ctx, project, zone):
+    from batch_shipyard_tpu.monitor import provision
+    provision.destroy_monitoring_vm(_ctx(click_ctx).store, project,
+                                    zone=zone)
+    click.echo("monitoring VM destroyed")
+
+
 @monitor.command("add")
 @click.option("--pool-id", "pool_id", default=None)
 @click.pass_context
@@ -751,6 +784,110 @@ def slurm_resume(click_ctx, hostlist):
     fleet._emit({"assignments": assignments}, click_ctx.obj["raw"])
 
 
+@slurm.command("publish-munge-key")
+@click.option("--cluster-id", required=True)
+@click.option("--key-file", required=True)
+@click.pass_context
+def slurm_publish_munge_key(click_ctx, cluster_id, key_file):
+    """Controller-side: publish the munge key through the store."""
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    with open(key_file, "rb") as fh:
+        slurm_prov.publish_munge_key(_ctx(click_ctx).store,
+                                     cluster_id, fh.read())
+    click.echo("munge key published")
+
+
+@slurm.command("fetch-munge-key")
+@click.option("--cluster-id", required=True)
+@click.option("--key-file", required=True)
+@click.option("--timeout", type=float, default=600.0)
+@click.pass_context
+def slurm_fetch_munge_key(click_ctx, cluster_id, key_file, timeout):
+    """Node-side: poll the store for the controller's munge key."""
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    data = slurm_prov.fetch_munge_key(_ctx(click_ctx).store,
+                                      cluster_id, timeout=timeout)
+    with open(key_file, "wb") as fh:
+        fh.write(data)
+    click.echo("munge key fetched")
+
+
+@slurm.command("cluster-create")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.option("--db-password", default="shipyard")
+@click.option("--login-count", type=int, default=0)
+@click.option("--package-source", default="batch-shipyard-tpu",
+              help="pip requirement or gs:// wheel the VMs install")
+@click.pass_context
+def slurm_cluster_create(click_ctx, project, zone, db_password,
+                         login_count, package_source):
+    """Provision the slurm control plane (controller + logins)."""
+    import yaml as _yaml
+
+    from batch_shipyard_tpu.slurm import burst
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    partitions = sconf.get("slurm_options", {}).get(
+        "elastic_partitions", {})
+    # The VMs reach the same state store this CLI uses: ship our
+    # credentials config into their bootstrap.
+    store_config = _yaml.safe_dump(ctx.configs.get("credentials", {}))
+    record = slurm_prov.create_slurm_cluster(
+        ctx.store, cluster_id,
+        burst.generate_slurm_conf(cluster_id, partitions),
+        db_password, project, zone=zone, login_count=login_count,
+        package_source=package_source,
+        store_config_yaml=store_config)
+    fleet._emit(record, click_ctx.obj["raw"])
+
+
+@slurm.command("cluster-destroy")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def slurm_cluster_destroy(click_ctx, project, zone):
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    slurm_prov.destroy_slurm_cluster(ctx.store, cluster_id, project,
+                                     zone=zone)
+    click.echo(f"slurm cluster {cluster_id} destroyed")
+
+
+@slurm.command("cluster-status")
+@click.option("--project", default=None)
+@click.option("--zone", default=None)
+@click.pass_context
+def slurm_cluster_status(click_ctx, project, zone):
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    fleet._emit(slurm_prov.slurm_cluster_status(
+        ctx.store, cluster_id, project=project, zone=zone),
+        click_ctx.obj["raw"])
+
+
+@slurm.command("join-script")
+@click.pass_context
+def slurm_join_script(click_ctx):
+    """Emit the compute-node slurmd join script."""
+    from batch_shipyard_tpu.slurm import burst
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    sconf = ctx.configs.get("slurm", {}).get("slurm", {})
+    cluster_id = sconf.get("cluster_id", "shipyard")
+    partitions = sconf.get("slurm_options", {}).get(
+        "elastic_partitions", {})
+    click.echo(slurm_prov.generate_compute_join_script(
+        cluster_id,
+        burst.generate_slurm_conf(cluster_id, partitions)))
+
+
 @slurm.command("suspend")
 @click.argument("hostlist")
 @click.pass_context
@@ -810,6 +947,84 @@ def fs_cluster_mount_args(click_ctx, cluster_id):
     for line in remotefs.create_storage_cluster_mount_args(
             _ctx(click_ctx).store, cluster_id):
         click.echo(line)
+
+
+@fs_cluster.command("provision")
+@click.argument("cluster_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_provision(click_ctx, cluster_id, project, zone):
+    """Create the NFS server VM + striped data disks."""
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.provision_nfs_server(_ctx(click_ctx).store, cluster_id,
+                                  project, zone=zone)
+    click.echo(f"storage cluster {cluster_id} provisioned")
+
+
+@fs_cluster.command("suspend")
+@click.argument("cluster_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_suspend(click_ctx, cluster_id, project, zone):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.suspend_storage_cluster(_ctx(click_ctx).store,
+                                     cluster_id, project, zone=zone)
+    click.echo(f"storage cluster {cluster_id} suspended")
+
+
+@fs_cluster.command("start")
+@click.argument("cluster_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_start(click_ctx, cluster_id, project, zone):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.start_storage_cluster(_ctx(click_ctx).store, cluster_id,
+                                   project, zone=zone)
+    click.echo(f"storage cluster {cluster_id} started")
+
+
+@fs_cluster.command("status")
+@click.argument("cluster_id")
+@click.option("--project", default=None)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_status(click_ctx, cluster_id, project, zone):
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    fleet._emit(remotefs.storage_cluster_status(
+        _ctx(click_ctx).store, cluster_id, project=project,
+        zone=zone), click_ctx.obj["raw"])
+
+
+@fs_cluster.command("resize")
+@click.argument("cluster_id")
+@click.option("--vm-size", required=True)
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_resize(click_ctx, cluster_id, vm_size, project, zone):
+    """Change the server's machine type (stop -> resize -> start)."""
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    remotefs.resize_storage_cluster(_ctx(click_ctx).store, cluster_id,
+                                    vm_size, project, zone=zone)
+    click.echo(f"storage cluster {cluster_id} resized to {vm_size}")
+
+
+@fs_cluster.command("expand")
+@click.argument("cluster_id")
+@click.option("--additional-disks", type=int, required=True)
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fs_cluster_expand(click_ctx, cluster_id, additional_disks,
+                      project, zone):
+    """Attach new striped disks; prints the on-server grow script."""
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    click.echo(remotefs.expand_storage_cluster_live(
+        _ctx(click_ctx).store, cluster_id, additional_disks, project,
+        zone=zone))
 
 
 # -------------------------------- misc ---------------------------------

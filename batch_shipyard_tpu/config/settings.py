@@ -227,6 +227,9 @@ class PoolSettings:
     ssh: PoolSshSettings
     environment_variables: dict
     max_wait_time_seconds: int
+    # None = upload task outputs in full (streamed); a value caps each
+    # output at head+tail around an explicit truncation marker.
+    output_upload_cap_mb: Optional[int]
     node_exporter: PrometheusExporterSettings
     cadvisor: PrometheusExporterSettings
 
@@ -342,6 +345,8 @@ def pool_settings(config: dict) -> PoolSettings:
             spec, "environment_variables", default={}),
         max_wait_time_seconds=_get(
             spec, "max_wait_time_seconds", default=1800),
+        output_upload_cap_mb=_get(
+            spec, "output_upload_cap_mb", default=None),
         node_exporter=PrometheusExporterSettings(
             enabled=_get(
                 spec, "prometheus", "node_exporter", "enabled",

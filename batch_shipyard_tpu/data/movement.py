@@ -133,12 +133,17 @@ def plan_multinode_transfer(
         dest_path: str, method: str = "scp",
         ssh_username: str = "shipyard",
         ssh_private_key: Optional[str] = None,
+        host_key_checking: str = "accept-new",
         ) -> list[TransferCommand]:
     """Shard files across nodes round-robin balanced by size and emit
     per-node transfer command lines (reference _multinode_transfer
     data.py:567: largest-first onto least-loaded node).
 
     files: [(local_path, size)]; nodes: [(node_id, ip, port)].
+    host_key_checking: OpenSSH StrictHostKeyChecking value. The
+    'accept-new' default is trust-on-first-use; pass 'no' for
+    throwaway/re-provisioned nodes whose IPs get recycled with fresh
+    host keys (the reference's unconditional behavior).
     """
     if method not in ("scp", "rsync"):
         raise ValueError(f"unknown transfer method {method!r}")
@@ -155,15 +160,16 @@ def plan_multinode_transfer(
         if not shard:
             continue
         key_args = (("-i", ssh_private_key) if ssh_private_key else ())
+        hk = (("-o", f"StrictHostKeyChecking={host_key_checking}") +
+              (("-o", "UserKnownHostsFile=/dev/null")
+               if host_key_checking == "no" else ()))
         if method == "scp":
-            argv = ("scp", "-o", "StrictHostKeyChecking=no",
-                    "-o", "UserKnownHostsFile=/dev/null",
+            argv = ("scp", *hk,
                     "-P", str(port), *key_args, "-p", *shard,
                     f"{ssh_username}@{ip}:{dest_path}")
         else:
             ssh_cmd = " ".join((
-                "ssh", "-o", "StrictHostKeyChecking=no",
-                "-o", "UserKnownHostsFile=/dev/null",
+                "ssh", *hk,
                 *key_args, "-p", str(port)))
             argv = ("rsync", "-az", "-e", ssh_cmd, *shard,
                     f"{ssh_username}@{ip}:{dest_path}")

@@ -41,7 +41,7 @@ services:
   prometheus:
     image: prom/prometheus:latest
     ports:
-      - "{prom_port}:9090"
+      - "{prom_bind}{prom_port}:9090"
     volumes:
       - ./prometheus.yml:/etc/prometheus/prometheus.yml:ro
       - ./file_sd:/etc/prometheus/file_sd:ro
@@ -49,7 +49,7 @@ services:
   grafana:
     image: grafana/grafana-oss:latest
     ports:
-      - "{grafana_port}:3000"
+      - "{grafana_bind}{grafana_port}:3000"
     environment:
       - GF_SECURITY_ADMIN_PASSWORD={grafana_password}
     volumes:
@@ -199,9 +199,14 @@ def generate_monitoring_bundle(
               encoding="utf-8") as fh:
         fh.write(_PROMETHEUS_YML.format(
             scrape_interval=scrape_interval, prom_port=prometheus_port))
+    # With the TLS front enabled, bind Grafana/Prometheus to loopback
+    # only so the nginx HTTPS proxy (and its HTTP->HTTPS redirect)
+    # cannot be bypassed over plaintext host ports.
+    bind = "127.0.0.1:" if lets_encrypt_fqdn else ""
     compose = _DOCKER_COMPOSE_YML.format(
         prom_port=prometheus_port, grafana_port=grafana_port,
-        grafana_password=grafana_password)
+        grafana_password=grafana_password,
+        prom_bind=bind, grafana_bind=bind)
     if lets_encrypt_fqdn:
         compose += _NGINX_COMPOSE_SERVICES.format(
             fqdn=lets_encrypt_fqdn, email=lets_encrypt_email,
@@ -244,3 +249,81 @@ def start_local(bundle_dir: str) -> int:
 def stop_local(bundle_dir: str) -> int:
     return util.subprocess_with_output(
         ["docker", "compose", "down"], cwd=bundle_dir)
+
+
+def provision_monitoring_vm(
+        store, project: str, zone: Optional[str] = None,
+        network: Optional[str] = None,
+        vm_size: str = "e2-standard-2",
+        name: str = "shipyard-monitor",
+        vms=None, **bundle_kwargs) -> str:
+    """Create a GCE VM running the monitoring bundle end-to-end
+    (reference convoy/monitor.py:126 create_monitoring_resource: the
+    VM + custom-script extension). The generated bundle is shipped
+    inside the startup script as a base64 tarball, docker + compose
+    are installed, and the systemd unit keeps the stack up across
+    reboots. Returns the VM's internal IP; the VM is registered under
+    TABLE_MONITOR (pk="vms") so destroy_monitoring_vm can find it.
+
+    ``vms`` injects a GceVmManager (tests pass a fake runner).
+    """
+    import base64
+    import io
+    import tarfile
+    import tempfile
+
+    from batch_shipyard_tpu.state import names as _names
+
+    if vms is None:
+        from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+        vms = GceVmManager(project, zone=zone, network=network)
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle_dir = generate_monitoring_bundle(tmp, **bundle_kwargs)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            tar.add(bundle_dir, arcname=".")
+        payload = base64.b64encode(buf.getvalue()).decode("ascii")
+    startup = f"""#!/usr/bin/env bash
+set -euo pipefail
+# batch-shipyard-tpu monitoring VM bootstrap
+if ! command -v docker >/dev/null 2>&1; then
+  apt-get update
+  apt-get install -y docker.io docker-compose-v2
+fi
+mkdir -p /opt/shipyard-monitoring
+echo '{payload}' | base64 -d | \\
+  tar -xz -C /opt/shipyard-monitoring
+sed -i 's#WorkingDirectory=.*#WorkingDirectory=/opt/shipyard-monitoring#' \\
+  /opt/shipyard-monitoring/shipyard-monitoring.service
+cp /opt/shipyard-monitoring/shipyard-monitoring.service \\
+  /etc/systemd/system/
+systemctl daemon-reload
+systemctl enable --now shipyard-monitoring.service
+"""
+    ip = vms.create_vm(name, vm_size, startup_script=startup,
+                       tags=("shipyard-monitor",))
+    store.upsert_entity(_names.TABLE_MONITOR, "vms", name, {
+        "internal_ip": ip, "state": "running",
+        "created_at": util.datetime_utcnow_iso(),
+    })
+    logger.info("monitoring VM %s provisioned at %s", name, ip)
+    return ip
+
+
+def destroy_monitoring_vm(store, project: str,
+                          zone: Optional[str] = None,
+                          name: str = "shipyard-monitor",
+                          vms=None) -> None:
+    """Delete the monitoring VM and its registration (reference
+    convoy/monitor.py delete_monitoring_resource analog)."""
+    from batch_shipyard_tpu.state import names as _names
+    from batch_shipyard_tpu.state.base import NotFoundError
+
+    if vms is None:
+        from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+        vms = GceVmManager(project, zone=zone)
+    vms.delete_vm(name)
+    try:
+        store.delete_entity(_names.TABLE_MONITOR, "vms", name)
+    except NotFoundError:
+        pass

@@ -12,11 +12,12 @@ Layout convention throughout: q/k/v are [batch, seq, heads, head_dim]
     static shapes, no data-dependent control flow.
   - ``flash_attention``: Pallas TPU kernels for forward AND backward.
     Forward: grid over batch*heads x q-blocks, KV streamed through
-    VMEM, logsumexp rows saved. Backward: a dq kernel (grid over
-    q-blocks, streaming KV) and a fused dk/dv kernel (grid over
-    kv-blocks, streaming Q), both reconstructing probabilities from
-    the saved logsumexp — on a v5e chip this is ~4x faster than the
-    autodiff-of-blockwise backward it replaced.
+    VMEM, logsumexp rows saved. Backward: a single fused kernel (grid
+    over kv-blocks, streaming Q) producing dk/dv per block while dq
+    accumulates in a VMEM fp32 scratch across the sequential grid —
+    P is reconstructed from the saved logsumexp exactly once per
+    (q, kv) tile, which matters because the backward is exp/VPU-bound
+    on v5e. ~6x faster than the autodiff-of-blockwise backward.
 """
 
 from __future__ import annotations
@@ -28,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
@@ -167,46 +169,57 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     grid-blocked refs and accumulate with online softmax in VMEM.
     Also emits the logsumexp rows consumed by the backward kernels."""
     qi = pl.program_id(1)
-    q_tile = q_ref[...].astype(jnp.float32)  # [q_block, D]
+    # Operands stay in their input dtype (bf16 in production): the MXU
+    # multiplies bf16 x bf16 with exact fp32 accumulation at full rate,
+    # where pre-casting to fp32 forces the ~3x-slower multi-pass mode.
+    q_tile = q_ref[...]  # [q_block, D]
     t_kv = k_ref.shape[0]
     num_kb = t_kv // block_k
 
-    def body(kb, carry):
-        o, m, l = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        scores = jax.lax.dot_general(
-            q_tile, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [qb, kb]
-        if causal:
-            q_pos = (qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 0))
-            k_pos = (kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 1))
-            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-        m_blk = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        correction = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        pv = jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        o_new = o * correction[:, None] + pv
-        return o_new, m_new, l_new
+    def make_body(masked: bool):
+        def body(kb, carry):
+            o, m, l = carry
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            scores = jax.lax.dot_general(
+                q_tile, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [qb, kb]
+            if masked:
+                q_pos = (qi * q_block + jax.lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 0))
+                k_pos = (kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 1))
+                scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+            m_blk = jnp.max(scores, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            correction = jnp.exp(m - m_new)
+            p = jnp.exp(scores - m_new[:, None])
+            l_new = l * correction + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return o * correction[:, None] + pv, m_new, l_new
+
+        return body
 
     o = jnp.zeros((q_block, q_ref.shape[-1]), dtype=jnp.float32)
     m = jnp.full((q_block,), _NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((q_block,), dtype=jnp.float32)
     if causal:
-        # Only blocks up to (and including) the diagonal contribute.
+        # KV blocks fully below the diagonal need no mask; only blocks
+        # straddling it do, and blocks past it contribute nothing
+        # (exact ceil — the old floor+1 bound ran a fully-masked
+        # wasted block whenever the division was exact).
+        n_full = qi * q_block // block_k
         upper = jnp.minimum(
-            num_kb, (qi + 1) * q_block // block_k + 1)
+            num_kb, ((qi + 1) * q_block + block_k - 1) // block_k)
+        o, m, l = jax.lax.fori_loop(0, n_full, make_body(False),
+                                    (o, m, l))
+        o, m, l = jax.lax.fori_loop(n_full, upper, make_body(True),
+                                    (o, m, l))
     else:
-        upper = num_kb
-    o, m, l = jax.lax.fori_loop(0, upper, body, (o, m, l))
+        o, m, l = jax.lax.fori_loop(0, num_kb, make_body(False),
+                                    (o, m, l))
     denom = jnp.where(l == 0.0, 1.0, l)
     o_ref[...] = (o / denom[:, None]).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(denom))[:, None]
@@ -261,106 +274,89 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     return out
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, *, block_k: int, causal: bool,
-                     scale: float, q_block: int):
-    """dQ for one (batch*head, q-block): stream KV blocks.
-    dS = P * (dO @ V^T - delta); dQ = scale * dS @ K."""
-    qi = pl.program_id(1)
-    q_tile = q_ref[...].astype(jnp.float32)
-    do_tile = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][:, 0]
-    delta = delta_ref[...][:, 0]
-    t_kv = k_ref.shape[0]
-    num_kb = t_kv // block_k
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc, *,
+                      block_q: int, causal: bool, scale: float,
+                      k_block: int):
+    """Fused backward for one (batch*head, kv-block): stream Q blocks.
 
-    def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        scores = jax.lax.dot_general(
-            q_tile, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = (qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 0))
-            k_pos = (kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 1))
-            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse[:, None])
-        dp = jax.lax.dot_general(
-            do_tile, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dq = dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        return dq
-
-    if causal:
-        upper = jnp.minimum(num_kb, (qi + 1) * q_block // block_k + 1)
-    else:
-        upper = num_kb
-    dq = jax.lax.fori_loop(
-        0, upper, body,
-        jnp.zeros((q_block, q_ref.shape[-1]), dtype=jnp.float32))
-    dq_ref[...] = dq.astype(dq_ref.dtype)
-
-
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, block_q: int, causal: bool,
-                      scale: float, k_block: int):
-    """dK/dV for one (batch*head, kv-block): stream Q blocks.
-    dV = P^T @ dO; dK = scale * dS^T @ Q."""
+    dV = P^T @ dO; dK = scale * dS^T @ Q — and dQ accumulates into a
+    VMEM fp32 scratch across the (sequential) kv-block grid dimension,
+    so P = exp(S - lse) and the score matmul are computed ONCE per
+    (q, kv) tile instead of once in a dq kernel and again in a dkv
+    kernel. On a v5e chip the backward is exp/VPU-bound, so the fusion
+    is worth ~1.5x on the whole backward.
+    """
     kb = pl.program_id(1)
-    k_tile = k_ref[...].astype(jnp.float32)
-    v_tile = v_ref[...].astype(jnp.float32)
+    num_kb = pl.num_programs(1)
+    k_tile = k_ref[...]
+    v_tile = v_ref[...]
     t_q = q_ref.shape[0]
     num_qb = t_q // block_q
 
-    def body(qi, carry):
-        dk, dv = carry
-        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32)
-        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32)
-        lse_blk = lse_ref[pl.ds(qi * block_q, block_q), 0]
-        delta_blk = delta_ref[pl.ds(qi * block_q, block_q), 0]
-        scores = jax.lax.dot_general(
-            q_blk, k_tile, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [qb, kb]
-        if causal:
-            q_pos = (qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, k_block), 0))
-            k_pos = (kb * k_block + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, k_block), 1))
-            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse_blk[:, None])  # [qb, kb]
-        dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [kb, D]
-        dp = jax.lax.dot_general(
-            do_blk, v_tile, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [qb, kb]
-        ds = p * (dp - delta_blk[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [kb, D]
-        return dk, dv
+    @pl.when(kb == 0)
+    def _zero_dq():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def make_body(masked: bool):
+        def body(qi, carry):
+            dk, dv = carry
+            q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
+            do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
+            lse_blk = lse_ref[pl.ds(qi * block_q, block_q), 0]
+            delta_blk = delta_ref[pl.ds(qi * block_q, block_q), 0]
+            scores = jax.lax.dot_general(
+                q_blk, k_tile, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [qb, kb]
+            if masked:
+                q_pos = (qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, k_block), 0))
+                k_pos = (kb * k_block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, k_block), 1))
+                scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+            p = jnp.exp(scores - lse_blk[:, None])  # [qb, kb]
+            dv = dv + jax.lax.dot_general(
+                p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [kb, D]
+            dp = jax.lax.dot_general(
+                do_blk, v_tile, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [qb, kb]
+            ds = p * (dp - delta_blk[:, None])
+            dk = dk + jax.lax.dot_general(
+                ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [kb, D]
+            dq_blk = jax.lax.dot_general(
+                ds.astype(k_tile.dtype), k_tile,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [qb, D]
+            dq_acc[pl.ds(qi * block_q, block_q), :] = (
+                dq_acc[pl.ds(qi * block_q, block_q), :] + dq_blk)
+            return dk, dv
+
+        return body
 
     if causal:
         # Q blocks strictly before the diagonal see nothing of this
-        # KV block.
+        # KV block; blocks past the diagonal need no mask at all.
         lower = (kb * k_block) // block_q
+        first_full = ((kb + 1) * k_block + block_q - 1) // block_q
     else:
         lower = 0
+        first_full = 0
+    zeros = (jnp.zeros((k_block, k_ref.shape[-1]), dtype=jnp.float32),
+             jnp.zeros((k_block, v_ref.shape[-1]), dtype=jnp.float32))
     dk, dv = jax.lax.fori_loop(
-        lower, num_qb, body,
-        (jnp.zeros((k_block, k_ref.shape[-1]), dtype=jnp.float32),
-         jnp.zeros((k_block, v_ref.shape[-1]), dtype=jnp.float32)))
+        lower, jnp.minimum(first_full, num_qb),
+        make_body(masked=causal), zeros)
+    dk, dv = jax.lax.fori_loop(
+        jnp.maximum(lower, jnp.minimum(first_full, num_qb)), num_qb,
+        make_body(masked=False), (dk, dv))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kb == num_kb - 1)
+    def _emit_dq():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
@@ -368,8 +364,15 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     batch, t_q, heads, depth = q.shape
     t_kv = k.shape[1]
     scale = 1.0 / math.sqrt(depth)
-    block_q = min(block_q, t_q)
+    # The fused kernel keeps more live [block_q, block_k] fp32
+    # temporaries than the forward (p, dp, ds + casts), so its q-block
+    # is halved — and the k-block too for fp32 inputs, whose resident
+    # Q/dO/KV buffers are twice the size — to stay inside the ~16MB
+    # VMEM scoped-stack budget.
+    block_q = min(block_q, t_q, 256)
     block_k = min(block_k, t_kv)
+    if jnp.dtype(q.dtype).itemsize >= 4:
+        block_k = min(block_k, 512)
     bh = batch * heads
     q_r = q.transpose(0, 2, 1, 3).reshape(bh, t_q, depth)
     k_r = k.transpose(0, 2, 1, 3).reshape(bh, t_kv, depth)
@@ -386,33 +389,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         # makes the ring merge (whose weights depend on each block's
         # lse) differentiate correctly through the per-block kernels.
         delta = delta - g_lse.astype(jnp.float32)
-    seq_spec = pl.BlockSpec((None, t_kv, depth),
-                            lambda b, i: (b, 0, 0))
-    row_full = pl.BlockSpec((None, t_q, 1), lambda b, i: (b, 0, 0))
-    dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale, q_block=block_q),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, depth), q.dtype),
-        grid=(bh, t_q // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, depth),
-                         lambda b, i: (b, i, 0)),
-            seq_spec, seq_spec,
-            pl.BlockSpec((None, block_q, depth),
-                         lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1),
-                         lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1),
-                         lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, depth),
-                               lambda b, i: (b, i, 0)),
-    )(q_r, k_r, v_r, do_r, lse, delta)
     q_full = pl.BlockSpec((None, t_q, depth), lambda b, i: (b, 0, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, block_q=block_q,
+    row_full = pl.BlockSpec((None, t_q, 1), lambda b, i: (b, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_kernel, block_q=block_q,
                           causal=causal, scale=scale, k_block=block_k),
         out_shape=(
+            jax.ShapeDtypeStruct((bh, t_q, depth), q.dtype),
             jax.ShapeDtypeStruct((bh, t_kv, depth), k.dtype),
             jax.ShapeDtypeStruct((bh, t_kv, depth), v.dtype),
         ),
@@ -427,11 +410,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             row_full, row_full,
         ],
         out_specs=(
+            q_full,
             pl.BlockSpec((None, block_k, depth),
                          lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, depth),
                          lambda b, i: (b, i, 0)),
         ),
+        scratch_shapes=[pltpu.VMEM((t_q, depth), jnp.float32)],
     )(q_r, k_r, v_r, do_r, lse, delta)
 
     def unflatten(x, t_len):

@@ -24,14 +24,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _largest_divisor_block(dim: int, preferred: int) -> int:
-    """Largest divisor of dim that is <= preferred: grid blocks stay
-    VMEM-bounded for ANY dim (a non-divisible dim never silently falls
-    back to one whole-array block)."""
-    block = min(preferred, dim)
-    while dim % block:
-        block -= 1
-    return block
+def _largest_divisor_block(dim: int, preferred: int,
+                           align: int = 128) -> int:
+    """Largest divisor of dim that is <= preferred AND a multiple of
+    the TPU tile alignment (last dim: 128 lanes; second-to-last: 8/32
+    sublanes). Falls back to the whole axis when no aligned divisor
+    exists — Mosaic accepts a block equal to the full array dim."""
+    block = (min(preferred, dim) // align) * align
+    while block >= align:
+        if dim % block == 0:
+            return block
+        block -= align
+    return dim
 
 
 def _quantize_kernel(x_ref, bits_ref, values_ref, scales_ref):
@@ -57,7 +61,7 @@ def quantize_int8(x, seed: int = 0, block_m: int = 256):
     x: [M, K] float -> (values [M, K] int8, scales [M, 1] f32).
     Row-blocked grid keeps VMEM bounded for large M."""
     m, k = x.shape
-    block_m = _largest_divisor_block(m, block_m)
+    block_m = _largest_divisor_block(m, block_m, align=8)
     bits = jax.lax.bitcast_convert_type(
         jax.random.bits(jax.random.PRNGKey(seed), (m, k),
                         jnp.uint32), jnp.int32)
@@ -104,8 +108,8 @@ def int8_matmul(x_q, x_scales, w_q, w_scales,
     Grid over (M, N) tiles with K resident per program."""
     m, k = x_q.shape
     _, n = w_q.shape
-    block_m = _largest_divisor_block(m, block_m)
-    block_n = _largest_divisor_block(n, block_n)
+    block_m = _largest_divisor_block(m, block_m, align=8)
+    block_n = _largest_divisor_block(n, block_n, align=128)
     return pl.pallas_call(
         _int8_matmul_kernel,
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
@@ -129,9 +133,11 @@ def int8_matmul(x_q, x_scales, w_q, w_scales,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def quantized_linear(x, w, seed: int = 0):
     """x [M,K] @ w [K,N] with both sides int8-quantized on the fly;
-    full-precision backward (QAT straight-through)."""
-    x_q, x_s = quantize_int8(x.astype(jnp.float32), seed)
-    w_q, w_s = quantize_int8(w.astype(jnp.float32).T, seed + 1)
+    full-precision backward (QAT straight-through). The quantize
+    kernel casts to fp32 internally, so bf16 operands pass through
+    without materializing an fp32 copy in HBM."""
+    x_q, x_s = quantize_int8(x, seed)
+    w_q, w_s = quantize_int8(w.T, seed + 1)
     return int8_matmul(x_q, x_s, w_q.T, w_s)
 
 

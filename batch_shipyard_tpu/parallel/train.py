@@ -211,6 +211,115 @@ def build_transformer_train_pp(
                         batch_sharding=batch_sharding)
 
 
+def build_transformer_train_1f1b(
+        mesh: Mesh, config: tfm.TransformerConfig,
+        batch_size: int, seq_len: int,
+        num_microbatches: int = 8,
+        learning_rate: float = 3e-4,
+        seed: int = 0) -> TrainHarness:
+    """Pipeline-parallel transformer training on the 1F1B schedule
+    (parallel/pipeline.pipeline_1f1b_train): same model split as
+    build_transformer_train_pp, but the backward interleaves with the
+    forward so pipeline memory is bounded by the stage count instead
+    of the microbatch count, with stage-granular recompute. The tied
+    embedding's gradient combines the token-gather path (via the
+    pipeline's dx) and the CE head path (inside last_fn).
+    """
+    from flax import linen as nn
+
+    from batch_shipyard_tpu.parallel import pipeline as pipe
+    num_stages = mesh.shape["pp"]
+    if config.n_layers % num_stages:
+        raise ValueError(
+            f"n_layers {config.n_layers} not divisible by pp "
+            f"{num_stages}")
+    layers_per_stage = config.n_layers // num_stages
+    block = tfm.Block(config)
+    embed = nn.Embed(config.vocab_size, config.d_model,
+                     dtype=config.dtype, param_dtype=config.param_dtype)
+    norm = tfm.RMSNorm(dtype=config.dtype)
+    positions = jnp.arange(seq_len, dtype=jnp.int32)
+
+    rng = jax.random.PRNGKey(seed)
+    rngs = jax.random.split(rng, config.n_layers + 2)
+    x0 = jnp.zeros((1, seq_len, config.d_model), config.dtype)
+    per_layer = [block.init(rngs[i], x0, positions)["params"]
+                 for i in range(config.n_layers)]
+    per_stage = [
+        pipe.stack_stage_params(
+            per_layer[s * layers_per_stage:(s + 1) * layers_per_stage])
+        for s in range(num_stages)]
+    params = {
+        "embed": embed.init(
+            rngs[-2], jnp.zeros((1, seq_len), jnp.int32))["params"],
+        "stages": pipe.stack_stage_params(per_stage),
+        "final_norm": norm.init(rngs[-1], x0)["params"],
+    }
+    optimizer = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def stage_fn(stage_p, x):
+        def layer_step(h, layer_p):
+            return block.apply({"params": layer_p}, h, positions), None
+        out, _ = jax.lax.scan(layer_step, x, stage_p)
+        return out
+
+    def last_fn(last_p, y, target):
+        h = norm.apply({"params": last_p["final_norm"]}, y)
+        return tfm.lm_loss_chunked(h, last_p["embedding"], target)
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    param_specs = {
+        "embed": jax.tree_util.tree_map(lambda _: P(),
+                                        params["embed"]),
+        "stages": jax.tree_util.tree_map(
+            lambda p: P("pp", *([None] * (p.ndim - 1))),
+            params["stages"]),
+        "final_norm": jax.tree_util.tree_map(
+            lambda _: P(), params["final_norm"]),
+    }
+    param_shardings = shard_rules.to_shardings(mesh, param_specs)
+    params = jax.device_put(params, param_shardings)
+    opt_state = optimizer.init(params)
+
+    def grads_fn(params, tokens, targets):
+        h0, embed_vjp = jax.vjp(
+            lambda ep: embed.apply({"params": ep}, tokens),
+            params["embed"])
+        last_params = {"final_norm": params["final_norm"],
+                       "embedding": params["embed"]["embedding"]}
+        loss, dstages, dlast, dh0 = pipe.pipeline_1f1b_train(
+            params["stages"], h0, targets, last_params, mesh=mesh,
+            stage_fn=stage_fn, last_fn=last_fn,
+            num_microbatches=num_microbatches, batch_axes=("dp",))
+        (dembed,) = embed_vjp(dh0.astype(h0.dtype))
+        dembed = {"embedding": dembed["embedding"] +
+                  dlast["embedding"].astype(
+                      dembed["embedding"].dtype)}
+        grads = {"embed": dembed, "stages": dstages,
+                 "final_norm": dlast["final_norm"]}
+        return loss, grads
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=(param_shardings, None, batch_sharding,
+                      batch_sharding),
+        out_shardings=(param_shardings, None, None))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = grads_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    def step_wrapper(params, opt_state, batch):
+        params, opt_state, metrics = step(
+            params, opt_state, batch["tokens"], batch["targets"])
+        return params, opt_state, metrics
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)
+
+
 def build_resnet_train(mesh: Mesh,
                        config: Optional[resnet_mod.ResNetConfig] = None,
                        batch_size: int = 256, image_size: int = 224,

@@ -16,7 +16,6 @@ available (gated; records/plans always work for tests).
 
 from __future__ import annotations
 
-import shutil
 from typing import Optional
 
 from batch_shipyard_tpu.state import names
@@ -134,55 +133,141 @@ def gcsfuse_mount_args(bucket: str,
             f"rw,_netdev,allow_other,implicit_dirs 0 0"]
 
 
+def _vm_name(cluster_id: str) -> str:
+    return f"shipyard-fs-{cluster_id}"
+
+
+def _vm_manager(project: str, zone: Optional[str],
+                network: Optional[str], vms=None):
+    if vms is not None:
+        return vms
+    from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+    return GceVmManager(project, zone=zone, network=network)
+
+
 def provision_nfs_server(store: StateStore, cluster_id: str,
                          project: str, zone: Optional[str] = None,
-                         network: Optional[str] = None) -> None:
-    """Create the NFS server VM + striped disks with gcloud
-    (create_storage_cluster :623 + resource.py:680 analog; gated)."""
-    if shutil.which("gcloud") is None:
-        raise RuntimeError(
-            "gcloud CLI is required to provision a remotefs server")
+                         network: Optional[str] = None,
+                         vms=None) -> None:
+    """Create the NFS server VM + striped data disks
+    (create_storage_cluster :623 + resource.py:680 analog). ``vms``
+    injects a GceVmManager (tests pass a fake runner)."""
+    vms = _vm_manager(project, zone, network, vms)
     cluster = get_storage_cluster(store, cluster_id)
-    name = f"shipyard-fs-{cluster_id}"
-    disks = int(cluster["disk_count"])
-    create_disk_args = []
-    for i in range(disks):
-        rc, _out, err = util.subprocess_capture([
-            "gcloud", "compute", "disks", "create",
-            f"{name}-data{i}",
-            f"--size={cluster['disk_size_gb']}GB",
-            f"--type={cluster['disk_type']}",
-            f"--project={project}",
-            *([f"--zone={zone}"] if zone else [])])
-        if rc != 0:
-            raise RuntimeError(f"disk create failed: {err.strip()}")
-        create_disk_args += [
-            "--disk",
-            f"name={name}-data{i},device-name=data{i},mode=rw"]
-    import tempfile
-    with tempfile.NamedTemporaryFile(
-            "w", suffix=".sh", delete=False) as fh:
-        fh.write(generate_nfs_bootstrap_script(cluster))
-        startup = fh.name
-    rc, _out, err = util.subprocess_capture([
-        "gcloud", "compute", "instances", "create", name,
-        f"--machine-type={cluster['vm_size']}",
-        f"--project={project}",
-        *([f"--zone={zone}"] if zone else []),
-        *([f"--network={network}"] if network else []),
-        f"--metadata-from-file=startup-script={startup}",
-        *create_disk_args])
-    if rc != 0:
-        raise RuntimeError(f"instance create failed: {err.strip()}")
-    rc, out, err = util.subprocess_capture([
-        "gcloud", "compute", "instances", "describe", name,
-        f"--project={project}",
-        *([f"--zone={zone}"] if zone else []),
-        "--format=value(networkInterfaces[0].networkIP)"])
+    name = _vm_name(cluster_id)
+    disks = []
+    for i in range(int(cluster["disk_count"])):
+        vms.create_disk(f"{name}-data{i}",
+                        int(cluster["disk_size_gb"]),
+                        cluster["disk_type"])
+        disks.append((f"{name}-data{i}", f"data{i}"))
+    ip = vms.create_vm(name, cluster["vm_size"],
+                       startup_script=generate_nfs_bootstrap_script(
+                           cluster),
+                       disks=disks)
     store.upsert_entity(_NODES_TABLE, cluster_id, name, {
-        "internal_ip": out.strip(), "state": "running"})
+        "internal_ip": ip, "state": "running"})
     store.merge_entity(_TABLE, "remotefs", cluster_id,
                        {"state": "provisioned"})
+
+
+def suspend_storage_cluster(store: StateStore, cluster_id: str,
+                            project: str, zone: Optional[str] = None,
+                            vms=None) -> None:
+    """Stop the server VM, keeping disks (remotefs.py:1680
+    suspend_storage_cluster analog)."""
+    vms = _vm_manager(project, zone, None, vms)
+    get_storage_cluster(store, cluster_id)
+    name = _vm_name(cluster_id)
+    vms.stop_vm(name)
+    store.upsert_entity(_NODES_TABLE, cluster_id, name,
+                        {"state": "suspended"})
+    store.merge_entity(_TABLE, "remotefs", cluster_id,
+                       {"state": "suspended"})
+
+
+def start_storage_cluster(store: StateStore, cluster_id: str,
+                          project: str, zone: Optional[str] = None,
+                          vms=None) -> None:
+    """Restart a suspended server VM (remotefs.py start analog)."""
+    vms = _vm_manager(project, zone, None, vms)
+    get_storage_cluster(store, cluster_id)
+    name = _vm_name(cluster_id)
+    vms.start_vm(name)
+    store.upsert_entity(_NODES_TABLE, cluster_id, name, {
+        "internal_ip": vms.internal_ip(name), "state": "running"})
+    store.merge_entity(_TABLE, "remotefs", cluster_id,
+                       {"state": "provisioned"})
+
+
+def storage_cluster_status(store: StateStore, cluster_id: str,
+                           project: Optional[str] = None,
+                           zone: Optional[str] = None,
+                           vms=None) -> dict:
+    """Cluster record + live VM status when reachable
+    (remotefs.py:1929 stat analog)."""
+    cluster = get_storage_cluster(store, cluster_id)
+    nodes = list(store.query_entities(_NODES_TABLE,
+                                     partition_key=cluster_id))
+    status = {"cluster": cluster, "nodes": nodes}
+    if project or vms is not None:
+        vms = _vm_manager(project, zone, None, vms)
+        try:
+            status["vm_status"] = vms.vm_status(_vm_name(cluster_id))
+        except Exception as exc:  # noqa: BLE001 - live probe optional
+            status["vm_status"] = f"unknown ({exc})"
+    return status
+
+
+def resize_storage_cluster(store: StateStore, cluster_id: str,
+                           new_vm_size: str, project: str,
+                           zone: Optional[str] = None,
+                           vms=None) -> None:
+    """Change the server's machine type: stop -> set-machine-type ->
+    start (remotefs.py:852 resize analog; GCE requires a stopped VM)."""
+    vms = _vm_manager(project, zone, None, vms)
+    cluster = get_storage_cluster(store, cluster_id)
+    name = _vm_name(cluster_id)
+    vms.stop_vm(name)
+    vms.set_machine_type(name, new_vm_size)
+    vms.start_vm(name)
+    store.merge_entity(_TABLE, "remotefs", cluster_id,
+                       {"vm_size": new_vm_size},
+                       if_match=cluster["_etag"])
+    store.upsert_entity(_NODES_TABLE, cluster_id, name, {
+        "internal_ip": vms.internal_ip(name), "state": "running"})
+
+
+def expand_storage_cluster_live(store: StateStore, cluster_id: str,
+                                additional_disks: int, project: str,
+                                zone: Optional[str] = None,
+                                vms=None) -> str:
+    """Attach new data disks to the live server and return the
+    on-server grow script (remotefs.py:1171 expand + bootstrap's
+    mdadm --add/--grow rebalance analog)."""
+    vms = _vm_manager(project, zone, None, vms)
+    cluster = get_storage_cluster(store, cluster_id)
+    name = _vm_name(cluster_id)
+    start = int(cluster["disk_count"])
+    new_devices = []
+    for i in range(start, start + additional_disks):
+        vms.create_disk(f"{name}-data{i}",
+                        int(cluster["disk_size_gb"]),
+                        cluster["disk_type"])
+        vms.attach_disk(name, f"{name}-data{i}", f"data{i}")
+        new_devices.append(f"/dev/disk/by-id/google-data{i}")
+    expand_storage_cluster(store, cluster_id, additional_disks)
+    total = start + additional_disks
+    devs = " ".join(new_devices)
+    return f"""#!/usr/bin/env bash
+set -euo pipefail
+# batch-shipyard-tpu remotefs expand: grow the RAID-0 stripe in place.
+# RAID-0 cannot take --add'ed spares; growing it is the one-shot
+# --grow --raid-devices=N --add form (mdadm reshapes via an implicit
+# raid4 intermediate, then back to raid0).
+mdadm --grow /dev/md0 --raid-devices={total} --add {devs}
+resize2fs /dev/md0
+"""
 
 
 def register_server_node(store: StateStore, cluster_id: str,

@@ -28,7 +28,7 @@ from __future__ import annotations
 import abc
 import dataclasses
 import datetime
-from typing import Any, Iterator, Optional
+from typing import Any, Iterable, Iterator, Optional
 
 
 class NotFoundError(KeyError):
@@ -102,6 +102,31 @@ class StateStore(abc.ABC):
 
     @abc.abstractmethod
     def list_objects(self, prefix: str = "") -> list[str]: ...
+
+    # Default streaming chunk: large enough to amortize round trips,
+    # small enough that a chunk is never a memory concern.
+    STREAM_CHUNK_BYTES = 8 * 1024 * 1024
+
+    def put_object_stream(self, key: str, chunks: Iterable[bytes],
+                          if_generation_match: Optional[int] = None
+                          ) -> int:
+        """Write an object from an iterable of byte chunks without the
+        caller materializing the whole payload (the blobxfer streaming
+        role, reference convoy/data.py:981). Backends with a native
+        streaming path override this; the fallback concatenates (the
+        memory backend stores the whole buffer anyway)."""
+        return self.put_object(key, b"".join(chunks),
+                               if_generation_match=if_generation_match)
+
+    def get_object_stream(self, key: str,
+                          chunk_size: Optional[int] = None
+                          ) -> Iterator[bytes]:
+        """Yield an object's bytes in chunks. Fallback reads whole;
+        backends with ranged/positional reads override."""
+        chunk_size = chunk_size or self.STREAM_CHUNK_BYTES
+        data = self.get_object(key)
+        for i in range(0, len(data), chunk_size):
+            yield data[i:i + chunk_size]
 
     def object_exists(self, key: str) -> bool:
         try:

@@ -85,6 +85,55 @@ class GCSStateStore(base.StateStore):
             raise self._wrap_precondition(exc, key)
         return int(blob.generation)
 
+    def put_object_stream(self, key, chunks,
+                          if_generation_match=None) -> int:
+        """Native streaming via resumable upload from a file-like
+        adapter over the chunk iterator — the object never
+        materializes client-side."""
+        import io
+
+        class _IterReader(io.RawIOBase):
+            def __init__(self, it):
+                self._it = iter(it)
+                self._buf = b""
+
+            def readable(self):
+                return True
+
+            def readinto(self, b):
+                while len(self._buf) < len(b):
+                    try:
+                        self._buf += next(self._it)
+                    except StopIteration:
+                        break
+                n = min(len(b), len(self._buf))
+                b[:n] = self._buf[:n]
+                self._buf = self._buf[n:]
+                return n
+
+        blob = self._blob(f"objects/{key}")
+        blob.chunk_size = self.STREAM_CHUNK_BYTES
+        try:
+            blob.upload_from_file(
+                io.BufferedReader(_IterReader(chunks),
+                                  self.STREAM_CHUNK_BYTES),
+                if_generation_match=if_generation_match)
+        except Exception as exc:  # pragma: no cover - network
+            raise self._wrap_precondition(exc, key)
+        return int(blob.generation)
+
+    def get_object_stream(self, key, chunk_size=None):
+        chunk_size = chunk_size or self.STREAM_CHUNK_BYTES
+        blob = self._blob(f"objects/{key}")
+        try:
+            blob.reload()
+            size = blob.size or 0
+            for start in range(0, size, chunk_size):
+                end = min(start + chunk_size, size) - 1
+                yield blob.download_as_bytes(start=start, end=end)
+        except Exception as exc:  # pragma: no cover - network
+            raise self._wrap_precondition(exc, key)
+
     def get_object(self, key: str) -> bytes:
         blob = self._blob(f"objects/{key}")
         try:
@@ -175,10 +224,19 @@ class GCSStateStore(base.StateStore):
     def release_lease(self, handle: LeaseHandle) -> None:
         blob = self._blob(f"leases/{handle.key}")
         try:
+            # Capture the generation BEFORE validating the token, and
+            # delete only if it still matches: if the lease expires and
+            # is stolen at any point after the snapshot, the delete
+            # fails with PreconditionFailed instead of destroying the
+            # new owner's lease record.
+            blob.reload()
+            generation = int(blob.generation)
             held = json.loads(blob.download_as_bytes())
             if held["token"] != handle.token:
                 raise LeaseLostError(handle.key)
-            blob.delete()
+            blob.delete(if_generation_match=generation)
+        except self._exceptions.PreconditionFailed:
+            raise LeaseLostError(handle.key)
         except self._exceptions.NotFound:
             raise LeaseLostError(handle.key)
 

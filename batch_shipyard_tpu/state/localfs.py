@@ -100,6 +100,57 @@ class LocalFSStateStore(base.StateStore):
             self._save_db("objects", db)
             return counter
 
+    def put_object_stream(self, key, chunks,
+                          if_generation_match=None) -> int:
+        """True streaming: chunks are written incrementally to a temp
+        file next to the target, then renamed under the lock — the
+        whole object never sits in memory."""
+        path = self._object_path(key)
+        tmp = f"{path}.stream.{os.getpid()}"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        size = 0
+        try:
+            with open(tmp, "wb") as fh:
+                for chunk in chunks:
+                    fh.write(chunk)
+                    size += len(chunk)
+            with self._locked():
+                db = self._load_db("objects")
+                meta = db.get(key)
+                if if_generation_match is not None:
+                    cur_gen = meta["generation"] if meta else 0
+                    if cur_gen != if_generation_match:
+                        raise PreconditionFailedError(
+                            f"{key}: generation {cur_gen} != "
+                            f"{if_generation_match}")
+                counter = db.get("\x00counter", 0) + 1
+                db["\x00counter"] = counter
+                os.replace(tmp, path)
+                db[key] = {"generation": counter, "size": size,
+                           "updated": time.time()}
+                self._save_db("objects", db)
+                return counter
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def get_object_stream(self, key, chunk_size=None):
+        chunk_size = chunk_size or self.STREAM_CHUNK_BYTES
+        with self._locked():
+            db = self._load_db("objects")
+            if key not in db or key == "\x00counter":
+                raise NotFoundError(key)
+            path = self._object_path(key)
+        try:
+            with open(path, "rb") as fh:
+                while True:
+                    chunk = fh.read(chunk_size)
+                    if not chunk:
+                        return
+                    yield chunk
+        except FileNotFoundError:
+            raise NotFoundError(key)
+
     def get_object(self, key: str) -> bytes:
         with self._locked():
             db = self._load_db("objects")

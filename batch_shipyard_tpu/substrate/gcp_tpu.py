@@ -252,6 +252,9 @@ def _bootstrap_script(pool: PoolSettings, storage_backend: str,
         },
         "work_dir": "/var/shipyard",
         "run_nodeprep": True,
+        "output_upload_cap_bytes": (
+            pool.output_upload_cap_mb * 1024 * 1024
+            if pool.output_upload_cap_mb else None),
     }
     b64 = base64.b64encode(json.dumps(template).encode()).decode()
     fill_py = (

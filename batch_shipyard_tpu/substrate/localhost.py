@@ -70,6 +70,9 @@ class LocalhostSubstrate(base.ComputeSubstrate):
             "poll_interval": 0.2,
             "node_stale_seconds": 10.0,
             "run_nodeprep": self.run_nodeprep,
+            "output_upload_cap_bytes": (
+                pool.output_upload_cap_mb * 1024 * 1024
+                if pool.output_upload_cap_mb else None),
         }
         boot_path = os.path.join(work_dir, "bootstrap.json")
         with open(boot_path, "w", encoding="utf-8") as fh:

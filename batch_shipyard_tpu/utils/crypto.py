@@ -83,11 +83,19 @@ def decrypt_credential(private_pem: bytes, encrypted_b64: str) -> str:
 def ssh_command(ip: str, port: int = 22, username: str = "shipyard",
                 private_key_file: Optional[str] = None,
                 command: Optional[str] = None,
-                extra_options: Sequence[str] = ()) -> list[str]:
-    """Build an ssh argv (reference crypto.py:171 connect helper)."""
-    argv = ["ssh", "-o", "StrictHostKeyChecking=no",
-            "-o", "UserKnownHostsFile=/dev/null",
+                extra_options: Sequence[str] = (),
+                host_key_checking: str = "accept-new") -> list[str]:
+    """Build an ssh argv (reference crypto.py:171 connect helper).
+
+    host_key_checking: OpenSSH StrictHostKeyChecking value. The
+    default 'accept-new' is trust-on-first-use — unlike the
+    reference's unconditional 'no', a changed host key (MITM) is
+    rejected; pass 'no' explicitly for throwaway nodes.
+    """
+    argv = ["ssh", "-o", f"StrictHostKeyChecking={host_key_checking}",
             "-p", str(port)]
+    if host_key_checking == "no":
+        argv[3:3] = ["-o", "UserKnownHostsFile=/dev/null"]
     if private_key_file:
         argv += ["-i", private_key_file]
     argv += list(extra_options)
@@ -115,8 +123,8 @@ def ssh_tunnel_script(ip: str, port: int, local_port: int,
     script = (
         "#!/usr/bin/env bash\n"
         "set -e\n"
-        f"exec ssh -o StrictHostKeyChecking=no "
-        f"-o UserKnownHostsFile=/dev/null {key_arg}-p {port} "
+        f"exec ssh -o StrictHostKeyChecking=accept-new "
+        f"{key_arg}-p {port} "
         f"-N -L {local_port}:localhost:{remote_port} "
         f"{username}@{ip}\n")
     with open(output_path, "w", encoding="utf-8") as fh:

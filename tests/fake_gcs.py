@@ -55,11 +55,19 @@ class FakeBlob:
             self.size = len(data)
             self.updated = datetime.datetime.now(datetime.timezone.utc)
 
-    def download_as_bytes(self):
+    def download_as_bytes(self, start=None, end=None):
         with self._store.lock:
             if self.name not in self._store.blobs:
                 raise NotFound(self.name)
-            return self._store.blobs[self.name][0]
+            data = self._store.blobs[self.name][0]
+        if start is not None:
+            # GCS ranges are inclusive of end.
+            return data[start:(end + 1) if end is not None else None]
+        return data
+
+    def upload_from_file(self, fileobj, if_generation_match=None):
+        self.upload_from_string(fileobj.read(),
+                                if_generation_match=if_generation_match)
 
     def reload(self):
         with self._store.lock:

@@ -1,0 +1,321 @@
+"""VM-backed provisioning: GCE helper, remotefs lifecycle verbs,
+monitoring VM, slurm control plane + munge distribution, and the
+fake-substrate slurm resume->join->suspend end-to-end path."""
+
+import pytest
+
+from batch_shipyard_tpu.state.base import NotFoundError
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.gce_vm import GceVmError, GceVmManager
+
+
+class FakeRunner:
+    """Records gcloud argvs; answers describe queries."""
+
+    def __init__(self):
+        self.calls = []
+        self.fail_next = None
+        self.status = "RUNNING"
+
+    def __call__(self, argv, **_kw):
+        self.calls.append(list(argv))
+        # Snapshot startup scripts now — create_vm deletes the temp
+        # file (it can embed secrets) before returning.
+        for arg in argv:
+            if arg.startswith("--metadata-from-file=startup-script="):
+                with open(arg.split("=", 2)[2],
+                          encoding="utf-8") as fh:
+                    self.startup_scripts = getattr(
+                        self, "startup_scripts", [])
+                    self.startup_scripts.append(fh.read())
+        if self.fail_next:
+            msg, self.fail_next = self.fail_next, None
+            return 1, "", msg
+        joined = " ".join(argv)
+        if "describe" in joined and "networkIP" in joined:
+            return 0, "10.0.0.5\n", ""
+        if "describe" in joined and "status" in joined:
+            return 0, f"{self.status}\n", ""
+        return 0, "", ""
+
+    def verbs(self):
+        return [c[2] + ":" + c[3] for c in self.calls]
+
+
+@pytest.fixture()
+def vms():
+    runner = FakeRunner()
+    return GceVmManager("proj", zone="us-central1-a",
+                        runner=runner), runner
+
+
+def test_gce_vm_create_and_lifecycle(vms):
+    mgr, runner = vms
+    ip = mgr.create_vm("vm1", "e2-standard-2",
+                       startup_script="#!/bin/bash\necho hi\n",
+                       disks=[("d0", "data0")], tags=("t1",))
+    assert ip == "10.0.0.5"
+    create = runner.calls[0]
+    assert "--machine-type=e2-standard-2" in create
+    assert "--tags=t1" in create
+    assert any(a.startswith("--metadata-from-file=startup-script=")
+               for a in create)
+    assert "name=d0,device-name=data0,mode=rw" in create
+    assert "--project=proj" in create and "--zone=us-central1-a" in \
+        create
+    mgr.stop_vm("vm1")
+    mgr.set_machine_type("vm1", "e2-standard-8")
+    mgr.start_vm("vm1")
+    assert mgr.vm_status("vm1") == "RUNNING"
+    mgr.delete_vm("vm1")
+    assert "instances:stop" in runner.verbs()
+    assert "instances:set-machine-type" in runner.verbs()
+
+
+def test_gce_vm_error_surface(vms):
+    mgr, runner = vms
+    runner.fail_next = "quota exceeded"
+    with pytest.raises(GceVmError, match="quota exceeded"):
+        mgr.create_disk("d1", 100)
+
+
+# ----------------------------- remotefs --------------------------------
+
+
+def test_remotefs_full_lifecycle():
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+
+    store = MemoryStateStore()
+    runner = FakeRunner()
+    mgr = GceVmManager("proj", zone="z", runner=runner)
+    remotefs.create_storage_cluster_record(store, "fsA", disk_count=2,
+                                           disk_size_gb=128)
+    remotefs.provision_nfs_server(store, "fsA", "proj", vms=mgr)
+    st = remotefs.storage_cluster_status(store, "fsA", vms=mgr)
+    assert st["cluster"]["state"] == "provisioned"
+    assert st["nodes"][0]["internal_ip"] == "10.0.0.5"
+    assert st["vm_status"] == "RUNNING"
+    # disk creates: 2 disks then instance create
+    assert runner.verbs()[:3] == ["disks:create", "disks:create",
+                                  "instances:create"]
+
+    remotefs.suspend_storage_cluster(store, "fsA", "proj", vms=mgr)
+    assert remotefs.get_storage_cluster(store, "fsA")["state"] == \
+        "suspended"
+    remotefs.start_storage_cluster(store, "fsA", "proj", vms=mgr)
+    assert remotefs.get_storage_cluster(store, "fsA")["state"] == \
+        "provisioned"
+
+    remotefs.resize_storage_cluster(store, "fsA", "n2-standard-16",
+                                    "proj", vms=mgr)
+    cluster = remotefs.get_storage_cluster(store, "fsA")
+    assert cluster["vm_size"] == "n2-standard-16"
+    # resize = stop, set-machine-type, start
+    seq = runner.verbs()
+    i = seq.index("instances:set-machine-type")
+    assert seq[i - 1] == "instances:stop"
+    assert seq[i + 1] == "instances:start"
+
+    script = remotefs.expand_storage_cluster_live(
+        store, "fsA", 2, "proj", vms=mgr)
+    assert "mdadm --grow /dev/md0 --raid-devices=4" in script
+    assert "resize2fs" in script
+    assert remotefs.get_storage_cluster(store, "fsA")["disk_count"] == 4
+    assert seq.count("disks:create") == 2  # before expand
+    assert runner.verbs().count("instances:attach-disk") == 2
+
+
+def test_nfs_bootstrap_stripes_multiple_disks():
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    script = remotefs.generate_nfs_bootstrap_script(
+        {"disk_count": 3, "export_path": "/export/x"})
+    assert "--raid-devices=3" in script
+    assert "google-data2" in script
+
+
+# ------------------------------ monitor --------------------------------
+
+
+def test_monitor_vm_provision_and_destroy():
+    from batch_shipyard_tpu.monitor import provision
+    from batch_shipyard_tpu.state import names
+
+    store = MemoryStateStore()
+    runner = FakeRunner()
+    mgr = GceVmManager("proj", runner=runner)
+    ip = provision.provision_monitoring_vm(store, "proj", vms=mgr,
+                                           grafana_port=3001)
+    assert ip == "10.0.0.5"
+    rec = store.get_entity(names.TABLE_MONITOR, "vms",
+                           "shipyard-monitor")
+    assert rec["state"] == "running"
+    # The startup script ships the bundle as a base64 tarball and
+    # enables the systemd unit.
+    import re
+    script = runner.startup_scripts[0]
+    assert "base64 -d" in script and "tar -xz" in script
+    assert "systemctl enable --now shipyard-monitoring.service" in \
+        script
+    assert re.search(r"echo '[A-Za-z0-9+/=]{100,}'", script)
+
+    provision.destroy_monitoring_vm(store, "proj", vms=mgr)
+    with pytest.raises(NotFoundError):
+        store.get_entity(names.TABLE_MONITOR, "vms",
+                         "shipyard-monitor")
+
+
+def test_monitor_tls_bundle_binds_loopback(tmp_path):
+    from batch_shipyard_tpu.monitor import provision
+    bundle = provision.generate_monitoring_bundle(
+        str(tmp_path), lets_encrypt_fqdn="mon.example.com")
+    compose = (tmp_path / "docker-compose.yml").read_text()
+    assert '"127.0.0.1:3000:3000"' in compose
+    assert '"127.0.0.1:9090:9090"' in compose
+    assert "nginx" in compose
+
+
+def test_monitor_plain_bundle_publishes_ports(tmp_path):
+    from batch_shipyard_tpu.monitor import provision
+    provision.generate_monitoring_bundle(str(tmp_path))
+    compose = (tmp_path / "docker-compose.yml").read_text()
+    assert '"3000:3000"' in compose
+    assert "127.0.0.1" not in compose
+
+
+# ------------------------------- slurm ---------------------------------
+
+
+def test_munge_key_publish_fetch_roundtrip():
+    from batch_shipyard_tpu.slurm import provision as sp
+
+    store = MemoryStateStore()
+    sp.publish_munge_key(store, "c1", b"\x01\x02keybytes")
+    assert sp.fetch_munge_key(store, "c1", timeout=1.0) == \
+        b"\x01\x02keybytes"
+    with pytest.raises(TimeoutError):
+        sp.fetch_munge_key(store, "other", timeout=0.2,
+                           poll_interval=0.05)
+
+
+def test_slurm_config_generators():
+    from batch_shipyard_tpu.slurm import provision as sp
+
+    dbd = sp.generate_slurmdbd_conf("ctrl0", "pw123")
+    assert "DbdHost=ctrl0" in dbd
+    assert "StoragePass=pw123" in dbd
+    assert "accounting_storage/mysql" in dbd
+    sql = sp.generate_db_init_sql("pw123")
+    assert "slurm_acct_db" in sql and "pw123" in sql
+    wrappers = sp.generate_power_save_wrappers()
+    assert set(wrappers) == {"slurm_resume.sh", "slurm_suspend.sh",
+                             "slurm_resume_fail.sh"}
+    assert "scontrol show hostnames" in wrappers["slurm_resume.sh"]
+    assert "slurm resume" in wrappers["slurm_resume.sh"]
+    assert "slurm suspend" in wrappers["slurm_resume_fail.sh"]
+
+
+def test_slurm_controller_bootstrap_contents():
+    from batch_shipyard_tpu.slurm import provision as sp
+
+    conf = "ClusterName=c1\n"
+    script = sp.generate_controller_bootstrap("c1", conf, "pw")
+    for needle in ("slurmctld", "mariadb-server", "slurmdbd",
+                   "publish-munge-key", "slurm_resume.sh",
+                   "slurm_suspend.sh", "ClusterName=c1",
+                   "systemctl enable --now slurmctld"):
+        assert needle in script, needle
+    lean = sp.generate_controller_bootstrap("c1", conf, "pw",
+                                            with_slurmdbd=False)
+    assert "mariadb" not in lean
+    # The framework CLI + its store config are installed before any
+    # store-mediated step (munge publication, power-save wrappers).
+    wired = sp.generate_controller_bootstrap(
+        "c1", conf, "pw", package_source="gs://bkt/pkg.whl",
+        store_config_yaml="credentials:\n  storage: {backend: gcs}\n")
+    assert "gcloud storage cp gs://bkt/pkg.whl" in wired
+    assert "pip3 install" in wired
+    assert "credentials.yaml" in wired
+    assert wired.index("pip3 install") < wired.index(
+        "publish-munge-key")
+
+
+def test_slurm_compute_join_and_login_scripts():
+    from batch_shipyard_tpu.slurm import provision as sp
+
+    conf = "ClusterName=c1\n"
+    join = sp.generate_compute_join_script("c1", conf)
+    assert "fetch-munge-key" in join
+    assert "systemctl restart slurmd" in join
+    assert "ClusterName=c1" in join
+    login = sp.generate_login_bootstrap("c1", conf)
+    assert "slurm-client" in login and "fetch-munge-key" in login
+
+
+def test_slurm_cluster_create_destroy_status():
+    from batch_shipyard_tpu.slurm import provision as sp
+
+    store = MemoryStateStore()
+    runner = FakeRunner()
+    mgr = GceVmManager("proj", runner=runner)
+    record = sp.create_slurm_cluster(
+        store, "c1", "ClusterName=c1\n", "pw", "proj", vms=mgr,
+        login_count=2)
+    assert record["controller_ip"] == "10.0.0.5"
+    assert len(record["logins"]) == 2
+    status = sp.slurm_cluster_status(store, "c1", vms=mgr)
+    assert status["controller_status"] == "RUNNING"
+    assert runner.verbs().count("instances:create") == 3
+    sp.destroy_slurm_cluster(store, "c1", "proj", vms=mgr)
+    with pytest.raises(ValueError):
+        sp.slurm_cluster_status(store, "c1")
+    assert runner.verbs().count("instances:delete") == 3
+
+
+def test_slurm_resume_join_suspend_e2e():
+    """Fake-substrate end-to-end: resume grows the pool and binds
+    hosts; the compute join script is generated for those hosts; the
+    munge key flows controller->node through the store; suspend
+    releases and reclaims (VERDICT r1 next #4 done criterion)."""
+    from batch_shipyard_tpu.config import settings as S
+    from batch_shipyard_tpu.pool import manager as pool_mgr
+    from batch_shipyard_tpu.slurm import burst
+    from batch_shipyard_tpu.slurm import provision as sp
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    conf = {"pool_specification": {
+        "id": "slurmpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-8"},
+        "max_wait_time_seconds": 30}}
+    pool = S.pool_settings(conf)
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             S.global_settings({}), conf)
+
+        # Controller boots: publishes its munge key.
+        sp.publish_munge_key(store, "c1", b"controller-key")
+
+        # Slurm asks for 2 elastic nodes -> resume binds pool nodes.
+        hosts = burst.expand_hostlist("part-[0-1]")
+        assignments = burst.process_resume(
+            store, substrate, pool, "c1", "part", hosts,
+            wait_timeout=30.0)
+        assert set(assignments) == {"part-0", "part-1"}
+        assert len(set(assignments.values())) == 2
+
+        # Compute nodes join: fetch the munge key + join script.
+        assert sp.fetch_munge_key(store, "c1", timeout=1.0) == \
+            b"controller-key"
+        join = sp.generate_compute_join_script(
+            "c1", burst.generate_slurm_conf(
+                "c1", {"part": {"max_nodes": 2}}))
+        assert "NodeName=part-[0-1]" in join
+
+        # Suspend releases the bindings.
+        released = burst.process_suspend(store, substrate, pool,
+                                         "c1", "part", hosts)
+        assert released == 2
+        assert burst.host_assignments(store, "c1", "part") == {}
+    finally:
+        substrate.stop_all()

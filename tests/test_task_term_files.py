@@ -88,3 +88,49 @@ def test_all_modules_import():
         except Exception as exc:  # noqa: BLE001
             failures.append((info.name, repr(exc)))
     assert not failures, failures
+
+
+def test_large_output_uploaded_in_full(env):
+    """A task writing >4MB of stdout is uploaded whole (streamed) —
+    the round-1 silent 4MB truncation is gone."""
+    store, substrate, pool = env
+    from batch_shipyard_tpu.state import names
+    jobs = settings_mod.job_settings_list({"job_specifications": [{
+        "id": "jbig",
+        "tasks": [{"command":
+                   "python3 -c \"import sys; "
+                   "sys.stdout.write('x' * (6 * 1024 * 1024))\""}]}]})
+    jobs_mgr.add_jobs(store, pool, jobs)
+    jobs_mgr.wait_for_tasks(store, "tt", "jbig", timeout=60)
+    key = names.task_output_key("tt", "jbig", "task-00000",
+                                "stdout.txt")
+    assert store.get_object_meta(key).size == 6 * 1024 * 1024
+
+
+def test_output_cap_keeps_head_tail_with_marker(tmp_path):
+    """With a configured cap, uploads keep head+tail around an
+    explicit truncation marker (never a silent cut)."""
+    import os
+
+    from batch_shipyard_tpu.agent import node_agent as na
+    from batch_shipyard_tpu.agent import task_runner
+
+    store = MemoryStateStore()
+    agent = na.NodeAgent.__new__(na.NodeAgent)
+    agent.store = store
+    agent.identity = type("I", (), {"pool_id": "p", "node_id": "n"})()
+    agent.output_upload_cap_bytes = 1024
+    task_dir = tmp_path / "t"
+    task_dir.mkdir()
+    payload = b"H" * 5000 + b"T" * 5000
+    (task_dir / "stdout.txt").write_bytes(payload)
+    execution = task_runner.TaskExecution.__new__(
+        task_runner.TaskExecution)
+    execution.task_dir = str(task_dir)
+    agent._upload_outputs("j", "t0", execution)
+    from batch_shipyard_tpu.state import names
+    data = store.get_object(
+        names.task_output_key("p", "j", "t0", "stdout.txt"))
+    assert data.startswith(b"H" * 512)
+    assert data.endswith(b"T" * 512)
+    assert b"output truncated, 10000 bytes total, cap 1024" in data
