@@ -213,13 +213,25 @@ class NodeAgent:
 
     def _worker_loop(self, slot: int) -> None:
         pool_id, node_id = self._nid
-        taskq = names.task_queue(pool_id)
+        shards = self.pool.task_queue_shards
+        queues = names.task_queues(pool_id, shards)
+        shards = len(queues)
+        # Stagger each slot's starting shard so pollers spread over
+        # the fan-out instead of convoying on shard 0.
+        idx = (self.identity.node_index + slot) % shards
+        empty_streak = 0
         while not self.stop_event.is_set():
+            taskq = queues[idx]
+            idx = (idx + 1) % shards
             msgs = self.store.get_messages(
                 taskq, max_messages=1, visibility_timeout=60.0)
             if not msgs:
-                time.sleep(self.poll_interval)
+                empty_streak += 1
+                if empty_streak >= shards:
+                    empty_streak = 0
+                    time.sleep(self.poll_interval)
                 continue
+            empty_streak = 0
             msg = msgs[0]
             try:
                 self._process_task_message(
@@ -493,7 +505,9 @@ class NodeAgent:
                 "node_id": None})
             self.store.delete_message(msg)
             self.store.put_message(
-                names.task_queue(self.identity.pool_id),
+                names.task_queue_for(
+                    self.identity.pool_id, task_id,
+                    self.pool.task_queue_shards),
                 json.dumps({"job_id": job_id, "task_id": task_id}).encode())
             return
         self._finish_task(job_id, task_id, result)

@@ -230,6 +230,9 @@ class PoolSettings:
     # None = upload task outputs in full (streamed); a value caps each
     # output at head+tail around an explicit truncation marker.
     output_upload_cap_mb: Optional[int]
+    # Task queue fan-out: >1 spreads task messages over N queues so
+    # large pools (10^4+ tasks) don't serialize on one queue's lock.
+    task_queue_shards: int
     node_exporter: PrometheusExporterSettings
     cadvisor: PrometheusExporterSettings
 
@@ -347,6 +350,8 @@ def pool_settings(config: dict) -> PoolSettings:
             spec, "max_wait_time_seconds", default=1800),
         output_upload_cap_mb=_get(
             spec, "output_upload_cap_mb", default=None),
+        task_queue_shards=_get(
+            spec, "task_queue_shards", default=1),
         node_exporter=PrometheusExporterSettings(
             enabled=_get(
                 spec, "prometheus", "node_exporter", "enabled",

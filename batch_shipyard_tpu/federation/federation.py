@@ -189,7 +189,9 @@ def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
     nodes = pool_mgr.list_nodes(store, pool_id)
     idle = [n for n in nodes if n.state == "idle"]
     ready = [n for n in nodes if n.state in pool_mgr.READY_STATES]
-    backlog = store.queue_length(names.task_queue(pool_id))
+    backlog = sum(
+        store.queue_length(q)
+        for q in names.task_queues(pool_id, pool.task_queue_shards))
     slots = max(1, len(ready) * pool.task_slots_per_node)
     return {
         "pool_id": pool_id,

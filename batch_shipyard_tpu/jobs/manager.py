@@ -135,13 +135,26 @@ def add_jobs(store: StateStore, pool: PoolSettings,
 _SUBMIT_CHUNK = 100
 
 
+def pool_queue_shards(store: StateStore, pool_id: str) -> int:
+    """Task-queue shard count for a pool, read from its stored spec
+    (so cross-pool producers — federation, migrate — route to the
+    TARGET pool's sharding, not the caller's)."""
+    try:
+        pool = store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+    except NotFoundError:
+        return 1
+    return int(pool.get("spec", {}).get("pool_specification", {})
+               .get("task_queue_shards", 1))
+
+
 def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
                           tasks: list[tuple[str, dict]]) -> None:
     """Chunked batch submission (the reference's 100-task
     TaskAddCollection chunks, batch.py:4313): one entity batch + one
-    message batch per chunk instead of 2N store round trips."""
+    message batch per shard per chunk instead of 2N store round
+    trips, with messages fanned out over the pool's queue shards."""
     pk = names.task_pk(pool_id, job_id)
-    queue = names.task_queue(pool_id)
+    shards = pool_queue_shards(store, pool_id)
     submitted_at = util.datetime_utcnow_iso()
     for chunk_start in range(0, len(tasks), _SUBMIT_CHUNK):
         chunk = tasks[chunk_start:chunk_start + _SUBMIT_CHUNK]
@@ -150,19 +163,24 @@ def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
             "submitted_at": submitted_at,
         }) for task_id, spec in chunk]
         store.insert_entities(names.TABLE_TASKS, rows)
-        payloads: list[bytes] = []
+        by_queue: dict[str, list[bytes]] = {}
         for task_id, spec in chunk:
+            queue = names.task_queue_for(pool_id, task_id, shards)
             num_instances = (spec.get("multi_instance") or {}).get(
                 "num_instances")
             if num_instances:
-                payloads.extend(json.dumps({
-                    "job_id": job_id, "task_id": task_id,
-                    "instance": k}).encode()
+                by_queue.setdefault(queue, []).extend(
+                    json.dumps({
+                        "job_id": job_id, "task_id": task_id,
+                        "instance": k}).encode()
                     for k in range(num_instances))
             else:
-                payloads.append(json.dumps({
-                    "job_id": job_id, "task_id": task_id}).encode())
-        store.put_messages(queue, payloads)
+                by_queue.setdefault(queue, []).append(
+                    json.dumps({
+                        "job_id": job_id,
+                        "task_id": task_id}).encode())
+        for queue, payloads in by_queue.items():
+            store.put_messages(queue, payloads)
 
 
 def _submit_task(store: StateStore, pool_id: str, job_id: str,
@@ -341,6 +359,7 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
         "created_at": job.get("created_at"),
         "migrated_from": src_pool_id,
     })
+    dst_shards = pool_queue_shards(store, dst_pool_id)
     for task in tasks:
         entity = {k: v for k, v in task.items()
                   if not k.startswith("_")}
@@ -348,18 +367,20 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
                             entity)
         store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
         if entity.get("state") == "pending":
+            dst_queue = names.task_queue_for(
+                dst_pool_id, task["_rk"], dst_shards)
             num_instances = (entity.get("spec", {}).get(
                 "multi_instance") or {}).get("num_instances")
             if num_instances:
                 for k in range(num_instances):
                     store.put_message(
-                        names.task_queue(dst_pool_id),
+                        dst_queue,
                         json.dumps({"job_id": job_id,
                                     "task_id": task["_rk"],
                                     "instance": k}).encode())
             else:
                 store.put_message(
-                    names.task_queue(dst_pool_id),
+                    dst_queue,
                     json.dumps({"job_id": job_id,
                                 "task_id": task["_rk"]}).encode())
             moved += 1

@@ -7,6 +7,8 @@ daemons, and node agents agree on the schema.
 
 from __future__ import annotations
 
+import zlib
+
 # Tables (partition key scheme in comments)
 TABLE_POOLS = "pools"          # pk="pools",           rk=pool_id
 TABLE_NODES = "nodes"          # pk=pool_id,           rk=node_id
@@ -33,8 +35,28 @@ def gang_pk(pool_id: str, job_id: str, task_id: str) -> str:
 
 
 # Queues
-def task_queue(pool_id: str) -> str:
-    return f"taskq-{pool_id}"
+def task_queue(pool_id: str, shard: int = 0) -> str:
+    """Task queue name for one shard. Shard 0 keeps the unsharded
+    name, so pools with task_queue_shards=1 (the default) are
+    unchanged on disk."""
+    if shard == 0:
+        return f"taskq-{pool_id}"
+    return f"taskq-{pool_id}-{shard}"
+
+
+def task_queues(pool_id: str, shards: int) -> list[str]:
+    return [task_queue(pool_id, k) for k in range(max(shards, 1))]
+
+
+def task_queue_for(pool_id: str, task_id: str, shards: int) -> str:
+    """Deterministic shard for a task: every producer (submit,
+    migrate, retry requeue) routes a task's messages to the same
+    shard (reference analog: the 100-task TaskAddCollection fan-in,
+    batch.py:4313 — re-designed as queue fan-OUT so 10^4-task pools
+    don't serialize on one queue)."""
+    if shards <= 1:
+        return task_queue(pool_id)
+    return task_queue(pool_id, zlib.crc32(task_id.encode()) % shards)
 
 
 def control_queue(pool_id: str, node_id: str) -> str:

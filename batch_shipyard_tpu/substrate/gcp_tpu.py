@@ -242,6 +242,10 @@ def _bootstrap_script(pool: PoolSettings, storage_backend: str,
                 "num_slices": pool.tpu.num_slices,
             },
             "task_slots_per_node": pool.task_slots_per_node,
+            # Agents poll the queue fan-out; the shard count MUST
+            # match what producers read from the stored pool spec or
+            # messages on shards > 0 are never consumed.
+            "task_queue_shards": pool.task_queue_shards,
         }},
         "identity": {
             "pool_id": pool.id,

@@ -126,20 +126,36 @@ def bench_transformer(batch_size: int = 16, seq_len: int = 2048,
 
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
-    second BASELINE.md metric), on the fake substrate so it measures
-    OUR scheduling overhead, not cloud allocation."""
+    second BASELINE.md metric), on the LOCALHOST substrate: real
+    subprocess node agents over the localfs store running the real
+    nodeprep path — honest framework overhead, not fake-thread timing
+    (round-1 weak #5). Docker is absent in the bench container, so the
+    image-prefetch phase is reported as unavailable rather than faked;
+    every other phase comes from the perf-event pipeline
+    (agent/perf.py), and the text gantt is published to
+    BENCH_GANTT.txt."""
+    import shutil
+    import tempfile
+
     from batch_shipyard_tpu.config import settings as S
+    from batch_shipyard_tpu.graph import perf_graph
     from batch_shipyard_tpu.jobs import manager as jobs_mgr
     from batch_shipyard_tpu.pool import manager as pool_mgr
-    from batch_shipyard_tpu.state.memory import MemoryStateStore
-    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+    from batch_shipyard_tpu.substrate.localhost import (
+        LocalhostSubstrate)
 
-    store = MemoryStateStore()
-    substrate = FakePodSubstrate(store)
+    tmp = tempfile.mkdtemp(prefix="shipyard-bench-")
+    store = LocalFSStateStore(os.path.join(tmp, "store"))
     conf = {"pool_specification": {
-        "id": "benchpool", "substrate": "fake",
-        "tpu": {"accelerator_type": "v5litepod-16"},
-        "max_wait_time_seconds": 60}}
+        "id": "benchpool", "substrate": "localhost",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "max_wait_time_seconds": 120}}
+    creds = S.credentials_settings({"credentials": {"storage": {
+        "backend": "localfs", "root": os.path.join(tmp, "store")}}})
+    substrate = LocalhostSubstrate(
+        store, creds, work_root=os.path.join(tmp, "nodes"),
+        pool_config=conf, run_nodeprep=True)
     pool = S.pool_settings(conf)
     try:
         t0 = time.perf_counter()
@@ -152,16 +168,44 @@ def bench_orchestration_latency() -> dict:
         t1 = time.perf_counter()
         jobs_mgr.add_jobs(store, pool, jobs)
         tasks = jobs_mgr.wait_for_tasks(store, "benchpool", "benchjob",
-                                        timeout=60)
+                                        timeout=120)
         task_done = time.perf_counter() - t1
+
+        # Phase breakdown from the perf-event pipeline.
+        from batch_shipyard_tpu.agent import perf as perf_mod
+        events = perf_mod.query(store, "benchpool")
+        by_node: dict = {}
+        for ev in events:
+            by_node.setdefault(ev["node_id"], {})[
+                f"{ev['source']}:{ev['event']}"] = ev["timestamp"]
+        phases = {}
+        for node, evs in by_node.items():
+            np_start = evs.get("nodeprep:start")
+            np_end = evs.get("nodeprep:end")
+            if np_start and np_end:
+                phases.setdefault("nodeprep_seconds", []).append(
+                    np_end - np_start)
+        summary = {k: max(v) for k, v in phases.items()}
+        try:
+            with open(REPO_ROOT / "BENCH_GANTT.txt", "w",
+                      encoding="utf-8") as fh:
+                fh.write(perf_graph.render_text_gantt(
+                    perf_graph.coalesce_data(store, "benchpool")))
+        except Exception:
+            pass
         started = tasks[0].get("started_at")
         return {
+            "substrate": "localhost (real subprocess agents, real "
+                         "nodeprep; docker absent in bench container)",
             "pool_add_to_ready_seconds": pool_ready,
             "submit_to_task_complete_seconds": task_done,
+            "image_prefetch_seconds": None,
             "task_started_at": started,
+            **summary,
         }
     finally:
-        substrate.stop_all()
+        substrate.deallocate_pool("benchpool")
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> int:

@@ -85,3 +85,52 @@ def test_chaos_tasks_survive_agent_crashes():
         if stop_chaos is not None:
             stop_chaos.set()
         substrate.stop_all()
+
+
+def test_scheduler_stress_10k_tasks_sharded_queues():
+    """10,000 tasks across 16 fake nodes with 8-way sharded task
+    queues complete exactly once under a time budget (VERDICT r1 #8:
+    two orders of magnitude beyond the old 120-task regime)."""
+    conf = {"pool_specification": {
+        "id": "stress10k", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-64"},
+        "task_slots_per_node": 2,
+        "task_queue_shards": 8,
+        "max_wait_time_seconds": 60}}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    assert pool.tpu.total_workers == 16
+    try:
+        pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "huge",
+            "tasks": [{"id": f"t{i:05d}", "command": "true",
+                       "runtime": "none"}
+                      for i in range(10_000)],
+        }]})
+        start = time.monotonic()
+        jobs_mgr.add_jobs(store, pool, jobs)
+        submit_elapsed = time.monotonic() - start
+        # Batched entity writes: submission itself must be fast.
+        assert submit_elapsed < 30, submit_elapsed
+        # The crc32 fan-out spreads tasks over every shard (checked on
+        # the routing function — live queue lengths race with the
+        # already-consuming agents).
+        from collections import Counter
+
+        from batch_shipyard_tpu.state import names
+        spread = Counter(names.task_queue_for("stress10k", f"t{i:05d}", 8)
+                         for i in range(10_000))
+        assert len(spread) == 8 and min(spread.values()) > 500, spread
+        tasks = jobs_mgr.wait_for_tasks(store, "stress10k", "huge",
+                                        timeout=420)
+        elapsed = time.monotonic() - start
+        assert len(tasks) == 10_000
+        states = {}
+        for t in tasks:
+            states[t["state"]] = states.get(t["state"], 0) + 1
+        assert states == {"completed": 10_000}, states
+        assert elapsed < 420, elapsed
+    finally:
+        substrate.stop_all()
