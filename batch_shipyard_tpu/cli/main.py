@@ -251,6 +251,14 @@ def jobs_add(click_ctx, tail):
     fleet.action_jobs_add(_ctx(click_ctx), tail=tail)
 
 
+@jobs.command("autopool-reap")
+@click.pass_context
+def jobs_autopool_reap(click_ctx):
+    """Delete auto pools whose job has completed."""
+    reaped = fleet.action_autopool_reap(_ctx(click_ctx))
+    click.echo(f"reaped: {reaped}")
+
+
 @jobs.command("list")
 @click.pass_context
 def jobs_list(click_ctx):
@@ -1039,15 +1047,38 @@ def misc():
 @click.argument("task_id")
 @click.option("--logdir", default=None)
 @click.option("--local-port", type=int, default=16006)
+@click.option("--plan-only", is_flag=True, default=False,
+              help="Emit the plan without starting anything")
 @click.pass_context
-def misc_tensorboard(click_ctx, job_id, task_id, logdir, local_port):
-    """Plan a TensorBoard ssh tunnel to a task's node."""
+def misc_tensorboard(click_ctx, job_id, task_id, logdir, local_port,
+                     plan_only):
+    """Start TensorBoard on a task's node + the local ssh tunnel."""
     from batch_shipyard_tpu.utils import misc as misc_mod
     ctx = _ctx(click_ctx)
-    plan = misc_mod.plan_tensorboard_tunnel(
+    if plan_only:
+        plan = misc_mod.plan_tensorboard_tunnel(
+            ctx.store, ctx.substrate(), ctx.pool.id, job_id, task_id,
+            logdir=logdir, local_port=local_port)
+        fleet._emit(plan, click_ctx.obj["raw"])
+        return
+    misc_mod.tunnel_tensorboard(
         ctx.store, ctx.substrate(), ctx.pool.id, job_id, task_id,
         logdir=logdir, local_port=local_port)
-    fleet._emit(plan, click_ctx.obj["raw"])
+
+
+@misc.command("mirror-images")
+@click.argument("dest_registry")
+@click.option("--dry-run", is_flag=True, default=False)
+@click.pass_context
+def misc_mirror_images(click_ctx, dest_registry, dry_run):
+    """Mirror the global-resource images into a private registry."""
+    from batch_shipyard_tpu.utils import misc as misc_mod
+    ctx = _ctx(click_ctx)
+    images = list(ctx.global_settings.docker_images)
+    targets = misc_mod.mirror_images(images, dest_registry,
+                                     dry_run=dry_run)
+    for t in targets:
+        click.echo(t)
 
 
 def main():

@@ -459,6 +459,11 @@ class JobSettings:
     tasks: tuple[dict, ...]  # raw task dicts (expanded by task factories)
     merge_task: Optional[dict]
     federation_constraints: dict
+    # auto_pool: {"keep_alive": bool} — the job provisions its own
+    # pool (derived from the configured pool spec) and the reaper
+    # tears it down when the job completes (reference
+    # _construct_auto_pool_specification, fleet.py:1768).
+    auto_pool: Optional[dict]
 
 
 def job_settings_list(config: dict) -> list[JobSettings]:
@@ -507,6 +512,7 @@ def _job_settings(job: dict) -> JobSettings:
         merge_task=_get(job, "merge_task"),
         federation_constraints=_get(
             job, "federation_constraints", default={}),
+        auto_pool=_get(job, "auto_pool"),
     )
 
 

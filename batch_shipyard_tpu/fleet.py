@@ -277,20 +277,83 @@ def action_account_info(ctx: Context, raw: bool = False) -> None:
 
 # ------------------------------ job actions ----------------------------
 
+def _submit_auto_pool_job(ctx: Context, job) -> dict:
+    """Provision a dedicated pool for one job and submit the job to it
+    (reference _construct_auto_pool_specification, fleet.py:1768: pool
+    lifetime tied to the job). The pool spec is the configured pool
+    with a derived id; action_autopool_reap (or the CLI's
+    `jobs autopool-reap`) deletes it once the job completes."""
+    import copy
+
+    auto_id = f"{job.id}-autopool"
+    conf = copy.deepcopy(ctx.configs.get("pool"))
+    conf["pool_specification"]["id"] = auto_id
+    auto_pool = settings_mod.pool_settings(conf)
+    substrate = ctx.substrate(auto_pool)
+    pool_mgr.create_pool(ctx.store, substrate, auto_pool,
+                         ctx.global_settings, conf)
+    ctx.store.merge_entity(names.TABLE_POOLS, "pools", auto_id, {
+        "auto_pool_for": job.id,
+        "auto_pool_keep_alive": bool(
+            (job.auto_pool or {}).get("keep_alive", False)),
+    })
+    if not job.auto_complete:
+        # The pool's lifetime is the job's: the job must be able to
+        # reach a completed state on its own.
+        job = dataclasses.replace(job, auto_complete=True)
+    return jobs_mgr.add_jobs(ctx.store, auto_pool, [job])
+
+
+def action_autopool_reap(ctx: Context) -> list[str]:
+    """Delete auto pools whose job completed (keep_alive pools are
+    left). Run after jobs finish or periodically."""
+    reaped = []
+    for rec in pool_mgr.list_pools(ctx.store):
+        job_id = rec.get("auto_pool_for")
+        if not job_id or rec.get("auto_pool_keep_alive"):
+            continue
+        pool_id = rec["_rk"]
+        try:
+            job = jobs_mgr.get_job(ctx.store, pool_id, job_id)
+        except jobs_mgr.JobNotFoundError:
+            # Job record deleted: the pool has nothing to live for.
+            # (Transient store errors must propagate — never treat
+            # them as "completed" and delete a live pool.)
+            job = {"state": "completed"}
+        if job.get("state") == "completed":
+            spec = rec.get("spec", {}).get("pool_specification", {})
+            kind_pool = settings_mod.pool_settings(rec.get("spec", {})) \
+                if spec else ctx.pool
+            pool_mgr.delete_pool(ctx.store, ctx.substrate(kind_pool),
+                                 pool_id)
+            reaped.append(pool_id)
+            logger.info("auto pool %s reaped (job %s completed)",
+                        pool_id, job_id)
+    return reaped
+
+
 def action_jobs_add(ctx: Context, tail: Optional[str] = None) -> dict:
     """jobs add (fleet.py:4000 analog). tail: stream the given file of
     the last task submitted (reference --tail)."""
     pool = ctx.pool
-    ctx.substrate().ensure_attached(pool)
-    submitted = jobs_mgr.add_jobs(ctx.store, pool, ctx.jobs)
+    regular = [j for j in ctx.jobs if not j.auto_pool]
+    submitted = {}
+    for job in ctx.jobs:
+        if job.auto_pool:
+            submitted.update(_submit_auto_pool_job(ctx, job))
+    if regular:
+        ctx.substrate().ensure_attached(pool)
+        submitted.update(jobs_mgr.add_jobs(ctx.store, pool, regular))
     logger.info("submitted %s", submitted)
     if tail:
         job = ctx.jobs[-1]
-        tasks = jobs_mgr.list_tasks(ctx.store, pool.id, job.id)
+        tail_pool = (f"{job.id}-autopool" if job.auto_pool
+                     else pool.id)
+        tasks = jobs_mgr.list_tasks(ctx.store, tail_pool, job.id)
         if tasks:
             last = sorted(t["_rk"] for t in tasks)[-1]
             for chunk in jobs_mgr.stream_task_output(
-                    ctx.store, pool.id, job.id, last, filename=tail):
+                    ctx.store, tail_pool, job.id, last, filename=tail):
                 sys.stdout.write(chunk.decode(errors="replace"))
                 sys.stdout.flush()
     return submitted

@@ -58,6 +58,42 @@ def plan_tensorboard_tunnel(
     }
 
 
+def tunnel_tensorboard(store: StateStore, substrate, pool_id: str,
+                       job_id: str, task_id: str,
+                       logdir: Optional[str] = None,
+                       local_port: int = 16006,
+                       ssh_username: str = "shipyard",
+                       ssh_private_key: Optional[str] = None,
+                       output_dir: str = ".",
+                       wait: bool = True) -> dict:
+    """EXECUTE the TensorBoard tunnel (tunnel_tensorboard misc.py:62):
+    start TensorBoard on the task's node over ssh, then run the local
+    port-forward (blocking while the tunnel is up when wait=True).
+    plan_tensorboard_tunnel remains the dry-run variant."""
+    import subprocess
+
+    plan = plan_tensorboard_tunnel(
+        store, substrate, pool_id, job_id, task_id, logdir=logdir,
+        local_port=local_port, ssh_username=ssh_username,
+        ssh_private_key=ssh_private_key, output_dir=output_dir)
+    rc, out, err = crypto.ssh_exec(
+        plan["node_ip"],
+        f"nohup {plan['remote_command']} >/tmp/tensorboard.log 2>&1 & "
+        f"echo started",
+        port=plan["ssh_port"], username=ssh_username,
+        private_key_file=ssh_private_key)
+    if rc != 0:
+        raise RuntimeError(
+            f"failed to start remote TensorBoard: {err.strip()}")
+    logger.info("TensorBoard starting on %s; tunnel at %s",
+                plan["node_id"], plan["local_url"])
+    proc = subprocess.Popen(["bash", plan["tunnel_script"]])
+    plan["tunnel_pid"] = proc.pid
+    if wait:
+        proc.wait()
+    return plan
+
+
 def mirror_images_plan(images: list[str],
                        dest_registry: str) -> list[list[str]]:
     """Command plan to mirror images into a private registry
@@ -69,3 +105,24 @@ def mirror_images_plan(images: list[str],
         plan.append(["docker", "tag", image, target])
         plan.append(["docker", "push", target])
     return plan
+
+
+def mirror_images(images: list[str], dest_registry: str,
+                  dry_run: bool = False) -> list[str]:
+    """EXECUTE image mirroring into a private registry (misc.py:250):
+    pull, tag, push each image; returns the mirrored targets. Raises
+    on the first failing command."""
+    import shutil
+    import subprocess
+
+    if not dry_run and shutil.which("docker") is None:
+        raise RuntimeError("docker is required to mirror images")
+    targets = []
+    for argv in mirror_images_plan(images, dest_registry):
+        if dry_run:
+            logger.info("dry-run: %s", " ".join(argv))
+        else:
+            subprocess.run(argv, check=True)
+        if argv[1] == "push":
+            targets.append(argv[2])
+    return targets

@@ -38,6 +38,16 @@ def main() -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel axis (requires --moe-"
+                             "experts divisible by ep)")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="replace every moe-every'th MLP with N "
+                             "routed experts (0 = dense)")
+    parser.add_argument("--moe-every", type=int, default=2)
+    parser.add_argument("--int8", action="store_true",
+                        help="int8 MXU matmuls for projections/MLP "
+                             "(QAT straight-through backward)")
     parser.add_argument("--no-remat", action="store_true")
     parser.add_argument("--checkpoint-dir", default=None,
                         help="Orbax checkpoint dir (use the job "
@@ -49,12 +59,20 @@ def main() -> int:
     ctx = distributed.setup()
     n_dev = jax.device_count()
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(
-        n_dev, tp=args.tp, sp=args.sp, fsdp=args.fsdp))
+        n_dev, tp=args.tp, sp=args.sp, fsdp=args.fsdp, ep=args.ep))
+    moe = None
+    if args.moe_experts:
+        from batch_shipyard_tpu.models.moe import MoEConfig
+        moe = MoEConfig(num_experts=args.moe_experts,
+                        d_model=args.d_model, d_ff=args.d_ff,
+                        dtype=jnp.bfloat16)
     config = train_mod.make_transformer_config(
         mesh, vocab_size=args.vocab, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=args.d_ff,
         max_seq_len=args.seq_len, dtype=jnp.bfloat16,
+        moe=moe, moe_every=args.moe_every,
+        quantize_matmuls=args.int8,
         remat=not args.no_remat)
     harness = train_mod.build_transformer_train(
         mesh, config, batch_size=args.batch, seq_len=args.seq_len)

@@ -233,3 +233,48 @@ def test_heimdall_daemon_loop(tmp_path):
         assert json_mod.loads(path.read_text())
     finally:
         substrate.stop_all()
+
+
+def test_auto_pool_job_lifecycle():
+    """auto_pool: the job provisions its own pool, runs there, and the
+    reaper deletes the pool once the job completes (reference
+    _construct_auto_pool_specification, fleet.py:1768)."""
+    from batch_shipyard_tpu import fleet
+    from batch_shipyard_tpu.pool import manager as pool_mgr
+
+    ctx = fleet.load_context(extra={
+        "credentials": {"credentials": {
+            "storage": {"backend": "memory"}}},
+        "pool": {"pool_specification": {
+            "id": "mainpool", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}},
+        "jobs": {"job_specifications": [{
+            "id": "apjob",
+            "auto_pool": {"pool_lifetime": "job"},
+            "tasks": [{"command": "echo auto-pool-ran"}]}]},
+    })
+    try:
+        submitted = fleet.action_jobs_add(ctx)
+        assert submitted == {"apjob": 1}
+        # The job landed on its own derived pool, not the configured one.
+        pools = {p["_rk"] for p in pool_mgr.list_pools(ctx.store)}
+        assert "apjob-autopool" in pools
+        assert "mainpool" not in pools
+        tasks = jobs_mgr.wait_for_tasks(ctx.store, "apjob-autopool",
+                                        "apjob", timeout=30)
+        assert tasks[0]["state"] == "completed"
+        # auto_complete was forced; once completed, the reaper removes
+        # the pool.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            job = jobs_mgr.get_job(ctx.store, "apjob-autopool", "apjob")
+            if job.get("state") == "completed":
+                break
+            time.sleep(0.1)
+        reaped = fleet.action_autopool_reap(ctx)
+        assert reaped == ["apjob-autopool"]
+        assert not pool_mgr.pool_exists(ctx.store, "apjob-autopool")
+    finally:
+        for sub in ctx._substrates.values():
+            getattr(sub, "stop_all", lambda: None)()
