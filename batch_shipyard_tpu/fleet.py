@@ -290,18 +290,28 @@ def _submit_auto_pool_job(ctx: Context, job) -> dict:
     conf["pool_specification"]["id"] = auto_id
     auto_pool = settings_mod.pool_settings(conf)
     substrate = ctx.substrate(auto_pool)
-    pool_mgr.create_pool(ctx.store, substrate, auto_pool,
-                         ctx.global_settings, conf)
-    ctx.store.merge_entity(names.TABLE_POOLS, "pools", auto_id, {
-        "auto_pool_for": job.id,
-        "auto_pool_keep_alive": bool(
-            (job.auto_pool or {}).get("keep_alive", False)),
-    })
+    try:
+        pool_mgr.create_pool(ctx.store, substrate, auto_pool,
+                             ctx.global_settings, conf)
+    finally:
+        # Mark even on a failed/timed-out create (the record is
+        # inserted before allocation): a half-created auto pool must
+        # stay reapable, never a leaked allocation.
+        if pool_mgr.pool_exists(ctx.store, auto_id):
+            ctx.store.merge_entity(names.TABLE_POOLS, "pools",
+                                   auto_id, {
+                "auto_pool_for": job.id,
+                "auto_pool_keep_alive": bool(
+                    (job.auto_pool or {}).get("keep_alive", False)),
+            })
     if not job.auto_complete:
         # The pool's lifetime is the job's: the job must be able to
         # reach a completed state on its own.
         job = dataclasses.replace(job, auto_complete=True)
-    return jobs_mgr.add_jobs(ctx.store, auto_pool, [job])
+    # Override any job-level pool_id: an auto_pool job lives on its
+    # derived pool by definition.
+    return jobs_mgr.add_jobs(ctx.store, auto_pool, [job],
+                             pool_id_override=auto_id)
 
 
 def action_autopool_reap(ctx: Context) -> list[str]:

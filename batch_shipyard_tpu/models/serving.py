@@ -1,0 +1,202 @@
+"""Continuous batching: a slot-based serving engine over the KV-cache
+decode path.
+
+ROADMAP item (the reference has no serving story): instead of
+generating whole batches in lockstep (models/inference.generate —
+every sequence must finish before any slot frees), the engine holds a
+fixed pool of decode SLOTS sharing one batched KV cache. Requests
+admit into free slots as they arrive (per-slot prefill via a batch-1
+scatter into the big cache), every engine step decodes ONE token for
+all active slots in a single jitted call, and finished slots free
+immediately for the next request — the throughput property
+continuous-batching servers (Orca/vLLM-class) are built around.
+
+TPU-first mechanics: the per-slot cache index ([B] int32,
+transformer._decode_attend) lets slots sit at different depths in one
+[B, T, H, D] cache; per-slot RoPE positions ride the 2-D positions
+path; everything is static-shape jitted — admit/emit bookkeeping is
+host-side Python, compute is two compiled functions (prefill, step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batch_shipyard_tpu.models import inference as inf
+from batch_shipyard_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt: list[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[Request] = None
+    generated: list[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching engine.
+
+    Usage:
+        engine = ContinuousBatcher(config, params, num_slots=8,
+                                   max_decode_len=2048)
+        engine.submit(Request("r1", prompt_ids, max_new_tokens=128))
+        while engine.pending():
+            for request_id, tokens in engine.step():
+                ...  # finished request
+    """
+
+    def __init__(self, config: tfm.TransformerConfig, params,
+                 num_slots: int, max_decode_len: int,
+                 sampling: inf.SamplingConfig = inf.SamplingConfig(),
+                 seed: int = 0):
+        self.config = inf.decode_config(config, max_decode_len)
+        self.model = tfm.TransformerLM(self.config)
+        self.params = params
+        self.num_slots = num_slots
+        self.max_decode_len = max_decode_len
+        self.sampling = sampling
+        self.cache = inf.init_cache(self.model, params, num_slots)
+        self._slots = [_Slot() for _ in range(num_slots)]
+        self._queue: list[Request] = []
+        self._tokens = jnp.zeros((num_slots, 1), jnp.int32)
+        self._positions = jnp.zeros((num_slots,), jnp.int32)
+        self._active = jnp.zeros((num_slots,), jnp.bool_)
+        self._key = jax.random.PRNGKey(seed)
+
+        model = self.model
+        sampling_cfg = self.sampling
+
+        @jax.jit
+        def decode_step(params, cache, tokens, positions, active, key):
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=positions[:, None], mutable=["cache"])
+            next_tok = inf._sample(logits[:, 0].astype(jnp.float32),
+                                   key, sampling_cfg)
+            # Inactive slots DO write garbage into their cache rows,
+            # and that is fine: a freed row is never read (the
+            # per-slot mask excludes other rows) and _admit's prefill
+            # rewrites the whole row + index before reuse — restoring
+            # the full K/V trees here would double per-token HBM
+            # traffic for no observable effect. Only the cheap token/
+            # position bookkeeping needs masking.
+            next_tok = jnp.where(active, next_tok, tokens[:, 0])
+            positions = jnp.where(active, positions + 1, positions)
+            return (mutated["cache"], next_tok[:, None], positions,
+                    next_tok)
+
+        self._decode_step = decode_step
+
+        @functools.partial(jax.jit, static_argnames=("prompt_len",))
+        def prefill(params, cache, slot, prompt, prompt_len):
+            """Fill ONE slot's cache region from a prompt [1, L]
+            (batch-1 forward, scattered into the slot row), returning
+            the last-token logits for the first sample."""
+            small = inf.init_cache(model, params, 1)
+
+            def body(carry, tok):
+                c, pos = carry
+                logits, mut = model.apply(
+                    {"params": params, "cache": c}, tok[None, None],
+                    positions=pos[None], mutable=["cache"])
+                return (mut["cache"], pos + 1), logits[0, 0]
+
+            (small, _pos), logits_seq = jax.lax.scan(
+                body, (small, jnp.int32(0)), prompt[0, :prompt_len])
+            cache = jax.tree_util.tree_map(
+                lambda big, sm: big.at[slot].set(sm[0]), cache, small)
+            return cache, logits_seq[-1]
+
+        self._prefill = prefill
+
+    # ------------------------------ public -----------------------------
+
+    def submit(self, request: Request) -> None:
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"{request.request_id}: max_new_tokens must be >= 1")
+        if len(request.prompt) + request.max_new_tokens > \
+                self.max_decode_len:
+            raise ValueError(
+                f"{request.request_id}: prompt+generation "
+                f"{len(request.prompt)}+{request.max_new_tokens} "
+                f"exceeds max_decode_len {self.max_decode_len}")
+        self._queue.append(request)
+
+    def pending(self) -> int:
+        return len(self._queue) + sum(
+            1 for s in self._slots if s.request is not None)
+
+    def step(self) -> list[tuple[str, list[int]]]:
+        """Admit queued requests into free slots, decode one token for
+        every active slot, emit finished requests."""
+        self._admit()
+        # Slots whose prefill-sampled first token already satisfied the
+        # request (max_new_tokens == 1 or immediate eos) emit without a
+        # decode step.
+        emitted: list[tuple[str, list[int]]] = []
+        for i, slot in enumerate(self._slots):
+            req = slot.request
+            if req is None or not slot.generated:
+                continue
+            last = slot.generated[-1]
+            if (len(slot.generated) >= req.max_new_tokens or
+                    (req.eos_id is not None and last == req.eos_id)):
+                emitted.append((req.request_id, list(slot.generated)))
+                self._slots[i] = _Slot()
+                self._active = self._active.at[i].set(False)
+        if not any(s.request is not None for s in self._slots):
+            return emitted
+        self._key, step_key = jax.random.split(self._key)
+        self.cache, self._tokens, self._positions, next_tok = \
+            self._decode_step(self.params, self.cache, self._tokens,
+                              self._positions, self._active, step_key)
+        next_host = np.asarray(next_tok)
+        for i, slot in enumerate(self._slots):
+            req = slot.request
+            if req is None:
+                continue
+            token = int(next_host[i])
+            slot.generated.append(token)
+            done = (len(slot.generated) >= req.max_new_tokens or
+                    (req.eos_id is not None and token == req.eos_id))
+            if done:
+                emitted.append((req.request_id, list(slot.generated)))
+                self._slots[i] = _Slot()
+                self._active = self._active.at[i].set(False)
+        return emitted
+
+    # ----------------------------- internal ----------------------------
+
+    def _admit(self) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot.request is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            prompt = jnp.asarray([req.prompt], jnp.int32)
+            self.cache, last_logits = self._prefill(
+                self.params, self.cache, i, prompt, len(req.prompt))
+            self._key, sample_key = jax.random.split(self._key)
+            first = inf._sample(
+                last_logits[None].astype(jnp.float32), sample_key,
+                self.sampling)
+            # The prefill-sampled token IS the first generated token.
+            self._slots[i] = _Slot(request=req,
+                                   generated=[int(first[0])])
+            self._tokens = self._tokens.at[i, 0].set(first[0])
+            self._positions = self._positions.at[i].set(
+                len(req.prompt))
+            self._active = self._active.at[i].set(True)

@@ -61,14 +61,21 @@ class TransformerConfig:
 
 
 def rotary_embedding(x, positions, theta: float):
-    """Apply RoPE. x: [B, T, H, D]; positions: [T] global positions."""
+    """Apply RoPE. x: [B, T, H, D]; positions: [T] global positions
+    shared across the batch, or [B, T] per-sequence positions (the
+    continuous-batching decode case, where each slot sits at its own
+    depth)."""
     depth = x.shape[-1]
     freqs = jnp.exp(
         -jnp.log(theta) *
         jnp.arange(0, depth, 2, dtype=jnp.float32) / depth)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    if positions.ndim == 1:
+        cos = jnp.cos(angles)[None, :, None, :]   # [1, T, 1, D/2]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:
+        cos = jnp.cos(angles)[:, :, None, :]      # [B, T, 1, D/2]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -119,7 +126,11 @@ class Attention(nn.Module):
 
     def _decode_attend(self, q, k, v):
         """Single-step decode: insert this step's K/V into the cache
-        and attend the (length-1) query over the valid prefix."""
+        and attend the (length-1) query over the valid prefix.
+
+        The write index is PER SLOT ([B] int32), so independent
+        sequences at different depths share one batched cache — the
+        requirement for continuous batching (models/serving.py)."""
         cfg = self.config
         batch, seq, heads, depth = q.shape
         assert seq == 1, "decode mode consumes one token per call"
@@ -130,12 +141,13 @@ class Attention(nn.Module):
             "cache", "v", jnp.zeros,
             (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
         index = self.variable(
-            "cache", "index", lambda: jnp.zeros((), jnp.int32))
-        idx = index.value
-        cache_k.value = jax.lax.dynamic_update_slice(
-            cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-        cache_v.value = jax.lax.dynamic_update_slice(
-            cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            "cache", "index", lambda: jnp.zeros((batch,), jnp.int32))
+        idx = index.value  # [B]
+        rows = jnp.arange(batch)
+        cache_k.value = cache_k.value.at[rows, idx].set(
+            k[:, 0].astype(cfg.dtype))
+        cache_v.value = cache_v.value.at[rows, idx].set(
+            v[:, 0].astype(cfg.dtype))
         index.value = idx + 1
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, cache_k.value,
@@ -143,8 +155,8 @@ class Attention(nn.Module):
         scores = scores / jnp.sqrt(jnp.float32(depth))
         key_pos = jax.lax.broadcasted_iota(
             jnp.int32, (cfg.max_decode_len, 1), 0)[:, 0]
-        mask = key_pos <= idx
-        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+        mask = key_pos[None, :] <= idx[:, None]   # [B, T]
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cache_v.value,
