@@ -97,13 +97,21 @@ class Context:
     @property
     def store(self) -> StateStore:
         if self._store is None:
-            self._store = create_statestore(self.credentials.storage)
+            creds = self.credentials
+            # Headless identity (federation proxy VM, monitor VM,
+            # slurm controller): activate the configured service
+            # account before ANY cloud client is constructed.
+            from batch_shipyard_tpu.utils import auth
+            auth.ensure_service_account(creds.gcp)
+            self._store = create_statestore(creds.storage)
         return self._store
 
     def substrate(self, pool=None) -> ComputeSubstrate:
         pool = pool or self.pool
         kind = pool.substrate
         if kind not in self._substrates:
+            from batch_shipyard_tpu.utils import auth
+            auth.ensure_service_account(self.credentials.gcp)
             kwargs = dict(self.substrate_kwargs.get(kind, {}))
             if kind == "localhost":
                 kwargs.setdefault("pool_config", self.configs.get("pool"))
@@ -296,14 +304,20 @@ def _submit_auto_pool_job(ctx: Context, job) -> dict:
     finally:
         # Mark even on a failed/timed-out create (the record is
         # inserted before allocation): a half-created auto pool must
-        # stay reapable, never a leaked allocation.
-        if pool_mgr.pool_exists(ctx.store, auto_id):
-            ctx.store.merge_entity(names.TABLE_POOLS, "pools",
-                                   auto_id, {
-                "auto_pool_for": job.id,
-                "auto_pool_keep_alive": bool(
-                    (job.auto_pool or {}).get("keep_alive", False)),
-            })
+        # stay reapable, never a leaked allocation. The bookkeeping
+        # itself must not mask an in-flight create_pool exception.
+        try:
+            if pool_mgr.pool_exists(ctx.store, auto_id):
+                ctx.store.merge_entity(names.TABLE_POOLS, "pools",
+                                       auto_id, {
+                    "auto_pool_for": job.id,
+                    "auto_pool_keep_alive": bool(
+                        (job.auto_pool or {}).get("keep_alive",
+                                                  False)),
+                })
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "failed to mark auto pool %s reapable", auto_id)
     if not job.auto_complete:
         # The pool's lifetime is the job's: the job must be able to
         # reach a completed state on its own.

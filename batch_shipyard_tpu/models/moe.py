@@ -40,6 +40,11 @@ class MoEConfig:
     param_dtype: Any = jnp.float32
     router_noise: float = 0.0
     num_selected: int = 1    # 1 = Switch-style, 2 = GShard top-2
+    # "tokens": tokens pick experts (top-1/top-k above, needs the
+    # load-balancing aux loss). "expert_choice": experts pick their
+    # top-C tokens (Zhou et al. 2022) — perfectly load-balanced by
+    # construction, no aux loss.
+    routing: str = "tokens"
 
 
 def top1_routing(logits, capacity: int):
@@ -107,6 +112,28 @@ def topk_routing(logits, capacity: int, num_selected: int = 2):
     return dispatch, combine, aux
 
 
+def expert_choice_routing(logits, capacity: int):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT selects
+    its top-C tokens by affinity, the transpose of token-choice.
+    Load is perfectly balanced by construction (every expert processes
+    exactly C tokens), so there is no auxiliary loss (returns 0.0);
+    a token may be picked by several experts (outputs sum) or by none
+    (the residual path carries it).
+
+    logits: [G, E]. Returns (dispatch [G, E, C], combine [G, E, C],
+    aux=0.0).
+    """
+    groups, num_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # Per-expert token affinities: [E, G]; each expert takes top-C.
+    gate_vals, token_idx = jax.lax.top_k(probs.T, capacity)  # [E, C]
+    dispatch = jax.nn.one_hot(
+        token_idx, groups, dtype=jnp.float32)                # [E, C, G]
+    dispatch = dispatch.transpose(2, 0, 1)                   # [G, E, C]
+    combine = dispatch * gate_vals[None, :, :]
+    return dispatch, combine, jnp.float32(0.0)
+
+
 class MoEMLP(nn.Module):
     """Drop-in MLP replacement: top-1 routed SwiGLU experts."""
 
@@ -131,7 +158,10 @@ class MoEMLP(nn.Module):
                 minval=1.0 - cfg.router_noise,
                 maxval=1.0 + cfg.router_noise)
             logits = logits * noise
-        if cfg.num_selected > 1:
+        if cfg.routing == "expert_choice":
+            dispatch, combine, aux = expert_choice_routing(logits,
+                                                           capacity)
+        elif cfg.num_selected > 1:
             dispatch, combine, aux = topk_routing(
                 logits, capacity, cfg.num_selected)
         else:

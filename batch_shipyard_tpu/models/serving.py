@@ -61,14 +61,63 @@ class ContinuousBatcher:
     def __init__(self, config: tfm.TransformerConfig, params,
                  num_slots: int, max_decode_len: int,
                  sampling: inf.SamplingConfig = inf.SamplingConfig(),
-                 seed: int = 0):
+                 seed: int = 0,
+                 kv_page_size: Optional[int] = None,
+                 kv_num_pages: Optional[int] = None):
+        """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
+        live in a shared kv_num_pages-page pool and slots hold block
+        tables covering only their live tokens, so HBM is sized for
+        aggregate active context instead of
+        num_slots * max_decode_len. kv_num_pages defaults to the
+        no-deadlock capacity (num_slots * ceil(max_len/page)); size it
+        smaller to overcommit — admission waits for pages, and a
+        decode step that cannot grow raises."""
         self.config = inf.decode_config(config, max_decode_len)
+        self.paged = kv_page_size is not None
+        if self.paged:
+            if max_decode_len % kv_page_size:
+                raise ValueError("max_decode_len must be a multiple "
+                                 "of kv_page_size")
+            if kv_num_pages is None:
+                kv_num_pages = num_slots * (
+                    max_decode_len // kv_page_size)
+            self.config = dataclasses.replace(
+                self.config, kv_page_size=kv_page_size,
+                kv_num_pages=kv_num_pages)
+            self.page_size = kv_page_size
+            self.max_blocks = max_decode_len // kv_page_size
+            self._free_pages = list(range(kv_num_pages))
+            # Reservation budget: admission reserves each request's
+            # WORST-CASE page count up front (prompt + max_new_tokens)
+            # so lazy growth during decode can never deadlock two
+            # half-grown slots against each other.
+            self._avail_pages = kv_num_pages
+            self._total_pages = kv_num_pages
+            self._slot_reserved = [0] * num_slots
+            # The decode step runs the full slot batch, so INACTIVE
+            # slots keep writing (masked-on-read) K/V through their
+            # block tables. Their tables must therefore never point at
+            # allocatable pages: one extra physical SCRATCH page (index
+            # kv_num_pages) absorbs those writes, and freed slots'
+            # table rows reset to it.
+            self._scratch_page = kv_num_pages
+            self.config = dataclasses.replace(
+                self.config, kv_num_pages=kv_num_pages + 1)
+            self._table = np.full((num_slots, self.max_blocks),
+                                  self._scratch_page, np.int32)
+            self._slot_pages: list[list[int]] = [
+                [] for _ in range(num_slots)]
         self.model = tfm.TransformerLM(self.config)
         self.params = params
         self.num_slots = num_slots
         self.max_decode_len = max_decode_len
         self.sampling = sampling
         self.cache = inf.init_cache(self.model, params, num_slots)
+        if self.paged:
+            # Fresh caches default block tables to zeros (a REAL
+            # page); point every slot at the scratch page before any
+            # step runs.
+            self._push_tables()
         self._slots = [_Slot() for _ in range(num_slots)]
         self._queue: list[Request] = []
         self._tokens = jnp.zeros((num_slots, 1), jnp.int32)
@@ -100,27 +149,74 @@ class ContinuousBatcher:
 
         self._decode_step = decode_step
 
-        @functools.partial(jax.jit, static_argnames=("prompt_len",))
-        def prefill(params, cache, slot, prompt, prompt_len):
-            """Fill ONE slot's cache region from a prompt [1, L]
-            (batch-1 forward, scattered into the slot row), returning
-            the last-token logits for the first sample."""
-            small = inf.init_cache(model, params, 1)
+        # Prefill always runs on a DENSE batch-1 decode model sharing
+        # the params; paged mode then scatters its rows into the
+        # slot's allocated pages.
+        dense_model = tfm.TransformerLM(
+            inf.decode_config(config, max_decode_len))
+        page = getattr(self, "page_size", 0)
+
+        def dense_prefill(params, prompt, prompt_len):
+            small = inf.init_cache(dense_model, params, 1)
 
             def body(carry, tok):
                 c, pos = carry
-                logits, mut = model.apply(
+                logits, mut = dense_model.apply(
                     {"params": params, "cache": c}, tok[None, None],
                     positions=pos[None], mutable=["cache"])
                 return (mut["cache"], pos + 1), logits[0, 0]
 
             (small, _pos), logits_seq = jax.lax.scan(
                 body, (small, jnp.int32(0)), prompt[0, :prompt_len])
+            return small, logits_seq[-1]
+
+        @functools.partial(jax.jit, static_argnames=("prompt_len",))
+        def prefill(params, cache, slot, prompt, prompt_len):
+            """Fill ONE slot's cache region from a prompt [1, L]
+            (batch-1 forward, scattered into the slot row), returning
+            the last-token logits for the first sample."""
+            small, last = dense_prefill(params, prompt, prompt_len)
             cache = jax.tree_util.tree_map(
                 lambda big, sm: big.at[slot].set(sm[0]), cache, small)
-            return cache, logits_seq[-1]
+            return cache, last
+
+        @functools.partial(jax.jit, static_argnames=("prompt_len",))
+        def prefill_paged(params, cache, slot, prompt, table_row,
+                          prompt_len):
+            """Paged variant: dense batch-1 prefill, rows scattered
+            page-by-page into the slot's allocated pages; the slot's
+            block-table row and length are set in every layer's
+            cache copy."""
+            small, last = dense_prefill(params, prompt, prompt_len)
+            n_blocks = -(-prompt_len // page)
+
+            def scatter(big, sm):
+                if isinstance(big, dict) and "k_pages" in big:
+                    kp, vp = big["k_pages"], big["v_pages"]
+                    for b in range(n_blocks):
+                        start = b * page
+                        take = min(page, prompt_len - start)
+                        krows = jax.lax.dynamic_slice_in_dim(
+                            sm["k"][0], start, take, 0)
+                        vrows = jax.lax.dynamic_slice_in_dim(
+                            sm["v"][0], start, take, 0)
+                        kp = kp.at[table_row[b], :take].set(
+                            krows.astype(kp.dtype))
+                        vp = vp.at[table_row[b], :take].set(
+                            vrows.astype(vp.dtype))
+                    return {
+                        "k_pages": kp, "v_pages": vp,
+                        "block_table":
+                            big["block_table"].at[slot].set(table_row),
+                        "length":
+                            big["length"].at[slot].set(prompt_len),
+                    }
+                return {key: scatter(big[key], sm[key]) for key in big}
+
+            return scatter(cache, small), last
 
         self._prefill = prefill
+        self._prefill_paged = prefill_paged
 
     # ------------------------------ public -----------------------------
 
@@ -128,6 +224,14 @@ class ContinuousBatcher:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"{request.request_id}: max_new_tokens must be >= 1")
+        if self.paged:
+            worst = -(-(len(request.prompt) + request.max_new_tokens)
+                      // self.page_size)
+            if worst > self._total_pages:
+                raise ValueError(
+                    f"{request.request_id}: worst-case page need "
+                    f"{worst} exceeds the pool ({self._total_pages} "
+                    f"pages) — it could never admit")
         if len(request.prompt) + request.max_new_tokens > \
                 self.max_decode_len:
             raise ValueError(
@@ -156,10 +260,11 @@ class ContinuousBatcher:
             if (len(slot.generated) >= req.max_new_tokens or
                     (req.eos_id is not None and last == req.eos_id)):
                 emitted.append((req.request_id, list(slot.generated)))
-                self._slots[i] = _Slot()
-                self._active = self._active.at[i].set(False)
+                self._free_slot(i)
         if not any(s.request is not None for s in self._slots):
             return emitted
+        if self.paged:
+            self._grow_pages()
         self._key, step_key = jax.random.split(self._key)
         self.cache, self._tokens, self._positions, next_tok = \
             self._decode_step(self.params, self.cache, self._tokens,
@@ -175,9 +280,65 @@ class ContinuousBatcher:
                     (req.eos_id is not None and token == req.eos_id))
             if done:
                 emitted.append((req.request_id, list(slot.generated)))
-                self._slots[i] = _Slot()
-                self._active = self._active.at[i].set(False)
+                self._free_slot(i)
         return emitted
+
+    def _free_slot(self, i: int) -> None:
+        self._slots[i] = _Slot()
+        self._active = self._active.at[i].set(False)
+        if self.paged:
+            self._free_pages.extend(self._slot_pages[i])
+            self._slot_pages[i] = []
+            self._avail_pages += self._slot_reserved[i]
+            self._slot_reserved[i] = 0
+            # The freed slot keeps decoding (masked) in the full-batch
+            # step: its table must stop referencing returned pages
+            # BEFORE they are reallocated.
+            self._table[i] = self._scratch_page
+            self._push_tables()
+
+    def _grow_pages(self) -> None:
+        """Allocate a fresh page for any active slot whose NEXT write
+        starts a new block, and push the updated tables into every
+        layer's cache copy."""
+        positions = np.asarray(self._positions)
+        active = np.asarray(self._active)
+        changed = False
+        for i in range(self.num_slots):
+            if not active[i]:
+                continue
+            pos = int(positions[i])
+            if pos % self.page_size != 0:
+                continue
+            block = pos // self.page_size
+            if block < len(self._slot_pages[i]):
+                continue  # prefill already covers this block
+            if not self._free_pages:
+                raise RuntimeError(
+                    "paged KV pool exhausted mid-decode; size "
+                    "kv_num_pages >= num_slots * max_decode_len / "
+                    "page_size to rule this out")
+            pagenum = self._free_pages.pop()
+            self._slot_pages[i].append(pagenum)
+            self._table[i, block] = pagenum
+            changed = True
+        if changed:
+            self._push_tables()
+
+    def _push_tables(self) -> None:
+        """Write the canonical block table into every layer's cache
+        copy."""
+        table = jnp.asarray(self._table)
+
+        def push(leaf_dict):
+            if isinstance(leaf_dict, dict) and \
+                    "block_table" in leaf_dict:
+                return {**leaf_dict, "block_table": table}
+            if isinstance(leaf_dict, dict):
+                return {k: push(v) for k, v in leaf_dict.items()}
+            return leaf_dict
+
+        self.cache = push(self.cache)
 
     # ----------------------------- internal ----------------------------
 
@@ -185,10 +346,35 @@ class ContinuousBatcher:
         for i, slot in enumerate(self._slots):
             if slot.request is not None or not self._queue:
                 continue
-            req = self._queue.pop(0)
+            req = self._queue[0]
             prompt = jnp.asarray([req.prompt], jnp.int32)
-            self.cache, last_logits = self._prefill(
-                self.params, self.cache, i, prompt, len(req.prompt))
+            if self.paged:
+                blocks_needed = -(-len(req.prompt) // self.page_size)
+                worst = -(-(len(req.prompt) + req.max_new_tokens)
+                          // self.page_size)
+                if self._avail_pages < worst:
+                    # Not enough budget for this request's worst case:
+                    # wait for frees rather than risking a mid-decode
+                    # exhaustion deadlock between half-grown slots.
+                    break
+                self._avail_pages -= worst
+                self._slot_reserved[i] = worst
+                self._queue.pop(0)
+                pages = [self._free_pages.pop()
+                         for _ in range(blocks_needed)]
+                self._slot_pages[i] = pages
+                row = np.full((self.max_blocks,), self._scratch_page,
+                              np.int32)
+                row[:blocks_needed] = pages
+                self._table[i] = row
+                self.cache, last_logits = self._prefill_paged(
+                    self.params, self.cache, i, prompt,
+                    jnp.asarray(row), len(req.prompt))
+            else:
+                self._queue.pop(0)
+                self.cache, last_logits = self._prefill(
+                    self.params, self.cache, i, prompt,
+                    len(req.prompt))
             self._key, sample_key = jax.random.split(self._key)
             first = inf._sample(
                 last_logits[None].astype(jnp.float32), sample_key,

@@ -58,6 +58,11 @@ class TransformerConfig:
     # stochastic rounding, int32 MXU accumulation (2x the bf16 rate on
     # v5e), full-precision QAT backward. Opt-in — changes numerics.
     quantize_matmuls: bool = False
+    # Paged KV cache for decode (vLLM-style): slots hold page-index
+    # block tables into a shared page pool instead of reserving
+    # max_decode_len rows each. None = dense cache.
+    kv_page_size: Optional[int] = None
+    kv_num_pages: int = 0
 
 
 def rotary_embedding(x, positions, theta: float):
@@ -114,9 +119,10 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
+            attend = (self._decode_attend_paged
+                      if cfg.kv_page_size else self._decode_attend)
             return dense(cfg.d_model, "o_proj")(
-                self._decode_attend(q, k, v).reshape(
-                    batch, seq, features))
+                attend(q, k, v).reshape(batch, seq, features))
         attention_fn = cfg.attention_fn or (
             lambda q_, k_, v_, causal: attn_ops.attention(
                 q_, k_, v_, causal=causal))
@@ -162,6 +168,62 @@ class Attention(nn.Module):
             "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cache_v.value,
             preferred_element_type=jnp.float32)
         return out.astype(cfg.dtype)
+
+    def _decode_attend_paged(self, q, k, v):
+        """Paged decode attention (vLLM-style block tables): K/V live
+        in a SHARED page pool [P, page, H, D]; each slot owns a row of
+        page indices (block_table) covering only its actual length —
+        the memory win over the dense cache is that the pool is sized
+        for aggregate live tokens, not num_slots * max_decode_len.
+
+        block_table/length are duplicated per layer (tiny int arrays)
+        so everything stays inside the flax cache collection; the
+        serving engine's page allocator mutates every layer's copy
+        identically (models/serving.py).
+        """
+        cfg = self.config
+        batch, seq, heads, depth = q.shape
+        assert seq == 1, "decode mode consumes one token per call"
+        page = cfg.kv_page_size
+        max_blocks = (cfg.max_decode_len + page - 1) // page
+        k_pages = self.variable(
+            "cache", "k_pages", jnp.zeros,
+            (cfg.kv_num_pages, page, heads, depth), cfg.dtype)
+        v_pages = self.variable(
+            "cache", "v_pages", jnp.zeros,
+            (cfg.kv_num_pages, page, heads, depth), cfg.dtype)
+        block_table = self.variable(
+            "cache", "block_table",
+            lambda: jnp.zeros((batch, max_blocks), jnp.int32))
+        length = self.variable(
+            "cache", "length", lambda: jnp.zeros((batch,), jnp.int32))
+        idx = length.value                       # [B]
+        rows = jnp.arange(batch)
+        page_idx = jnp.take_along_axis(
+            block_table.value, (idx // page)[:, None], axis=1)[:, 0]
+        offset = idx % page
+        k_pages.value = k_pages.value.at[page_idx, offset].set(
+            k[:, 0].astype(cfg.dtype))
+        v_pages.value = v_pages.value.at[page_idx, offset].set(
+            v[:, 0].astype(cfg.dtype))
+        length.value = idx + 1
+        # Gather each slot's pages into its logical [L_max, H, D] view.
+        k_all = k_pages.value[block_table.value].reshape(
+            batch, max_blocks * page, heads, depth)
+        v_all = v_pages.value[block_table.value].reshape(
+            batch, max_blocks * page, heads, depth)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(depth))
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (max_blocks * page, 1), 0)[:, 0]
+        mask = key_pos[None, :] <= idx[:, None]
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
+                         v_all, preferred_element_type=jnp.float32)
+        return out.astype(cfg.dtype)
+
 
 
 class QuantDense(nn.Module):

@@ -231,3 +231,53 @@ def test_monitoring_bundle_with_lets_encrypt(tmp_path):
     assert "mon.example.com" in compose and "--staging" in compose
     nginx = open(os.path.join(out, "nginx.conf")).read()
     assert "mon.example.com" in nginx and "443 ssl" in nginx
+
+
+def test_service_account_activation(tmp_path, monkeypatch):
+    """utils/auth: key file -> ADC env + one-time gcloud activation;
+    impersonation args only when email configured without a key
+    (reference aad.py token machinery analog)."""
+    from batch_shipyard_tpu.config.settings import (
+        GcpCredentialsSettings)
+    from batch_shipyard_tpu.utils import auth
+
+    key = tmp_path / "sa.json"
+    key.write_text("{}")
+    calls = []
+
+    def runner(argv, **_kw):
+        calls.append(list(argv))
+        return 0, "tok-abc\n", ""
+
+    monkeypatch.delenv("GOOGLE_APPLICATION_CREDENTIALS",
+                       raising=False)
+    auth._activated.clear()
+    gcp = GcpCredentialsSettings(
+        project="p", zone=None,
+        service_account_key_file=str(key),
+        service_account_email=None)
+    assert auth.ensure_service_account(gcp, runner=runner) is True
+    assert os.environ["GOOGLE_APPLICATION_CREDENTIALS"] == str(key)
+    assert calls == [["gcloud", "auth", "activate-service-account",
+                      f"--key-file={key}"]]
+    # Idempotent: second call does not re-activate.
+    assert auth.ensure_service_account(gcp, runner=runner) is True
+    assert len(calls) == 1
+    # No key file -> ambient credentials, nothing run.
+    assert auth.ensure_service_account(None, runner=runner) is False
+    # Impersonation args: email without key only.
+    imp = GcpCredentialsSettings(
+        project="p", zone=None, service_account_key_file=None,
+        service_account_email="svc@p.iam.gserviceaccount.com")
+    assert auth.gcloud_impersonation_args(imp) == [
+        "--impersonate-service-account="
+        "svc@p.iam.gserviceaccount.com"]
+    assert auth.gcloud_impersonation_args(gcp) == []
+    assert auth.access_token(runner=runner) == "tok-abc"
+    # Missing key file is a hard error.
+    bad = GcpCredentialsSettings(
+        project="p", zone=None,
+        service_account_key_file=str(tmp_path / "nope.json"),
+        service_account_email=None)
+    with pytest.raises(FileNotFoundError):
+        auth.ensure_service_account(bad, runner=runner)
