@@ -298,14 +298,20 @@ def _submit_auto_pool_job(ctx: Context, job) -> dict:
     conf["pool_specification"]["id"] = auto_id
     auto_pool = settings_mod.pool_settings(conf)
     substrate = ctx.substrate(auto_pool)
+    create_exc: Optional[BaseException] = None
     try:
         pool_mgr.create_pool(ctx.store, substrate, auto_pool,
                              ctx.global_settings, conf)
+    except BaseException as exc:
+        create_exc = exc
+        raise
     finally:
         # Mark even on a failed/timed-out create (the record is
         # inserted before allocation): a half-created auto pool must
         # stay reapable, never a leaked allocation. The bookkeeping
-        # itself must not mask an in-flight create_pool exception.
+        # must not mask an in-flight create_pool exception — but on
+        # the success path a marking failure MUST surface (an
+        # unmarked pool would silently leak).
         try:
             if pool_mgr.pool_exists(ctx.store, auto_id):
                 ctx.store.merge_entity(names.TABLE_POOLS, "pools",
@@ -318,6 +324,8 @@ def _submit_auto_pool_job(ctx: Context, job) -> dict:
         except Exception:  # noqa: BLE001
             logger.exception(
                 "failed to mark auto pool %s reapable", auto_id)
+            if create_exc is None:
+                raise
     if not job.auto_complete:
         # The pool's lifetime is the job's: the job must be able to
         # reach a completed state on its own.

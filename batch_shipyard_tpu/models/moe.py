@@ -143,6 +143,10 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         """x: [B, T, D] -> ([B, T, D], aux_loss)."""
         cfg = self.config
+        if cfg.routing not in ("tokens", "expert_choice"):
+            raise ValueError(
+                f"unknown MoE routing {cfg.routing!r} "
+                f"(expected 'tokens' or 'expert_choice')")
         batch, t_len, d_model = x.shape
         groups = batch * t_len
         capacity = max(1, int(cfg.capacity_factor * groups /

@@ -47,8 +47,10 @@ def ensure_service_account(gcp, runner=None) -> bool:
         raise FileNotFoundError(
             f"service_account_key_file does not exist: {key_file}")
     with _lock:
-        os.environ.setdefault("GOOGLE_APPLICATION_CREDENTIALS",
-                              key_file)
+        # Plain assignment: ADC and gcloud must agree on the identity
+        # (a leftover GOOGLE_APPLICATION_CREDENTIALS, or a second key
+        # file in the same process, would otherwise split them).
+        os.environ["GOOGLE_APPLICATION_CREDENTIALS"] = key_file
         if key_file in _activated:
             return True
         run = runner or util.subprocess_capture
