@@ -744,6 +744,42 @@ def fed_jobs_zap(click_ctx, federation_id, action_id):
     fed_mod.zap_action(_ctx(click_ctx).store, federation_id, action_id)
 
 
+@fed.command("create-vm")
+@click.argument("federation_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.option("--replicas", type=int, default=1,
+              help="Proxy replicas (store lease elects the active)")
+@click.option("--package-source", default="batch-shipyard-tpu")
+@click.pass_context
+def fed_create_vm(click_ctx, federation_id, project, zone, replicas,
+                  package_source):
+    """Provision federation proxy VM(s) running the processor."""
+    import yaml as _yaml
+
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    ctx = _ctx(click_ctx)
+    store_config = _yaml.safe_dump(ctx.configs.get("credentials", {}))
+    for replica in range(replicas):
+        ip = fed_prov.provision_proxy_vm(
+            ctx.store, federation_id, project, zone=zone,
+            replica=replica, package_source=package_source,
+            store_config_yaml=store_config)
+        click.echo(f"proxy{replica}: {ip}")
+
+
+@fed.command("destroy-vm")
+@click.argument("federation_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def fed_destroy_vm(click_ctx, federation_id, project, zone):
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    count = fed_prov.destroy_proxy_vms(
+        _ctx(click_ctx).store, federation_id, project, zone=zone)
+    click.echo(f"destroyed {count} proxy VM(s)")
+
+
 @fed.command("proxy")
 @click.option("--poll-interval", type=float, default=1.0)
 @click.pass_context

@@ -1,0 +1,126 @@
+"""Federation proxy VM provisioning.
+
+Reference analog: convoy/federation.py (provisions the federation
+proxy VM running the docker-composed federation daemon) +
+scripts/shipyard_federation_bootstrap.sh. Ours provisions a GCE VM
+(substrate/gce_vm.py) whose startup script installs the framework +
+store credentials and runs `shipyard-tpu fed proxy` under systemd —
+the HA story is N replicas of this VM (the processor's store lease
+elects the active one).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import NotFoundError, StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_SYSTEMD_UNIT = """\
+[Unit]
+Description=batch-shipyard-tpu federation processor
+After=network-online.target
+
+[Service]
+ExecStart=/usr/bin/python3 -m batch_shipyard_tpu.cli.main \\
+  --configdir {configdir} fed proxy
+Restart=always
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+
+def generate_proxy_bootstrap(
+        federation_id: str,
+        configdir: str = "/opt/shipyard/config",
+        package_source: str = "batch-shipyard-tpu",
+        store_config_yaml: Optional[str] = None) -> str:
+    """First-boot script for the proxy VM (the
+    shipyard_federation_bootstrap.sh role)."""
+    from batch_shipyard_tpu.slurm.provision import (
+        _framework_install_script)
+    framework = _framework_install_script(package_source, configdir,
+                                          store_config_yaml)
+    unit = _SYSTEMD_UNIT.format(configdir=configdir)
+    return f"""#!/usr/bin/env bash
+set -euo pipefail
+# batch-shipyard-tpu federation proxy bootstrap ({federation_id})
+apt-get update
+apt-get install -y python3-pip
+mkdir -p /opt/shipyard
+{framework}
+cat > /etc/systemd/system/shipyard-fed-proxy.service <<'SHIPYARD_EOF'
+{unit}SHIPYARD_EOF
+systemctl daemon-reload
+systemctl enable --now shipyard-fed-proxy.service
+"""
+
+
+def provision_proxy_vm(store: StateStore, federation_id: str,
+                       project: str, zone: Optional[str] = None,
+                       network: Optional[str] = None,
+                       vm_size: str = "e2-standard-2",
+                       replica: int = 0,
+                       package_source: str = "batch-shipyard-tpu",
+                       store_config_yaml: Optional[str] = None,
+                       vms=None) -> str:
+    """Create a proxy VM replica; returns its internal IP. Run more
+    than one replica for HA — the store lease serializes them."""
+    from batch_shipyard_tpu.federation.federation import get_federation
+    get_federation(store, federation_id)  # raises on unknown id
+    if vms is None:
+        from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+        vms = GceVmManager(project, zone=zone, network=network)
+    name = f"shipyard-fed-{federation_id}-proxy{replica}"
+    ip = vms.create_vm(
+        name, vm_size,
+        startup_script=generate_proxy_bootstrap(
+            federation_id, package_source=package_source,
+            store_config_yaml=store_config_yaml),
+        tags=("shipyard-federation",))
+    store.upsert_entity(names.TABLE_FEDERATIONS, "proxies",
+                        name, {
+        "federation_id": federation_id, "internal_ip": ip,
+        "state": "running",
+        "created_at": util.datetime_utcnow_iso(),
+    })
+    logger.info("federation proxy %s provisioned at %s", name, ip)
+    return ip
+
+
+def destroy_proxy_vms(store: StateStore, federation_id: str,
+                      project: str, zone: Optional[str] = None,
+                      vms=None) -> int:
+    """Delete every registered proxy replica for a federation."""
+    if vms is None:
+        from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+        vms = GceVmManager(project, zone=zone)
+    count = 0
+    for row in list(store.query_entities(names.TABLE_FEDERATIONS,
+                                         partition_key="proxies")):
+        if row.get("federation_id") != federation_id:
+            continue
+        try:
+            vms.delete_vm(row["_rk"])
+        except Exception as exc:  # noqa: BLE001
+            if "not found" in str(exc).lower():
+                # Deleted out-of-band: the record is stale, clear it.
+                logger.info("proxy VM %s already gone", row["_rk"])
+            else:
+                # Keep the record (so a retry can find it) and keep
+                # going — one bad replica must not block the rest.
+                logger.exception("failed to delete proxy VM %s",
+                                 row["_rk"])
+                continue
+        try:
+            store.delete_entity(names.TABLE_FEDERATIONS, "proxies",
+                                row["_rk"])
+        except NotFoundError:
+            pass
+        count += 1
+    return count

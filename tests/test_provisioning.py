@@ -319,3 +319,42 @@ def test_slurm_resume_join_suspend_e2e():
         assert burst.host_assignments(store, "c1", "part") == {}
     finally:
         substrate.stop_all()
+
+
+# ----------------------------- federation ------------------------------
+
+
+def test_federation_proxy_vm_lifecycle():
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    from batch_shipyard_tpu.state import names
+
+    from batch_shipyard_tpu.federation import federation as fed_mod
+
+    store = MemoryStateStore()
+    runner = FakeRunner()
+    mgr = GceVmManager("proj", runner=runner)
+    with pytest.raises(ValueError):
+        fed_prov.provision_proxy_vm(store, "nope", "proj", vms=mgr)
+    fed_mod.create_federation(store, "fedA")
+    ip = fed_prov.provision_proxy_vm(
+        store, "fedA", "proj", vms=mgr, replica=0,
+        store_config_yaml="credentials:\n  storage: {backend: gcs}\n")
+    assert ip == "10.0.0.5"
+    script = runner.startup_scripts[0]
+    assert "fed proxy" in script
+    assert "shipyard-fed-proxy.service" in script
+    assert "pip3 install" in script and "credentials.yaml" in script
+    rec = store.get_entity(names.TABLE_FEDERATIONS, "proxies",
+                           "shipyard-fed-fedA-proxy0")
+    assert rec["federation_id"] == "fedA"
+    fed_prov.provision_proxy_vm(store, "fedA", "proj", vms=mgr,
+                                replica=1)
+    # One replica's VM failing to delete must not block the other or
+    # wedge retries: 'not found' clears the stale record.
+    runner.fail_next = "resource not found"
+    assert fed_prov.destroy_proxy_vms(store, "fedA", "proj",
+                                      vms=mgr) == 2
+    assert runner.verbs().count("instances:delete") == 2
+    from batch_shipyard_tpu.state import names as _n
+    assert not list(store.query_entities(_n.TABLE_FEDERATIONS,
+                                         partition_key="proxies"))
