@@ -21,6 +21,7 @@ sharding-agnostic, which is what lets XLA insert the collectives.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -63,6 +64,14 @@ class TransformerConfig:
     # max_decode_len rows each. None = dense cache.
     kv_page_size: Optional[int] = None
     kv_num_pages: int = 0
+    # Megatron-style tensor parallelism INSIDE a shard_map body (the
+    # pipeline path): q/k/v/gate/up are column-sharded and
+    # o_proj/down_proj row-sharded over this mesh axis, with explicit
+    # psums after the row-sharded matmuls. The module then sees LOCAL
+    # head/ff counts (configure n_heads/d_ff divided by tp). The
+    # global-view jit path leaves this None — there XLA inserts the
+    # collectives from parameter shardings.
+    tp_axis: Optional[str] = None
 
 
 def rotary_embedding(x, positions, theta: float):
@@ -87,6 +96,53 @@ def rotary_embedding(x, positions, theta: float):
     return rotated.astype(x.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_input(x, axis_name: str):
+    """Megatron's "f" operator: identity forward, psum backward.
+
+    Placed where a REPLICATED activation enters a tensor-parallel
+    region (column-sharded matmuls): each tp member's backward
+    produces only its shard's partial cotangent, and this is the
+    point where those partials sum. Explicit custom_vjp — psum's AD
+    transpose under shard_map is exactly the thing one should not
+    lean on.
+    """
+    return x
+
+
+def _tpi_fwd(x, axis_name):
+    return x, None
+
+
+def _tpi_bwd(axis_name, _res, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+tp_region_input.defvjp(_tpi_fwd, _tpi_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_output(x, axis_name: str):
+    """Megatron's "g" operator: psum forward, identity backward.
+
+    Placed where a tensor-parallel region's row-sharded partial sums
+    leave it: forward reduces the partials; backward passes the
+    (replicated) cotangent straight through to every member.
+    """
+    return jax.lax.psum(x, axis_name)
+
+
+def _tpo_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _tpo_bwd(axis_name, _res, g):
+    return (g,)
+
+
+tp_region_output.defvjp(_tpo_fwd, _tpo_bwd)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
     dtype: Any = jnp.bfloat16
@@ -109,6 +165,8 @@ class Attention(nn.Module):
         cfg = self.config
         features = cfg.n_heads * cfg.d_head
         dense = functools_partial_dense(cfg)
+        if cfg.tp_axis:
+            x = tp_region_input(x, cfg.tp_axis)
         q = dense(features, "q_proj")(x)
         k = dense(features, "k_proj")(x)
         v = dense(features, "v_proj")(x)
@@ -119,6 +177,11 @@ class Attention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
         if cfg.decode:
+            if cfg.tp_axis:
+                raise NotImplementedError(
+                    "tp_axis is a training-path (shard_map pipeline) "
+                    "feature; the decode path would return "
+                    "un-reduced o_proj partial sums")
             attend = (self._decode_attend_paged
                       if cfg.kv_page_size else self._decode_attend)
             return dense(cfg.d_model, "o_proj")(
@@ -128,7 +191,11 @@ class Attention(nn.Module):
                 q_, k_, v_, causal=causal))
         out = attention_fn(q, k, v, causal=True)
         out = out.reshape(batch, seq, features)
-        return dense(cfg.d_model, "o_proj")(out)
+        out = dense(cfg.d_model, "o_proj")(out)
+        if cfg.tp_axis:
+            # Row-sharded o_proj: each tp member holds a partial sum.
+            out = tp_region_output(out, cfg.tp_axis)
+        return out
 
     def _decode_attend(self, q, k, v):
         """Single-step decode: insert this step's K/V into the cache
@@ -269,9 +336,15 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = functools_partial_dense(cfg)
+        if cfg.tp_axis:
+            x = tp_region_input(x, cfg.tp_axis)
         gate = dense(cfg.d_ff, "gate_proj")(x)
         up = dense(cfg.d_ff, "up_proj")(x)
-        return dense(cfg.d_model, "down_proj")(nn.silu(gate) * up)
+        out = dense(cfg.d_model, "down_proj")(nn.silu(gate) * up)
+        if cfg.tp_axis:
+            # Row-sharded down_proj: partial sums across tp members.
+            out = tp_region_output(out, cfg.tp_axis)
+        return out
 
 
 class Block(nn.Module):

@@ -229,12 +229,26 @@ def build_transformer_train_1f1b(
 
     from batch_shipyard_tpu.parallel import pipeline as pipe
     num_stages = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
     if config.n_layers % num_stages:
         raise ValueError(
             f"n_layers {config.n_layers} not divisible by pp "
             f"{num_stages}")
+    if tp > 1 and (config.n_heads % tp or config.d_ff % tp):
+        raise ValueError(
+            f"n_heads {config.n_heads} and d_ff {config.d_ff} must "
+            f"both be divisible by tp {tp}")
     layers_per_stage = config.n_layers // num_stages
+    # Params are initialized at GLOBAL shapes; inside the pipeline's
+    # shard_map each tp member sees its column/row shard, so the
+    # APPLY-side block uses local head/ff counts and owns the Megatron
+    # psums (TransformerConfig.tp_axis).
     block = tfm.Block(config)
+    apply_block = block
+    if tp > 1:
+        apply_block = tfm.Block(dataclasses.replace(
+            config, n_heads=config.n_heads // tp,
+            d_ff=config.d_ff // tp, tp_axis="tp"))
     embed = nn.Embed(config.vocab_size, config.d_model,
                      dtype=config.dtype, param_dtype=config.param_dtype)
     norm = tfm.RMSNorm(dtype=config.dtype)
@@ -259,7 +273,8 @@ def build_transformer_train_1f1b(
 
     def stage_fn(stage_p, x):
         def layer_step(h, layer_p):
-            return block.apply({"params": layer_p}, h, positions), None
+            return apply_block.apply({"params": layer_p}, h,
+                                     positions), None
         out, _ = jax.lax.scan(layer_step, x, stage_p)
         return out
 
@@ -267,13 +282,30 @@ def build_transformer_train_1f1b(
         h = norm.apply({"params": last_p["final_norm"]}, y)
         return tfm.lm_loss_chunked(h, last_p["embedding"], target)
 
+    def stage_leaf_spec(path, leaf):
+        """pp on the stage dim; Megatron tp on the feature dims:
+        q/k/v/gate/up column-sharded (last dim), o/down row-sharded
+        (second-to-last)."""
+        name = shard_rules._path_str(path)
+        middle = [None] * (leaf.ndim - 2)
+        if tp > 1 and leaf.ndim >= 3:
+            if any(f"{k}/kernel" in name for k in
+                   ("q_proj", "k_proj", "v_proj", "gate_proj",
+                    "up_proj")):
+                return P("pp", *middle[:-1], None, "tp")
+            if any(f"{k}/kernel" in name for k in
+                   ("o_proj", "down_proj")):
+                return P("pp", *middle[:-1], "tp", None)
+        return P("pp", *([None] * (leaf.ndim - 1)))
+
+    stage_specs = jax.tree_util.tree_map_with_path(
+        stage_leaf_spec, params["stages"])
+
     batch_sharding = NamedSharding(mesh, P("dp"))
     param_specs = {
         "embed": jax.tree_util.tree_map(lambda _: P(),
                                         params["embed"]),
-        "stages": jax.tree_util.tree_map(
-            lambda p: P("pp", *([None] * (p.ndim - 1))),
-            params["stages"]),
+        "stages": stage_specs,
         "final_norm": jax.tree_util.tree_map(
             lambda _: P(), params["final_norm"]),
     }
@@ -290,7 +322,8 @@ def build_transformer_train_1f1b(
         loss, dstages, dlast, dh0 = pipe.pipeline_1f1b_train(
             params["stages"], h0, targets, last_params, mesh=mesh,
             stage_fn=stage_fn, last_fn=last_fn,
-            num_microbatches=num_microbatches, batch_axes=("dp",))
+            num_microbatches=num_microbatches, batch_axes=("dp",),
+            stage_param_specs=stage_specs)
         (dembed,) = embed_vjp(dh0.astype(h0.dtype))
         dembed = {"embedding": dembed["embedding"] +
                   dlast["embedding"].astype(
