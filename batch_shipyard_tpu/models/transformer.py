@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from batch_shipyard_tpu.ops import attention as attn_ops
+from batch_shipyard_tpu.ops import paged_attention as paged_ops
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +65,11 @@ class TransformerConfig:
     # max_decode_len rows each. None = dense cache.
     kv_page_size: Optional[int] = None
     kv_num_pages: int = 0
+    # Paged decode attention implementation: 'kernel' (Pallas, reads
+    # only live pages via scalar-prefetched block tables), 'xla'
+    # (gather over the full table width), or None = kernel on TPU and
+    # xla elsewhere (ops/paged_attention.py dispatch).
+    paged_attention_impl: Optional[str] = None
     # Megatron-style tensor parallelism INSIDE a shard_map body (the
     # pipeline path): q/k/v/gate/up are column-sharded and
     # o_proj/down_proj row-sharded over this mesh axis, with explicit
@@ -274,22 +280,10 @@ class Attention(nn.Module):
         v_pages.value = v_pages.value.at[page_idx, offset].set(
             v[:, 0].astype(cfg.dtype))
         length.value = idx + 1
-        # Gather each slot's pages into its logical [L_max, H, D] view.
-        k_all = k_pages.value[block_table.value].reshape(
-            batch, max_blocks * page, heads, depth)
-        v_all = v_pages.value[block_table.value].reshape(
-            batch, max_blocks * page, heads, depth)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(depth))
-        key_pos = jax.lax.broadcasted_iota(
-            jnp.int32, (max_blocks * page, 1), 0)[:, 0]
-        mask = key_pos[None, :] <= idx[:, None]
-        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
-                         v_all, preferred_element_type=jnp.float32)
-        return out.astype(cfg.dtype)
+        return paged_ops.paged_decode_attention(
+            q, k_pages.value, v_pages.value, block_table.value,
+            length.value, impl=cfg.paged_attention_impl).astype(
+                cfg.dtype)
 
 
 

@@ -1,0 +1,180 @@
+"""Pallas paged-attention decode kernel (vLLM-style block tables).
+
+The XLA formulation of paged decode attention
+(models/transformer.py:_decode_attend_paged) gathers every slot's
+pages into a dense [B, max_blocks*page, H, D] view before the score
+matmul — it reads the full logical table width from HBM every step,
+even for slots holding ten tokens. Decode attention is HBM-bandwidth
+bound, so that gather IS the step time.
+
+This kernel reads only real pages: the block table rides Pallas scalar
+prefetch (pltpu.PrefetchScalarGridSpec), the k/v page BlockSpec index
+maps translate grid step j into the slot's j-th physical page id, and
+Mosaic DMAs exactly that page into VMEM. Pages past a slot's live
+length are skipped (the index map clamps to the slot's last live page
+so the prefetched DMA never fetches garbage, and @pl.when skips the
+compute). Online softmax accumulates across the (sequential) page grid
+dimension in VMEM scratch — the flash-attention recurrence over the
+page list.
+
+Reference analog: none — the reference (Azure batch-shipyard) has no
+serving runtime; this is net-new TPU compute-path work alongside
+ops/attention.py. The block-table design follows the public
+vLLM/PagedAttention scheme (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
+                         o_ref, o_acc, m_acc, l_acc, *,
+                         page: int, scale: float):
+    """One (slot, head, page-step) program.
+
+    q_ref: [1, D] this slot+head's query row.
+    k_ref/v_ref: [page, D] the physical page selected by the BlockSpec
+    index map (table_ref[b, j]).
+    Scratch persists across the sequential page dimension: o_acc [1, D]
+    fp32 numerator, m_acc/l_acc [1, 1] running max / denominator.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_blocks = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    @pl.when(j * page < length)
+    def _accumulate():
+        k_tile = k_ref[...]
+        v_tile = v_ref[...]
+        scores = jax.lax.dot_general(
+            q_ref[...], k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [1, page]
+        pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)
+        scores = jnp.where(pos < length, scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=-1, keepdims=True)   # [1, 1]
+        m_new = jnp.maximum(m_acc[...], m_blk)
+        correction = jnp.exp(m_acc[...] - m_new)
+        p = jnp.exp(scores - m_new)                        # [1, page]
+        l_new = (l_acc[...] * correction +
+                 jnp.sum(p, axis=-1, keepdims=True))
+        pv = jax.lax.dot_general(
+            p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [1, D]
+        o_acc[...] = o_acc[...] * correction + pv
+        m_acc[...] = m_new
+        l_acc[...] = l_new
+
+    @pl.when(j == num_blocks - 1)
+    def _emit():
+        l_final = l_acc[...]
+        denom = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[...] = (o_acc[...] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
+                                  lengths):
+    """Pallas path. q: [B, 1, H, D]; k_pages/v_pages:
+    [P, page, H, D]; block_table: [B, max_blocks] int32; lengths: [B]
+    int32 valid-key counts (INCLUDING the token written this step, so
+    every attended slot has length >= 1 — a length-0 slot yields zeros
+    here but softmax-of-all-masked garbage from the XLA path; the
+    decode contract never attends an unwritten slot).
+    Returns [B, 1, H, D] in q.dtype."""
+    batch, seq, heads, depth = q.shape
+    assert seq == 1, "decode consumes one token per call"
+    _pages, page, _heads, _depth = k_pages.shape
+    max_blocks = block_table.shape[1]
+    scale = 1.0 / (depth ** 0.5)
+    q_r = q.reshape(batch, heads, 1, depth)
+
+    def page_index(b, h, j, tbl, ln):
+        # Clamp dead steps to the slot's LAST live page: the prefetch
+        # pipeline fetches block j+1 while computing block j, and an
+        # unclamped map would DMA whatever stale id sits in the dead
+        # tail of the table row. Page 0 fallback covers length == 0.
+        live = jnp.maximum((ln[b] + page - 1) // page - 1, 0)
+        return (tbl[b, jnp.minimum(j, live)], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, heads, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, depth),
+                         lambda b, h, j, tbl, ln: (b, h, 0, 0)),
+            pl.BlockSpec((None, page, None, depth), page_index),
+            pl.BlockSpec((None, page, None, depth), page_index),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, depth),
+                               lambda b, h, j, tbl, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, depth), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=page, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, heads, 1, depth),
+                                       q.dtype),
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q_r, k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
+
+
+def paged_decode_attention_xla(q, k_pages, v_pages, block_table,
+                               lengths):
+    """XLA gather formulation (the CPU/fallback path): materialize each
+    slot's full logical [max_blocks*page, H, D] view, then one masked
+    softmax. Same math as the kernel; reads the whole table width."""
+    batch, seq, heads, depth = q.shape
+    assert seq == 1
+    page = k_pages.shape[1]
+    max_blocks = block_table.shape[1]
+    k_all = k_pages[block_table].reshape(
+        batch, max_blocks * page, heads, depth)
+    v_all = v_pages[block_table].reshape(
+        batch, max_blocks * page, heads, depth)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(depth))
+    key_pos = jax.lax.broadcasted_iota(
+        jnp.int32, (max_blocks * page, 1), 0)[:, 0]
+    mask = key_pos[None, :] < lengths[:, None]
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, lengths,
+                           impl: Optional[str] = None):
+    """Dispatch: 'kernel' (Pallas) or 'xla'. Default: kernel on TPU,
+    xla elsewhere (mirrors ops/attention.attention's dispatch)."""
+    if impl is None:
+        impl = "kernel" if jax.default_backend() == "tpu" else "xla"
+    if impl == "kernel":
+        return paged_decode_attention_kernel(
+            q, k_pages, v_pages, block_table, lengths)
+    if impl == "xla":
+        return paged_decode_attention_xla(
+            q, k_pages, v_pages, block_table, lengths)
+    raise ValueError(f"unknown paged attention impl {impl!r}")
