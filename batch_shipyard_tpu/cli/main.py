@@ -961,6 +961,23 @@ def fs_cluster():
     """Storage cluster lifecycle."""
 
 
+@fs.group("bucket")
+def fs_bucket():
+    """Serverless GCS-FUSE shared storage (fs.yaml gcs_buckets)."""
+
+
+@fs_bucket.command("mount-args")
+@click.argument("name")
+@click.pass_context
+def fs_bucket_mount_args(click_ctx, name):
+    """Render the nodeprep mount command for a configured bucket."""
+    from batch_shipyard_tpu.remotefs import manager as remotefs
+    ctx = _ctx(click_ctx)
+    for line in remotefs.gcs_bucket_mount_commands(
+            ctx.configs.get("fs", {}), name):
+        click.echo(line)
+
+
 @fs_cluster.command("add")
 @click.argument("cluster_id")
 @click.option("--disk-count", type=int, default=2)

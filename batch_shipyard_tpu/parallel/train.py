@@ -411,3 +411,119 @@ def build_resnet_train(mesh: Mesh,
     return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
                         step=step_wrapper,
                         batch_sharding=batch_sharding)
+
+
+def build_vit_train(mesh: Mesh, config=None, batch_size: int = 256,
+                    learning_rate: float = 1e-3,
+                    seed: int = 0) -> TrainHarness:
+    """ViT image-classification training: data parallel over the batch
+    axes with the transformer tp rules applied to the encoder blocks
+    (q/k/v/up column-sharded, o/down row-sharded — the param names
+    match parallel/sharding's rules by construction)."""
+    from batch_shipyard_tpu.models import vit as vit_mod
+    config = config or vit_mod.ViTConfig()
+    model = vit_mod.ViT(config)
+    optimizer = optax.adamw(learning_rate, weight_decay=0.05)
+    data_spec = P(("dp", "fsdp", "sp"))
+    batch_sharding = NamedSharding(mesh, data_spec)
+
+    def init_fn(rng):
+        images = jnp.zeros(
+            (batch_size, config.image_size, config.image_size, 3),
+            dtype=jnp.float32)
+        return model.init(rng, images)["params"]
+
+    rng = jax.random.PRNGKey(seed)
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = shard_rules.to_shardings(
+        mesh, shard_rules.transformer_param_specs(abstract))
+    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, images, labels):
+        logits = model.apply({"params": params}, images)
+        return vit_mod.cross_entropy_loss(logits, labels)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=(shardings, None, batch_sharding, batch_sharding),
+        out_shardings=(shardings, None, None))
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images,
+                                                  labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    def step_wrapper(params, opt_state, batch):
+        return step(params, opt_state, batch["images"],
+                    batch["labels"])
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)
+
+
+def build_diffusion_train(mesh: Mesh, config=None,
+                          batch_size: int = 256,
+                          learning_rate: float = 1e-4,
+                          seed: int = 0) -> TrainHarness:
+    """DiT denoising-diffusion training. The per-step (t, noise) draws
+    come from a PRNG key folded with the step counter inside the jit —
+    host code never touches randomness, so the step stays one compiled
+    program (batch: {"images": [B,H,W,C] in [-1,1], optional
+    "labels": [B]})."""
+    from batch_shipyard_tpu.models import diffusion as dif_mod
+    config = config or dif_mod.DiTConfig()
+    model = dif_mod.DiT(config)
+    optimizer = optax.adamw(learning_rate, weight_decay=0.0)
+    data_spec = P(("dp", "fsdp", "sp"))
+    batch_sharding = NamedSharding(mesh, data_spec)
+    labeled = config.num_classes is not None
+
+    def init_fn(rng):
+        x = jnp.zeros((batch_size, config.image_size,
+                       config.image_size, config.channels),
+                      jnp.float32)
+        t = jnp.zeros((batch_size,), jnp.int32)
+        labels = (jnp.zeros((batch_size,), jnp.int32) if labeled
+                  else None)
+        return model.init(rng, x, t, labels)["params"]
+
+    rng = jax.random.PRNGKey(seed)
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = shard_rules.to_shardings(
+        mesh, shard_rules.transformer_param_specs(abstract))
+    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    opt_state = optimizer.init(params)
+    base_key = jax.random.PRNGKey(seed + 1)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=(shardings, None, batch_sharding,
+                      None if not labeled else batch_sharding, None),
+        out_shardings=(shardings, None, None))
+    def step(params, opt_state, images, labels, step_idx):
+        key = jax.random.fold_in(base_key, step_idx)
+
+        def loss_fn(params):
+            return dif_mod.diffusion_loss(model, params, images, key,
+                                          labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    counter = {"step": 0}
+
+    def step_wrapper(params, opt_state, batch):
+        params, opt_state, metrics = step(
+            params, opt_state, batch["images"], batch.get("labels"),
+            counter["step"])
+        counter["step"] += 1
+        return params, opt_state, metrics
+
+    return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
+                        step=step_wrapper,
+                        batch_sharding=batch_sharding)

@@ -133,6 +133,34 @@ def gcsfuse_mount_args(bucket: str,
             f"rw,_netdev,allow_other,implicit_dirs 0 0"]
 
 
+def gcs_bucket_mount_commands(fs_config: dict, name: str) -> list[str]:
+    """Render the nodeprep mount command for a gcs_buckets entry in
+    fs.yaml (the RemoteFS-GCSFuse+Pool recipe's `fs bucket mount-args`
+    surface): mkdir + gcsfuse with the configured options."""
+    buckets = (fs_config.get("remote_fs") or {}).get(
+        "gcs_buckets") or {}
+    if name not in buckets:
+        raise KeyError(
+            f"gcs bucket {name!r} not in fs.yaml (have: "
+            f"{sorted(buckets)})")
+    entry = buckets[name] or {}
+    bucket = entry.get("bucket") or name
+    mount_point = entry.get("mount_point", f"/mnt/{name}")
+    opts = []
+    for opt in entry.get("mount_options") or []:
+        # Flag-style options (implicit-dirs) pass as --flags;
+        # key=value pairs ride -o.
+        if "=" in str(opt):
+            opts.append(f"-o {opt}")
+        else:
+            opts.append(f"--{opt}")
+    opt_str = (" ".join(opts) + " ") if opts else ""
+    return [
+        f"mkdir -p {mount_point} && "
+        f"gcsfuse {opt_str}{bucket} {mount_point}",
+    ]
+
+
 def _vm_name(cluster_id: str) -> str:
     return f"shipyard-fs-{cluster_id}"
 

@@ -1,0 +1,82 @@
+"""Alternate-frontend payload: dm-haiku classifier training (the
+Chainer/Keras+Theano recipe analog,
+/root/reference/recipes/Chainer-CPU — those recipes exist to show the
+scheduler is framework-agnostic; this one shows any JAX frontend runs
+unchanged in the task runner, not just flax).
+
+Usage (recipe command):
+    python -m batch_shipyard_tpu.workloads.haiku_mlp --steps 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import haiku as hk
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from batch_shipyard_tpu.workloads import distributed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--features", type=int, default=256)
+    parser.add_argument("--classes", type=int, default=16)
+    parser.add_argument("--hidden", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=200)
+    args = parser.parse_args()
+    ctx = distributed.setup()
+
+    def forward(x):
+        mlp = hk.Sequential([
+            hk.Linear(args.hidden), jax.nn.relu,
+            hk.Linear(args.hidden), jax.nn.relu,
+            hk.Linear(args.classes),
+        ])
+        return mlp(x)
+
+    model = hk.without_apply_rng(hk.transform(forward))
+    rng = np.random.RandomState(0)
+    # Fixed synthetic classification problem (linearly separable-ish).
+    true_w = rng.randn(args.features, args.classes)
+    x = rng.randn(args.batch, args.features).astype(np.float32)
+    y = np.argmax(x @ true_w + 0.1 * rng.randn(args.batch,
+                                               args.classes), axis=1)
+    x, y = jnp.asarray(x), jnp.asarray(y, jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            onehot = jax.nn.one_hot(y, args.classes)
+            return -jnp.mean(jnp.sum(
+                onehot * jax.nn.log_softmax(logits), axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    start = time.perf_counter()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state)
+    loss = float(loss)
+    elapsed = time.perf_counter() - start
+    logits = model.apply(params, x)
+    acc = float(jnp.mean(jnp.argmax(logits, axis=-1) == y))
+    distributed.log(ctx, (
+        f"haiku_mlp: {args.steps} steps in {elapsed:.1f}s, "
+        f"loss={loss:.4f}, train acc={acc:.3f} "
+        f"{'PASS' if acc > 0.8 else 'FAIL'}"))
+    return 0 if acc > 0.8 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

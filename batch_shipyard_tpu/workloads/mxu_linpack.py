@@ -1,0 +1,92 @@
+"""Dense linear-algebra benchmark: the HPLinpack recipe analog
+(/root/reference/recipes/HPLinpack-Infiniband-IntelMPI — solve a dense
+system, report FLOP/s), restated for the MXU.
+
+Two phases, both on-device:
+  - solve: LU-factorize and solve A x = b at --n (fp32; XLA's blocked
+    LU rides the MXU) and report the classic HPL GFLOP/s figure
+    (2/3 n^3 + 2 n^2) / t, validated by the HPL residual
+    ||Ax-b|| / (||A|| ||x|| n eps);
+  - peak: sustained big-matmul GFLOP/s in bf16 and fp32 (the MXU
+    ceiling the solve is measured against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batch_shipyard_tpu.workloads import distributed
+
+
+def bench_solve(n: int, iters: int) -> dict:
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(n, n), jnp.float32)
+    b = jnp.asarray(rng.randn(n), jnp.float32)
+    solve = jax.jit(jnp.linalg.solve)
+    x = solve(a, b).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        x = solve(a, b)
+    x.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    flops = (2.0 / 3.0) * n ** 3 + 2.0 * n ** 2
+    # HPL-style scaled residual.
+    resid = float(jnp.linalg.norm(a @ x - b) /
+                  (jnp.linalg.norm(a) * jnp.linalg.norm(x) * n *
+                   np.finfo(np.float32).eps))
+    return {"gflops": flops / elapsed / 1e9, "seconds": elapsed,
+            "residual": resid}
+
+
+def bench_peak_matmul(n: int, iters: int, dtype) -> float:
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(n, n), dtype)
+    b = jnp.asarray(rng.randn(n, n), dtype)
+
+    @jax.jit
+    def chain(a, b):
+        # 8 dependent matmuls per call amortize dispatch overhead.
+        out = a
+        for _ in range(8):
+            out = jnp.matmul(out, b,
+                             preferred_element_type=jnp.float32
+                             ).astype(dtype)
+        return out
+
+    chain(a, b).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = chain(a, b)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    return 8 * 2.0 * n ** 3 / elapsed / 1e9
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=8192,
+                        help="solve dimension")
+    parser.add_argument("--peak-n", type=int, default=8192)
+    parser.add_argument("--iters", type=int, default=3)
+    args = parser.parse_args()
+    ctx = distributed.setup()
+    solve = bench_solve(args.n, args.iters)
+    peak_bf16 = bench_peak_matmul(args.peak_n, args.iters,
+                                  jnp.bfloat16)
+    peak_f32 = bench_peak_matmul(args.peak_n, args.iters, jnp.float32)
+    ok = solve["residual"] < 16.0  # HPL acceptance threshold
+    distributed.log(ctx, (
+        f"mxu_linpack: n={args.n} {solve['gflops']:.1f} GFLOP/s "
+        f"(fp32 LU solve, residual={solve['residual']:.3f} "
+        f"{'PASS' if ok else 'FAIL'}), peak matmul "
+        f"{peak_bf16:.0f} GFLOP/s bf16 / {peak_f32:.0f} fp32"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""DiT denoising-diffusion training payload + few-step DDIM sampling
+(the generative-vision workload; the reference runs such jobs only as
+opaque framework containers, /root/reference/recipes/Chainer-GPU).
+
+Usage (recipe command):
+    python -m batch_shipyard_tpu.workloads.train_diffusion \
+        --batch-per-device 64 --steps 50 --sample 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batch_shipyard_tpu.models import diffusion as dif_mod
+from batch_shipyard_tpu.parallel import mesh as mesh_mod
+from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import distributed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-per-device", type=int, default=64)
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--patch-size", type=int, default=4)
+    parser.add_argument("--d-model", type=int, default=512)
+    parser.add_argument("--layers", type=int, default=8)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--num-classes", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--sample", type=int, default=0,
+                        help="generate N DDIM samples at the end")
+    parser.add_argument("--sample-steps", type=int, default=50)
+    args = parser.parse_args()
+
+    ctx = distributed.setup()
+    n_dev = jax.device_count()
+    batch_size = args.batch_per_device * n_dev
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
+    config = dif_mod.DiTConfig(
+        image_size=args.image_size, patch_size=args.patch_size,
+        d_model=args.d_model, n_layers=args.layers,
+        n_heads=args.heads, d_ff=4 * args.d_model,
+        num_classes=args.num_classes, dtype=jnp.bfloat16)
+    harness = train_mod.build_diffusion_train(
+        mesh, config, batch_size=batch_size)
+    from batch_shipyard_tpu.data import loader
+
+    rng = np.random.RandomState(jax.process_index())
+    local_batch = batch_size // jax.process_count()
+    batch = {"images": np.tanh(
+        rng.randn(local_batch, args.image_size, args.image_size,
+                  3)).astype(np.float32)}
+    if args.num_classes:
+        batch["labels"] = rng.randint(
+            0, args.num_classes, (local_batch,)).astype(np.int32)
+    batch = loader.place_global(batch, harness.batch_sharding)
+    params, opt_state = harness.params, harness.opt_state
+    for _ in range(args.warmup):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+        float(metrics["loss"])  # hard sync
+    start = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+    loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    images_per_sec = batch_size * args.steps / elapsed
+    distributed.log(ctx, (
+        f"dit: mesh={dict(mesh.shape)} {images_per_sec:.1f} img/s "
+        f"total, loss={loss:.4f}"))
+    if args.sample and jax.process_count() > 1:
+        # Params span non-addressable devices on a multi-host pod; the
+        # single-process eager sampler below cannot run there (it
+        # would crash on process 0 and deadlock the others).
+        distributed.log(ctx, "ddim sampling skipped on multi-host "
+                             "runs; sample from a restored checkpoint")
+    elif args.sample:
+        model = dif_mod.DiT(config)
+        labels = (jnp.zeros((args.sample,), jnp.int32)
+                  if args.num_classes else None)
+        samples = dif_mod.ddim_sample(
+            model, params, jax.random.PRNGKey(0), args.sample,
+            num_steps=args.sample_steps, labels=labels)
+        arr = np.asarray(samples)
+        distributed.log(ctx, (
+            f"ddim samples: shape={arr.shape} "
+            f"range=[{arr.min():.3f}, {arr.max():.3f}]"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

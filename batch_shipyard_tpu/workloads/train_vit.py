@@ -1,0 +1,82 @@
+"""ViT image-classification training payload (the reference's
+Caffe/MXNet/CNTK image-classification recipes' workload analog,
+/root/reference/recipes/Caffe-GPU/README.md — TPU-native model instead
+of a framework container).
+
+Usage (recipe command):
+    python -m batch_shipyard_tpu.workloads.train_vit \
+        --batch-per-device 128 --steps 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batch_shipyard_tpu.models import vit as vit_mod
+from batch_shipyard_tpu.parallel import mesh as mesh_mod
+from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import distributed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-per-device", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--patch-size", type=int, default=16)
+    parser.add_argument("--d-model", type=int, default=768)
+    parser.add_argument("--layers", type=int, default=12)
+    parser.add_argument("--heads", type=int, default=12)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    ctx = distributed.setup()
+    n_dev = jax.device_count()
+    batch_size = args.batch_per_device * n_dev
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
+    config = vit_mod.ViTConfig(
+        image_size=args.image_size, patch_size=args.patch_size,
+        num_classes=args.num_classes, d_model=args.d_model,
+        n_layers=args.layers, n_heads=args.heads,
+        d_ff=4 * args.d_model, dtype=jnp.bfloat16)
+    harness = train_mod.build_vit_train(mesh, config,
+                                        batch_size=batch_size)
+    from batch_shipyard_tpu.data import loader
+
+    rng = np.random.RandomState(jax.process_index())
+    local_batch = batch_size // jax.process_count()
+    synthetic = loader.place_global({
+        "images": np.asarray(
+            rng.randn(local_batch, args.image_size, args.image_size,
+                      3), np.float32),
+        "labels": np.asarray(
+            rng.randint(0, args.num_classes, (local_batch,)),
+            np.int32),
+    }, harness.batch_sharding)
+    params, opt_state = harness.params, harness.opt_state
+    for _ in range(args.warmup):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  synthetic)
+        float(metrics["loss"])  # hard sync
+    start = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  synthetic)
+    loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    images_per_sec = batch_size * args.steps / elapsed
+    distributed.log(ctx, (
+        f"vit: mesh={dict(mesh.shape)} {images_per_sec:.1f} img/s "
+        f"total, {images_per_sec / n_dev:.1f} img/s/chip, "
+        f"loss={loss:.4f}"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,3 +100,33 @@ def test_cli_rejects_bad_config(tmp_path):
         cli, ["--configdir", str(tmp_path), "pool", "add"])
     assert result.exit_code != 0
     assert "bogus" in str(result.exception or result.output)
+
+
+def test_fs_bucket_mount_args(tmp_path):
+    """gcs_buckets in fs.yaml render nodeprep gcsfuse mount commands
+    (the RemoteFS-GCSFuse+Pool recipe surface)."""
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "fs": {"remote_fs": {
+            "resource_group": "rg",
+            "gcs_buckets": {"shared-data": {
+                "bucket": "my-bucket",
+                "mount_options": ["implicit-dirs", "file-mode=644"],
+            }}}},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    result = CliRunner().invoke(
+        cli, ["--configdir", str(tmp_path), "fs", "bucket",
+              "mount-args", "shared-data"])
+    assert result.exit_code == 0, result.output
+    assert "gcsfuse --implicit-dirs -o file-mode=644 my-bucket " \
+        "/mnt/shared-data" in result.output
+    assert "mkdir -p /mnt/shared-data" in result.output
+    missing = CliRunner().invoke(
+        cli, ["--configdir", str(tmp_path), "fs", "bucket",
+              "mount-args", "nope"])
+    assert missing.exit_code != 0

@@ -1,0 +1,119 @@
+"""ViT and DiT model-family tests: shapes, loss descent through the
+train harnesses on the virtual 8-device mesh, sampler determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batch_shipyard_tpu.models import diffusion as dif_mod
+from batch_shipyard_tpu.models import vit as vit_mod
+from batch_shipyard_tpu.parallel import mesh as mesh_mod
+from batch_shipyard_tpu.parallel import train as train_mod
+
+TINY_VIT = vit_mod.ViTConfig(
+    image_size=32, patch_size=8, num_classes=10, d_model=64,
+    n_layers=2, n_heads=2, d_ff=128, dtype=jnp.float32)
+
+TINY_DIT = dif_mod.DiTConfig(
+    image_size=16, patch_size=4, d_model=64, n_layers=2, n_heads=2,
+    d_ff=128, timesteps=100, dtype=jnp.float32)
+
+
+def test_vit_forward_shape_and_grad():
+    model = vit_mod.ViT(TINY_VIT)
+    images = jnp.ones((2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), images)["params"]
+    logits = model.apply({"params": params}, images)
+    assert logits.shape == (2, 10)
+    # sincos positions: no position parameter in the tree
+    assert "pos_embed" not in params
+
+    def loss(p):
+        return vit_mod.cross_entropy_loss(
+            model.apply({"params": p}, images),
+            jnp.asarray([1, 2], jnp.int32))
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(leaf)) for leaf in leaves)
+
+
+def test_vit_train_loss_decreases():
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
+    harness = train_mod.build_vit_train(
+        mesh, TINY_VIT, batch_size=16, learning_rate=1e-3)
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": jnp.asarray(rng.randn(16, 32, 32, 3), jnp.float32),
+        "labels": jnp.asarray(rng.randint(0, 10, (16,)), jnp.int32),
+    }
+    params, opt_state = harness.params, harness.opt_state
+    losses = []
+    for _ in range(8):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_dit_forward_shape_identity_at_init():
+    """adaLN-Zero: with zero-initialized gates and head, the initial
+    prediction is exactly zero (every block starts as identity)."""
+    model = dif_mod.DiT(TINY_DIT)
+    x = jnp.ones((2, 16, 16, 3), jnp.float32)
+    t = jnp.asarray([0, 50], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t, None)["params"]
+    pred = model.apply({"params": params}, x, t, None)
+    assert pred.shape == (2, 16, 16, 3)
+    np.testing.assert_allclose(np.asarray(pred), 0.0, atol=1e-6)
+
+
+def test_dit_class_conditional_requires_labels():
+    cfg = dif_mod.DiTConfig(
+        image_size=16, patch_size=4, d_model=64, n_layers=1,
+        n_heads=2, d_ff=128, num_classes=10, dtype=jnp.float32)
+    model = dif_mod.DiT(cfg)
+    x = jnp.ones((2, 16, 16, 3), jnp.float32)
+    t = jnp.zeros((2,), jnp.int32)
+    labels = jnp.asarray([3, 7], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t, labels)["params"]
+    out = model.apply({"params": params}, x, t, labels)
+    assert out.shape == x.shape
+    try:
+        model.apply({"params": params}, x, t, None)
+        raise AssertionError("expected ValueError without labels")
+    except ValueError:
+        pass
+
+
+def test_diffusion_train_loss_decreases():
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
+    harness = train_mod.build_diffusion_train(
+        mesh, TINY_DIT, batch_size=16, learning_rate=2e-3)
+    rng = np.random.RandomState(1)
+    x0 = np.tanh(rng.randn(16, 16, 16, 3)).astype(np.float32)
+    batch = {"images": jnp.asarray(x0)}
+    params, opt_state = harness.params, harness.opt_state
+    losses = []
+    for _ in range(10):
+        params, opt_state, metrics = harness.step(params, opt_state,
+                                                  batch)
+        losses.append(float(metrics["loss"]))
+    # At init the prediction is 0 so the loss is E[noise^2] ~= 1.
+    assert 0.5 < losses[0] < 2.0
+    assert losses[-1] < losses[0]
+
+
+def test_ddim_sampler_shape_and_determinism():
+    model = dif_mod.DiT(TINY_DIT)
+    x = jnp.ones((1, 16, 16, 3), jnp.float32)
+    t = jnp.zeros((1,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t, None)["params"]
+    key = jax.random.PRNGKey(42)
+    a = dif_mod.ddim_sample(model, params, key, num_images=2,
+                            num_steps=4)
+    b = dif_mod.ddim_sample(model, params, key, num_images=2,
+                            num_steps=4)
+    assert a.shape == (2, 16, 16, 3)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert np.all(np.isfinite(np.asarray(a)))
