@@ -101,3 +101,38 @@ def psum_latency_probe(x, axis: str = "dp"):
     """Minimal-size psum for latency measurement (OSU latency analog).
     Call under shard_map or pjit with x sharded over axis."""
     return jax.lax.psum(x, axis)
+
+
+def hierarchical_all_to_all(x, outer_axis: str, inner_axis: str):
+    """Two-phase all-to-all over a factored device axis: ICI first,
+    then DCN — the expert-parallel dispatch primitive when experts
+    span slices.
+
+    Call inside shard_map on a mesh where the expert axis is factored
+    as (outer_axis, inner_axis) — outer across slices (DCN), inner
+    within a slice (ICI). ``x`` is DESTINATION-indexed per device:
+    shape [n_out, n_in, ...] where x[o', i'] is the block this device
+    sends to device (o', i'). Returns the SOURCE-indexed gather:
+    y[o, i] = block sent to this device by device (o, i).
+
+    Why not one all_to_all over the combined axis: that sends each
+    (src, dst) block as its own DCN message — n_in^2 small messages
+    per slice pair. Phase 1 (inner axis, ICI) routes blocks to the
+    slice-mate whose inner rank matches the destination's; phase 2
+    (outer axis, DCN) then moves ONE aggregated [n_in, ...] message
+    per slice pair — n_in-fold fewer, n_in-fold bigger DCN transfers,
+    which is the win on a latency-dominated cross-slice fabric.
+
+    Phase algebra (device (o, i), A = phase-1 result, B = result):
+      A[d_o, s_i] = x_{(o, s_i)}[d_o, i]      (a2a over inner, dim 1)
+      B[s_o, s_i] = A_{(s_o, i)}[o, s_i]
+                  = x_{(s_o, s_i)}[o, i]      (a2a over outer, dim 0)
+
+    Reference analog: none (SURVEY.md 5.8 net-new); the factored
+    exchange follows the standard hierarchical/2D all-to-all scheme
+    used by MoE systems (PAPERS.md).
+    """
+    x = jax.lax.all_to_all(x, inner_axis, split_axis=1,
+                           concat_axis=1)
+    return jax.lax.all_to_all(x, outer_axis, split_axis=0,
+                              concat_axis=0)
