@@ -157,6 +157,14 @@ class ContinuousBatcher:
         page = getattr(self, "page_size", 0)
 
         def dense_prefill(params, prompt, prompt_len):
+            """Batch-1 prefill over the (bucket-padded) prompt [1, L].
+            prompt_len is DYNAMIC (a traced int32): the scan consumes
+            all L tokens — the rows written past prompt_len are
+            garbage, but they are masked-on-read (key_pos <= idx) and
+            each is overwritten by the decode step that first reaches
+            its position, so only the length bookkeeping needs the
+            true value. This is what makes L bucketable: one compile
+            per BUCKET instead of one per distinct prompt length."""
             small = inf.init_cache(dense_model, params, 1)
 
             def body(carry, tok):
@@ -167,42 +175,56 @@ class ContinuousBatcher:
                 return (mut["cache"], pos + 1), logits[0, 0]
 
             (small, _pos), logits_seq = jax.lax.scan(
-                body, (small, jnp.int32(0)), prompt[0, :prompt_len])
-            return small, logits_seq[-1]
+                body, (small, jnp.int32(0)), prompt[0])
+            last = jnp.take(logits_seq, prompt_len - 1, axis=0)
+            return small, last
 
-        @functools.partial(jax.jit, static_argnames=("prompt_len",))
+        @jax.jit
         def prefill(params, cache, slot, prompt, prompt_len):
             """Fill ONE slot's cache region from a prompt [1, L]
             (batch-1 forward, scattered into the slot row), returning
-            the last-token logits for the first sample."""
+            the last-token logits for the first sample. The small
+            cache's write index ran to L (the padded length); the
+            slot's index is corrected to the true prompt_len."""
             small, last = dense_prefill(params, prompt, prompt_len)
-            cache = jax.tree_util.tree_map(
-                lambda big, sm: big.at[slot].set(sm[0]), cache, small)
+
+            def scatter(big, sm, path_key):
+                if path_key == "index":
+                    return big.at[slot].set(prompt_len)
+                return big.at[slot].set(sm[0])
+
+            cache = jax.tree_util.tree_map_with_path(
+                lambda kp, big, sm: scatter(
+                    big, sm, kp[-1].key if hasattr(kp[-1], "key")
+                    else str(kp[-1])),
+                cache, small)
             return cache, last
 
-        @functools.partial(jax.jit, static_argnames=("prompt_len",))
+        @jax.jit
         def prefill_paged(params, cache, slot, prompt, table_row,
                           prompt_len):
             """Paged variant: dense batch-1 prefill, rows scattered
             page-by-page into the slot's allocated pages; the slot's
-            block-table row and length are set in every layer's
-            cache copy."""
+            block-table row and length are set in every layer's cache
+            copy. Full pages are written unconditionally: blocks past
+            the allocation point at the scratch page (which absorbs
+            padded-garbage writes), and partial-page garbage is
+            masked-on-read via the true length."""
             small, last = dense_prefill(params, prompt, prompt_len)
-            n_blocks = -(-prompt_len // page)
+            # Bucket blocks, static (ceil: a bucket smaller than one
+            # page still needs its first page written; the small
+            # cache has max_decode_len >= n_blocks*page rows).
+            n_blocks = -(-prompt.shape[1] // page)
 
             def scatter(big, sm):
                 if isinstance(big, dict) and "k_pages" in big:
                     kp, vp = big["k_pages"], big["v_pages"]
                     for b in range(n_blocks):
-                        start = b * page
-                        take = min(page, prompt_len - start)
-                        krows = jax.lax.dynamic_slice_in_dim(
-                            sm["k"][0], start, take, 0)
-                        vrows = jax.lax.dynamic_slice_in_dim(
-                            sm["v"][0], start, take, 0)
-                        kp = kp.at[table_row[b], :take].set(
+                        krows = sm["k"][0, b * page:(b + 1) * page]
+                        vrows = sm["v"][0, b * page:(b + 1) * page]
+                        kp = kp.at[table_row[b]].set(
                             krows.astype(kp.dtype))
-                        vp = vp.at[table_row[b], :take].set(
+                        vp = vp.at[table_row[b]].set(
                             vrows.astype(vp.dtype))
                     return {
                         "k_pages": kp, "v_pages": vp,
@@ -342,12 +364,23 @@ class ContinuousBatcher:
 
     # ----------------------------- internal ----------------------------
 
+    def _bucket_length(self, n: int) -> int:
+        """Round a prompt length up to its compile bucket (the next
+        power of two, floored at 16, capped at max_decode_len): one
+        prefill compile per bucket instead of per distinct length."""
+        bucket = 16
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, self.max_decode_len)
+
     def _admit(self) -> None:
         for i, slot in enumerate(self._slots):
             if slot.request is not None or not self._queue:
                 continue
             req = self._queue[0]
-            prompt = jnp.asarray([req.prompt], jnp.int32)
+            bucket = self._bucket_length(len(req.prompt))
+            padded = req.prompt + [0] * (bucket - len(req.prompt))
+            prompt = jnp.asarray([padded], jnp.int32)
             if self.paged:
                 blocks_needed = -(-len(req.prompt) // self.page_size)
                 worst = -(-(len(req.prompt) + req.max_new_tokens)

@@ -153,10 +153,19 @@ def wait_for_pool_ready(store: StateStore, substrate: ComputeSubstrate,
                     raise PoolAllocationError(
                         f"node {node.node_id} unusable "
                         f"(attempt_recovery_on_unusable disabled)")
-        # Fatal allocation errors recorded by the substrate.
+        # Allocation errors recorded by the substrate: fatal ones
+        # (quota/permission/config) can never succeed; 'other_zone'
+        # retries (stockout) also fail fast because the zone is fixed
+        # by credentials — waiting out the pool timeout cannot help,
+        # the operator must pick another zone. Only 'backoff' errors
+        # (transient service trouble) keep polling.
         entity = get_pool(store, pool.id)
-        if entity.get("allocation_error_fatal"):
-            raise PoolAllocationError(entity["allocation_error"])
+        if entity.get("allocation_error_fatal") or \
+                entity.get("allocation_error_retry") == "other_zone":
+            raise PoolAllocationError(
+                f"{entity['allocation_error']} "
+                f"[kind={entity.get('allocation_error_kind')}, "
+                f"retry={entity.get('allocation_error_retry')}]")
         if time.monotonic() > deadline:
             states = {n.node_id: n.state for n in nodes}
             raise PoolAllocationError(

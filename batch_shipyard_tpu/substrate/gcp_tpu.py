@@ -36,12 +36,10 @@ from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
 
-# Fatal allocation errors (quota/stockout) vs transient — the resize
-# error classification of the reference (batch.py:661-672).
-FATAL_ALLOCATION_MARKERS = (
-    "QUOTA_EXCEEDED", "RESOURCE_EXHAUSTED", "stockout",
-    "does not have enough resources",
-)
+# Allocation-error taxonomy lives in substrate/gcloud_errors.py — a
+# table-driven classifier tested against captured real gcloud payloads
+# (the resize error classification of the reference, batch.py:661-672).
+from batch_shipyard_tpu.substrate import gcloud_errors  # noqa: E402
 
 
 class GcpTpuSubstrate(base.ComputeSubstrate):
@@ -105,12 +103,13 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
         try:
             self._gcloud(*args)
         except RuntimeError as exc:
-            fatal = any(marker.lower() in str(exc).lower()
-                        for marker in FATAL_ALLOCATION_MARKERS)
+            err = gcloud_errors.classify(str(exc))
             self.store.merge_entity(
                 names.TABLE_POOLS, "pools", pool.id, {
                     "allocation_error": str(exc),
-                    "allocation_error_fatal": fatal})
+                    "allocation_error_kind": err.kind,
+                    "allocation_error_fatal": err.fatal,
+                    "allocation_error_retry": err.retry})
             raise
         self._register_workers(pool, slice_index)
         self._bootstrap_agents(pool, slice_index)

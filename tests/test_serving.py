@@ -178,3 +178,73 @@ def test_paged_submit_rejects_unadmittable(params):
     with pytest.raises(ValueError, match="could never admit"):
         engine.submit(serving.Request("huge", [1] * 20,
                                       max_new_tokens=12))
+
+
+def test_prefill_buckets_bound_compiles(params):
+    """Prompts of different lengths inside one power-of-two bucket
+    share a single prefill compilation; a longer prompt crossing into
+    the next bucket adds exactly one more."""
+    eng = serving.ContinuousBatcher(CFG, params, num_slots=4,
+                                    max_decode_len=64)
+    for rid, n in (("a", 3), ("b", 5), ("c", 11)):   # bucket 16
+        eng.submit(serving.Request(rid, [7] * n, max_new_tokens=2))
+    done = []
+    for _ in range(30):
+        done += eng.step()
+        if len(done) == 3:
+            break
+    assert len(done) == 3
+    assert eng._prefill._cache_size() == 1
+    eng.submit(serving.Request("d", [7] * 20, max_new_tokens=2))
+    for _ in range(30):
+        done += eng.step()
+        if len(done) == 4:
+            break
+    assert len(done) == 4
+    assert eng._prefill._cache_size() == 2
+
+
+def test_paged_prefill_bucket_shorter_than_page(params):
+    """A prompt whose bucket is smaller than the page size still
+    writes its (single, partial) page correctly: greedy output equals
+    the dense engine's."""
+    prompt = [5, 9, 2]                     # bucket 16 < page 32
+    dense = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                      max_decode_len=64)
+    paged = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                      max_decode_len=64,
+                                      kv_page_size=32)
+    outs = []
+    for eng in (dense, paged):
+        eng.submit(serving.Request("r", prompt, max_new_tokens=6))
+        got = []
+        for _ in range(20):
+            got += eng.step()
+            if got:
+                break
+        outs.append(got[0][1])
+    assert outs[0] == outs[1], outs
+
+
+def test_int8_quantized_serving_generates(params):
+    """ROADMAP 'int8 serving via QuantDense': a quantize_matmuls
+    config runs the whole continuous-batching path on the int8
+    kernels (interpret mode here; MXU int8 on hardware)."""
+    from jax.experimental.pallas import tpu as pltpu
+    qcfg = tfm.TransformerConfig(
+        vocab_size=97, d_model=128, n_layers=1, n_heads=2, d_head=64,
+        d_ff=128, dtype=jnp.float32, param_dtype=jnp.float32,
+        quantize_matmuls=True)
+    with pltpu.force_tpu_interpret_mode():
+        qparams = tfm.TransformerLM(qcfg).init(
+            jax.random.PRNGKey(1),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = serving.ContinuousBatcher(qcfg, qparams, num_slots=2,
+                                        max_decode_len=32)
+        eng.submit(serving.Request("q", [5, 9], max_new_tokens=3))
+        done = []
+        for _ in range(10):
+            done += eng.step()
+            if done:
+                break
+    assert done and len(done[0][1]) == 3
