@@ -497,6 +497,92 @@ def account_info(click_ctx):
                               raw=click_ctx.obj["raw"])
 
 
+# ------------------------------ secrets --------------------------------
+
+def _secret_io_params(click_ctx):
+    return _ctx(click_ctx).secret_io
+
+
+@cli.group()
+def secrets():
+    """Secret store management (the keyvault group analog)."""
+
+
+@secrets.command("put")
+@click.argument("secret_id")
+@click.option("--value", default=None,
+              help="Secret value; read from stdin when omitted so it "
+                   "stays out of shell history")
+@click.pass_context
+def secrets_put(click_ctx, secret_id, value):
+    """Store a value under a secret:// id (keyvault add analog)."""
+    import sys as _sys
+
+    from batch_shipyard_tpu.utils import secrets as secrets_mod
+    if value is None:
+        value = _sys.stdin.read().rstrip("\n")
+    secrets_file, project = _secret_io_params(click_ctx)
+    secrets_mod.store_secret(secret_id, value,
+                             secrets_file=secrets_file,
+                             project=project)
+    click.echo(f"stored {secret_id}")
+
+
+@secrets.command("get")
+@click.argument("secret_id")
+@click.pass_context
+def secrets_get(click_ctx, secret_id):
+    """Resolve and print a secret:// id."""
+    from batch_shipyard_tpu.utils import secrets as secrets_mod
+    secrets_file, project = _secret_io_params(click_ctx)
+    click.echo(secrets_mod.resolve_secret(
+        secret_id, secrets_file=secrets_file, project=project))
+
+
+@secrets.command("store-credentials")
+@click.argument("secret_id")
+@click.pass_context
+def secrets_store_credentials(click_ctx, secret_id):
+    """Store the loaded credentials.yaml under one secret id (the
+    reference keeps whole credential files in KeyVault)."""
+    from batch_shipyard_tpu.utils import secrets as secrets_mod
+    ctx = _ctx(click_ctx)
+    raw = ctx.configs.get("credentials")
+    if not raw:
+        raise click.ClickException("no credentials config loaded")
+    secrets_file, project = _secret_io_params(click_ctx)
+    secrets_mod.store_credentials_config(
+        secret_id, raw, secrets_file=secrets_file, project=project)
+    click.echo(f"credentials stored at {secret_id}")
+
+
+@secrets.command("fetch-credentials")
+@click.argument("secret_id")
+@click.option("--out", default=None,
+              help="Write to this file instead of stdout")
+@click.pass_context
+def secrets_fetch_credentials(click_ctx, secret_id, out):
+    """Fetch a credentials.yaml stored via store-credentials."""
+    import yaml as _yaml
+
+    from batch_shipyard_tpu.utils import secrets as secrets_mod
+    secrets_file, project = _secret_io_params(click_ctx)
+    data = secrets_mod.fetch_credentials_config(
+        secret_id, secrets_file=secrets_file, project=project)
+    text = _yaml.safe_dump(data, default_flow_style=False)
+    if out:
+        import os as _os
+
+        # Credential material: never world-readable (matches
+        # store_secret's 0o600 on the secrets file).
+        with open(out, "w", encoding="utf-8",
+                  opener=lambda p, f: _os.open(p, f, 0o600)) as fh:
+            fh.write(text)
+        click.echo(f"wrote {out}")
+    else:
+        click.echo(text)
+
+
 # ------------------------------ storage --------------------------------
 
 @cli.group()

@@ -58,6 +58,17 @@ class Context:
     # ------------------------- config access ---------------------------
 
     @property
+    def secret_io(self) -> tuple:
+        """(secrets_file, gcp_project) for secret:// resolution and
+        storage — the single place that knows where these live in the
+        credentials config (used by lazy credential resolution and
+        the secrets CLI group)."""
+        creds = self.configs.get("credentials", {}).get(
+            "credentials", {})
+        return ((creds.get("secrets") or {}).get("file"),
+                (creds.get("gcp") or {}).get("project"))
+
+    @property
     def credentials(self):
         # Secret indirection resolves lazily, on first credential use:
         # commands that never touch credentials must not fail (or pay
@@ -66,9 +77,7 @@ class Context:
         if self._resolved_credentials is None:
             raw = self.configs.get("credentials", {})
             from batch_shipyard_tpu.utils import secrets
-            creds = raw.get("credentials", {})
-            secrets_file = (creds.get("secrets") or {}).get("file")
-            project = (creds.get("gcp") or {}).get("project")
+            secrets_file, project = self.secret_io
             self._resolved_credentials = (
                 secrets.resolve_config_secrets(raw, secrets_file,
                                                project))

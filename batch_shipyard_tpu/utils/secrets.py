@@ -103,3 +103,83 @@ def resolve_config_secrets(config: dict,
             return resolve_secret(node, secrets_file, project)
         return node
     return walk(config)
+
+
+# ------------------------- store (keyvault add) -------------------------
+
+def store_secret(secret_id: str, value: str,
+                 secrets_file: Optional[str] = None,
+                 project: Optional[str] = None) -> None:
+    """Write a secret value under a secret:// id (the reference's
+    `keyvault add` half, convoy/keyvault.py:112 store_credentials /
+    :176 get_secret's sibling). Providers: ``file`` updates the YAML
+    secrets file in place; ``gcp_secret_manager`` creates the secret
+    (idempotently) and adds a new version via gcloud with the value
+    on stdin — never in argv, so it cannot leak through process
+    listings. The ``env`` provider is read-only by nature."""
+    provider, name = parse_secret_id(secret_id)
+    if provider == "env":
+        raise SecretResolutionError(
+            "env secrets are read-only (set the variable in the "
+            "environment instead)")
+    if provider == "file":
+        if not secrets_file:
+            raise SecretResolutionError(
+                "file secret provider requires credentials.secrets."
+                "file")
+        data = {}
+        if os.path.exists(secrets_file):
+            with open(secrets_file, "r", encoding="utf-8") as fh:
+                data = yaml.safe_load(fh) or {}
+        data[name] = value
+        tmp = secrets_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8",
+                  opener=lambda p, f: os.open(p, f, 0o600)) as fh:
+            yaml.safe_dump(data, fh, default_flow_style=False)
+        os.replace(tmp, secrets_file)
+        return
+    if provider == "gcp_secret_manager":
+        import shutil
+        if shutil.which("gcloud") is None:
+            raise SecretResolutionError(
+                "gcloud CLI required for gcp_secret_manager provider")
+        base = ["gcloud", "secrets"]
+        if project:
+            base.append(f"--project={project}")
+        # Idempotent create; failure is fine when it already exists.
+        util.subprocess_capture(
+            base[:2] + ["create", name,
+                        "--replication-policy=automatic"] + base[2:])
+        rc, _out, err = util.subprocess_capture(
+            base[:2] + ["versions", "add", name, "--data-file=-"] +
+            base[2:], stdin_data=value)
+        if rc != 0:
+            raise SecretResolutionError(
+                f"gcloud secret store failed: {err.strip()}")
+        return
+    raise SecretResolutionError(f"unknown secret provider {provider!r}")
+
+
+def store_credentials_config(secret_id: str, credentials: dict,
+                             secrets_file: Optional[str] = None,
+                             project: Optional[str] = None) -> None:
+    """Store an entire credentials.yaml under one secret id (the
+    reference keeps whole credential files in KeyVault,
+    convoy/keyvault.py:71/:112); fetch back with
+    fetch_credentials_config."""
+    store_secret(secret_id, yaml.safe_dump(credentials),
+                 secrets_file=secrets_file, project=project)
+
+
+def fetch_credentials_config(secret_id: str,
+                             secrets_file: Optional[str] = None,
+                             project: Optional[str] = None) -> dict:
+    """Fetch a whole credentials.yaml stored via
+    store_credentials_config."""
+    raw = resolve_secret(secret_id, secrets_file=secrets_file,
+                         project=project)
+    data = yaml.safe_load(raw)
+    if not isinstance(data, dict):
+        raise SecretResolutionError(
+            f"secret {secret_id} does not hold a credentials mapping")
+    return data

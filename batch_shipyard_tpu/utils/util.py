@@ -147,11 +147,15 @@ def subprocess_with_output(cmd: str | Sequence[str], shell: bool = False,
 def subprocess_capture(cmd: str | Sequence[str], shell: bool = False,
                        cwd: str | None = None,
                        env: dict[str, str] | None = None,
-                       timeout: float | None = None) -> tuple[int, str, str]:
-    """Run a subprocess, capture stdout/stderr, return (rc, out, err)."""
+                       timeout: float | None = None,
+                       stdin_data: str | None = None
+                       ) -> tuple[int, str, str]:
+    """Run a subprocess, capture stdout/stderr, return (rc, out, err).
+    stdin_data feeds the child's stdin (secret values ride stdin, not
+    argv, so they never appear in process listings)."""
     proc = subprocess.run(
         cmd, shell=shell, cwd=cwd, env=env, capture_output=True, text=True,
-        timeout=timeout)
+        timeout=timeout, input=stdin_data)
     return proc.returncode, proc.stdout, proc.stderr
 
 
@@ -212,3 +216,33 @@ def human_bytes(num: float) -> str:
             return f"{num:.1f}{unit}"
         num /= 1024.0
     return f"{num:.1f}PiB"
+
+
+def probe_default_devices(timeout: float = 75.0
+                          ) -> tuple[int, str | None]:
+    """Count the default JAX backend's devices in a SUBPROCESS with a
+    hard timeout, so a wedged accelerator relay can never hang the
+    caller in-process (initializing a backend in-process is
+    unrecoverable if it blocks). Returns (count, None) on success or
+    (0, reason) on timeout/failure. Shared by bench.py's probe and
+    __graft_entry__.dryrun_multichip's CPU-bootstrap decision."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return 0, (f"device init timed out after {timeout:.0f}s "
+                   f"(wedged accelerator relay?)")
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace").strip()
+        return 0, (f"device init exited rc={proc.returncode}: "
+                   f"{tail[-400:]}")
+    try:
+        count = int(proc.stdout.decode().strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return 0, "device probe printed no device count"
+    return count, None

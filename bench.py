@@ -212,20 +212,14 @@ def _probe_devices(timeout: float = 240.0):
     """Device init in a subprocess with a hard timeout: a wedged
     accelerator relay must produce an honest failure record — with
     the real cause — not a hung bench run. Returns None on success,
-    else a reason string."""
-    import subprocess
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices())"],
-            timeout=timeout, capture_output=True)
-    except subprocess.TimeoutExpired:
-        return (f"device init timed out after {timeout:.0f}s "
-                f"(wedged accelerator relay?)")
-    if proc.returncode != 0:
-        tail = proc.stderr.decode(errors="replace").strip()
-        return (f"device init exited rc={proc.returncode}: "
-                f"{tail[-400:]}")
+    else a reason string (shared helper: utils/util.py
+    probe_default_devices, also used by __graft_entry__)."""
+    from batch_shipyard_tpu.utils.util import probe_default_devices
+    count, reason = probe_default_devices(timeout=timeout)
+    if reason is not None:
+        return reason
+    if count < 1:
+        return "device probe found no devices"
     return None
 
 
