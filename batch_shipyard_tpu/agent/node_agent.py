@@ -772,6 +772,8 @@ class NodeAgent:
                          ) -> task_runner.TaskExecution:
         env = dict(spec.get("environment_variables", {}))
         env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
+        if spec.get("auto_scratch"):
+            env["SHIPYARD_JOB_SCRATCH"] = self._job_scratch_dir(job_id)
         if extra_env:
             env.update(extra_env)
         task_dir = os.path.join(
@@ -804,7 +806,8 @@ class NodeAgent:
         semantics)."""
         jp_command = spec.get("job_preparation_command")
         job_inputs = spec.get("job_input_data") or []
-        if not jp_command and not job_inputs:
+        auto_scratch = spec.get("auto_scratch")
+        if not jp_command and not job_inputs and not auto_scratch:
             return True
         pk = names.task_pk(self.identity.pool_id, job_id)
         try:
@@ -825,6 +828,11 @@ class NodeAgent:
             return False
         exit_code = 0
         try:
+            if auto_scratch:
+                # Per-job scratch with job lifetime (BeeOND analog):
+                # created here, removed by job release.
+                os.makedirs(self._job_scratch_dir(job_id),
+                            exist_ok=True)
             # Job-level input_data lands in the job's shared dir
             # (exposed to tasks as SHIPYARD_JOB_SHARED_DIR; the
             # $AZ_BATCH_NODE_SHARED_DIR analog).
@@ -838,17 +846,23 @@ class NodeAgent:
                         {"input_data": job_inputs}, job_id),
                     shared)
             if jp_command:
+                jp_env = {
+                    **spec.get("environment_variables", {}),
+                    "SHIPYARD_JOB_SHARED_DIR":
+                        self._job_shared_dir(job_id),
+                }
+                if auto_scratch:
+                    # Prep commands pre-populate scratch (the
+                    # canonical BeeOND prep pattern).
+                    jp_env["SHIPYARD_JOB_SCRATCH"] = (
+                        self._job_scratch_dir(job_id))
                 execution = task_runner.TaskExecution(
                     pool_id=self.identity.pool_id, job_id=job_id,
                     task_id="jobprep",
                     node_id=self.identity.node_id,
                     node_index=self.identity.node_index,
                     command=jp_command, runtime="none",
-                    env={
-                        **spec.get("environment_variables", {}),
-                        "SHIPYARD_JOB_SHARED_DIR":
-                            self._job_shared_dir(job_id),
-                    },
+                    env=jp_env,
                     task_dir=os.path.join(self.work_dir, "jobprep",
                                           job_id))
                 exit_code = task_runner.run_task(execution).exit_code
@@ -862,6 +876,9 @@ class NodeAgent:
 
     def _job_shared_dir(self, job_id: str) -> str:
         return os.path.join(self.work_dir, "shared", job_id)
+
+    def _job_scratch_dir(self, job_id: str) -> str:
+        return os.path.join(self.work_dir, "scratch", job_id)
 
     def _terminate_running_task(self, job_id: str,
                                 task_id: str) -> None:
@@ -962,16 +979,31 @@ class NodeAgent:
                 names.TABLE_JOBS, self.identity.pool_id, job_id)
         except NotFoundError:
             return
-        jr_command = job.get("spec", {}).get("job_release_command")
-        if not jr_command:
-            return
-        execution = task_runner.TaskExecution(
-            pool_id=self.identity.pool_id, job_id=job_id,
-            task_id="jobrelease", node_id=self.identity.node_id,
-            node_index=self.identity.node_index,
-            command=jr_command, runtime="none",
-            task_dir=os.path.join(self.work_dir, "jobrelease", job_id))
-        task_runner.run_task(execution)
+        spec = job.get("spec", {})
+        jr_command = spec.get("job_release_command")
+        if jr_command:
+            jr_env = {"SHIPYARD_JOB_SHARED_DIR":
+                      self._job_shared_dir(job_id)}
+            if spec.get("auto_scratch"):
+                # Release commands harvest scratch (archive/copy out)
+                # BEFORE the rmtree below ends its lifetime.
+                jr_env["SHIPYARD_JOB_SCRATCH"] = (
+                    self._job_scratch_dir(job_id))
+            execution = task_runner.TaskExecution(
+                pool_id=self.identity.pool_id, job_id=job_id,
+                task_id="jobrelease", node_id=self.identity.node_id,
+                node_index=self.identity.node_index,
+                command=jr_command, runtime="none", env=jr_env,
+                task_dir=os.path.join(self.work_dir, "jobrelease",
+                                      job_id))
+            task_runner.run_task(execution)
+        if spec.get("auto_scratch"):
+            # End of the scratch drive's lifetime (the release half of
+            # the BeeOND analog).
+            import shutil
+
+            shutil.rmtree(self._job_scratch_dir(job_id),
+                          ignore_errors=True)
 
     def _resolved_inputs(self, spec: dict, job_id: str) -> list[dict]:
         resolved = []

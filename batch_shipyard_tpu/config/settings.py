@@ -455,6 +455,12 @@ class JobSettings:
     recurrence: Optional[RecurrenceSettings]
     job_preparation_command: Optional[str]
     job_release_command: Optional[str]
+    # Per-job scratch space with job lifetime (the reference's BeeOND
+    # auto_scratch analog, settings.py:1496/batch.py:4949 — there a
+    # distributed FS across job nodes; here node-local NVMe scratch
+    # at SHIPYARD_JOB_SCRATCH, created at job prep and removed at job
+    # release; cross-node sharing rides gcsfuse/fs clusters instead).
+    auto_scratch: bool
     input_data: tuple[dict, ...]
     tasks: tuple[dict, ...]  # raw task dicts (expanded by task factories)
     merge_task: Optional[dict]
@@ -507,6 +513,7 @@ def _job_settings(job: dict) -> JobSettings:
         recurrence=recurrence,
         job_preparation_command=_get(job, "job_preparation", "command"),
         job_release_command=_get(job, "job_release", "command"),
+        auto_scratch=_get(job, "auto_scratch", default=False),
         input_data=tuple(_get(job, "input_data", default=[])),
         tasks=tuple(_get(job, "tasks", default=[])),
         merge_task=_get(job, "merge_task"),

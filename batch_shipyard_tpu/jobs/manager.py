@@ -63,6 +63,7 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "resource_files": list(task.resource_files),
         "job_preparation_command": job.job_preparation_command,
         "job_input_data": list(job.input_data),
+        "auto_scratch": job.auto_scratch,
         "exit_options": dict(task.default_exit_options),
     }
     if task.multi_instance is not None:
@@ -97,6 +98,7 @@ def add_jobs(store: StateStore, pool: PoolSettings,
                     "auto_complete": job.auto_complete,
                     "priority": job.priority,
                     "job_release_command": job.job_release_command,
+                    "auto_scratch": job.auto_scratch,
                     "recurrence": (
                         {"interval":
                          job.recurrence.recurrence_interval_seconds}

@@ -278,3 +278,64 @@ def test_auto_pool_job_lifecycle():
     finally:
         for sub in ctx._substrates.values():
             getattr(sub, "stop_all", lambda: None)()
+
+
+def test_auto_scratch_lifecycle():
+    """auto_scratch (BeeOND analog): tasks of the job share a per-job
+    scratch dir via SHIPYARD_JOB_SCRATCH; the dir exists for the job's
+    lifetime and is removed at job release."""
+    import os
+
+    conf = {"pool_specification": {
+        "id": "scratchpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},  # single node
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "scratchjob",
+            "auto_scratch": True,
+            "auto_complete": True,
+            # Release harvests scratch BEFORE its lifetime ends.
+            "job_release": {"command":
+                            "sh -c 'cp $SHIPYARD_JOB_SCRATCH/marker "
+                            "$SHIPYARD_JOB_SHARED_DIR/harvested'"},
+            "tasks": [
+                {"id": "writer",
+                 "command": "sh -c 'echo payload-42 > "
+                            "$SHIPYARD_JOB_SCRATCH/marker'"},
+                {"id": "reader", "depends_on": ["writer"],
+                 "command": "sh -c 'cat "
+                            "$SHIPYARD_JOB_SCRATCH/marker'"},
+            ]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "scratchpool",
+                                        "scratchjob", timeout=60)
+        assert all(t["state"] == "completed" for t in tasks), tasks
+        out = jobs_mgr.get_task_output(store, "scratchpool",
+                                       "scratchjob", "reader")
+        assert out.strip() == b"payload-42"
+        # Job release (auto_complete fan-out) removes the scratch dir.
+        node_id = FakePodSubstrate.node_id("scratchpool", 0, 0)
+        scratch = os.path.join(substrate.work_root, "scratchpool",
+                               node_id, "scratch", "scratchjob")
+        deadline = time.monotonic() + 30
+        while os.path.isdir(scratch):
+            assert time.monotonic() < deadline, \
+                f"scratch dir {scratch} not cleaned up"
+            time.sleep(0.25)
+        job = store.get_entity(names.TABLE_JOBS, "scratchpool",
+                               "scratchjob")
+        assert job["state"] == "completed"
+        harvested = os.path.join(substrate.work_root, "scratchpool",
+                                 node_id, "shared", "scratchjob",
+                                 "harvested")
+        assert os.path.isfile(harvested)
+        with open(harvested) as fh:
+            assert fh.read().strip() == "payload-42"
+    finally:
+        substrate.stop_all()
