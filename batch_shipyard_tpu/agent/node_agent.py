@@ -982,6 +982,10 @@ class NodeAgent:
         spec = job.get("spec", {})
         jr_command = spec.get("job_release_command")
         if jr_command:
+            # The shared dir may not exist yet (it is only created by
+            # job-input staging) — release commands harvesting into it
+            # must find it present.
+            os.makedirs(self._job_shared_dir(job_id), exist_ok=True)
             jr_env = {"SHIPYARD_JOB_SHARED_DIR":
                       self._job_shared_dir(job_id)}
             if spec.get("auto_scratch"):
