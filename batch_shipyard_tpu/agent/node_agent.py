@@ -1000,7 +1000,21 @@ class NodeAgent:
                 command=jr_command, runtime="none", env=jr_env,
                 task_dir=os.path.join(self.work_dir, "jobrelease",
                                       job_id))
-            task_runner.run_task(execution)
+            result = task_runner.run_task(execution)
+            if result.exit_code != 0:
+                logger.warning(
+                    "job %s release command exited %d", job_id,
+                    result.exit_code)
+                if spec.get("auto_scratch"):
+                    # The release command harvests scratch; if it
+                    # failed, deleting scratch would irrecoverably
+                    # destroy the un-harvested data. Leave it for the
+                    # operator.
+                    logger.warning(
+                        "preserving job %s auto-scratch at %s for "
+                        "manual harvest", job_id,
+                        self._job_scratch_dir(job_id))
+                    return
         if spec.get("auto_scratch"):
             # End of the scratch drive's lifetime (the release half of
             # the BeeOND analog).

@@ -143,17 +143,23 @@ def gcs_bucket_mount_commands(fs_config: dict, name: str) -> list[str]:
         raise KeyError(
             f"gcs bucket {name!r} not in fs.yaml (have: "
             f"{sorted(buckets)})")
+    import shlex
+
     entry = buckets[name] or {}
-    bucket = entry.get("bucket") or name
-    mount_point = entry.get("mount_point", f"/mnt/{name}")
+    # Values come from user-authored fs.yaml — quote everything that
+    # reaches the shell so spaces/metacharacters cannot break or
+    # inject into the nodeprep script.
+    bucket = shlex.quote(str(entry.get("bucket") or name))
+    mount_point = shlex.quote(
+        str(entry.get("mount_point", f"/mnt/{name}")))
     opts = []
     for opt in entry.get("mount_options") or []:
         # Flag-style options (implicit-dirs) pass as --flags;
         # key=value pairs ride -o.
         if "=" in str(opt):
-            opts.append(f"-o {opt}")
+            opts.append(f"-o {shlex.quote(str(opt))}")
         else:
-            opts.append(f"--{opt}")
+            opts.append(f"--{shlex.quote(str(opt))}")
     opt_str = (" ".join(opts) + " ") if opts else ""
     return [
         f"mkdir -p {mount_point} && "

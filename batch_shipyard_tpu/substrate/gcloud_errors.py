@@ -41,13 +41,29 @@ _RULES: tuple[tuple[str, str, bool, str], ...] = (
     ("quota exceeded", "quota", True, "none"),
     ("quota_exceeded", "quota", True, "none"),
     ("exceeded quota", "quota", True, "none"),
-    # Stockout/capacity: zone is dry; try elsewhere or wait.
+    # Stockout/capacity: zone is dry; try elsewhere or wait. The
+    # specific capacity phrasings come BEFORE the bare
+    # RESOURCE_EXHAUSTED rule: GCP also returns RESOURCE_EXHAUSTED for
+    # API rate limiting (HTTP 429), where other_zone would wrongly
+    # abort the allocation — a bare status with no capacity wording
+    # therefore backs off instead (advisor r2 finding #1).
     ("no more capacity in the zone", "stockout", False, "other_zone"),
     ("does not have enough resources available",
      "stockout", False, "other_zone"),
-    ("resource_exhausted", "stockout", False, "other_zone"),
     ("stockout", "stockout", False, "other_zone"),
     ("not enough available capacity", "stockout", False, "other_zone"),
+    ("insufficient capacity", "stockout", False, "other_zone"),
+    ("resource_exhausted", "unavailable", False, "backoff"),
+    # Config errors BEFORE the generic not-found rules: "Accelerator
+    # type v5p-8 was not found" is a fatal config error, and the
+    # generic "was not found" rule would otherwise classify it as a
+    # non-fatal not_found and poll to timeout (advisor r2 finding #2).
+    ("accelerator type .* not found", "invalid_argument", True,
+     "none"),
+    ("is not a valid accelerator-type", "invalid_argument", True,
+     "none"),
+    ("invalid value for field", "invalid_argument", True, "none"),
+    ("unsupported runtime version", "invalid_argument", True, "none"),
     # Conflict / not-found / transient BEFORE the permission rules:
     # GCP conflates wording ("does not have permission ... or it may
     # not exist"), and a merely-mentioned "permission" must not brick
@@ -74,14 +90,10 @@ _RULES: tuple[tuple[str, str, bool, str], ...] = (
     ("request had insufficient authentication",
      "permission", True, "none"),
     ("unauthenticated", "permission", True, "none"),
-    # Config errors: fatal, same request can never work.
+    # Config errors: fatal, same request can never work. (The
+    # specific phrasings live above the not-found rules; the bare
+    # status string stays down here below the permission rules.)
     ("invalid_argument", "invalid_argument", True, "none"),
-    ("invalid value for field", "invalid_argument", True, "none"),
-    ("accelerator type .* not found", "invalid_argument", True,
-     "none"),
-    ("is not a valid accelerator-type", "invalid_argument", True,
-     "none"),
-    ("unsupported runtime version", "invalid_argument", True, "none"),
 )
 
 

@@ -183,3 +183,35 @@ def test_manager_fails_fast_on_stockout(tmp_path, monkeypatch):
                                      poll_interval=0.05)
     assert time.monotonic() - start < 10  # not the 300 s timeout
     assert "stockout" in str(exc.value)
+
+
+def test_bare_resource_exhausted_backs_off():
+    """RESOURCE_EXHAUSTED with no capacity wording is GCP's API
+    rate-limit shape (HTTP 429); other_zone would abort allocation on
+    a transient, so it must back off instead (advisor r2 #1)."""
+    got = ge.classify(
+        '{"error": {"code": 429, "status": "RESOURCE_EXHAUSTED", '
+        '"message": "Too many requests; try again later."}}')
+    assert got.kind == "unavailable"
+    assert not got.fatal
+    assert got.retry == "backoff"
+
+
+def test_capacity_worded_resource_exhausted_is_stockout():
+    got = ge.classify(
+        '{"status": "RESOURCE_EXHAUSTED", "message": "There is no '
+        'more capacity in the zone \"us-central2-b\"."}')
+    assert got.kind == "stockout"
+    assert got.retry == "other_zone"
+
+
+def test_accelerator_not_found_beats_generic_not_found():
+    """'Accelerator type X was not found' is a fatal config error;
+    the generic 'was not found' rule must not swallow it into a
+    non-fatal not_found that polls to timeout (advisor r2 #2)."""
+    got = ge.classify(
+        "ERROR: (gcloud.compute.tpus.tpu-vm.create) Accelerator type "
+        "v5litepod-4 was not found in zone us-east1-d")
+    assert got.kind == "invalid_argument"
+    assert got.fatal
+    assert got.retry == "none"

@@ -339,3 +339,55 @@ def test_auto_scratch_lifecycle():
             assert fh.read().strip() == "payload-42"
     finally:
         substrate.stop_all()
+
+
+def test_auto_scratch_preserved_when_harvest_fails():
+    """If the job-release (harvest) command fails, the scratch dir
+    must NOT be deleted — partially-harvested data would be
+    irrecoverable (advisor r2 #3)."""
+    import os
+
+    conf = {"pool_specification": {
+        "id": "scratchpool2", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "scratchjob2",
+            "auto_scratch": True,
+            "auto_complete": True,
+            "job_release": {"command": "sh -c 'exit 3'"},
+            "tasks": [
+                {"id": "writer",
+                 "command": "sh -c 'echo keep-me > "
+                            "$SHIPYARD_JOB_SCRATCH/marker'"},
+            ]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "scratchpool2",
+                                        "scratchjob2", timeout=60)
+        assert all(t["state"] == "completed" for t in tasks), tasks
+        node_id = FakePodSubstrate.node_id("scratchpool2", 0, 0)
+        scratch = os.path.join(substrate.work_root, "scratchpool2",
+                               node_id, "scratch", "scratchjob2")
+        # Wait for the job to complete (release ran and failed).
+        deadline = time.monotonic() + 30
+        while True:
+            job = store.get_entity(names.TABLE_JOBS, "scratchpool2",
+                                   "scratchjob2")
+            if job["state"] == "completed":
+                break
+            assert time.monotonic() < deadline, job
+            time.sleep(0.25)
+        # Scratch survives the failed harvest.
+        marker = os.path.join(scratch, "marker")
+        assert os.path.isfile(marker), \
+            f"scratch deleted despite failed harvest: {scratch}"
+        with open(marker) as fh:
+            assert fh.read().strip() == "keep-me"
+    finally:
+        substrate.stop_all()

@@ -358,3 +358,29 @@ def test_federation_proxy_vm_lifecycle():
     from batch_shipyard_tpu.state import names as _n
     assert not list(store.query_entities(_n.TABLE_FEDERATIONS,
                                          partition_key="proxies"))
+
+
+def test_gcs_bucket_mount_commands_quote_user_values():
+    """fs.yaml values reach the nodeprep shell; metacharacters must be
+    inert (advisor r2 #5)."""
+    from batch_shipyard_tpu.remotefs import manager as rfm
+
+    cmds = rfm.gcs_bucket_mount_commands(
+        {"remote_fs": {"gcs_buckets": {"b": {
+            "bucket": "my bucket; rm -rf /",
+            "mount_point": "/mnt/evil $(whoami)",
+            "mount_options": ["implicit-dirs", "uid=100; reboot"],
+        }}}}, "b")
+    assert len(cmds) == 1
+    cmd = cmds[0]
+    # Every user value appears only inside single quotes.
+    assert "'my bucket; rm -rf /'" in cmd
+    assert "'/mnt/evil $(whoami)'" in cmd
+    assert "-o 'uid=100; reboot'" in cmd
+    # And never bare (outside the quoted spans).
+    stripped = (cmd.replace("'my bucket; rm -rf /'", "")
+                   .replace("'/mnt/evil $(whoami)'", "")
+                   .replace("'uid=100; reboot'", ""))
+    assert "rm -rf" not in stripped
+    assert "$(whoami)" not in stripped
+    assert "reboot" not in stripped
