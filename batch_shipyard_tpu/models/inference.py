@@ -3,10 +3,10 @@
 The framework's serving-side counterpart to the training path
 (ROADMAP item; the reference had no inference story at all). Design:
 
-  - prefill: one jitted full-sequence forward over the prompt while
-    writing the KV cache (token-by-token via scan keeps the same cache
-    layout as decode — simple and correct; a batched prefill kernel is
-    a later optimization);
+  - prefill: ONE jitted full-sequence forward over the prompt writing
+    all KV-cache rows in a single MXU-batched pass (the multi-token
+    insert path of transformer._decode_attend) — prefill cost is one
+    forward, not T_prompt sequential micro-steps;
   - decode: one token per step through the transformer's decode mode
     (flax 'cache' collection holding per-layer K/V + write index),
     inside a single jitted lax.scan — no per-token Python dispatch;
@@ -91,22 +91,22 @@ def generate(model: tfm.TransformerLM, params, cache, prompt,
         return ((mutated["cache"], next_token[:, None], pos + 1, key),
                 next_token)
 
-    # Prefill: feed prompt tokens through the same single-step path so
-    # the cache fills; outputs before the last prompt token are
-    # teacher-forced (discarded).
-    def prefill_step(carry, token_t):
-        cache, pos = carry
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache},
-            token_t[:, None], positions=pos[None], mutable=["cache"])
-        return (mutated["cache"], pos + 1), logits[:, 0]
-
-    (cache, pos), prefill_logits = jax.lax.scan(
-        prefill_step, (cache, jnp.int32(0)),
-        jnp.moveaxis(prompt, 1, 0))
+    # Prefill: ONE full-sequence forward through the multi-token
+    # cache-insert path (transformer._decode_attend seq > 1) — all
+    # prompt K/V land in the cache in a single MXU-batched pass
+    # instead of a T_prompt-step scan. Only the last position's
+    # logits are needed, so return_hidden + a [B, d] x [d, vocab]
+    # matmul avoids materializing [B, T, vocab] fp32 logits.
+    hidden, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt,
+        return_hidden=True, mutable=["cache"])
+    cache = mutated["cache"]
+    pos = jnp.int32(prompt_len)
+    embedding = params["embed"]["embedding"]
+    last_logits = jnp.dot(hidden[:, -1].astype(jnp.float32),
+                          embedding.astype(jnp.float32).T)
     key, sample_key = jax.random.split(key)
-    first = _sample(prefill_logits[-1].astype(jnp.float32),
-                    sample_key, sampling)
+    first = _sample(last_logits, sample_key, sampling)
     (cache, _tok, _pos, _key), generated = jax.lax.scan(
         step, (cache, first[:, None], pos, key), None,
         length=num_tokens - 1)

@@ -157,27 +157,33 @@ class ContinuousBatcher:
         page = getattr(self, "page_size", 0)
 
         def dense_prefill(params, prompt, prompt_len):
-            """Batch-1 prefill over the (bucket-padded) prompt [1, L].
-            prompt_len is DYNAMIC (a traced int32): the scan consumes
-            all L tokens — the rows written past prompt_len are
-            garbage, but they are masked-on-read (key_pos <= idx) and
-            each is overwritten by the decode step that first reaches
-            its position, so only the length bookkeeping needs the
-            true value. This is what makes L bucketable: one compile
-            per BUCKET instead of one per distinct prompt length."""
+            """Batch-1 BATCHED prefill over the (bucket-padded) prompt
+            [1, L]: ONE full-sequence forward (the multi-token insert
+            path of transformer._decode_attend) writes all L cache
+            rows and attends causally in a single MXU pass — prefill
+            wall-clock is one forward, not L sequential micro-steps.
+
+            prompt_len is DYNAMIC (a traced int32): rows written past
+            prompt_len are garbage, but they are masked-on-read
+            (key_pos <= idx) and each is overwritten by the decode
+            step that first reaches its position, so only the length
+            bookkeeping needs the true value. This is what makes L
+            bucketable: one compile per BUCKET instead of one per
+            distinct prompt length.
+
+            The last-token logits come from the final hidden state at
+            prompt_len-1 (return_hidden + a [d, vocab] matvec) so the
+            full [L, vocab] fp32 logits tensor never materializes."""
             small = inf.init_cache(dense_model, params, 1)
-
-            def body(carry, tok):
-                c, pos = carry
-                logits, mut = dense_model.apply(
-                    {"params": params, "cache": c}, tok[None, None],
-                    positions=pos[None], mutable=["cache"])
-                return (mut["cache"], pos + 1), logits[0, 0]
-
-            (small, _pos), logits_seq = jax.lax.scan(
-                body, (small, jnp.int32(0)), prompt[0])
-            last = jnp.take(logits_seq, prompt_len - 1, axis=0)
-            return small, last
+            hidden, mut = dense_model.apply(
+                {"params": params, "cache": small}, prompt,
+                return_hidden=True, mutable=["cache"])
+            last_h = jnp.take(hidden[0], prompt_len - 1,
+                              axis=0)                       # [d]
+            embedding = params["embed"]["embedding"]
+            last = jnp.dot(embedding.astype(jnp.float32),
+                           last_h.astype(jnp.float32))      # [vocab]
+            return mut["cache"], last
 
         @jax.jit
         def prefill(params, cache, slot, prompt, prompt_len):

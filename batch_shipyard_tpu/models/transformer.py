@@ -55,6 +55,14 @@ class TransformerConfig:
     # 'cache' collection) and consumes one token step per call.
     decode: bool = False
     max_decode_len: int = 2048
+    # Fuse each block's RMSNorm into its first projection matmul via
+    # the Pallas kernel (ops/fused_norm.py): q/k/v collapse into one
+    # [d, 3F] matmul and gate/up into one [d, 2*d_ff] matmul, with the
+    # normalized activation never touching HBM. Changes the parameter
+    # layout (qkv_kernel / gate_up_kernel instead of per-projection
+    # Dense kernels) — opt-in, mutually exclusive with tp_axis /
+    # quantize_matmuls / decode.
+    fused_norm: bool = False
     # Run projection/MLP matmuls through the int8 Pallas kernels
     # (ops/quantization.py): both operands quantized per-row with
     # stochastic rounding, int32 MXU accumulation (2x the bf16 rate on
@@ -171,12 +179,29 @@ class Attention(nn.Module):
         cfg = self.config
         features = cfg.n_heads * cfg.d_head
         dense = functools_partial_dense(cfg)
-        if cfg.tp_axis:
-            x = tp_region_input(x, cfg.tp_axis)
-        q = dense(features, "q_proj")(x)
-        k = dense(features, "k_proj")(x)
-        v = dense(features, "v_proj")(x)
-        batch, seq = x.shape[0], x.shape[1]
+        if cfg.fused_norm:
+            # x arrives UN-normed; the block's attn RMSNorm is fused
+            # into one [d, 3F] qkv projection (ops/fused_norm.py).
+            from batch_shipyard_tpu.ops import fused_norm as fn_ops
+            norm_scale = self.param(
+                "norm_scale", nn.initializers.ones,
+                (x.shape[-1],), jnp.float32)
+            qkv_kernel = self.param(
+                "qkv_kernel", nn.initializers.lecun_normal(),
+                (x.shape[-1], 3 * features), cfg.param_dtype)
+            batch, seq = x.shape[0], x.shape[1]
+            qkv = fn_ops.rmsnorm_matmul(
+                x.reshape(batch * seq, -1), norm_scale,
+                qkv_kernel.astype(cfg.dtype))
+            q, k, v = jnp.split(
+                qkv.reshape(batch, seq, 3 * features), 3, axis=-1)
+        else:
+            if cfg.tp_axis:
+                x = tp_region_input(x, cfg.tp_axis)
+            q = dense(features, "q_proj")(x)
+            k = dense(features, "k_proj")(x)
+            v = dense(features, "v_proj")(x)
+            batch, seq = x.shape[0], x.shape[1]
         q = q.reshape(batch, seq, cfg.n_heads, cfg.d_head)
         k = k.reshape(batch, seq, cfg.n_heads, cfg.d_head)
         v = v.reshape(batch, seq, cfg.n_heads, cfg.d_head)
@@ -204,15 +229,19 @@ class Attention(nn.Module):
         return out
 
     def _decode_attend(self, q, k, v):
-        """Single-step decode: insert this step's K/V into the cache
-        and attend the (length-1) query over the valid prefix.
+        """Cache-writing decode attention. seq == 1 is the per-token
+        decode step; seq > 1 is BATCHED PREFILL / chunked insert: all
+        seq K/V rows land in the cache in one scatter and the queries
+        attend causally over the cache in one MXU-batched pass —
+        prefill wall-clock is one forward instead of L sequential
+        micro-steps (VERDICT r2 order #2).
 
         The write index is PER SLOT ([B] int32), so independent
         sequences at different depths share one batched cache — the
-        requirement for continuous batching (models/serving.py)."""
+        requirement for continuous batching (models/serving.py).
+        Multi-token inserts start at each slot's current index."""
         cfg = self.config
         batch, seq, heads, depth = q.shape
-        assert seq == 1, "decode mode consumes one token per call"
         cache_k = self.variable(
             "cache", "k", jnp.zeros,
             (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
@@ -222,20 +251,34 @@ class Attention(nn.Module):
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((batch,), jnp.int32))
         idx = index.value  # [B]
-        rows = jnp.arange(batch)
-        cache_k.value = cache_k.value.at[rows, idx].set(
-            k[:, 0].astype(cfg.dtype))
-        cache_v.value = cache_v.value.at[rows, idx].set(
-            v[:, 0].astype(cfg.dtype))
-        index.value = idx + 1
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (cfg.max_decode_len, 1), 0)[:, 0]
+        if seq == 1:
+            rows = jnp.arange(batch)
+            cache_k.value = cache_k.value.at[rows, idx].set(
+                k[:, 0].astype(cfg.dtype))
+            cache_v.value = cache_v.value.at[rows, idx].set(
+                v[:, 0].astype(cfg.dtype))
+            index.value = idx + 1
+            mask = (key_pos[None, :] <= idx[:, None])[:, None, None, :]
+        else:
+            rows = jnp.arange(batch)[:, None]                 # [B, 1]
+            cols = idx[:, None] + jnp.arange(seq)[None, :]    # [B, S]
+            cache_k.value = cache_k.value.at[rows, cols].set(
+                k.astype(cfg.dtype))
+            cache_v.value = cache_v.value.at[rows, cols].set(
+                v.astype(cfg.dtype))
+            index.value = idx + seq
+            # Causal over absolute cache positions: query s (absolute
+            # idx+s) sees keys <= idx+s — earlier chunks AND the
+            # causal prefix of this one.
+            mask = (key_pos[None, None, :] <=
+                    cols[:, :, None])[:, None, :, :]  # [B, 1, S, T]
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, cache_k.value,
             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(depth))
-        key_pos = jax.lax.broadcasted_iota(
-            jnp.int32, (cfg.max_decode_len, 1), 0)[:, 0]
-        mask = key_pos[None, :] <= idx[:, None]   # [B, T]
-        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cache_v.value,
@@ -330,6 +373,24 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = functools_partial_dense(cfg)
+        if cfg.fused_norm:
+            # x arrives UN-normed; the block's mlp RMSNorm fuses into
+            # one [d, 2*d_ff] gate/up projection.
+            from batch_shipyard_tpu.ops import fused_norm as fn_ops
+            norm_scale = self.param(
+                "norm_scale", nn.initializers.ones,
+                (x.shape[-1],), jnp.float32)
+            gate_up_kernel = self.param(
+                "gate_up_kernel", nn.initializers.lecun_normal(),
+                (x.shape[-1], 2 * cfg.d_ff), cfg.param_dtype)
+            batch, seq = x.shape[0], x.shape[1]
+            gu = fn_ops.rmsnorm_matmul(
+                x.reshape(batch * seq, -1), norm_scale,
+                gate_up_kernel.astype(cfg.dtype))
+            gate, up = jnp.split(
+                gu.reshape(batch, seq, 2 * cfg.d_ff), 2, axis=-1)
+            return dense(cfg.d_model, "down_proj")(
+                nn.silu(gate) * up)
         if cfg.tp_axis:
             x = tp_region_input(x, cfg.tp_axis)
         gate = dense(cfg.d_ff, "gate_proj")(x)
@@ -348,6 +409,17 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
+        if cfg.fused_norm:
+            if (cfg.tp_axis or cfg.quantize_matmuls or cfg.decode
+                    or self.use_moe):
+                raise NotImplementedError(
+                    "fused_norm composes only with the plain dense "
+                    "training path (no tp_axis / quantize_matmuls / "
+                    "decode / moe)")
+            # The norms live INSIDE Attention/MLP (fused into their
+            # first projection); pass the raw residual stream.
+            x = x + Attention(cfg, name="attn")(x, positions)
+            return x + MLP(cfg, name="mlp")(x)
         x = x + Attention(cfg, name="attn")(
             RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions)
         normed = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)

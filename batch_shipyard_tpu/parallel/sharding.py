@@ -27,6 +27,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _TRANSFORMER_RULES: list[tuple[str, P]] = [
     (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$",
      P("fsdp", "tp")),
+    # Fused-norm path (models/transformer.py fused_norm): the merged
+    # qkv / gate-up projections are column-sharded like their unfused
+    # counterparts.
+    (r".*(qkv_kernel|gate_up_kernel)$", P("fsdp", "tp")),
     (r".*(o_proj|down_proj)/kernel$", P("tp", "fsdp")),
     (r".*embed/embedding$", P("tp", "fsdp")),
     # MoE: experts over ep, expert-internal dims over fsdp/tp.

@@ -1,0 +1,71 @@
+"""XLA/libtpu tuning-flag profiles (the conv/collective autotune lever
+from ROADMAP.md).
+
+XLA reads ``XLA_FLAGS`` at backend initialization and libtpu reads
+``LIBTPU_INIT_ARGS`` at TPU client creation, so profiles must be
+applied BEFORE the first jax device query — callers (bench.py, user
+launch scripts via `jobs/launcher.py` env synthesis) apply them at
+process start.
+
+Profiles are additive sets of publicly documented flags (the MaxText /
+scaling-book lineage); "default" is intentionally empty — flags are
+workload-dependent and a wrong flag silently regresses, so anything
+non-empty is opt-in via ``SHIPYARD_XLA_TUNING=<profile>`` and should
+be validated by a measured A/B on the target workload (tools/
+tpu_checks.py --tuning runs the compile-sanity half of that).
+"""
+
+from __future__ import annotations
+
+import os
+
+PROFILES: dict[str, dict[str, str]] = {
+    # No flags: trust the compiler defaults.
+    "default": {},
+    # Overlap collectives with compute (multi-chip training): the
+    # standard async-collective set from public large-model configs.
+    "async-collectives": {
+        "XLA_FLAGS": " ".join([
+            "--xla_tpu_enable_async_collective_fusion=true",
+            "--xla_tpu_enable_async_collective_fusion_fuse_all_gather"
+            "=true",
+            "--xla_tpu_enable_async_collective_fusion_multiple_steps"
+            "=true",
+            "--xla_tpu_overlap_compute_collective_tc=true",
+            "--xla_enable_async_all_gather=true",
+        ]),
+    },
+    # Data-parallel all-reduce scheduling (dp/fsdp training).
+    "dp-allreduce": {
+        "XLA_FLAGS": " ".join([
+            "--xla_tpu_enable_data_parallel_all_reduce_opt=true",
+            "--xla_tpu_data_parallel_opt_different_sized_ops=true",
+        ]),
+    },
+    # Larger scoped VMEM for conv/fusion tiling headroom (the conv
+    # autotune lever: gives XLA's fusion cost model more on-chip
+    # scratch to tile ResNet convs into).
+    "vmem-high": {
+        "XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=65536",
+    },
+}
+
+
+def apply_tuning_env(profile: str | None = None,
+                     environ: dict | None = None) -> str:
+    """Merge the chosen profile's flags into the environment
+    (appending to any user-set XLA_FLAGS rather than clobbering).
+    Profile resolution: explicit arg > SHIPYARD_XLA_TUNING > default.
+    Returns the profile name applied."""
+    env = os.environ if environ is None else environ
+    name = profile or env.get("SHIPYARD_XLA_TUNING", "default")
+    if name not in PROFILES:
+        raise KeyError(
+            f"unknown tuning profile {name!r} "
+            f"(have: {sorted(PROFILES)})")
+    for var, flags in PROFILES[name].items():
+        existing = env.get(var, "")
+        # Idempotent: don't append the same flags twice.
+        if flags and flags not in existing:
+            env[var] = f"{existing} {flags}".strip()
+    return name

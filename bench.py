@@ -77,7 +77,9 @@ def bench_resnet(batch_size: int = 256, image_size: int = 224,
 
 
 def bench_transformer(batch_size: int = 16, seq_len: int = 2048,
-                      warmup: int = 2, iters: int = 5) -> dict:
+                      warmup: int = 2, iters: int = 5,
+                      fused_norm: bool = False,
+                      quantize: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -93,7 +95,10 @@ def bench_transformer(batch_size: int = 16, seq_len: int = 2048,
         # No layer remat: flash/blockwise attention already
         # rematerializes its block scores, and at b16 the rest of the
         # activations fit v5e HBM — measured 24.6k vs 15.2k tok/s.
-        remat=False)
+        remat=False,
+        # MFU levers (ROADMAP): Pallas fused RMSNorm+matmul
+        # projections, or the int8 MXU path (2x bf16 rate on v5e).
+        fused_norm=fused_norm, quantize_matmuls=quantize)
     harness = train_mod.build_transformer_train(
         mesh, config, batch_size=batch_size, seq_len=seq_len)
     rng = np.random.RandomState(0)
@@ -121,6 +126,8 @@ def bench_transformer(batch_size: int = 16, seq_len: int = 2048,
         "chips": n_dev,
         "step_seconds": elapsed / iters,
         "final_loss": final_loss,
+        "fused_norm": fused_norm,
+        "quantize_matmuls": quantize,
     }
 
 
@@ -224,7 +231,11 @@ def _probe_devices(timeout: float = 240.0):
 
 
 def main() -> int:
+    # Tuning profile (SHIPYARD_XLA_TUNING) must land in the env before
+    # the first backend init in this process (parallel/tuning.py).
+    from batch_shipyard_tpu.parallel.tuning import apply_tuning_env
     details: dict = {"platform": None}
+    details["xla_tuning_profile"] = apply_tuning_env()
     probe_error = _probe_devices()
     if probe_error is not None:
         # Orchestration latency needs no accelerator; measure it and
@@ -266,10 +277,29 @@ def main() -> int:
     details["devices"] = [str(d) for d in jax.devices()]
     resnet = bench_resnet()
     details["resnet50"] = resnet
+    # Transformer: fused RMSNorm+matmul Pallas projections first (the
+    # MFU lever); if Mosaic rejects the kernel on this chip, fall
+    # back to the unfused path and record both outcomes.
     try:
-        details["transformer"] = bench_transformer()
+        details["transformer"] = bench_transformer(fused_norm=True)
     except Exception as exc:  # noqa: BLE001 - secondary metric
-        details["transformer"] = {"error": str(exc)}
+        details["transformer_fused_error"] = str(exc)
+        try:
+            details["transformer"] = bench_transformer()
+        except Exception as exc2:  # noqa: BLE001
+            details["transformer"] = {"error": str(exc2)}
+    if ("error" not in details.get("transformer", {})
+            and "transformer_fused_error" not in details):
+        # Unfused comparison point for the A/B. Skipped when the fused
+        # kernel failed — the fallback above already ran unfused.
+        try:
+            details["transformer_unfused"] = bench_transformer()
+        except Exception as exc:  # noqa: BLE001
+            details["transformer_unfused"] = {"error": str(exc)}
+    try:
+        details["transformer_int8"] = bench_transformer(quantize=True)
+    except Exception as exc:  # noqa: BLE001 - experimental path
+        details["transformer_int8"] = {"error": str(exc)}
     try:
         details["orchestration"] = bench_orchestration_latency()
     except Exception as exc:  # noqa: BLE001 - secondary metric

@@ -74,3 +74,62 @@ def test_decode_respects_max_len(setup):
     leaf = jax.tree_util.tree_leaves(
         {k: v for k, v in cache.items()})[0]
     assert leaf is not None
+
+
+def test_multi_token_insert_matches_sequential(setup):
+    """The batched prefill path (multi-token _decode_attend insert)
+    must produce the same cache state and outputs as feeding the same
+    tokens one step at a time — including a chunk inserted at a
+    nonzero per-slot depth."""
+    config, model, params = setup
+    dconfig = inference.decode_config(config, max_decode_len=32)
+    dmodel = tfm.TransformerLM(dconfig)
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 97, (2, 7)), jnp.int32)
+
+    # Sequential: one token per apply.
+    cache_seq = inference.init_cache(dmodel, params, 2)
+    outs = []
+    for t in range(tokens.shape[1]):
+        logits, mut = dmodel.apply(
+            {"params": params, "cache": cache_seq},
+            tokens[:, t:t + 1], positions=jnp.int32(t)[None],
+            mutable=["cache"])
+        cache_seq = mut["cache"]
+        outs.append(logits[:, 0])
+    seq_logits = jnp.stack(outs, axis=1)        # [B, T, vocab]
+
+    # Batched: one multi-token apply (positions default to arange).
+    cache_bat = inference.init_cache(dmodel, params, 2)
+    bat_logits, mut = dmodel.apply(
+        {"params": params, "cache": cache_bat}, tokens,
+        mutable=["cache"])
+    cache_bat = mut["cache"]
+    np.testing.assert_allclose(
+        np.asarray(bat_logits), np.asarray(seq_logits),
+        rtol=2e-5, atol=2e-5)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(cache_seq),
+            jax.tree_util.tree_leaves_with_path(cache_bat)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+            err_msg=str(pa))
+
+    # Chunked continuation from depth 7: next 3 tokens in one chunk
+    # vs one-at-a-time, on top of identical caches.
+    more = jnp.asarray(rng.randint(0, 97, (2, 3)), jnp.int32)
+    cache_a, cache_b = cache_seq, cache_bat
+    for t in range(3):
+        logits, mut = dmodel.apply(
+            {"params": params, "cache": cache_a},
+            more[:, t:t + 1], positions=jnp.int32(7 + t)[None],
+            mutable=["cache"])
+        cache_a = mut["cache"]
+    last_seq = logits[:, 0]
+    chunk_logits, mut = dmodel.apply(
+        {"params": params, "cache": cache_b}, more,
+        positions=jnp.arange(7, 10, dtype=jnp.int32),
+        mutable=["cache"])
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits[:, -1]), np.asarray(last_seq),
+        rtol=2e-5, atol=2e-5)
