@@ -46,6 +46,16 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
 
 
+@dataclasses.dataclass
+class _QueueEntry:
+    """A queued request, plus the tokens it had already generated if
+    it was preempted (overcommit mode): resumption re-prefills
+    prompt + resumed in one pass and continues decoding — the greedy
+    continuation is identical to the uninterrupted run."""
+    request: Request
+    resumed: list[int] = dataclasses.field(default_factory=list)
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching engine.
 
@@ -63,17 +73,35 @@ class ContinuousBatcher:
                  sampling: inf.SamplingConfig = inf.SamplingConfig(),
                  seed: int = 0,
                  kv_page_size: Optional[int] = None,
-                 kv_num_pages: Optional[int] = None):
+                 kv_num_pages: Optional[int] = None,
+                 overcommit: bool = False):
         """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
         live in a shared kv_num_pages-page pool and slots hold block
         tables covering only their live tokens, so HBM is sized for
         aggregate active context instead of
         num_slots * max_decode_len. kv_num_pages defaults to the
-        no-deadlock capacity (num_slots * ceil(max_len/page)); size it
-        smaller to overcommit — admission waits for pages, and a
-        decode step that cannot grow raises."""
+        no-deadlock capacity (num_slots * ceil(max_len/page)).
+
+        Admission policy for a smaller pool:
+          - overcommit=False (default): RESERVATION — admission takes
+            each request's worst-case page count (prompt +
+            max_new_tokens) up front, so decode can never exhaust the
+            pool, at the cost of admitting fewer concurrent requests
+            than actual usage would allow.
+          - overcommit=True: PREEMPTION — admission takes only the
+            prompt's pages (+1 headroom); when a decode step needs a
+            page and none is free, the active slot with the fewest
+            generated tokens is preempted (pages reclaimed, request
+            re-queued at the head) and later resumed by re-prefilling
+            prompt + already-generated tokens. Short actual
+            generations then share a pool far below worst-case."""
         self.config = inf.decode_config(config, max_decode_len)
         self.paged = kv_page_size is not None
+        self.overcommit = overcommit
+        self.preemptions = 0
+        if overcommit and not self.paged:
+            raise ValueError("overcommit requires the paged KV cache "
+                             "(kv_page_size)")
         if self.paged:
             if max_decode_len % kv_page_size:
                 raise ValueError("max_decode_len must be a multiple "
@@ -119,7 +147,7 @@ class ContinuousBatcher:
             # step runs.
             self._push_tables()
         self._slots = [_Slot() for _ in range(num_slots)]
-        self._queue: list[Request] = []
+        self._queue: list[_QueueEntry] = []
         self._tokens = jnp.zeros((num_slots, 1), jnp.int32)
         self._positions = jnp.zeros((num_slots,), jnp.int32)
         self._active = jnp.zeros((num_slots,), jnp.bool_)
@@ -252,6 +280,9 @@ class ContinuousBatcher:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"{request.request_id}: max_new_tokens must be >= 1")
+        if not request.prompt:
+            raise ValueError(
+                f"{request.request_id}: prompt must be non-empty")
         if self.paged:
             worst = -(-(len(request.prompt) + request.max_new_tokens)
                       // self.page_size)
@@ -266,7 +297,7 @@ class ContinuousBatcher:
                 f"{request.request_id}: prompt+generation "
                 f"{len(request.prompt)}+{request.max_new_tokens} "
                 f"exceeds max_decode_len {self.max_decode_len}")
-        self._queue.append(request)
+        self._queue.append(_QueueEntry(request))
 
     def pending(self) -> int:
         return len(self._queue) + sum(
@@ -328,12 +359,13 @@ class ContinuousBatcher:
     def _grow_pages(self) -> None:
         """Allocate a fresh page for any active slot whose NEXT write
         starts a new block, and push the updated tables into every
-        layer's cache copy."""
+        layer's cache copy. In overcommit mode an empty free list
+        preempts a victim instead of raising."""
         positions = np.asarray(self._positions)
-        active = np.asarray(self._active)
+        active = np.asarray(self._active).copy()
         changed = False
         for i in range(self.num_slots):
-            if not active[i]:
+            if not active[i] or self._slots[i].request is None:
                 continue
             pos = int(positions[i])
             if pos % self.page_size != 0:
@@ -341,17 +373,43 @@ class ContinuousBatcher:
             block = pos // self.page_size
             if block < len(self._slot_pages[i]):
                 continue  # prefill already covers this block
-            if not self._free_pages:
-                raise RuntimeError(
-                    "paged KV pool exhausted mid-decode; size "
-                    "kv_num_pages >= num_slots * max_decode_len / "
-                    "page_size to rule this out")
+            while not self._free_pages:
+                if not self.overcommit:
+                    raise RuntimeError(
+                        "paged KV pool exhausted mid-decode; size "
+                        "kv_num_pages >= num_slots * max_decode_len /"
+                        " page_size to rule this out, or enable "
+                        "overcommit=True for preemption")
+                victim = self._preempt(exclude=i)
+                active[victim] = False
             pagenum = self._free_pages.pop()
             self._slot_pages[i].append(pagenum)
             self._table[i, block] = pagenum
             changed = True
         if changed:
             self._push_tables()
+
+    def _preempt(self, exclude: int) -> int:
+        """Evict the active slot with the fewest generated tokens
+        (cheapest re-prefill), reclaim its pages, and re-queue its
+        request AT THE HEAD with its generated-so-far tokens so
+        resumption re-prefills prompt+generated and continues — the
+        greedy continuation is unchanged. Returns the victim index."""
+        candidates = [
+            j for j in range(self.num_slots)
+            if j != exclude and self._slots[j].request is not None]
+        if not candidates:
+            raise RuntimeError(
+                "paged KV pool exhausted with no preemptible slot — "
+                "a single request's live context exceeds the pool")
+        victim = min(candidates,
+                     key=lambda j: len(self._slots[j].generated))
+        slot = self._slots[victim]
+        self._queue.insert(
+            0, _QueueEntry(slot.request, list(slot.generated)))
+        self.preemptions += 1
+        self._free_slot(victim)
+        return victim
 
     def _push_tables(self) -> None:
         """Write the canonical block table into every layer's cache
@@ -383,21 +441,36 @@ class ContinuousBatcher:
         for i, slot in enumerate(self._slots):
             if slot.request is not None or not self._queue:
                 continue
-            req = self._queue[0]
-            bucket = self._bucket_length(len(req.prompt))
-            padded = req.prompt + [0] * (bucket - len(req.prompt))
+            entry = self._queue[0]
+            req = entry.request
+            # Resumed (preempted) requests re-prefill prompt + what
+            # they had already generated, in one batched pass.
+            tokens = req.prompt + entry.resumed
+            bucket = self._bucket_length(len(tokens))
+            padded = tokens + [0] * (bucket - len(tokens))
             prompt = jnp.asarray([padded], jnp.int32)
             if self.paged:
-                blocks_needed = -(-len(req.prompt) // self.page_size)
-                worst = -(-(len(req.prompt) + req.max_new_tokens)
+                blocks_needed = -(-len(tokens) // self.page_size)
+                remaining = req.max_new_tokens - len(entry.resumed)
+                worst = -(-(len(tokens) + remaining)
                           // self.page_size)
-                if self._avail_pages < worst:
-                    # Not enough budget for this request's worst case:
-                    # wait for frees rather than risking a mid-decode
-                    # exhaustion deadlock between half-grown slots.
-                    break
-                self._avail_pages -= worst
-                self._slot_reserved[i] = worst
+                if self.overcommit:
+                    # Take only the prompt's pages (+1 block of
+                    # decode headroom against immediate re-thrash);
+                    # exhaustion during decode preempts.
+                    want = min(blocks_needed + (1 if remaining else 0),
+                               worst)
+                    if len(self._free_pages) < want:
+                        break
+                else:
+                    if self._avail_pages < worst:
+                        # Not enough budget for this request's worst
+                        # case: wait for frees rather than risking a
+                        # mid-decode exhaustion deadlock between
+                        # half-grown slots.
+                        break
+                    self._avail_pages -= worst
+                    self._slot_reserved[i] = worst
                 self._queue.pop(0)
                 pages = [self._free_pages.pop()
                          for _ in range(blocks_needed)]
@@ -408,20 +481,19 @@ class ContinuousBatcher:
                 self._table[i] = row
                 self.cache, last_logits = self._prefill_paged(
                     self.params, self.cache, i, prompt,
-                    jnp.asarray(row), len(req.prompt))
+                    jnp.asarray(row), len(tokens))
             else:
                 self._queue.pop(0)
                 self.cache, last_logits = self._prefill(
-                    self.params, self.cache, i, prompt,
-                    len(req.prompt))
+                    self.params, self.cache, i, prompt, len(tokens))
             self._key, sample_key = jax.random.split(self._key)
             first = inf._sample(
                 last_logits[None].astype(jnp.float32), sample_key,
                 self.sampling)
-            # The prefill-sampled token IS the first generated token.
-            self._slots[i] = _Slot(request=req,
-                                   generated=[int(first[0])])
+            # The prefill-sampled token IS the next generated token.
+            self._slots[i] = _Slot(
+                request=req,
+                generated=entry.resumed + [int(first[0])])
             self._tokens = self._tokens.at[i, 0].set(first[0])
-            self._positions = self._positions.at[i].set(
-                len(req.prompt))
+            self._positions = self._positions.at[i].set(len(tokens))
             self._active = self._active.at[i].set(True)
