@@ -23,6 +23,7 @@ the protocol is fully unit-testable (SURVEY.md section 4).
 
 from __future__ import annotations
 
+import os
 import shutil
 import subprocess
 import threading
@@ -44,8 +45,14 @@ RENEW_INTERVAL = 15.0
 def populate_global_resources(store: StateStore, pool_id: str,
                               docker_images: list[str],
                               singularity_images: list[str] = (),
-                              concurrent_downloads: int = 10) -> None:
-    """Write the pool's image manifest (pool add path)."""
+                              concurrent_downloads: int = 10,
+                              registries: list = ()) -> None:
+    """Write the pool's image manifest (pool add path). ``registries``
+    (config.settings.DockerRegistry) ride the same manifest as
+    kind="registry" rows so every node logs in before its first pull
+    (reference scripts/registry_login.sh via the nodeprep flag
+    contract). Passwords are stored as their secret:// refs, resolved
+    on node — never plaintext in the state store."""
     for image in docker_images:
         key = util.hash_string(f"docker:{image}")[:24]
         store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
@@ -56,13 +63,27 @@ def populate_global_resources(store: StateStore, pool_id: str,
         store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
             "kind": "singularity", "image": image,
             "concurrent_downloads": concurrent_downloads})
+    for reg in registries or ():
+        key = util.hash_string(f"registry:{reg.server}")[:24]
+        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+            "kind": "registry", "server": reg.server,
+            "username": reg.username, "password": reg.password,
+            "auth": reg.auth})
+
+
+def registry_manifest(store: StateStore, pool_id: str) -> list[dict]:
+    """The pool's registry-credential rows."""
+    return [row for row in store.query_entities(
+        names.TABLE_IMAGES, partition_key=pool_id)
+        if row.get("kind") == "registry"]
 
 
 def global_resources_loaded(store: StateStore, pool_id: str,
                             node_id: str) -> bool:
     """Has this node recorded completion of all its image pulls?"""
     wanted = {row["_rk"] for row in store.query_entities(
-        names.TABLE_IMAGES, partition_key=pool_id)}
+        names.TABLE_IMAGES, partition_key=pool_id)
+        if row.get("kind") != "registry"}
     if not wanted:
         return True
     try:
@@ -78,13 +99,69 @@ class CascadeImageProvisioner:
 
     def __init__(self, store: StateStore, fallback_registry:
                  Optional[str] = None, pull_timeout: float = 1800.0,
-                 puller: Optional[object] = None) -> None:
+                 puller: Optional[object] = None,
+                 login_runner: Optional[object] = None,
+                 secrets_file: Optional[str] = None) -> None:
         self.store = store
         self.fallback_registry = fallback_registry
         self.pull_timeout = pull_timeout
         self._puller = puller  # test hook: callable(kind, image) -> int
+        # test hook: callable(argv: list[str], stdin: str|None) -> int
+        self._login_runner = login_runner
+        self._secrets_file = secrets_file or os.environ.get(
+            "SHIPYARD_SECRETS_FILE")
         self._loaded: set[str] = set()
+        self._logged_in: set[str] = set()
         self._lock = threading.Lock()
+
+    # -- registry auth --------------------------------------------------
+
+    def login_registries(self, pool_id: str) -> None:
+        """Authenticate to every registry in the pool manifest before
+        pulls (reference scripts/registry_login.sh:1-99 — docker login
+        per configured registry; Artifact Registry rows instead run
+        ``gcloud auth configure-docker``). secret:// passwords resolve
+        HERE, on node, via utils/secrets. Idempotent per server."""
+        from batch_shipyard_tpu.utils import secrets as secrets_mod
+        for row in registry_manifest(self.store, pool_id):
+            server = row.get("server") or ""
+            with self._lock:
+                if server in self._logged_in:
+                    continue
+            if row.get("auth") == "gcloud":
+                argv = ["gcloud", "auth", "configure-docker", server,
+                        "--quiet"]
+                rc = self._run_login(argv, None)
+            else:
+                password = row.get("password") or ""
+                if secrets_mod.is_secret_id(password):
+                    password = secrets_mod.resolve_secret(
+                        password, secrets_file=self._secrets_file)
+                argv = ["docker", "login", server,
+                        "--username", row.get("username") or "",
+                        "--password-stdin"]
+                rc = self._run_login(argv, password)
+            if rc != 0:
+                raise RuntimeError(
+                    f"registry login to {server!r} failed rc={rc}")
+            with self._lock:
+                self._logged_in.add(server)
+
+    def _run_login(self, argv: list, stdin_data) -> int:
+        if self._login_runner is not None:
+            return self._login_runner(argv, stdin_data)
+        if shutil.which(argv[0]) is None:
+            logger.info("%s unavailable; skipping registry login",
+                        argv[0])
+            return 0
+        proc = subprocess.run(
+            argv, input=(stdin_data.encode() if stdin_data else None),
+            timeout=120, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE)
+        if proc.returncode != 0:
+            logger.error("registry login failed: %s",
+                         proc.stderr.decode(errors="replace").strip())
+        return proc.returncode
 
     # -- entry points ---------------------------------------------------
 
@@ -92,8 +169,10 @@ class CascadeImageProvisioner:
         """Pull every image in the pool manifest (nodeprep path;
         reference cascade.py:724 distribute_global_resources)."""
         pool_id = agent.identity.pool_id
-        rows = list(self.store.query_entities(
-            names.TABLE_IMAGES, partition_key=pool_id))
+        self.login_registries(pool_id)
+        rows = [row for row in self.store.query_entities(
+            names.TABLE_IMAGES, partition_key=pool_id)
+            if row.get("kind") != "registry"]
         for row in rows:
             self._fetch(agent, row["_rk"], row["kind"], row["image"],
                         int(row.get("concurrent_downloads", 10)))
@@ -106,6 +185,7 @@ class CascadeImageProvisioner:
         The key must match populate_global_resources' kind-qualified
         hash so the pool-wide lease gate is actually shared."""
         pool_id = agent.identity.pool_id
+        self.login_registries(pool_id)
         for image in images:
             key = util.hash_string(f"{kind}:{image}")[:24]
             try:

@@ -765,12 +765,31 @@ class NodeAgent:
 
     # --------------------------- helpers -------------------------------
 
+    def _resolve_env_secrets(self, env: dict) -> dict:
+        """Resolve secret:// values in task/job environment_variables
+        ON NODE at launch time (reference analog: convoy/batch.py
+        :4556-4577 merges keyvault secret ids into per-task env, with
+        on-node decrypt via nodeprep :1281). The state store only ever
+        holds the refs; the plaintext exists in the task process env
+        and nowhere else. SHIPYARD_SECRETS_FILE points the agent at a
+        file-provider secrets YAML when one is used."""
+        from batch_shipyard_tpu.utils import secrets as secrets_mod
+        resolved = {}
+        secrets_file = os.environ.get("SHIPYARD_SECRETS_FILE")
+        for key, value in env.items():
+            if secrets_mod.is_secret_id(value):
+                value = secrets_mod.resolve_secret(
+                    value, secrets_file=secrets_file)
+            resolved[key] = value
+        return resolved
+
     def _build_execution(self, slot: int, job_id: str, task_id: str,
                          spec: dict, instance: int = 0, instances: int = 1,
                          host_list: tuple[str, ...] = (),
                          extra_env: Optional[dict] = None,
                          ) -> task_runner.TaskExecution:
-        env = dict(spec.get("environment_variables", {}))
+        env = self._resolve_env_secrets(
+            dict(spec.get("environment_variables", {})))
         env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
         if spec.get("auto_scratch"):
             env["SHIPYARD_JOB_SCRATCH"] = self._job_scratch_dir(job_id)
@@ -847,7 +866,8 @@ class NodeAgent:
                     shared)
             if jp_command:
                 jp_env = {
-                    **spec.get("environment_variables", {}),
+                    **self._resolve_env_secrets(
+                        dict(spec.get("environment_variables", {}))),
                     "SHIPYARD_JOB_SHARED_DIR":
                         self._job_shared_dir(job_id),
                 }

@@ -115,9 +115,33 @@ class GlobalSettings:
     singularity_images: tuple[str, ...]
     files: tuple[dict, ...]
     concurrent_source_downloads: int
+    docker_registries: tuple["DockerRegistry", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DockerRegistry:
+    """Private registry credentials (reference analog:
+    convoy/settings.py docker_registry accessors +
+    scripts/registry_login.sh — nodes log in before cascade pulls).
+    ``password`` should be a secret:// ref (utils/secrets.py), which
+    is stored verbatim and resolved ON NODE at login time — plaintext
+    never lands in the state store. ``auth='gcloud'`` instead runs
+    ``gcloud auth configure-docker <server>`` (Artifact Registry)."""
+    server: str
+    username: Optional[str] = None
+    password: Optional[str] = None
+    auth: str = "basic"           # basic | gcloud
 
 
 def global_settings(config: dict) -> GlobalSettings:
+    registries = []
+    for entry in _get(config, "shipyard_tpu", "docker_registries",
+                      default=[]) or []:
+        registries.append(DockerRegistry(
+            server=entry["server"],
+            username=entry.get("username"),
+            password=entry.get("password"),
+            auth=entry.get("auth", "basic")))
     return GlobalSettings(
         storage_entity_prefix=_get(
             config, "shipyard_tpu", "storage_entity_prefix",
@@ -134,6 +158,7 @@ def global_settings(config: dict) -> GlobalSettings:
         concurrent_source_downloads=_get(
             config, "data_replication", "concurrent_source_downloads",
             default=10),
+        docker_registries=tuple(registries),
     )
 
 

@@ -95,7 +95,9 @@ def create_pool(store: StateStore, substrate: ComputeSubstrate,
     cascade.populate_global_resources(
         store, pool.id, list(global_conf.docker_images),
         list(global_conf.singularity_images),
-        global_conf.concurrent_source_downloads)
+        global_conf.concurrent_source_downloads,
+        registries=list(
+            getattr(global_conf, "docker_registries", ()) or ()))
     try:
         substrate.allocate_pool(pool)
     except Exception as exc:

@@ -118,3 +118,76 @@ def test_perf_event_collision_bump():
     for _ in range(5):
         perf.emit(store, "p", "n0", "s", "same_event", timestamp=ts)
     assert len(perf.query(store, "p")) == 5
+
+
+def test_registry_login_before_pulls(monkeypatch):
+    """Private-registry auth (reference scripts/registry_login.sh):
+    registry rows ride the pool manifest; nodes docker-login (secret://
+    password resolved on node, passed via stdin never argv) and run
+    gcloud auth configure-docker for Artifact Registry rows — all
+    BEFORE the first pull."""
+    monkeypatch.setenv("REG_PW_TEST", "hunter2-secret")
+    store = MemoryStateStore()
+    registries = [
+        settings_mod.DockerRegistry(
+            server="reg.example.com", username="svc",
+            password="secret://env/REG_PW_TEST"),
+        settings_mod.DockerRegistry(
+            server="us-docker.pkg.dev", auth="gcloud"),
+    ]
+    populate_global_resources(
+        store, "p", ["reg.example.com/private/img:1"],
+        registries=registries)
+    # The stored manifest holds the REF, not the plaintext.
+    rows = list(store.query_entities("images", partition_key="p"))
+    reg_rows = [r for r in rows if r.get("kind") == "registry"]
+    assert len(reg_rows) == 2
+    assert all("hunter2" not in str(r) for r in reg_rows)
+
+    calls = []
+
+    def login_runner(argv, stdin_data):
+        calls.append((list(argv), stdin_data))
+        return 0
+
+    pulls = []
+    prov = CascadeImageProvisioner(
+        store, puller=lambda kind, img: pulls.append(img) or 0,
+        login_runner=login_runner)
+    agent = FakeAgent(store, "p", "n0")
+    prov.distribute_global_resources(agent)
+    # Logins happened, before any pull.
+    assert pulls == ["reg.example.com/private/img:1"]
+    assert len(calls) == 2
+    by_server = {c[0][2] if c[0][0] == "docker" else c[0][3]: c
+                 for c in calls}
+    docker_call = by_server["reg.example.com"]
+    assert docker_call[0][:3] == ["docker", "login", "reg.example.com"]
+    assert "--password-stdin" in docker_call[0]
+    assert docker_call[1] == "hunter2-secret"       # resolved, stdin
+    assert "hunter2-secret" not in " ".join(docker_call[0])  # not argv
+    gcloud_call = by_server["us-docker.pkg.dev"]
+    assert gcloud_call[0][:3] == ["gcloud", "auth", "configure-docker"]
+    # Idempotent: a second distribute does not re-login.
+    prov.distribute_global_resources(agent)
+    assert len(calls) == 2
+    # Registry rows never count as pending image resources.
+    assert global_resources_loaded(store, "p", "n0")
+
+
+def test_registry_login_failure_raises():
+    store = MemoryStateStore()
+    populate_global_resources(
+        store, "p", ["img:1"],
+        registries=[settings_mod.DockerRegistry(
+            server="bad.example.com", username="u", password="pw")])
+    prov = CascadeImageProvisioner(
+        store, puller=lambda kind, img: 0,
+        login_runner=lambda argv, stdin: 1)
+    agent = FakeAgent(store, "p", "n0")
+    try:
+        prov.distribute_global_resources(agent)
+    except RuntimeError as exc:
+        assert "bad.example.com" in str(exc)
+    else:
+        raise AssertionError("expected login failure to raise")

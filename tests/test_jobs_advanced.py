@@ -391,3 +391,45 @@ def test_auto_scratch_preserved_when_harvest_fails():
             assert fh.read().strip() == "keep-me"
     finally:
         substrate.stop_all()
+
+
+def test_task_env_secret_resolved_on_node(monkeypatch):
+    """environment_variables values may be secret:// refs (reference
+    convoy/batch.py:4556-4577 keyvault env merge): the state store
+    holds only the ref; the node agent resolves it at launch and the
+    task sees the plaintext."""
+    monkeypatch.setenv("TASK_API_KEY_TEST", "sk-live-abc123")
+    conf = {"pool_specification": {
+        "id": "secretpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "secretjob",
+            "tasks": [{
+                "id": "t0",
+                "environment_variables": {
+                    "API_KEY": "secret://env/TASK_API_KEY_TEST",
+                    "PLAIN": "not-a-secret",
+                },
+                "command": "sh -c 'echo -n $API_KEY:$PLAIN'",
+            }]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "secretpool",
+                                        "secretjob", timeout=60)
+        assert all(t["state"] == "completed" for t in tasks), tasks
+        out = jobs_mgr.get_task_output(store, "secretpool",
+                                       "secretjob", "t0")
+        assert out == b"sk-live-abc123:not-a-secret"
+        # The stored task spec still holds the ref, not the value.
+        task = store.get_entity(names.TABLE_TASKS,
+                                "secretpool$secretjob", "t0")
+        spec_env = task["spec"]["environment_variables"]
+        assert spec_env["API_KEY"] == "secret://env/TASK_API_KEY_TEST"
+    finally:
+        substrate.stop_all()
