@@ -232,6 +232,17 @@ class PrometheusExporterSettings:
 
 
 @dataclasses.dataclass(frozen=True)
+class PoolServicesSettings:
+    """Pool-resident daemons hosted by worker 0's node agent (the
+    reference runs its recurrent job manager as a job-manager task on
+    the pool, cargo/recurrent_job_manager.py:187 — a recurrence keeps
+    firing with no operator terminal alive)."""
+    schedules: bool
+    autoscale: bool
+    poll_interval_seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
 class PoolSettings:
     id: str
     substrate: str  # tpu_vm | fake | localhost
@@ -260,6 +271,7 @@ class PoolSettings:
     task_queue_shards: int
     node_exporter: PrometheusExporterSettings
     cadvisor: PrometheusExporterSettings
+    pool_services: "PoolServicesSettings" = None  # set by parser
 
     @property
     def is_tpu_pool(self) -> bool:
@@ -388,6 +400,15 @@ def pool_settings(config: dict) -> PoolSettings:
             enabled=_get(
                 spec, "prometheus", "cadvisor", "enabled", default=False),
             port=_get(spec, "prometheus", "cadvisor", "port", default=8080),
+        ),
+        pool_services=PoolServicesSettings(
+            schedules=_get(
+                spec, "pool_services", "schedules", default=False),
+            autoscale=_get(
+                spec, "pool_services", "autoscale", default=False),
+            poll_interval_seconds=_get(
+                spec, "pool_services", "poll_interval_seconds",
+                default=5.0),
         ),
     )
 

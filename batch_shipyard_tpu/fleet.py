@@ -377,10 +377,20 @@ def action_jobs_add(ctx: Context, tail: Optional[str] = None) -> dict:
     """jobs add (fleet.py:4000 analog). tail: stream the given file of
     the last task submitted (reference --tail)."""
     pool = ctx.pool
-    regular = [j for j in ctx.jobs if not j.auto_pool]
+    # Recurrence-bearing jobs REGISTER as pool schedules (fired by the
+    # pool-resident scheduler or `jobs schedule`) instead of running
+    # once immediately — the reference's JobScheduleAdd split.
+    recurrent = [j for j in ctx.jobs if j.recurrence is not None]
+    if recurrent:
+        from batch_shipyard_tpu.jobs import schedules
+        registered = schedules.register_schedules(
+            ctx.store, pool.id, ctx.configs["jobs"])
+        logger.info("registered schedules %s", registered)
+    regular = [j for j in ctx.jobs
+               if not j.auto_pool and j.recurrence is None]
     submitted = {}
     for job in ctx.jobs:
-        if job.auto_pool:
+        if job.auto_pool and job.recurrence is None:
             submitted.update(_submit_auto_pool_job(ctx, job))
     if regular:
         ctx.substrate().ensure_attached(pool)

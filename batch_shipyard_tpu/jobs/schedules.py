@@ -102,6 +102,73 @@ def _instantiate(job: JobSettings, inst_id: str) -> JobSettings:
                        job.recurrence.monitor_task_completion))
 
 
+def register_schedules(store: StateStore, pool_id: str,
+                       jobs_config: dict) -> list[str]:
+    """Persist the recurrence-bearing job templates from a raw jobs
+    config into the state store, so a POOL-RESIDENT scheduler (the
+    reference runs its recurrent job manager as a job-manager task on
+    the pool, cargo/recurrent_job_manager.py:187) can fire them with
+    no CLI process alive. Returns the registered job ids."""
+    from batch_shipyard_tpu.config import settings as settings_mod
+    registered = []
+    for raw in jobs_config.get("job_specifications") or []:
+        if not raw.get("recurrence"):
+            continue
+        # Parse NOW so a malformed template fails registration rather
+        # than poisoning every pool-service pass later.
+        parsed = settings_mod.job_settings_list(
+            {"job_specifications": [raw]})[0]
+        if parsed.recurrence.recurrence_interval_seconds is None:
+            raise ValueError(
+                f"schedule {raw['id']}: recurrence.schedule."
+                f"recurrence_interval_seconds is required")
+        store.upsert_entity(
+            _SCHED_TABLE, f"{pool_id}#templates", raw["id"],
+            {"spec": raw})
+        registered.append(raw["id"])
+    return registered
+
+
+def unregister_schedule(store: StateStore, pool_id: str,
+                        job_id: str) -> None:
+    store.delete_entity(_SCHED_TABLE, f"{pool_id}#templates", job_id)
+
+
+def stored_schedule_jobs(store: StateStore,
+                         pool_id: str) -> list[JobSettings]:
+    """Parse the registered templates back into JobSettings (re-read
+    every pass so new registrations are picked up live)."""
+    from batch_shipyard_tpu.config import settings as settings_mod
+    specs = [row["spec"] for row in store.query_entities(
+        _SCHED_TABLE, partition_key=f"{pool_id}#templates")]
+    if not specs:
+        return []
+    return settings_mod.job_settings_list(
+        {"job_specifications": specs})
+
+
+def run_pool_schedule_service(store: StateStore, pool: PoolSettings,
+                              stop_event: Optional[
+                                  threading.Event] = None,
+                              poll_interval: float = 1.0) -> int:
+    """The pool-resident scheduler loop: like run_schedule_daemon but
+    template-driven from the state store instead of a CLI process's
+    parsed config. Runs on worker 0 when
+    pool_specification.pool_services.schedules is enabled."""
+    stop = stop_event or threading.Event()
+    total = 0
+    while not stop.is_set():
+        try:
+            jobs = stored_schedule_jobs(store, pool.id)
+            if jobs:
+                total += len(run_due_schedules(store, pool, jobs))
+        except Exception:
+            logger.exception("pool schedule service pass failed")
+        if stop.wait(poll_interval):
+            break
+    return total
+
+
 def run_schedule_daemon(store: StateStore, pool: PoolSettings,
                         jobs: list[JobSettings],
                         stop_event: Optional[threading.Event] = None,

@@ -91,7 +91,7 @@ class FakePodSubstrate(base.ComputeSubstrate):
             poll_interval=0.05, gang_timeout=60.0,
             job_state_ttl=0.2,
             node_stale_seconds=self.node_stale_seconds,
-            nodeprep=self._nodeprep)
+            nodeprep=self._nodeprep, substrate=self)
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {
                 "state": "creating", "hostname": identity.hostname,
@@ -315,7 +315,7 @@ class FakePodSubstrate(base.ComputeSubstrate):
                     heartbeat_interval=self.heartbeat_interval,
                     poll_interval=0.05, gang_timeout=60.0,
                     job_state_ttl=0.2, node_stale_seconds=3.0,
-                    nodeprep=None)
+                    nodeprep=None, substrate=self)
                 thread = threading.Thread(
                     target=self._boot_agent, args=(revived,),
                     daemon=True)

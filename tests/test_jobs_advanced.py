@@ -433,3 +433,77 @@ def test_task_env_secret_resolved_on_node(monkeypatch):
         assert spec_env["API_KEY"] == "secret://env/TASK_API_KEY_TEST"
     finally:
         substrate.stop_all()
+
+
+def test_pool_resident_schedule_service_fires_without_cli():
+    """pool_services.schedules: the recurrence manager runs ON the
+    pool (worker 0's agent) — registered schedules fire with no CLI
+    daemon process alive (reference
+    cargo/recurrent_job_manager.py:187)."""
+    conf = {"pool_specification": {
+        "id": "svcpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30,
+        "pool_services": {"schedules": True,
+                          "poll_interval_seconds": 0.2},
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        # Register a 1-second recurrence template; nothing else runs
+        # client-side from here on.
+        schedules.register_schedules(store, "svcpool", {
+            "job_specifications": [{
+                "id": "recurjob",
+                "recurrence": {"schedule": {
+                    "recurrence_interval_seconds": 1}},
+                "tasks": [{"command": "true"}],
+            }]})
+        deadline = time.monotonic() + 30
+        seen = set()
+        while time.monotonic() < deadline and len(seen) < 2:
+            for row in store.query_entities(names.TABLE_JOBS,
+                                            partition_key="svcpool"):
+                if row["_rk"].startswith("recurjob-r"):
+                    seen.add(row["_rk"])
+            time.sleep(0.2)
+        assert len(seen) >= 2, (
+            f"pool-resident scheduler fired {len(seen)} instances; "
+            f"expected >=2 recurrences with no CLI process")
+    finally:
+        substrate.stop_all()
+
+
+def test_pool_resident_autoscale_service_resizes():
+    """pool_services.autoscale: the tick daemon runs on worker 0 with
+    the substrate handle — a user formula demanding more slices grows
+    the pool with no CLI process alive."""
+    conf = {"pool_specification": {
+        "id": "aspool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30,
+        "autoscale": {"enabled": True, "formula": "2"},
+        "pool_services": {"autoscale": True,
+                          "poll_interval_seconds": 0.2},
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        from batch_shipyard_tpu.pool import autoscale as as_mod
+        as_mod.enable_autoscale(store, pool)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            slices = {n.get("slice_index")
+                      for n in store.query_entities(
+                          names.TABLE_NODES, partition_key="aspool")}
+            if len(slices) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(slices) >= 2, \
+            f"autoscale service never grew the pool (slices={slices})"
+    finally:
+        substrate.stop_all()
