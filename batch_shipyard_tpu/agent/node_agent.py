@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import subprocess
 import threading
 import time
 import uuid
@@ -95,6 +96,8 @@ class NodeAgent:
         self._threads: list[threading.Thread] = []
         self._running_tasks = 0
         self._running_lock = threading.Lock()
+        # Resolved shared-scratch paths per job (auto_scratch: shared).
+        self._shared_scratch: dict[str, str] = {}
         # Short-TTL job-state cache: the disabled/terminated check runs
         # on every queue poll and must not cost a store round trip each
         # time on cloud backends.
@@ -837,7 +840,8 @@ class NodeAgent:
             dict(spec.get("environment_variables", {})))
         env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
         if spec.get("auto_scratch"):
-            env["SHIPYARD_JOB_SCRATCH"] = self._job_scratch_dir(job_id)
+            env["SHIPYARD_JOB_SCRATCH"] = self._resolve_scratch(
+                job_id, spec)
         if extra_env:
             env.update(extra_env)
         task_dir = os.path.join(
@@ -895,7 +899,7 @@ class NodeAgent:
             if auto_scratch:
                 # Per-job scratch with job lifetime (BeeOND analog):
                 # created here, removed by job release.
-                os.makedirs(self._job_scratch_dir(job_id),
+                os.makedirs(self._resolve_scratch(job_id, spec),
                             exist_ok=True)
             # Job-level input_data lands in the job's shared dir
             # (exposed to tasks as SHIPYARD_JOB_SHARED_DIR; the
@@ -920,7 +924,7 @@ class NodeAgent:
                     # Prep commands pre-populate scratch (the
                     # canonical BeeOND prep pattern).
                     jp_env["SHIPYARD_JOB_SCRATCH"] = (
-                        self._job_scratch_dir(job_id))
+                        self._resolve_scratch(job_id, spec))
                 execution = task_runner.TaskExecution(
                     pool_id=self.identity.pool_id, job_id=job_id,
                     task_id="jobprep",
@@ -944,6 +948,100 @@ class NodeAgent:
 
     def _job_scratch_dir(self, job_id: str) -> str:
         return os.path.join(self.work_dir, "scratch", job_id)
+
+    def _resolve_scratch(self, job_id: str, spec: dict) -> str:
+        """The job's scratch path on THIS node.
+
+        auto_scratch: true   -> node-local dir (BeeOND-lite).
+        auto_scratch: shared -> ONE POSIX namespace across the gang
+        (the reference's BeeOND shared parallel fs,
+        shipyard_auto_scratch.sh:1-82): worker 0 hosts the directory,
+        exports it over NFS, and publishes {path, host_ip} in the
+        jobprep table; other workers reuse the path directly when it
+        is visible on their filesystem (fake/localhost substrates) or
+        NFS-mount it (real multi-VM pools)."""
+        if spec.get("auto_scratch") != "shared":
+            return self._job_scratch_dir(job_id)
+        cached = self._shared_scratch.get(job_id)
+        if cached is not None:
+            return cached
+        pk = names.task_pk(self.identity.pool_id, job_id)
+        if self.identity.node_index == 0:
+            path = self._job_scratch_dir(job_id)
+            os.makedirs(path, exist_ok=True)
+            self._export_shared_scratch(path)
+            self.store.upsert_entity(
+                names.TABLE_JOBPREP, pk, "#scratchhost", {
+                    "path": path,
+                    "host_ip": self.identity.internal_ip,
+                    "node_id": self.identity.node_id})
+            self._shared_scratch[job_id] = path
+            return path
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                row = self.store.get_entity(
+                    names.TABLE_JOBPREP, pk, "#scratchhost")
+                break
+            except NotFoundError:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"job {job_id}: shared scratch host never "
+                        f"published (is worker 0 alive?)")
+                time.sleep(self.poll_interval)
+        host_path = row["path"]
+        if os.path.isdir(host_path):
+            # Same filesystem (fake/localhost substrates): the host
+            # path IS the shared namespace.
+            self._shared_scratch[job_id] = host_path
+            return host_path
+        mount_point = os.path.join(self.work_dir, "scratch-nfs",
+                                   job_id)
+        os.makedirs(mount_point, exist_ok=True)
+        rc = subprocess.call(
+            ["mount", "-t", "nfs",
+             f"{row['host_ip']}:{host_path}", mount_point])
+        if rc != 0:
+            raise RuntimeError(
+                f"job {job_id}: NFS mount of shared scratch "
+                f"{row['host_ip']}:{host_path} failed rc={rc}")
+        self._shared_scratch[job_id] = mount_point
+        return mount_point
+
+    def _export_shared_scratch(self, path: str) -> None:
+        """Export worker 0's scratch dir over NFS (no-op when
+        exportfs is unavailable or we lack root — the same-filesystem
+        substrates don't need it)."""
+        import shutil as shutil_mod
+        if shutil_mod.which("exportfs") is None or os.geteuid() != 0:
+            return
+        line = f"{path} *(rw,sync,no_subtree_check,no_root_squash)"
+        try:
+            with open("/etc/exports", "r+", encoding="utf-8") as fh:
+                if line not in fh.read():
+                    fh.write(line + "\n")
+            subprocess.call(["exportfs", "-ra"])
+        except OSError as exc:
+            logger.warning("shared-scratch export failed: %s", exc)
+
+    def _release_shared_scratch(self, job_id: str) -> None:
+        """End of a shared scratch's lifetime on this node: host node
+        removes the tree (+ the published record); mounters unmount."""
+        path = self._shared_scratch.pop(job_id, None)
+        if self.identity.node_index == 0:
+            import shutil as shutil_mod
+            shutil_mod.rmtree(self._job_scratch_dir(job_id),
+                              ignore_errors=True)
+            try:
+                self.store.delete_entity(
+                    names.TABLE_JOBPREP,
+                    names.task_pk(self.identity.pool_id, job_id),
+                    "#scratchhost")
+            except NotFoundError:
+                pass
+        elif path is not None and path.startswith(
+                os.path.join(self.work_dir, "scratch-nfs")):
+            subprocess.call(["umount", path])
 
     def _terminate_running_task(self, job_id: str,
                                 task_id: str) -> None:
@@ -1057,7 +1155,7 @@ class NodeAgent:
                 # Release commands harvest scratch (archive/copy out)
                 # BEFORE the rmtree below ends its lifetime.
                 jr_env["SHIPYARD_JOB_SCRATCH"] = (
-                    self._job_scratch_dir(job_id))
+                    self._resolve_scratch(job_id, spec))
             execution = task_runner.TaskExecution(
                 pool_id=self.identity.pool_id, job_id=job_id,
                 task_id="jobrelease", node_id=self.identity.node_id,
@@ -1078,9 +1176,11 @@ class NodeAgent:
                     logger.warning(
                         "preserving job %s auto-scratch at %s for "
                         "manual harvest", job_id,
-                        self._job_scratch_dir(job_id))
+                        self._resolve_scratch(job_id, spec))
                     return
-        if spec.get("auto_scratch"):
+        if spec.get("auto_scratch") == "shared":
+            self._release_shared_scratch(job_id)
+        elif spec.get("auto_scratch"):
             # End of the scratch drive's lifetime (the release half of
             # the BeeOND analog).
             import shutil
@@ -1195,9 +1295,12 @@ class NodeAgent:
                 if_match=job["_etag"])
         except (EtagMismatchError, NotFoundError):
             return
-        # Fan out job release to nodes that ran job prep.
+        # Fan out job release to nodes that ran job prep ("#"-prefixed
+        # rows are metadata, e.g. the shared-scratch host record).
         for row in self.store.query_entities(
                 names.TABLE_JOBPREP, partition_key=pk):
+            if row["_rk"].startswith("#"):
+                continue
             self.store.put_message(
                 names.control_queue(self.identity.pool_id, row["_rk"]),
                 json.dumps({

@@ -507,3 +507,71 @@ def test_pool_resident_autoscale_service_resizes():
             f"autoscale service never grew the pool (slices={slices})"
     finally:
         substrate.stop_all()
+
+
+def test_shared_auto_scratch_one_namespace_across_gang():
+    """auto_scratch: shared — worker 0 hosts the scratch dir and the
+    whole gang sees ONE POSIX namespace (the reference's BeeOND
+    shared-parallel-fs pattern, shipyard_auto_scratch.sh): an instance
+    on another node writes a file, and the reader on worker 0 sees it
+    at the same SHIPYARD_JOB_SCRATCH path."""
+    import os
+
+    conf = {"pool_specification": {
+        "id": "sharedscratch", "substrate": "fake",
+        # 4 workers on one slice.
+        "tpu": {"accelerator_type": "v5litepod-16"},
+        "max_wait_time_seconds": 60,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "gangscratch",
+            "auto_scratch": "shared",
+            "auto_complete": True,
+            "tasks": [
+                # Every gang instance writes its own marker into the
+                # SHARED namespace...
+                {"id": "writers",
+                 "command": "sh -c 'echo from-$SHIPYARD_NODE_INDEX > "
+                            "$SHIPYARD_JOB_SCRATCH/"
+                            "w$SHIPYARD_NODE_INDEX'",
+                 "multi_instance": {"num_instances": 4}},
+                # ...and a follow-up task (lands on one node) reads
+                # them ALL back through the same path.
+                {"id": "reader", "depends_on": ["writers"],
+                 "command": "sh -c 'cat $SHIPYARD_JOB_SCRATCH/w0 "
+                            "$SHIPYARD_JOB_SCRATCH/w1 "
+                            "$SHIPYARD_JOB_SCRATCH/w2 "
+                            "$SHIPYARD_JOB_SCRATCH/w3'"},
+            ]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "sharedscratch",
+                                        "gangscratch", timeout=90)
+        assert all(t["state"] == "completed" for t in tasks), tasks
+        out = jobs_mgr.get_task_output(store, "sharedscratch",
+                                       "gangscratch", "reader")
+        assert out.split() == [b"from-0", b"from-1", b"from-2",
+                               b"from-3"], out
+        # Lifetime: the host's dir goes away at job release and the
+        # published host record is cleaned up.
+        node0 = FakePodSubstrate.node_id("sharedscratch", 0, 0)
+        scratch = os.path.join(substrate.work_root, "sharedscratch",
+                               node0, "scratch", "gangscratch")
+        deadline = time.monotonic() + 30
+        while os.path.isdir(scratch):
+            assert time.monotonic() < deadline, scratch
+            time.sleep(0.25)
+        from batch_shipyard_tpu.state.base import NotFoundError
+        try:
+            store.get_entity(names.TABLE_JOBPREP,
+                             "sharedscratch$gangscratch",
+                             "#scratchhost")
+            raise AssertionError("scratchhost record not cleaned up")
+        except NotFoundError:
+            pass
+    finally:
+        substrate.stop_all()
