@@ -53,14 +53,26 @@ def populate_global_resources(store: StateStore, pool_id: str,
     (reference scripts/registry_login.sh via the nodeprep flag
     contract). Passwords are stored as their secret:// refs, resolved
     on node — never plaintext in the state store."""
+    def _upsert_preserving_blob(key: str, row: dict) -> None:
+        # A preloaded tarball (preload_image_tarball) may already have
+        # attached a source_blob to this image; re-populating the
+        # manifest must not sever it.
+        try:
+            old = store.get_entity(names.TABLE_IMAGES, pool_id, key)
+            if old.get("source_blob"):
+                row = {**row, "source_blob": old["source_blob"]}
+        except NotFoundError:
+            pass
+        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, row)
+
     for image in docker_images:
         key = util.hash_string(f"docker:{image}")[:24]
-        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+        _upsert_preserving_blob(key, {
             "kind": "docker", "image": image,
             "concurrent_downloads": concurrent_downloads})
     for image in singularity_images:
         key = util.hash_string(f"singularity:{image}")[:24]
-        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+        _upsert_preserving_blob(key, {
             "kind": "singularity", "image": image,
             "concurrent_downloads": concurrent_downloads})
     for reg in registries or ():
@@ -69,6 +81,29 @@ def populate_global_resources(store: StateStore, pool_id: str,
             "kind": "registry", "server": reg.server,
             "username": reg.username, "password": reg.password,
             "auth": reg.auth})
+
+
+def preload_image_tarball(store: StateStore, pool_id: str, image: str,
+                          chunks, kind: str = "docker") -> str:
+    """Upload an image tarball (e.g. `docker save` output chunks) to
+    the object store and bind it to the pool's image manifest row —
+    the reference cascade's DIRECT DOWNLOAD mode
+    (cascade/cascade.py:574 _direct_download_resources_async: images
+    ride Azure Storage instead of a registry). Nodes then stream the
+    tarball from the state store (lease-gated like registry pulls) and
+    `docker load` it, which also serves air-gapped pools with no
+    registry egress. Returns the object key."""
+    key = util.hash_string(f"{kind}:{image}")[:24]
+    blob_key = f"cascade/{pool_id}/{key}.tar"
+    store.put_object_stream(blob_key, chunks)
+    try:
+        store.merge_entity(names.TABLE_IMAGES, pool_id, key,
+                           {"source_blob": blob_key})
+    except NotFoundError:
+        store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
+            "kind": kind, "image": image,
+            "concurrent_downloads": 10, "source_blob": blob_key})
+    return blob_key
 
 
 def registry_manifest(store: StateStore, pool_id: str) -> list[dict]:
@@ -175,7 +210,8 @@ class CascadeImageProvisioner:
             if row.get("kind") != "registry"]
         for row in rows:
             self._fetch(agent, row["_rk"], row["kind"], row["image"],
-                        int(row.get("concurrent_downloads", 10)))
+                        int(row.get("concurrent_downloads", 10)),
+                        source_blob=row.get("source_blob"))
         perf.emit(self.store, pool_id, agent.identity.node_id, "cascade",
                   "global_resources_loaded")
 
@@ -195,12 +231,14 @@ class CascadeImageProvisioner:
                 row = {"kind": kind, "image": image,
                        "concurrent_downloads": 10}
             self._fetch(agent, key, row["kind"], row.get("image", image),
-                        int(row.get("concurrent_downloads", 10)))
+                        int(row.get("concurrent_downloads", 10)),
+                        source_blob=row.get("source_blob"))
 
     # -- internals ------------------------------------------------------
 
     def _fetch(self, agent, resource_hash: str, kind: str, image: str,
-               concurrent: int) -> None:
+               concurrent: int, source_blob: Optional[str] = None,
+               ) -> None:
         with self._lock:
             if resource_hash in self._loaded:
                 return
@@ -238,7 +276,7 @@ class CascadeImageProvisioner:
         try:
             perf.emit(self.store, pool_id, node_id, "cascade",
                       f"pull.start:{image}")
-            rc = self._pull(kind, image)
+            rc = self._pull(kind, image, source_blob=source_blob)
             perf.emit(self.store, pool_id, node_id, "cascade",
                       f"pull.end:{image}", message=str(rc))
             if rc == 0:
@@ -253,9 +291,12 @@ class CascadeImageProvisioner:
             except Exception:
                 pass
 
-    def _pull(self, kind: str, image: str) -> int:
+    def _pull(self, kind: str, image: str,
+              source_blob: Optional[str] = None) -> int:
         if self._puller is not None:
             return self._puller(kind, image)
+        if source_blob:
+            return self._direct_download(kind, image, source_blob)
         if kind == "docker":
             if shutil.which("docker") is None:
                 logger.info("docker unavailable; skipping pull of %s",
@@ -279,6 +320,39 @@ class CascadeImageProvisioner:
                 ["singularity", "pull", "--force", f"docker://{image}"],
                 timeout=self.pull_timeout)
         raise ValueError(f"unknown image kind {kind!r}")
+
+    def _direct_download(self, kind: str, image: str,
+                         source_blob: str) -> int:
+        """Stream a preloaded image tarball from the object store to
+        the node's cache (the reference's direct-download mode), then
+        `docker load` it when docker is present. Without docker the
+        tarball still lands on disk — real bytes over the real store
+        path, which is also what the bench measures."""
+        import tempfile
+        if not getattr(self, "_cache_dir", None):
+            self._cache_dir = tempfile.mkdtemp(
+                prefix="shipyard-image-cache-")
+        path = os.path.join(self._cache_dir,
+                            os.path.basename(source_blob))
+        tmp = path + ".part"
+        total = 0
+        with open(tmp, "wb") as fh:
+            for chunk in self.store.get_object_stream(source_blob):
+                fh.write(chunk)
+                total += len(chunk)
+        os.replace(tmp, path)
+        logger.info("direct-downloaded %s (%d bytes) from %s",
+                    image, total, source_blob)
+        if kind == "docker" and shutil.which("docker"):
+            return subprocess.call(["docker", "load", "-i", path],
+                                   timeout=self.pull_timeout)
+        if kind == "singularity" and shutil.which("singularity"):
+            # A saved OCI tarball loads as a sif build source.
+            return subprocess.call(
+                ["singularity", "build", "--force",
+                 path + ".sif", f"docker-archive://{path}"],
+                timeout=self.pull_timeout)
+        return 0
 
     def _record_loaded(self, pool_id: str, node_id: str) -> None:
         with self._lock:

@@ -191,3 +191,34 @@ def test_registry_login_failure_raises():
         assert "bad.example.com" in str(exc)
     else:
         raise AssertionError("expected login failure to raise")
+
+
+def test_direct_download_preloaded_tarball(tmp_path):
+    """Cascade direct-download mode (reference cascade.py:574
+    _direct_download_resources_async): a preloaded tarball streams
+    from the object store to the node cache — byte-identical — and
+    re-populating the manifest does not sever the source_blob
+    binding."""
+    import os
+
+    from batch_shipyard_tpu.agent.cascade import (
+        CascadeImageProvisioner, preload_image_tarball)
+
+    store = MemoryStateStore()
+    payload = os.urandom(1024 * 256)
+    chunks = [payload[i:i + 65536]
+              for i in range(0, len(payload), 65536)]
+    blob_key = preload_image_tarball(store, "p", "preload/img:1",
+                                     iter(chunks))
+    # populate AFTER preload (the pool-add ordering) keeps the blob.
+    populate_global_resources(store, "p", ["preload/img:1"])
+    rows = list(store.query_entities("images", partition_key="p"))
+    assert rows[0]["source_blob"] == blob_key
+
+    prov = CascadeImageProvisioner(store)
+    prov._cache_dir = str(tmp_path)
+    agent = FakeAgent(store, "p", "n0")
+    prov.distribute_global_resources(agent)
+    assert global_resources_loaded(store, "p", "n0")
+    cached = tmp_path / os.path.basename(blob_key)
+    assert cached.read_bytes() == payload
