@@ -261,26 +261,51 @@ class NodeAgent:
 
     def _worker_loop(self, slot: int) -> None:
         pool_id, node_id = self._nid
-        shards = self.pool.task_queue_shards
-        queues = names.task_queues(pool_id, shards)
-        shards = len(queues)
-        # Stagger each slot's starting shard so pollers spread over
-        # the fan-out instead of convoying on shard 0.
-        idx = (self.identity.node_index + slot) % shards
-        empty_streak = 0
+        shards = max(self.pool.task_queue_shards, 1)
+        # Strict priority-band drain order (hi before normal before
+        # lo): within each band, stagger each slot's starting shard so
+        # pollers spread over the fan-out instead of convoying on
+        # shard 0. A worker restarts its scan from the hi band after
+        # every message, so a high-priority job overtakes any backlog
+        # sitting in lower bands.
+        bands = names.task_queues_by_band(pool_id, shards)
+        stagger = self.identity.node_index + slot
+        # Idle-poll backoff for the hi/lo bands: most pools only ever
+        # use priority 0, and probing three bands instead of one
+        # every cycle would triple steady-state store traffic. A band
+        # seen empty gets skipped for a growing number of cycles
+        # (capped so a newly-submitted high-priority task waits at
+        # most ~4 poll intervals before the scan sees it).
+        skip = {0: 0, 2: 0}  # band index -> cycles left to skip
+        streak = {0: 0, 2: 0}
         while not self.stop_event.is_set():
-            taskq = queues[idx]
-            idx = (idx + 1) % shards
-            msgs = self.store.get_messages(
-                taskq, max_messages=1, visibility_timeout=60.0)
-            if not msgs:
-                empty_streak += 1
-                if empty_streak >= shards:
-                    empty_streak = 0
-                    time.sleep(self.poll_interval)
+            msg = None
+            for b, band_queues in enumerate(bands):
+                if b in skip and skip[b] > 0:
+                    skip[b] -= 1
+                    continue
+                n = len(band_queues)
+                found = False
+                for k in range(n):
+                    taskq = band_queues[(stagger + k) % n]
+                    msgs = self.store.get_messages(
+                        taskq, max_messages=1, visibility_timeout=60.0)
+                    if msgs:
+                        msg = msgs[0]
+                        found = True
+                        break
+                if b in skip:
+                    if found:
+                        streak[b] = 0
+                    else:
+                        streak[b] = min(streak[b] + 1, 4)
+                        skip[b] = streak[b]
+                if msg is not None:
+                    break
+            if msg is None:
+                time.sleep(self.poll_interval)
                 continue
-            empty_streak = 0
-            msg = msgs[0]
+            stagger += 1
             try:
                 self._process_task_message(
                     slot, json.loads(msg.payload), msg)
@@ -376,6 +401,13 @@ class NodeAgent:
             self.store.delete_message(msg)
             return
         spec = entity["spec"]
+        # Node-pinned task (federation required-target select): only
+        # the named node may claim it; everyone else re-hides the
+        # message so the pinned node finds it on its next poll.
+        required = spec.get("required_node")
+        if required and required != self.identity.node_id:
+            self.store.update_message(msg, visibility_timeout=2.0)
+            return
         deps = self._deps_status(job_id, spec)
         if deps == "blocked":
             try:
@@ -555,7 +587,8 @@ class NodeAgent:
             self.store.put_message(
                 names.task_queue_for(
                     self.identity.pool_id, task_id,
-                    self.pool.task_queue_shards),
+                    self.pool.task_queue_shards,
+                    priority=int(spec.get("priority", 0) or 0)),
                 json.dumps({"job_id": job_id, "task_id": task_id}).encode())
             return
         self._finish_task(job_id, task_id, result)

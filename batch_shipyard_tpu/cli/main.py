@@ -830,6 +830,18 @@ def fed_jobs_zap(click_ctx, federation_id, action_id):
     fed_mod.zap_action(_ctx(click_ctx).store, federation_id, action_id)
 
 
+@fed_jobs.command("gc")
+@click.argument("federation_id")
+@click.pass_context
+def fed_jobs_gc(click_ctx, federation_id):
+    """Remove stale job-location rows (jobs deleted behind the
+    federation's back)."""
+    from batch_shipyard_tpu.federation import federation as fed_mod
+    removed = fed_mod.gc_federation_jobs(
+        _ctx(click_ctx).store, federation_id)
+    fleet._emit({"removed": removed}, click_ctx.obj["raw"])
+
+
 @fed.command("create-vm")
 @click.argument("federation_id")
 @click.option("--project", required=True)

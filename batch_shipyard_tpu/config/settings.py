@@ -246,6 +246,11 @@ class PoolServicesSettings:
 class PoolSettings:
     id: str
     substrate: str  # tpu_vm | fake | localhost
+    # GCP zone override for this pool (falls back to credentials
+    # gcp.zone). Federation's `location` hard constraint matches
+    # against it (reference PoolConstraints.location,
+    # federation/federation.py:190).
+    zone: Optional[str]
     tpu: Optional[TpuPoolSettings]
     vm_size: Optional[str]
     vm_count_dedicated: int
@@ -352,6 +357,7 @@ def pool_settings(config: dict) -> PoolSettings:
     return PoolSettings(
         id=spec["id"],
         substrate=_get(spec, "substrate", default="tpu_vm"),
+        zone=_get(spec, "zone"),
         tpu=tpu,
         vm_size=_get(spec, "vm_configuration", "vm_size"),
         vm_count_dedicated=_get(

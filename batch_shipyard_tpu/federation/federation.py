@@ -26,16 +26,36 @@ Job-level constraints (jobs.yaml federation_constraints block):
   min_idle_nodes: int
   max_active_task_backlog:  float ratio of queued tasks to slots
   substrate: tpu_vm|fake|localhost
+  location: str             pool zone must match (PoolConstraints
+                            .location, reference federation.py:190)
+  registries: [server, ..]  pool must hold registry logins for every
+                            listed server (has_registry_login check,
+                            reference federation.py:1927)
+  low_priority_nodes:       {allow: bool, exclusive: bool} — dedicated
+                            -only or preemptible-only execution
+                            (reference federation.py:1947-1975)
+  autoscale: {allow: bool}  zero-capacity pools qualify if they can
+                            autoscale (reference federation.py:1952)
+  compute_node:             node-level filter (:1939 analog):
+    exclusive: bool           node must be running nothing
+    min_task_slots: int       node slot capacity floor
+    min_free_slots: int       current free-slot floor
+    min_chips_per_worker: int TPU chips attached per worker
+  required_target:          {pool_id: str, node_id: str|null} — pin
+                            the job to THIS pool (and node),
+                            bypassing best-fit (:2030 analog)
 """
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
 import threading
 import time
 import uuid
 from typing import Optional
 
+from batch_shipyard_tpu.agent import cascade
 from batch_shipyard_tpu.config import settings as settings_mod
 from batch_shipyard_tpu.jobs import manager as jobs_mgr
 from batch_shipyard_tpu.pool import manager as pool_mgr
@@ -67,11 +87,76 @@ def create_federation(store: StateStore, federation_id: str,
 
 
 def destroy_federation(store: StateStore, federation_id: str) -> None:
+    # Drop every job-location + zap row with the federation (the
+    # reference GCs its job tables on destroy, convoy/storage.py:898).
+    for row in list(store.query_entities(names.TABLE_FEDJOBS,
+                                         partition_key=federation_id)):
+        try:
+            store.delete_entity(names.TABLE_FEDJOBS, federation_id,
+                                row["_rk"])
+        except NotFoundError:
+            pass
     try:
         store.delete_entity(names.TABLE_FEDERATIONS, "fed",
                             federation_id)
     except NotFoundError:
         pass
+
+
+GC_GRACE_SECONDS = 300.0
+
+
+def gc_federation_jobs(store: StateStore, federation_id: str,
+                       grace_seconds: float = GC_GRACE_SECONDS,
+                       ) -> list[str]:
+    """Remove stale job-location rows — placements whose job no
+    longer exists on the recorded pool (deleted behind the
+    federation's back, or the pool itself is gone). Reference analog:
+    gc_federation_jobs, convoy/storage.py:898. Returns the removed
+    job ids.
+
+    Rows younger than ``grace_seconds`` are never collected: the
+    scheduler inserts the placement row BEFORE creating the job on
+    the pool, so a GC racing that window would delete a live
+    placement and let a later action re-place the job elsewhere.
+    """
+    removed = []
+    horizon = util.utcnow().timestamp() - grace_seconds
+    for row in list(store.query_entities(names.TABLE_FEDJOBS,
+                                         partition_key=federation_id)):
+        job_id = row["_rk"]
+        if job_id.startswith("zap$"):
+            continue
+        born = row.get("merged_at") or row.get("scheduled_at")
+        if born:
+            try:
+                ts = _dt.datetime.strptime(
+                    born, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+                        tzinfo=_dt.timezone.utc).timestamp()
+                if ts > horizon:
+                    continue
+            except ValueError:
+                pass
+        pool_id = row.get("pool_id")
+        stale = False
+        if not pool_id or not pool_mgr.pool_exists(store, pool_id):
+            stale = True
+        else:
+            try:
+                jobs_mgr.get_job(store, pool_id, job_id)
+            except jobs_mgr.JobNotFoundError:
+                stale = True
+        if stale:
+            try:
+                store.delete_entity(names.TABLE_FEDJOBS, federation_id,
+                                    job_id)
+                removed.append(job_id)
+            except NotFoundError:
+                pass
+    if removed:
+        logger.info("federation %s: GC removed stale job rows %s",
+                    federation_id, removed)
+    return removed
 
 
 def get_federation(store: StateStore, federation_id: str) -> dict:
@@ -176,7 +261,9 @@ def list_federation_jobs(store: StateStore,
 # --------------------------- constraint match --------------------------
 
 def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
-    """Assemble the scheduling facts for one member pool."""
+    """Assemble the scheduling facts for one member pool, including
+    per-node occupancy (the node-level facts behind the reference's
+    _filter_pool_nodes_with_constraints, federation.py:1939)."""
     try:
         entity = pool_mgr.get_pool(store, pool_id)
     except pool_mgr.PoolNotFoundError:
@@ -186,24 +273,43 @@ def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
         pool = settings_mod.pool_settings(spec_raw)
     except (ValueError, KeyError):
         return None
-    nodes = pool_mgr.list_nodes(store, pool_id)
-    idle = [n for n in nodes if n.state == "idle"]
-    ready = [n for n in nodes if n.state in pool_mgr.READY_STATES]
+    nodes = []
+    for row in store.query_entities(names.TABLE_NODES,
+                                    partition_key=pool_id):
+        slots = int(row.get("task_slots",
+                            pool.task_slots_per_node) or 1)
+        running = int(row.get("running_tasks", 0) or 0)
+        nodes.append({
+            "node_id": row["_rk"],
+            "state": row.get("state", "unknown"),
+            "task_slots": slots,
+            "running_tasks": running,
+            "free_slots": max(0, slots - running),
+        })
+    idle = [n for n in nodes if n["state"] == "idle"]
+    ready = [n for n in nodes if n["state"] in pool_mgr.READY_STATES]
     backlog = sum(
         store.queue_length(q)
         for q in names.task_queues(pool_id, pool.task_queue_shards))
     slots = max(1, len(ready) * pool.task_slots_per_node)
+    registries = {row.get("server")
+                  for row in cascade.registry_manifest(store, pool_id)}
     return {
         "pool_id": pool_id,
         "pool": pool,
         "state": entity.get("state"),
+        "zone": pool.zone,
+        "registries": registries,
+        "nodes": nodes,
         "nodes_total": len(nodes),
         "nodes_idle": len(idle),
         "nodes_ready": len(ready),
+        "free_slots": sum(n["free_slots"] for n in ready),
         "backlog": backlog,
         "backlog_ratio": backlog / slots,
         "chips": (pool.tpu.info.num_chips * pool.tpu.num_slices
                   if pool.tpu else 0),
+        "autoscale_enabled": pool.autoscale.enabled,
     }
 
 
@@ -238,17 +344,128 @@ def filter_pools_hard_constraints(
         if max_backlog is not None and (
                 fact["backlog_ratio"] > float(max_backlog)):
             continue
+        # location hard constraint (PoolConstraints.location, :190):
+        # matches the pool's GCP zone.
+        loc = constraints.get("location")
+        if loc and fact.get("zone") != loc:
+            continue
+        # registry hard constraint (:1927 has_registry_login): the
+        # pool must hold a credential row for every required server.
+        regs = constraints.get("registries")
+        if regs and not set(regs) <= (fact.get("registries") or set()):
+            continue
+        # dedicated-only / preemptible-only execution (:1947-1975).
+        # On TPU pools preemptibility is pool-wide (provisioning
+        # model); on VM pools it is the low-priority node count.
+        lp = constraints.get("low_priority_nodes") or {}
+        if lp.get("allow") is False and _pool_is_preemptible(pool):
+            continue
+        if lp.get("exclusive") and not _pool_is_preemptible(pool):
+            continue
         out.append(fact)
     return out
 
 
+def _pool_is_preemptible(pool) -> bool:
+    if pool.tpu is not None:
+        return pool.tpu.provisioning_model == "spot"
+    return (pool.vm_count_low_priority > 0 and
+            pool.vm_count_dedicated == 0)
+
+
+def qualifying_nodes(fact: dict, constraints: dict) -> list[dict]:
+    """Node-level filter (:1939 analog): which of the pool's nodes
+    could run this job's tasks right now, under the compute_node
+    constraints."""
+    cn = constraints.get("compute_node") or {}
+    pool = fact["pool"]
+    out = []
+    for node in fact.get("nodes", []):
+        if node["state"] not in pool_mgr.READY_STATES:
+            continue
+        if cn.get("exclusive") and node["running_tasks"] > 0:
+            continue
+        if cn.get("min_task_slots") and (
+                node["task_slots"] < int(cn["min_task_slots"])):
+            continue
+        min_free = int(cn.get("min_free_slots", 1) or 0)
+        if node["free_slots"] < min_free:
+            continue
+        mcw = cn.get("min_chips_per_worker")
+        if mcw:
+            chips = pool.tpu.chips_per_worker if pool.tpu else 0
+            if chips < int(mcw):
+                continue
+        out.append(node)
+    return out
+
+
+def filter_pool_nodes(facts: list[dict], constraints: dict,
+                      required_nodes: int = 1) -> list[dict]:
+    """Second-pass filter after the pool-level pass: keep pools with
+    at least ``required_nodes`` qualifying nodes (the gang size —
+    target-required capacity selection, :2030), or pools that could
+    reach that capacity via autoscale when the constraints allow it
+    (:1952). Annotates each fact with its qualifying node list."""
+    autoscale_allow = (constraints.get("autoscale") or {}).get(
+        "allow", True)
+    out = []
+    for fact in facts:
+        nodes = qualifying_nodes(fact, constraints)
+        fact = dict(fact, qualifying_nodes=nodes)
+        if len(nodes) >= required_nodes:
+            out.append(fact)
+        elif (autoscale_allow and fact.get("autoscale_enabled") and
+                fact["nodes_total"] < _autoscale_max_nodes(fact["pool"])):
+            # Capacity could appear: bin as available-via-autoscale.
+            fact["via_autoscale"] = True
+            out.append(fact)
+    return out
+
+
+def _autoscale_max_nodes(pool) -> float:
+    """Upper node bound the pool's autoscale can reach. A user
+    formula has no statically-known ceiling — treat it as unbounded
+    (the reference bins any steady autoscale-enabled pool as
+    available, federation.py:1952)."""
+    scenario = pool.autoscale.scenario
+    if scenario is None:
+        return float("inf")
+    return (scenario.maximum_vm_count_dedicated +
+            scenario.maximum_vm_count_low_priority)
+
+
+def _job_required_nodes(job) -> int:
+    """Gang size of the job's largest multi-instance task — the
+    capacity the chosen pool must offer (target-required selection,
+    reference federation.py:2030). Symbolic counts that resolve to
+    'the whole pool' (pool_current_dedicated, ...) count as 1: every
+    pool satisfies its own size by definition."""
+    req = 1
+    for raw in job.tasks:
+        mi = raw.get("multi_instance") or {}
+        n = mi.get("num_instances")
+        if isinstance(n, int):
+            req = max(req, n)
+    return req
+
+
 def greedy_best_fit(facts: list[dict]) -> Optional[dict]:
-    """Greedy best-fit pool choice (:2084 analog): most idle nodes,
-    then lowest backlog ratio, then largest pool."""
+    """Greedy best-fit pool choice (:2084 analog): pools that satisfy
+    the capacity NOW beat autoscale-pending ones; then most
+    qualifying nodes, most free slots, lowest backlog ratio, largest
+    pool."""
     if not facts:
         return None
-    return sorted(facts, key=lambda f: (
-        -f["nodes_idle"], f["backlog_ratio"], -f["nodes_total"]))[0]
+
+    def key(f):
+        qualifying = (len(f["qualifying_nodes"])
+                      if "qualifying_nodes" in f else f["nodes_idle"])
+        return (f.get("via_autoscale", False), -qualifying,
+                -f.get("free_slots", 0), f["backlog_ratio"],
+                -f["nodes_total"])
+
+    return sorted(facts, key=key)[0]
 
 
 # ----------------------------- daemon side -----------------------------
@@ -258,13 +475,16 @@ class FederationProcessor:
 
     def __init__(self, store: StateStore, owner: Optional[str] = None,
                  poll_interval: float = 1.0,
-                 action_retry_delay: float = 5.0) -> None:
+                 action_retry_delay: float = 5.0,
+                 gc_interval: float = 300.0) -> None:
         self.store = store
         self.owner = owner or f"fedproc-{uuid.uuid4().hex[:8]}"
         self.poll_interval = poll_interval
         self.action_retry_delay = action_retry_delay
+        self.gc_interval = gc_interval
         self.stop_event = threading.Event()
         self._lease = None
+        self._last_gc = 0.0
 
     # -- lock ----------------------------------------------------------
 
@@ -288,8 +508,18 @@ class FederationProcessor:
         if not self._hold_global_lock():
             return 0
         processed = 0
-        for fed in list_federations(self.store):
+        feds = list_federations(self.store)
+        for fed in feds:
             processed += self._process_federation_queue(fed["_rk"], fed)
+        now = time.monotonic()
+        if now - self._last_gc >= self.gc_interval:
+            self._last_gc = now
+            for fed in feds:
+                try:
+                    gc_federation_jobs(self.store, fed["_rk"])
+                except Exception:
+                    logger.exception("federation GC failed for %s",
+                                     fed["_rk"])
         return processed
 
     def _is_zapped(self, federation_id: str, action_id: str) -> bool:
@@ -344,46 +574,152 @@ class FederationProcessor:
             if f is not None]
         all_ok = True
         for job in jobs:
-            # Idempotent retry: a job already placed by a previous
-            # attempt of this (or another) action is never re-placed —
-            # the placement record is insert-only.
-            try:
-                placed = self.store.get_entity(
-                    names.TABLE_FEDJOBS, federation_id, job.id)
-                logger.info(
-                    "federation %s: job %s already on pool %s",
-                    federation_id, job.id, placed.get("pool_id"))
-                continue
-            except NotFoundError:
-                pass
-            constraints = dict(job.federation_constraints)
-            eligible = filter_pools_hard_constraints(facts, constraints)
-            choice = greedy_best_fit(eligible)
-            if choice is None:
-                logger.info(
-                    "federation %s: no eligible pool for job %s "
-                    "(constraints=%s)", federation_id, job.id,
-                    constraints)
+            if not self._schedule_one_job(federation_id, fed, action,
+                                          job, facts):
                 all_ok = False
-                continue
-            pool = choice["pool"]
-            try:
-                self.store.insert_entity(
-                    names.TABLE_FEDJOBS, federation_id, job.id, {
-                        "pool_id": pool.id,
-                        "action_id": action.get("action_id"),
-                        "scheduled_at": util.datetime_utcnow_iso(),
-                    })
-            except EntityExistsError:
-                continue  # lost a race with another scheduler pass
-            try:
-                jobs_mgr.add_jobs(self.store, pool, [job],
-                                  pool_id_override=pool.id)
-            except jobs_mgr.JobExistsError:
-                pass  # already scheduled by a previous attempt
-            logger.info("federation %s: job %s -> pool %s",
-                        federation_id, job.id, pool.id)
         return all_ok
+
+    def _schedule_one_job(self, federation_id: str, fed: dict,
+                          action: dict, job, facts: list[dict]) -> bool:
+        action_id = action.get("action_id")
+        constraints = dict(job.federation_constraints)
+        target = constraints.get("required_target") or {}
+        # A previously-placed job stays on its pool: a NEW action for
+        # the same job id appends its tasks there with task-id
+        # collision fixup; a RETRY of an already-applied action is a
+        # no-op (the action_ids list is the reference's UniqueIds
+        # dedup, federation.py:2567-2590).
+        try:
+            placed = self.store.get_entity(
+                names.TABLE_FEDJOBS, federation_id, job.id)
+        except NotFoundError:
+            placed = None
+        if placed is not None:
+            if action_id in (placed.get("action_ids") or ()):
+                logger.info(
+                    "federation %s: action %s already applied to job "
+                    "%s on pool %s", federation_id, action_id, job.id,
+                    placed.get("pool_id"))
+                return True
+            return self._merge_into_placed_job(
+                federation_id, job, placed, action_id,
+                target.get("node_id"))
+        required_node = None
+        if target.get("pool_id"):
+            # Required-target select (:2030 analog): pin to THIS pool
+            # (and node), bypassing constraint filtering + best-fit.
+            choice = self._select_required_target(
+                federation_id, fed, job, facts, target)
+            if choice is None:
+                return False
+            required_node = target.get("node_id")
+        else:
+            eligible = filter_pools_hard_constraints(facts, constraints)
+            eligible = filter_pool_nodes(
+                eligible, constraints,
+                required_nodes=_job_required_nodes(job))
+            choice = greedy_best_fit(eligible)
+        if choice is None:
+            logger.info(
+                "federation %s: no eligible pool for job %s "
+                "(constraints=%s)", federation_id, job.id, constraints)
+            return False
+        pool = choice["pool"]
+        try:
+            self.store.insert_entity(
+                names.TABLE_FEDJOBS, federation_id, job.id, {
+                    "pool_id": pool.id,
+                    "action_id": action_id,
+                    "action_ids": [action_id],
+                    "scheduled_at": util.datetime_utcnow_iso(),
+                })
+        except EntityExistsError:
+            return True  # lost a race with another scheduler pass
+        try:
+            jobs_mgr.add_jobs(self.store, pool, [job],
+                              pool_id_override=pool.id,
+                              required_node=required_node)
+        except jobs_mgr.JobExistsError:
+            pass  # already scheduled by a previous attempt
+        logger.info("federation %s: job %s -> pool %s",
+                    federation_id, job.id, pool.id)
+        return True
+
+    def _select_required_target(self, federation_id: str, fed: dict,
+                                job, facts: list[dict],
+                                target: dict) -> Optional[dict]:
+        pool_id = target["pool_id"]
+        if pool_id not in fed.get("pools", []):
+            logger.error(
+                "federation %s: job %s requires pool %s which is not "
+                "a member; dropping", federation_id, job.id, pool_id)
+            return None
+        fact = next((f for f in facts if f["pool_id"] == pool_id),
+                    None)
+        if fact is None or fact["state"] != "ready":
+            return None  # requeue until the pool is up
+        node_id = target.get("node_id")
+        if node_id and not any(
+                n["node_id"] == node_id and
+                n["state"] in pool_mgr.READY_STATES
+                for n in fact.get("nodes", [])):
+            return None  # requeue until the pinned node is schedulable
+        return fact
+
+    def _merge_into_placed_job(self, federation_id: str, job,
+                               placed: dict, action_id: str,
+                               required_node: Optional[str]) -> bool:
+        pool_id = placed["pool_id"]
+        try:
+            pool_entity = pool_mgr.get_pool(self.store, pool_id)
+            pool = settings_mod.pool_settings(
+                pool_entity.get("spec") or {})
+        except (pool_mgr.PoolNotFoundError, ValueError, KeyError):
+            logger.error(
+                "federation %s: job %s placed on missing pool %s; "
+                "dropping merge", federation_id, job.id, pool_id)
+            return True
+        if required_node is not None:
+            # Same validation first placement gets: a pin to a node
+            # that doesn't exist (typo, since-removed) would submit
+            # tasks no agent will ever claim — they'd bounce forever.
+            fact = _pool_facts(self.store, pool_id)
+            if fact is None or not any(
+                    n["node_id"] == required_node and
+                    n["state"] in pool_mgr.READY_STATES
+                    for n in fact.get("nodes", [])):
+                logger.info(
+                    "federation %s: merge for job %s requires node %s "
+                    "which is not schedulable on pool %s; retrying",
+                    federation_id, job.id, required_node, pool_id)
+                return False  # requeue with backoff
+        try:
+            added = jobs_mgr.merge_tasks_into_job(
+                self.store, pool, job, pool_id,
+                required_node=required_node)
+        except jobs_mgr.JobNotFoundError:
+            # Job was deleted on the pool after placement: treat the
+            # placement row as stale and re-place on the next pass.
+            self.store.delete_entity(names.TABLE_FEDJOBS,
+                                     federation_id, job.id)
+            return False
+        except jobs_mgr.JobExistsError as exc:
+            logger.error("federation %s: merge into job %s failed: %s",
+                         federation_id, job.id, exc)
+            return True  # non-retryable id conflict; drop
+        # Full ledger, never trimmed: dropping old ids would let a
+        # late redelivery of an ancient action re-merge its tasks.
+        action_ids = list(placed.get("action_ids") or [])
+        action_ids.append(action_id)
+        self.store.merge_entity(
+            names.TABLE_FEDJOBS, federation_id, job.id,
+            {"action_ids": action_ids,
+             "merged_at": util.datetime_utcnow_iso()})
+        logger.info(
+            "federation %s: merged %d tasks of action %s into job %s "
+            "on pool %s", federation_id, added, action_id, job.id,
+            pool_id)
+        return True
 
     def run(self) -> None:
         while not self.stop_event.is_set():

@@ -12,6 +12,7 @@ batch.py:4177) so depends_on_range works identically.
 from __future__ import annotations
 
 import json
+import re
 import time
 from typing import Iterator, Optional
 
@@ -65,6 +66,9 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "job_input_data": list(job.input_data),
         "auto_scratch": job.auto_scratch,
         "exit_options": dict(task.default_exit_options),
+        # Queue band for retry requeues (agents must put a retried
+        # high-priority task back on the high-priority band).
+        "priority": job.priority,
     }
     if task.multi_instance is not None:
         mi = task.multi_instance
@@ -84,10 +88,50 @@ def _task_spec(task: TaskSettings, job: JobSettings,
     return spec
 
 
+def _expand_job_tasks(store: StateStore, job: JobSettings,
+                      pool: PoolSettings,
+                      required_node: Optional[str] = None,
+                      start_number: int = 0,
+                      ) -> list[tuple[str, dict]]:
+    """Expand a job's task factories into (task_id, spec) pairs.
+    Generic ids are numbered task-%05d from ``start_number``
+    (reference id convention, batch.py:4177)."""
+    task_number = start_number
+    all_task_ids: list[str] = []
+    pending: list[tuple[str, dict]] = []
+    for raw_task in job.tasks:
+        for expanded in expand_task_factory(raw_task, store):
+            task = settings_mod.task_settings(expanded, job, pool)
+            task_id = task.id or f"task-{task_number:05d}"
+            task_number += 1
+            spec = _task_spec(task, job, pool)
+            if required_node:
+                spec["required_node"] = required_node
+            pending.append((task_id, spec))
+            all_task_ids.append(task_id)
+    if job.merge_task is not None:
+        # Merge task: runs after every other task of the job
+        # (reference batch.py merge_task handling :4177-4242).
+        merge_raw = dict(job.merge_task)
+        merge_raw["depends_on"] = all_task_ids
+        task = settings_mod.task_settings(merge_raw, job, pool)
+        merge_id = task.id or "merge-task"
+        spec = _task_spec(task, job, pool)
+        if required_node:
+            spec["required_node"] = required_node
+        pending.append((merge_id, spec))
+    return pending
+
+
 def add_jobs(store: StateStore, pool: PoolSettings,
              jobs: list[JobSettings],
-             pool_id_override: Optional[str] = None) -> dict[str, int]:
-    """Submit jobs + tasks; returns {job_id: task_count}."""
+             pool_id_override: Optional[str] = None,
+             required_node: Optional[str] = None) -> dict[str, int]:
+    """Submit jobs + tasks; returns {job_id: task_count}.
+
+    ``required_node`` pins every task to one node (federation
+    required-target select): agents bounce non-matching deliveries.
+    """
     submitted: dict[str, int] = {}
     for job in jobs:
         pool_id = pool_id_override or job.pool_id or pool.id
@@ -108,30 +152,84 @@ def add_jobs(store: StateStore, pool: PoolSettings,
             })
         except EntityExistsError:
             raise JobExistsError(f"job {job.id} exists on pool {pool_id}")
-        count = 0
-        task_number = 0
-        all_task_ids: list[str] = []
-        pending: list[tuple[str, dict]] = []
-        for raw_task in job.tasks:
-            for expanded in expand_task_factory(raw_task, store):
-                task = settings_mod.task_settings(expanded, job, pool)
-                task_id = task.id or f"task-{task_number:05d}"
-                task_number += 1
-                pending.append((task_id, _task_spec(task, job, pool)))
-                all_task_ids.append(task_id)
-                count += 1
-        if job.merge_task is not None:
-            # Merge task: runs after every other task of the job
-            # (reference batch.py merge_task handling :4177-4242).
-            merge_raw = dict(job.merge_task)
-            merge_raw["depends_on"] = all_task_ids
-            task = settings_mod.task_settings(merge_raw, job, pool)
-            merge_id = task.id or "merge-task"
-            pending.append((merge_id, _task_spec(task, job, pool)))
-            count += 1
-        _submit_tasks_batched(store, pool_id, job.id, pending)
-        submitted[job.id] = count
+        pending = _expand_job_tasks(store, job, pool,
+                                    required_node=required_node)
+        _submit_tasks_batched(store, pool_id, job.id, pending,
+                              priority=job.priority)
+        submitted[job.id] = len(pending)
     return submitted
+
+
+_GENERIC_TASK_ID = re.compile(r"^task-(\d{5,})$")
+
+
+def merge_tasks_into_job(store: StateStore, pool: PoolSettings,
+                         job: JobSettings, pool_id: str,
+                         required_node: Optional[str] = None) -> int:
+    """Add a job spec's tasks to an ALREADY EXISTING job, remapping
+    colliding task ids.
+
+    Reference analog: federation schedule_tasks task-id fixup
+    (federation/federation.py:2605 fixup + :2699
+    regenerate_next_generic_task_id) — a federated action targeting a
+    job that already ran on the pool re-numbers generic ids past the
+    job's current maximum so the merge never collides; depends_on
+    references within the incoming batch are remapped consistently.
+    Explicit (non-generic) ids that collide are an error. Returns the
+    number of tasks added.
+    """
+    get_job(store, pool_id, job.id)  # must exist
+    existing = {t["_rk"] for t in list_tasks(store, pool_id, job.id)}
+    next_number = 0
+    for tid in existing:
+        match = _GENERIC_TASK_ID.match(tid)
+        if match:
+            next_number = max(next_number, int(match.group(1)) + 1)
+    # Expand under the batch's OWN numbering (task-00000...), so
+    # depends_on references within the incoming batch resolve to
+    # batch members; collisions with existing ids are then renumbered
+    # past the job's current maximum and the references remapped.
+    pending = _expand_job_tasks(store, job, pool,
+                                required_node=required_node)
+    remap: dict[str, str] = {}
+    out: list[tuple[str, dict]] = []
+    has_range_deps = any(spec.get("depends_on_range")
+                         for _, spec in pending)
+    # Renumbered ids must dodge existing ids, ids already assigned in
+    # this merge, AND not-yet-processed ids of the incoming batch —
+    # otherwise renaming task-00000 to task-00005 collides with an
+    # incoming task-00005 later in the same batch.
+    taken = set(existing) | {tid for tid, _ in pending}
+    for task_id, spec in pending:
+        new_id = task_id
+        if task_id in existing:
+            if has_range_deps:
+                # depends_on_range references numeric ids positionally;
+                # re-numbering would silently retarget them (the
+                # reference likewise skips re-id when dependencies are
+                # present, federation.py:2686).
+                raise JobExistsError(
+                    f"cannot merge tasks into job {job.id}: id "
+                    f"{task_id} collides and the batch uses "
+                    f"depends_on_range")
+            if _GENERIC_TASK_ID.match(task_id) or task_id == "merge-task":
+                while f"task-{next_number:05d}" in taken:
+                    next_number += 1
+                new_id = f"task-{next_number:05d}"
+                next_number += 1
+            else:
+                raise JobExistsError(
+                    f"task {task_id} already exists in job {job.id} "
+                    f"on pool {pool_id} and is not a generic id")
+        taken.add(new_id)
+        remap[task_id] = new_id
+        out.append((new_id, spec))
+    for _, spec in out:
+        spec["depends_on"] = [remap.get(d, d)
+                              for d in spec.get("depends_on", [])]
+    _submit_tasks_batched(store, pool_id, job.id, out,
+                          priority=job.priority)
+    return len(out)
 
 
 _SUBMIT_CHUNK = 100
@@ -150,11 +248,13 @@ def pool_queue_shards(store: StateStore, pool_id: str) -> int:
 
 
 def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
-                          tasks: list[tuple[str, dict]]) -> None:
+                          tasks: list[tuple[str, dict]],
+                          priority: int = 0) -> None:
     """Chunked batch submission (the reference's 100-task
     TaskAddCollection chunks, batch.py:4313): one entity batch + one
     message batch per shard per chunk instead of 2N store round
-    trips, with messages fanned out over the pool's queue shards."""
+    trips, with messages fanned out over the pool's queue shards.
+    ``priority`` selects the queue band agents drain first."""
     pk = names.task_pk(pool_id, job_id)
     shards = pool_queue_shards(store, pool_id)
     submitted_at = util.datetime_utcnow_iso()
@@ -167,7 +267,8 @@ def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
         store.insert_entities(names.TABLE_TASKS, rows)
         by_queue: dict[str, list[bytes]] = {}
         for task_id, spec in chunk:
-            queue = names.task_queue_for(pool_id, task_id, shards)
+            queue = names.task_queue_for(pool_id, task_id, shards,
+                                         priority=priority)
             num_instances = (spec.get("multi_instance") or {}).get(
                 "num_instances")
             if num_instances:
@@ -362,6 +463,7 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
         "migrated_from": src_pool_id,
     })
     dst_shards = pool_queue_shards(store, dst_pool_id)
+    job_priority = int(job.get("spec", {}).get("priority", 0) or 0)
     for task in tasks:
         entity = {k: v for k, v in task.items()
                   if not k.startswith("_")}
@@ -370,7 +472,8 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
         store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
         if entity.get("state") == "pending":
             dst_queue = names.task_queue_for(
-                dst_pool_id, task["_rk"], dst_shards)
+                dst_pool_id, task["_rk"], dst_shards,
+                priority=job_priority)
             num_instances = (entity.get("spec", {}).get(
                 "multi_instance") or {}).get("num_instances")
             if num_instances:

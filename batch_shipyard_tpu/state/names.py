@@ -35,28 +35,62 @@ def gang_pk(pool_id: str, job_id: str, task_id: str) -> str:
 
 
 # Queues
-def task_queue(pool_id: str, shard: int = 0) -> str:
-    """Task queue name for one shard. Shard 0 keeps the unsharded
-    name, so pools with task_queue_shards=1 (the default) are
-    unchanged on disk."""
+#
+# Priority bands: job.priority maps onto separate queue families that
+# agents drain strictly in band order (hi before normal before lo), so
+# a high-priority job overtakes a 10k-task sweep backlog the way Azure
+# Batch's job priority does for the reference (jobs.yaml priority,
+# -1000..1000). Band "" (normal, priority 0) keeps the historical
+# queue names so existing pools are unchanged on disk.
+PRIORITY_BANDS = ("hi", "", "lo")
+
+
+def priority_band(priority: int) -> str:
+    if priority > 0:
+        return "hi"
+    if priority < 0:
+        return "lo"
+    return ""
+
+
+def task_queue(pool_id: str, shard: int = 0, band: str = "") -> str:
+    """Task queue name for one shard+band. Shard 0 of the normal band
+    keeps the unsharded name, so pools with task_queue_shards=1 (the
+    default) are unchanged on disk."""
+    suffix = f"-{band}" if band else ""
     if shard == 0:
-        return f"taskq-{pool_id}"
-    return f"taskq-{pool_id}-{shard}"
+        return f"taskq-{pool_id}{suffix}"
+    return f"taskq-{pool_id}{suffix}-{shard}"
 
 
 def task_queues(pool_id: str, shards: int) -> list[str]:
-    return [task_queue(pool_id, k) for k in range(max(shards, 1))]
+    """Every task queue of a pool, all bands — the set over which
+    backlog lengths (autoscale, federation facts) are summed."""
+    return [task_queue(pool_id, k, band)
+            for band in PRIORITY_BANDS
+            for k in range(max(shards, 1))]
 
 
-def task_queue_for(pool_id: str, task_id: str, shards: int) -> str:
+def task_queues_by_band(pool_id: str, shards: int) -> list[list[str]]:
+    """Queues grouped by band in strict drain order (hi, normal, lo):
+    agents exhaust earlier bands before popping later ones."""
+    return [[task_queue(pool_id, k, band)
+             for k in range(max(shards, 1))]
+            for band in PRIORITY_BANDS]
+
+
+def task_queue_for(pool_id: str, task_id: str, shards: int,
+                   priority: int = 0) -> str:
     """Deterministic shard for a task: every producer (submit,
     migrate, retry requeue) routes a task's messages to the same
     shard (reference analog: the 100-task TaskAddCollection fan-in,
     batch.py:4313 — re-designed as queue fan-OUT so 10^4-task pools
     don't serialize on one queue)."""
+    band = priority_band(priority)
     if shards <= 1:
-        return task_queue(pool_id)
-    return task_queue(pool_id, zlib.crc32(task_id.encode()) % shards)
+        return task_queue(pool_id, 0, band)
+    return task_queue(pool_id, zlib.crc32(task_id.encode()) % shards,
+                      band)
 
 
 def control_queue(pool_id: str, node_id: str) -> str:

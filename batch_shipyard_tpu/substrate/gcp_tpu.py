@@ -61,11 +61,13 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
 
     # ------------------------------ gcloud -----------------------------
 
-    def _gcloud(self, *args: str, parse_json: bool = False):
+    def _gcloud(self, *args: str, parse_json: bool = False,
+                zone: Optional[str] = None):
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
                f"--project={self.project}"]
-        if self.zone:
-            cmd.append(f"--zone={self.zone}")
+        zone = zone or self.zone
+        if zone:
+            cmd.append(f"--zone={zone}")
         if parse_json:
             cmd.append("--format=json")
         rc, out, err = util.subprocess_capture(cmd)
@@ -101,7 +103,7 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
         if tpu.subnetwork:
             args.append(f"--subnetwork={tpu.subnetwork}")
         try:
-            self._gcloud(*args)
+            self._gcloud(*args, zone=pool.zone)
         except RuntimeError as exc:
             err = gcloud_errors.classify(str(exc))
             self.store.merge_entity(
@@ -117,7 +119,8 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
     def _register_workers(self, pool: PoolSettings,
                           slice_index: int) -> None:
         name = self.slice_name(pool.id, slice_index)
-        desc = self._gcloud("describe", name, parse_json=True)
+        desc = self._gcloud("describe", name, parse_json=True,
+                            zone=pool.zone)
         endpoints = desc.get("networkEndpoints", [])
         workers = pool.tpu.workers_per_slice
         for w, endpoint in enumerate(endpoints[:workers]):
@@ -131,7 +134,7 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
                         "accessConfig", {}).get("externalIp", ""),
                     "node_index": slice_index * workers + w,
                     "slice_index": slice_index, "worker_index": w,
-                    "tpu_name": name})
+                    "tpu_name": name, "zone": pool.zone or self.zone})
 
     def _bootstrap_agents(self, pool: PoolSettings,
                           slice_index: int) -> None:
@@ -148,16 +151,16 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
             slice_index=slice_index, workers=workers,
             bundle_key=self.bootstrap_bundle_key or "")
         self._gcloud("ssh", name, "--worker=all",
-                     f"--command={script}")
+                     f"--command={script}", zone=pool.zone)
 
     def deallocate_pool(self, pool_id: str) -> None:
         rows = list(self.store.query_entities(
             names.TABLE_NODES, partition_key=pool_id))
-        slices = sorted({row.get("tpu_name") for row in rows
-                         if row.get("tpu_name")})
-        for name in slices:
+        slices = sorted({(row.get("tpu_name"), row.get("zone"))
+                         for row in rows if row.get("tpu_name")})
+        for name, zone in slices:
             try:
-                self._gcloud("delete", name, "--quiet")
+                self._gcloud("delete", name, "--quiet", zone=zone)
             except RuntimeError:
                 logger.exception("failed deleting %s", name)
         for row in rows:
@@ -178,7 +181,13 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
 
     def _delete_slice(self, pool_id: str, slice_index: int) -> None:
         name = self.slice_name(pool_id, slice_index)
-        self._gcloud("delete", name, "--quiet")
+        zone = None
+        for row in self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool_id):
+            if int(row.get("slice_index", -1)) == slice_index:
+                zone = row.get("zone")
+                break
+        self._gcloud("delete", name, "--quiet", zone=zone)
         for row in list(self.store.query_entities(
                 names.TABLE_NODES, partition_key=pool_id)):
             if int(row.get("slice_index", -1)) == slice_index:
@@ -196,7 +205,8 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
     def suspend_pool(self, pool: PoolSettings) -> None:
         """gcloud tpu-vm stop on every slice (billing pause)."""
         for s in range(pool.tpu.num_slices):
-            self._gcloud("stop", self.slice_name(pool.id, s))
+            self._gcloud("stop", self.slice_name(pool.id, s),
+                         zone=pool.zone)
         for row in list(self.store.query_entities(
                 names.TABLE_NODES, partition_key=pool.id)):
             self.store.merge_entity(names.TABLE_NODES, pool.id,
@@ -204,7 +214,8 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
 
     def start_pool(self, pool: PoolSettings) -> None:
         for s in range(pool.tpu.num_slices):
-            self._gcloud("start", self.slice_name(pool.id, s))
+            self._gcloud("start", self.slice_name(pool.id, s),
+                         zone=pool.zone)
             self._bootstrap_agents(pool, s)
 
     def get_remote_login(self, pool_id: str,

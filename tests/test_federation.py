@@ -150,3 +150,182 @@ def test_federated_job_term_and_del_routing(env):
         jobs_mgr.get_job(store, "routed", "rjob")
     with pytest.raises(ValueError):
         fed.locate_federation_job(store, "fedr", "rjob")
+
+
+# ------------------- round-4: node-level scheduling -------------------
+
+def test_node_level_filter_and_qualifying_nodes(env):
+    """Node-level constraints (reference federation.py:1939): a pool
+    passes the pool filter but fails the node filter when no node has
+    the required free capacity."""
+    store, substrate = env
+    make_pool(store, substrate, "busy", "v5litepod-4")
+    make_pool(store, substrate, "free", "v5litepod-4")
+    # Saturate 'busy': pretend every node is running a full slot load.
+    from batch_shipyard_tpu.state import names
+    for row in list(store.query_entities(names.TABLE_NODES,
+                                         partition_key="busy")):
+        store.merge_entity(names.TABLE_NODES, "busy", row["_rk"],
+                           {"running_tasks": row.get("task_slots", 1)})
+    facts = [fed._pool_facts(store, p) for p in ("busy", "free")]
+    eligible = fed.filter_pools_hard_constraints(facts, {})
+    assert len(eligible) == 2  # both pass the pool-level pass
+    narrowed = fed.filter_pool_nodes(eligible, {})
+    assert [f["pool_id"] for f in narrowed] == ["free"]
+    # exclusive: node must be running NOTHING
+    half = fed._pool_facts(store, "busy")
+    for node in half["nodes"]:
+        assert fed.qualifying_nodes(
+            half, {"compute_node": {"exclusive": True}}) == []
+    free_fact = fed._pool_facts(store, "free")
+    assert len(fed.qualifying_nodes(
+        free_fact,
+        {"compute_node": {"exclusive": True}})) == free_fact[
+            "nodes_total"] > 0
+
+
+def test_node_constrained_job_lands_on_only_qualifying_pool(env):
+    """Heterogeneous 3-pool federation: a job with node-level
+    constraints lands on the single pool whose nodes qualify."""
+    store, substrate = env
+    from batch_shipyard_tpu.state import names
+    make_pool(store, substrate, "tiny", "v5litepod-4")
+    make_pool(store, substrate, "occupied", "v5litepod-16")
+    make_pool(store, substrate, "roomy", "v5litepod-8")
+    for row in list(store.query_entities(names.TABLE_NODES,
+                                         partition_key="occupied")):
+        store.merge_entity(names.TABLE_NODES, "occupied", row["_rk"],
+                           {"running_tasks": row.get("task_slots", 1)})
+    fed.create_federation(store, "fnode")
+    for p in ("tiny", "occupied", "roomy"):
+        fed.add_pool_to_federation(store, "fnode", p)
+    # min_chips=8 rules out tiny; occupied is full -> roomy wins even
+    # though occupied has more idle-state nodes.
+    fed.submit_job_to_federation(store, "fnode", {
+        "job_specifications": [{
+            "id": "njob",
+            "federation_constraints": {
+                "min_chips": 8,
+                "compute_node": {"min_free_slots": 1}},
+            "tasks": [{"command": "echo node-constrained"}]}]})
+    assert fed.FederationProcessor(store).process_once() == 1
+    assert fed.locate_federation_job(store, "fnode", "njob") == "roomy"
+    jobs_mgr.wait_for_tasks(store, "roomy", "njob", timeout=30)
+
+
+def test_location_and_registry_constraints(env):
+    store, substrate = env
+    from batch_shipyard_tpu.agent import cascade
+    from batch_shipyard_tpu.config.settings import DockerRegistry
+    conf = {"pool_specification": {
+        "id": "zoned", "substrate": "fake", "zone": "us-central2-b",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30}}
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    make_pool(store, substrate, "elsewhere", "v5litepod-4")
+    cascade.populate_global_resources(
+        store, "zoned", [], registries=[DockerRegistry(
+            server="gcr.io/private", username="u",
+            password="secret://env/REG_PW", auth=None)])
+    facts = [fed._pool_facts(store, p) for p in ("zoned", "elsewhere")]
+    by_loc = fed.filter_pools_hard_constraints(
+        facts, {"location": "us-central2-b"})
+    assert [f["pool_id"] for f in by_loc] == ["zoned"]
+    by_reg = fed.filter_pools_hard_constraints(
+        facts, {"registries": ["gcr.io/private"]})
+    assert [f["pool_id"] for f in by_reg] == ["zoned"]
+    assert fed.filter_pools_hard_constraints(
+        facts, {"registries": ["quay.io/other"]}) == []
+
+
+def test_required_target_bypasses_best_fit(env):
+    """required_target pins a job to a named pool+node even when
+    best-fit would pick a bigger pool (reference :2030)."""
+    store, substrate = env
+    make_pool(store, substrate, "small-t", "v5litepod-8")
+    make_pool(store, substrate, "big-t", "v5litepod-16")
+    fed.create_federation(store, "ftarget")
+    fed.add_pool_to_federation(store, "ftarget", "small-t")
+    fed.add_pool_to_federation(store, "ftarget", "big-t")
+    fed.submit_job_to_federation(store, "ftarget", {
+        "job_specifications": [{
+            "id": "pinned",
+            "federation_constraints": {
+                "required_target": {"pool_id": "small-t",
+                                    "node_id": "small-t-s0-w1"}},
+            "tasks": [{"command": "echo pinned"}]}]})
+    assert fed.FederationProcessor(store).process_once() == 1
+    assert fed.locate_federation_job(
+        store, "ftarget", "pinned") == "small-t"
+    tasks = jobs_mgr.wait_for_tasks(store, "small-t", "pinned",
+                                    timeout=30)
+    assert tasks[0]["state"] == "completed"
+    # The pin is enforced by the agents, not just preferred.
+    assert tasks[0]["node_id"] == "small-t-s0-w1"
+    assert tasks[0]["spec"]["required_node"] == "small-t-s0-w1"
+
+
+def test_merge_action_into_existing_job_remaps_ids(env):
+    """A second fed action reusing a job id appends its tasks with
+    generic ids renumbered past the existing maximum (reference
+    task-id collision fixup, federation.py:2605)."""
+    store, substrate = env
+    make_pool(store, substrate, "mergep", "v5litepod-4")
+    fed.create_federation(store, "fmerge")
+    fed.add_pool_to_federation(store, "fmerge", "mergep")
+    fed.submit_job_to_federation(store, "fmerge", {
+        "job_specifications": [{
+            "id": "mj",
+            "tasks": [{"command": "echo one"},
+                      {"command": "echo two"}]}]})
+    proc = fed.FederationProcessor(store)
+    assert proc.process_once() == 1
+    jobs_mgr.wait_for_tasks(store, "mergep", "mj", timeout=30)
+    # Second action, same job id, colliding generic ids.
+    fed.submit_job_to_federation(store, "fmerge", {
+        "job_specifications": [{
+            "id": "mj",
+            "tasks": [{"command": "echo three"},
+                      {"command": "echo four",
+                       "depends_on": ["task-00000"]}]}]})
+    assert proc.process_once() == 1
+    tasks = jobs_mgr.wait_for_tasks(store, "mergep", "mj", timeout=30)
+    ids = sorted(t["_rk"] for t in tasks)
+    assert ids == ["task-00000", "task-00001", "task-00002",
+                   "task-00003"]
+    # The merged batch's internal depends_on was remapped: new
+    # task-00003 depends on new task-00002 (which was task-00000 in
+    # the incoming batch), not on the pre-existing task-00000.
+    dep = next(t for t in tasks if t["_rk"] == "task-00003")
+    assert dep["spec"]["depends_on"] == ["task-00002"]
+    assert all(t["state"] == "completed" for t in tasks)
+    # Idempotent replay: re-delivering an applied action adds nothing.
+    row = store.get_entity(
+        __import__("batch_shipyard_tpu.state.names",
+                   fromlist=["names"]).TABLE_FEDJOBS, "fmerge", "mj")
+    assert len(row["action_ids"]) == 2
+
+
+def test_gc_removes_stale_placement_rows(env):
+    store, substrate = env
+    make_pool(store, substrate, "gcp1", "v5litepod-4")
+    fed.create_federation(store, "fgc")
+    fed.add_pool_to_federation(store, "fgc", "gcp1")
+    fed.submit_job_to_federation(store, "fgc", {
+        "job_specifications": [{
+            "id": "gjob", "tasks": [{"command": "echo gc"}]}]})
+    fed.FederationProcessor(store).process_once()
+    jobs_mgr.wait_for_tasks(store, "gcp1", "gjob", timeout=30)
+    assert fed.gc_federation_jobs(store, "fgc",
+                                  grace_seconds=0.0) == []
+    # Delete the job behind the federation's back -> row is stale.
+    jobs_mgr.delete_job(store, "gcp1", "gjob")
+    # Young rows are protected by the grace window (a GC racing the
+    # scheduler's insert->add_jobs window must not reap them)...
+    assert fed.gc_federation_jobs(store, "fgc") == []
+    # ...but past the grace window the stale row is collected.
+    assert fed.gc_federation_jobs(store, "fgc",
+                                  grace_seconds=0.0) == ["gjob"]
+    with pytest.raises(ValueError):
+        fed.locate_federation_job(store, "fgc", "gjob")

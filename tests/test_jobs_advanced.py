@@ -575,3 +575,73 @@ def test_shared_auto_scratch_one_namespace_across_gang():
             pass
     finally:
         substrate.stop_all()
+
+
+def test_job_priority_overtakes_backlog():
+    """A high-priority job submitted behind a large sweep backlog
+    completes before the backlog drains (Azure Batch job-priority
+    semantics the reference inherits; jobs.yaml priority)."""
+    conf = {"pool_specification": {
+        "id": "prio", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},  # 1 node
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    try:
+        sweep = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "sweep",
+            "tasks": [{"command": "echo sweep",
+                       "task_factory": {"repeat": 200}}],
+        }]})
+        jobs_mgr.add_jobs(store, pool, sweep)
+        urgent = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "urgent", "priority": 100,
+            "tasks": [{"command": "echo urgent"}],
+        }]})
+        jobs_mgr.add_jobs(store, pool, urgent)
+        # The urgent task rides the hi band...
+        assert store.queue_length(
+            names.task_queue("prio", 0, "hi")) == 1
+        tasks = jobs_mgr.wait_for_tasks(store, "prio", "urgent",
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed"
+        # ... and finished while the sweep backlog was still deep.
+        sweep_pending = sum(
+            1 for t in jobs_mgr.list_tasks(store, "prio", "sweep")
+            if t.get("state") == "pending")
+        assert sweep_pending > 50, (
+            f"urgent overtook only {200 - sweep_pending} sweep tasks")
+    finally:
+        substrate.stop_all()
+
+
+def test_merge_tasks_into_job_collision_fixup():
+    """Direct merge API: generic ids renumber past the existing max;
+    explicit colliding ids are rejected."""
+    store, substrate, pool = make_env("mpool")
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "jm2", "tasks": [{"command": "echo first"}]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        jobs_mgr.wait_for_tasks(store, "mpool", "jm2", timeout=30)
+        added = jobs_mgr.merge_tasks_into_job(
+            store, pool, jobs[0], "mpool")
+        assert added == 1
+        tasks = jobs_mgr.wait_for_tasks(store, "mpool", "jm2",
+                                        timeout=30)
+        assert sorted(t["_rk"] for t in tasks) == [
+            "task-00000", "task-00001"]
+        # Explicit id collision -> error
+        named = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "jm2",
+            "tasks": [{"id": "fixed-id", "command": "echo x"}]}]})
+        jobs_mgr.merge_tasks_into_job(store, pool, named[0], "mpool")
+        with pytest.raises(jobs_mgr.JobExistsError):
+            jobs_mgr.merge_tasks_into_job(store, pool, named[0],
+                                          "mpool")
+    finally:
+        substrate.stop_all()

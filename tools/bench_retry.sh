@@ -18,7 +18,7 @@ EOF
     if [ $RC -eq 0 ] && grep -q '^OK' /tmp/probe_out.txt; then
         echo "$TS probe OK — running tpu_checks + bench" >> "$LOG"
         timeout 1800 python tools/tpu_checks.py \
-            > TPU_CHECKS_r03.txt 2>&1
+            > TPU_CHECKS_r04.txt 2>&1
         echo "$TS tpu_checks rc=$?" >> "$LOG"
         timeout 1800 python bench.py > /tmp/bench_out.txt 2>&1
         BRC=$?
