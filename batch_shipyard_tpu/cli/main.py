@@ -173,10 +173,19 @@ def pool_images_list(click_ctx):
     """List the pool's replicated image manifest."""
     ctx = _ctx(click_ctx)
     from batch_shipyard_tpu.state import names as names_mod
-    rows = [{"kind": r.get("kind"), "image": r.get("image")}
-            for r in ctx.store.query_entities(
-                names_mod.TABLE_IMAGES, partition_key=ctx.pool.id)]
-    fleet._emit({"images": rows}, click_ctx.obj["raw"])
+    images = []
+    registries = []
+    for r in ctx.store.query_entities(names_mod.TABLE_IMAGES,
+                                      partition_key=ctx.pool.id):
+        if r.get("kind") == "registry":
+            # Credential rows ride the same manifest; list them as a
+            # separate section (never their secret material).
+            registries.append({"server": r.get("server")})
+        else:
+            images.append({"kind": r.get("kind"),
+                           "image": r.get("image")})
+    fleet._emit({"images": images, "registries": registries},
+                click_ctx.obj["raw"])
 
 
 @images.command("update")
@@ -437,13 +446,32 @@ def data_files_get(click_ctx, job_id, task_id, dest):
 
 
 @data.command("ingress")
+@click.option("--ssh-private-key", default=None,
+              help="Key for direct-to-node (shared fs) ingress")
 @click.pass_context
-def data_ingress(click_ctx):
+def data_ingress(click_ctx, ssh_private_key):
     from batch_shipyard_tpu.data import movement
+    from batch_shipyard_tpu.state import names as names_mod
     ctx = _ctx(click_ctx)
+    node_logins = None
+    ssh_username = "shipyard"
+    if "pool" in ctx.configs:
+        from batch_shipyard_tpu.pool import manager as pool_mgr
+        node_logins = []
+        for row in ctx.store.query_entities(
+                names_mod.TABLE_NODES, partition_key=ctx.pool.id):
+            if row.get("state") not in pool_mgr.READY_STATES:
+                continue  # never shard onto booting/failed nodes
+            ip = row.get("external_ip") or row.get("internal_ip")
+            if ip:
+                node_logins.append((row["_rk"], ip, 22))
+        ssh_username = ctx.pool.ssh.username
     movement.ingress_data(ctx.store, ctx.global_settings,
                           pool_id=ctx.pool.id if "pool" in
-                          ctx.configs else None)
+                          ctx.configs else None,
+                          node_logins=node_logins or None,
+                          ssh_username=ssh_username,
+                          ssh_private_key=ssh_private_key)
 
 
 # ------------------------------- diag ----------------------------------
