@@ -1,0 +1,119 @@
+"""Poisson-arrival load generator for the serving front end.
+
+Measures what continuous-batching engines are judged by: TTFT and
+TPOT percentiles under concurrent load, plus aggregate tokens/sec —
+the serving benchmark the reference's recipes-as-acceptance strategy
+(SURVEY.md section 4) implies but never had an ML engine to apply to.
+stdlib-only: urllib for transport, threads for in-flight requests,
+random.Random(seed) for reproducible arrivals.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from batch_shipyard_tpu.models.server import percentile
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+_HIST_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                    5000, 10000, 30000)
+
+
+def _histogram(values_ms: list[float]) -> dict[str, int]:
+    """Fixed-bucket latency histogram {"<=5ms": n, ..., ">30000ms": n}."""
+    out: dict[str, int] = {}
+    rest = list(values_ms)
+    for edge in _HIST_BUCKETS_MS:
+        hit = [v for v in rest if v <= edge]
+        rest = [v for v in rest if v > edge]
+        out[f"<={edge}ms"] = len(hit)
+    out[f">{_HIST_BUCKETS_MS[-1]}ms"] = len(rest)
+    return out
+
+
+def _post_generate(base_url: str, payload: dict,
+                   timeout: float) -> dict:
+    req = urllib.request.Request(
+        f"{base_url}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def run_load(base_url: str, num_requests: int,
+             rate_hz: float = 8.0,
+             prompt_len: tuple[int, int] = (4, 32),
+             max_new_tokens: tuple[int, int] = (8, 32),
+             vocab_size: int = 97,
+             seed: int = 0,
+             eos_id: Optional[int] = None,
+             request_timeout: float = 300.0) -> dict:
+    """Fire ``num_requests`` at Poisson arrivals of ``rate_hz`` and
+    return the latency report: TTFT/TPOT/latency p50/p95/p99,
+    tokens/sec, and a fixed-bucket TTFT histogram."""
+    rng = random.Random(seed)
+    results: list[Optional[dict]] = [None] * num_requests
+    errors: list[Optional[str]] = [None] * num_requests
+    threads = []
+
+    def _one(k: int, payload: dict) -> None:
+        try:
+            results[k] = _post_generate(base_url, payload,
+                                        request_timeout)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            errors[k] = str(exc)
+
+    started = time.perf_counter()
+    for k in range(num_requests):
+        plen = rng.randint(*prompt_len)
+        payload = {
+            "request_id": f"load-{seed}-{k}",
+            "prompt": [rng.randrange(vocab_size) for _ in range(plen)],
+            "max_new_tokens": rng.randint(*max_new_tokens),
+        }
+        if eos_id is not None:
+            payload["eos_id"] = eos_id
+        thread = threading.Thread(target=_one, args=(k, payload),
+                                  daemon=True)
+        thread.start()
+        threads.append(thread)
+        if k < num_requests - 1:
+            time.sleep(rng.expovariate(rate_hz))
+    for thread in threads:
+        thread.join(request_timeout)
+    elapsed = time.perf_counter() - started
+    done = [r for r in results if r is not None]
+    failed = [e for e in errors if e is not None]
+    ttfts = [r["ttft_ms"] for r in done]
+    tpots = [r["tpot_ms"] for r in done]
+    lats = [r["latency_ms"] for r in done]
+    tokens = sum(r["num_tokens"] for r in done)
+    report = {
+        "num_requests": num_requests,
+        "completed": len(done),
+        "failed": len(failed),
+        "offered_rate_hz": rate_hz,
+        "elapsed_seconds": elapsed,
+        "requests_per_second": len(done) / elapsed if elapsed else 0.0,
+        "tokens_per_second": tokens / elapsed if elapsed else 0.0,
+        "generated_tokens": tokens,
+        "ttft_ms": {f"p{p}": percentile(ttfts, p)
+                    for p in (50, 95, 99)},
+        "tpot_ms": {f"p{p}": percentile(tpots, p)
+                    for p in (50, 95, 99)},
+        "latency_ms": {f"p{p}": percentile(lats, p)
+                       for p in (50, 95, 99)},
+        "ttft_histogram": _histogram(ttfts),
+    }
+    if failed:
+        report["errors"] = failed[:8]
+    return report

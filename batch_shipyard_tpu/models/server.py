@@ -1,0 +1,270 @@
+"""HTTP serving front end over the continuous-batching engine.
+
+The reference has no serving story; SURVEY.md treats recipes as the
+acceptance surface, and an Orca/vLLM-class engine is judged by
+TTFT/TPOT under load — which needs an ingress path. This front end is
+deliberately stdlib-only (http.server): the engine's throughput comes
+from the jitted decode step, not the socket layer, and one thread per
+in-flight request is plenty for a per-replica slot count.
+
+Architecture:
+  - HTTP handlers parse/validate and enqueue (request, Event) pairs;
+  - ONE engine thread owns the ContinuousBatcher: it drains the
+    submission queue, calls engine.step() while work is active, and
+    completes waiters — the engine is never touched from two threads;
+  - the engine's on_token hook timestamps each request's first token,
+    giving true TTFT (time-to-first-token) rather than
+    time-to-completion.
+
+Endpoints:
+  POST /v1/generate   {"prompt": [ids], "max_new_tokens": n,
+                       "request_id"?: str, "eos_id"?: int}
+      -> {"request_id", "tokens", "num_tokens", "ttft_ms",
+          "tpot_ms", "latency_ms"}
+  GET  /v1/stats      aggregate counters + latency percentiles
+  GET  /healthz       liveness
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from batch_shipyard_tpu.models.serving import ContinuousBatcher, Request
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+class _Pending:
+    __slots__ = ("request", "event", "submitted_at", "first_token_at",
+                 "finished_at", "tokens", "error")
+
+    def __init__(self, request: Request) -> None:
+        self.request = request
+        self.event = threading.Event()
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.tokens: Optional[list[int]] = None
+        self.error: Optional[str] = None
+
+
+def percentile(values: list[float], pct: float) -> float:
+    """Nearest-rank percentile (no numpy dependency in the serving
+    path)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    k = max(1, min(len(ordered),
+                   math.ceil(pct / 100.0 * len(ordered))))
+    return ordered[k - 1]
+
+
+class ServingFrontEnd:
+    """Owns the engine thread + HTTP server around a
+    ContinuousBatcher."""
+
+    def __init__(self, engine: ContinuousBatcher,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        engine.on_token = self._on_token
+        self._submit_q: "queue.Queue[_Pending]" = queue.Queue()
+        self._inflight: dict[str, _Pending] = {}
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._completed: list[dict] = []
+        self._started_at = time.perf_counter()
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="serving-engine", daemon=True)
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Silence per-request stderr logging.
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                elif self.path == "/v1/stats":
+                    self._reply(200, front.stats())
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/generate":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    spec = json.loads(self.rfile.read(length))
+                    result = front.generate(spec)
+                except ValueError as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                except Exception as exc:  # defensive: keep serving
+                    logger.exception("generate failed")
+                    self._reply(500, {"error": str(exc)})
+                    return
+                self._reply(200, result)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+
+    # ------------------------------ lifecycle --------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServingFrontEnd":
+        self._engine_thread.start()
+        self._http_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._engine_thread.join(timeout=10.0)
+
+    # ------------------------------ serving ----------------------------
+
+    def generate(self, spec: dict, timeout: float = 300.0) -> dict:
+        """Blocking generate: enqueue to the engine thread, wait for
+        completion, return tokens + latency breakdown."""
+        prompt = spec.get("prompt")
+        if not isinstance(prompt, list) or not all(
+                isinstance(t, int) for t in prompt):
+            raise ValueError("prompt must be a list of token ids")
+        request_id = str(spec.get("request_id") or uuid.uuid4().hex[:12])
+        request = Request(
+            request_id=request_id, prompt=prompt,
+            max_new_tokens=int(spec.get("max_new_tokens", 16)),
+            eos_id=spec.get("eos_id"))
+        pending = _Pending(request)
+        with self._inflight_lock:
+            if request_id in self._inflight:
+                raise ValueError(f"request_id {request_id} in flight")
+            self._inflight[request_id] = pending
+        self._submit_q.put(pending)
+        try:
+            if not pending.event.wait(timeout):
+                raise TimeoutError(
+                    f"request {request_id} timed out after {timeout}s")
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(request_id, None)
+        if pending.error is not None:
+            raise ValueError(pending.error)
+        n = len(pending.tokens)
+        ttft = (pending.first_token_at or pending.finished_at) - \
+            pending.submitted_at
+        decode = pending.finished_at - (pending.first_token_at or
+                                        pending.submitted_at)
+        tpot = decode / max(1, n - 1)
+        result = {
+            "request_id": request_id,
+            "tokens": pending.tokens,
+            "num_tokens": n,
+            "ttft_ms": ttft * 1e3,
+            "tpot_ms": tpot * 1e3,
+            "latency_ms": (pending.finished_at -
+                           pending.submitted_at) * 1e3,
+        }
+        with self._stats_lock:
+            self._completed.append({
+                "ttft_ms": result["ttft_ms"],
+                "tpot_ms": result["tpot_ms"],
+                "latency_ms": result["latency_ms"],
+                "num_tokens": n,
+            })
+        return result
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            done = list(self._completed)
+        elapsed = time.perf_counter() - self._started_at
+        tokens = sum(r["num_tokens"] for r in done)
+        ttfts = [r["ttft_ms"] for r in done]
+        tpots = [r["tpot_ms"] for r in done]
+        return {
+            "completed_requests": len(done),
+            "generated_tokens": tokens,
+            "uptime_seconds": elapsed,
+            "tokens_per_second": tokens / elapsed if elapsed else 0.0,
+            "ttft_ms": {p: percentile(ttfts, p) for p in (50, 95, 99)},
+            "tpot_ms": {p: percentile(tpots, p) for p in (50, 95, 99)},
+        }
+
+    # --------------------------- engine thread -------------------------
+
+    def _on_token(self, request_id: str, token: int, index: int) -> None:
+        if index == 0:
+            with self._inflight_lock:
+                pending = self._inflight.get(request_id)
+            if pending is not None and pending.first_token_at is None:
+                pending.first_token_at = time.perf_counter()
+
+    def _engine_loop(self) -> None:
+        while not self._stop.is_set():
+            # Park only when fully idle; with active slots the loop
+            # must spin at full decode rate — a blocking get here
+            # would throttle every active request's TPOT.
+            if not self.engine.pending():
+                try:
+                    self._submit(self._submit_q.get(timeout=0.2))
+                except queue.Empty:
+                    pass
+            while True:
+                try:
+                    self._submit(self._submit_q.get_nowait())
+                except queue.Empty:
+                    break
+            if not self.engine.pending():
+                continue
+            try:
+                finished = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed")
+                continue
+            now = time.perf_counter()
+            for request_id, tokens in finished:
+                with self._inflight_lock:
+                    pending = self._inflight.get(request_id)
+                if pending is None:
+                    continue
+                pending.tokens = tokens
+                pending.finished_at = now
+                pending.event.set()
+
+    def _submit(self, pending: _Pending) -> None:
+        try:
+            self.engine.submit(pending.request)
+        except ValueError as exc:
+            pending.error = str(exc)
+            pending.finished_at = time.perf_counter()
+            pending.event.set()

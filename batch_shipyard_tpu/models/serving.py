@@ -74,7 +74,9 @@ class ContinuousBatcher:
                  seed: int = 0,
                  kv_page_size: Optional[int] = None,
                  kv_num_pages: Optional[int] = None,
-                 overcommit: bool = False):
+                 overcommit: bool = False,
+                 on_token: Optional[
+                     Callable[[str, int, int], None]] = None):
         """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
         live in a shared kv_num_pages-page pool and slots hold block
         tables covering only their live tokens, so HBM is sized for
@@ -98,6 +100,11 @@ class ContinuousBatcher:
         self.config = inf.decode_config(config, max_decode_len)
         self.paged = kv_page_size is not None
         self.overcommit = overcommit
+        # Observer called as (request_id, token, index) the moment a
+        # token is generated (index 0 = the prefill-sampled first
+        # token) — the TTFT/TPOT measurement point for serving front
+        # ends. Runs on the engine's stepping thread.
+        self.on_token = on_token
         self.preemptions = 0
         if overcommit and not self.paged:
             raise ValueError("overcommit requires the paged KV cache "
@@ -335,6 +342,9 @@ class ContinuousBatcher:
                 continue
             token = int(next_host[i])
             slot.generated.append(token)
+            if self.on_token is not None:
+                self.on_token(req.request_id, token,
+                              len(slot.generated) - 1)
             done = (len(slot.generated) >= req.max_new_tokens or
                     (req.eos_id is not None and token == req.eos_id))
             if done:
@@ -494,6 +504,9 @@ class ContinuousBatcher:
             self._slots[i] = _Slot(
                 request=req,
                 generated=entry.resumed + [int(first[0])])
+            if self.on_token is not None:
+                self.on_token(req.request_id, int(first[0]),
+                              len(entry.resumed))
             self._tokens = self._tokens.at[i, 0].set(first[0])
             self._positions = self._positions.at[i].set(len(tokens))
             self._active = self._active.at[i].set(True)

@@ -1,0 +1,108 @@
+"""Serving workload: HTTP front end over the continuous-batching
+engine, with an optional built-in Poisson load benchmark.
+
+Recipe command (Serving-ContinuousBatching):
+    python -m batch_shipyard_tpu.workloads.serve \
+        --num-slots 8 --max-decode-len 512 \
+        --loadgen 64 --rate 16 --report latency_report.json
+
+Without --loadgen the server runs until terminated (a long-lived
+serving task); with it, the benchmark runs against the in-process
+server, writes the latency-histogram JSON report, prints it as the
+final stdout line, and exits nonzero if any request failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from batch_shipyard_tpu.models import inference as inf
+from batch_shipyard_tpu.models import serving
+from batch_shipyard_tpu.models import transformer as tfm
+from batch_shipyard_tpu.models.server import ServingFrontEnd
+
+
+def build_engine(args) -> serving.ContinuousBatcher:
+    config = tfm.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads,
+        d_head=args.d_model // args.n_heads, d_ff=args.d_ff,
+        max_seq_len=args.max_decode_len, dtype=jnp.bfloat16)
+    model = tfm.TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, 8), jnp.int32))["params"]
+    return serving.ContinuousBatcher(
+        config, params, num_slots=args.num_slots,
+        max_decode_len=args.max_decode_len,
+        sampling=inf.SamplingConfig(temperature=args.temperature,
+                                    top_k=args.top_k),
+        seed=args.seed,
+        kv_page_size=args.kv_page_size,
+        kv_num_pages=args.kv_num_pages,
+        overcommit=args.overcommit)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=4)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--d-ff", type=int, default=1024)
+    parser.add_argument("--vocab", type=int, default=32000)
+    parser.add_argument("--num-slots", type=int, default=8)
+    parser.add_argument("--max-decode-len", type=int, default=512)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kv-page-size", type=int, default=None)
+    parser.add_argument("--kv-num-pages", type=int, default=None)
+    parser.add_argument("--overcommit", action="store_true")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8900)
+    # Benchmark mode
+    parser.add_argument("--loadgen", type=int, default=0,
+                        help="Run N Poisson requests then exit")
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="Poisson arrival rate (req/s)")
+    parser.add_argument("--prompt-len", type=int, nargs=2,
+                        default=(4, 32), metavar=("MIN", "MAX"))
+    parser.add_argument("--gen-tokens", type=int, nargs=2,
+                        default=(8, 32), metavar=("MIN", "MAX"))
+    parser.add_argument("--report", default="latency_report.json")
+    args = parser.parse_args()
+
+    engine = build_engine(args)
+    front = ServingFrontEnd(engine, host=args.host,
+                            port=args.port).start()
+    print(f"serving on {front.url}", flush=True)
+    if not args.loadgen:
+        try:
+            front._http_thread.join()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            front.shutdown()
+        return 0
+    from batch_shipyard_tpu.models.loadgen import run_load
+    # One warmup request so jit compilation doesn't pollute TTFT.
+    front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
+    report = run_load(
+        front.url, args.loadgen, rate_hz=args.rate,
+        prompt_len=tuple(args.prompt_len),
+        max_new_tokens=tuple(args.gen_tokens),
+        vocab_size=args.vocab, seed=args.seed)
+    front.shutdown()
+    with open(args.report, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2)
+    print(json.dumps(report), flush=True)
+    return 1 if report["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Serving front end + load generator: HTTP ingress over the
+continuous-batching engine, TTFT/TPOT measurement, Poisson load
+report (VERDICT r3 order #4 — an Orca/vLLM-class engine is judged by
+TTFT/TPOT under load, which needs an ingress path)."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batch_shipyard_tpu.models import inference as inf
+from batch_shipyard_tpu.models import loadgen, serving
+from batch_shipyard_tpu.models import transformer as tfm
+from batch_shipyard_tpu.models.server import ServingFrontEnd, percentile
+
+CFG = tfm.TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32,
+    param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = tfm.TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(7),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+@pytest.fixture()
+def front(params):
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                       max_decode_len=64)
+    fe = ServingFrontEnd(engine, port=0).start()
+    yield fe
+    fe.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        f"{url}/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def test_generate_over_http_matches_engine_greedy(front, params):
+    prompt = [5, 17, 31, 2]
+    out = _post(front.url, {"prompt": prompt, "max_new_tokens": 6})
+    assert len(out["tokens"]) == 6
+    assert out["num_tokens"] == 6
+    assert out["ttft_ms"] > 0 and out["tpot_ms"] >= 0
+    assert out["latency_ms"] >= out["ttft_ms"]
+    # Greedy equivalence with the lockstep decoder.
+    run, _ = inf.make_decoder(CFG, params, max_decode_len=64)
+    ref, _ = run(jnp.asarray([prompt], jnp.int32), 6,
+                 jax.random.PRNGKey(0))
+    assert out["tokens"] == list(
+        np.asarray(ref[0, len(prompt):]).tolist())
+
+
+def test_health_stats_and_errors(front):
+    with urllib.request.urlopen(f"{front.url}/healthz",
+                                timeout=30) as resp:
+        assert json.loads(resp.read())["ok"] is True
+    _post(front.url, {"prompt": [1, 2], "max_new_tokens": 3})
+    with urllib.request.urlopen(f"{front.url}/v1/stats",
+                                timeout=30) as resp:
+        stats = json.loads(resp.read())
+    assert stats["completed_requests"] >= 1
+    assert stats["generated_tokens"] >= 3
+    assert set(stats["ttft_ms"]) == {"50", "95", "99"} or set(
+        stats["ttft_ms"]) == {50, 95, 99}
+    # Bad request -> 400, server keeps serving.
+    bad = urllib.request.Request(
+        f"{front.url}/v1/generate",
+        data=json.dumps({"prompt": "nope"}).encode(), method="POST")
+    try:
+        urllib.request.urlopen(bad, timeout=30)
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+    out = _post(front.url, {"prompt": [3], "max_new_tokens": 2})
+    assert len(out["tokens"]) == 2
+
+
+def test_poisson_load_report(front):
+    report = loadgen.run_load(
+        front.url, num_requests=12, rate_hz=50.0,
+        prompt_len=(2, 8), max_new_tokens=(2, 6), vocab_size=97,
+        seed=3)
+    assert report["completed"] == 12 and report["failed"] == 0
+    assert report["generated_tokens"] >= 24
+    assert report["tokens_per_second"] > 0
+    for section in ("ttft_ms", "tpot_ms", "latency_ms"):
+        assert set(report[section]) == {"p50", "p95", "p99"}
+        assert report[section]["p99"] >= report[section]["p50"]
+    hist = report["ttft_histogram"]
+    assert sum(hist.values()) == 12
+    # Reproducible arrivals + prompts under the same seed.
+    again = loadgen.run_load(
+        front.url, num_requests=3, rate_hz=100.0, prompt_len=(2, 4),
+        max_new_tokens=(2, 3), vocab_size=97, seed=9)
+    once_more = loadgen.run_load(
+        front.url, num_requests=3, rate_hz=100.0, prompt_len=(2, 4),
+        max_new_tokens=(2, 3), vocab_size=97, seed=9)
+    assert again["generated_tokens"] == once_more["generated_tokens"]
+
+
+def test_paged_overcommit_engine_behind_front(params):
+    engine = serving.ContinuousBatcher(
+        CFG, params, num_slots=2, max_decode_len=64,
+        kv_page_size=8, kv_num_pages=12, overcommit=True)
+    fe = ServingFrontEnd(engine, port=0).start()
+    try:
+        report = loadgen.run_load(
+            fe.url, num_requests=6, rate_hz=100.0,
+            prompt_len=(2, 6), max_new_tokens=(2, 8), vocab_size=97,
+            seed=1)
+        assert report["completed"] == 6 and report["failed"] == 0
+    finally:
+        fe.shutdown()
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 99) == 0.0
+    vals = [float(v) for v in range(1, 101)]
+    assert percentile(vals, 50) == 50.0
+    assert percentile(vals, 99) == 99.0
