@@ -32,6 +32,7 @@ from typing import Optional
 
 from batch_shipyard_tpu.agent import perf
 from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.utils import secrets
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, NotFoundError, StateStore)
 from batch_shipyard_tpu.utils import util
@@ -76,6 +77,15 @@ def populate_global_resources(store: StateStore, pool_id: str,
             "kind": "singularity", "image": image,
             "concurrent_downloads": concurrent_downloads})
     for reg in registries or ():
+        if reg.password and not secrets.is_secret_id(reg.password):
+            # The documented contract is that plaintext never lands in
+            # the state store; a raw password here would persist in
+            # the images table readable by every node.
+            logger.warning(
+                "docker registry %s password is NOT a secret:// ref; "
+                "it will be stored in the shared state store in "
+                "PLAINTEXT — use secret://env/... or "
+                "secret://gcp-sm/... instead", reg.server)
         key = util.hash_string(f"registry:{reg.server}")[:24]
         store.upsert_entity(names.TABLE_IMAGES, pool_id, key, {
             "kind": "registry", "server": reg.server,

@@ -44,6 +44,11 @@ logger = util.get_logger(__name__)
 
 _OUTPUT_STREAM_CHUNK = 4 * 1024 * 1024
 
+# Identity file worker 0 writes inside a shared scratch dir; other
+# workers read it THROUGH the published path to decide whether they
+# already share the host's filesystem.
+_SCRATCH_NONCE = ".shipyard_scratch_nonce"
+
 
 class NodeUnusableError(Exception):
     """Raised by a nodeprep callable to mark the node unusable (as
@@ -75,6 +80,16 @@ class NodeAgent:
                      Callable[["NodeAgent", list[str]], None]] = None,
                  output_upload_cap_bytes: Optional[int] = None,
                  substrate: Optional[object] = None,
+                 scratch_mount_runner: Optional[
+                     Callable[[str, str], int]] = None,
+                 scratch_export_runner: Optional[
+                     Callable[[str], int]] = None,
+                 scratch_unexport_runner: Optional[
+                     Callable[[str], int]] = None,
+                 scratch_umount_runner: Optional[
+                     Callable[[str], int]] = None,
+                 force_remote_scratch: bool = False,
+                 scratch_finalize_timeout: float = 120.0,
                  ) -> None:
         self.store = store
         self.identity = identity
@@ -92,6 +107,18 @@ class NodeAgent:
         # None = upload task outputs in full (streamed). A configured
         # cap keeps head+tail around an explicit truncation marker.
         self.output_upload_cap_bytes = output_upload_cap_bytes
+        # Shared-scratch plumbing commands, injectable so mount/export
+        # synthesis and their failure modes run under fault injection
+        # (on real pools these shell out to mount/umount/exportfs).
+        self._scratch_mount = scratch_mount_runner or self._nfs_mount
+        self._scratch_export = (scratch_export_runner or
+                                self._nfs_export)
+        self._scratch_unexport = (scratch_unexport_runner or
+                                  self._nfs_unexport)
+        self._scratch_umount = (scratch_umount_runner or
+                                self._nfs_umount)
+        self._force_remote_scratch = force_remote_scratch
+        self._scratch_finalize_timeout = scratch_finalize_timeout
         self.stop_event = threading.Event()
         self._threads: list[threading.Thread] = []
         self._running_tasks = 0
@@ -873,8 +900,18 @@ class NodeAgent:
             dict(spec.get("environment_variables", {})))
         env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
         if spec.get("auto_scratch"):
-            env["SHIPYARD_JOB_SCRATCH"] = self._resolve_scratch(
-                job_id, spec)
+            try:
+                env["SHIPYARD_JOB_SCRATCH"] = self._resolve_scratch(
+                    job_id, spec)
+            except RuntimeError:
+                # Shared-scratch resolution can only fail here when
+                # job prep already failed on this node (success caches
+                # the path) — the task will not run, but the gang path
+                # still needs a constructible execution to record the
+                # instance's failure instead of bouncing the message
+                # forever.
+                env["SHIPYARD_JOB_SCRATCH"] = \
+                    self._job_scratch_dir(job_id)
         if extra_env:
             env.update(extra_env)
         task_dir = os.path.join(
@@ -886,6 +923,7 @@ class NodeAgent:
             node_index=self.identity.node_index,
             command=spec.get("command", ""),
             runtime=spec.get("runtime", "none"),
+            container_runtime=spec.get("container_runtime", "runc"),
             image=spec.get("image"),
             env=env, task_dir=task_dir.rstrip("/"), slot=slot,
             instances=instances, instance=instance, host_list=host_list,
@@ -1002,12 +1040,26 @@ class NodeAgent:
         if self.identity.node_index == 0:
             path = self._job_scratch_dir(job_id)
             os.makedirs(path, exist_ok=True)
-            self._export_shared_scratch(path)
+            # Nonce: lets non-host workers decide "same filesystem"
+            # by reading it THROUGH the published path rather than by
+            # bare directory existence (a stale preserved scratch at
+            # the identical layout path would otherwise silently
+            # become a private local dir).
+            nonce = uuid.uuid4().hex
+            with open(os.path.join(path, _SCRATCH_NONCE), "w",
+                      encoding="utf-8") as fh:
+                fh.write(nonce)
+            rc = self._scratch_export(path)
+            if rc != 0:
+                raise RuntimeError(
+                    f"job {job_id}: NFS export of shared scratch "
+                    f"{path} failed rc={rc}")
             self.store.upsert_entity(
                 names.TABLE_JOBPREP, pk, "#scratchhost", {
                     "path": path,
                     "host_ip": self.identity.internal_ip,
-                    "node_id": self.identity.node_id})
+                    "node_id": self.identity.node_id,
+                    "nonce": nonce})
             self._shared_scratch[job_id] = path
             return path
         deadline = time.monotonic() + 60.0
@@ -1023,7 +1075,8 @@ class NodeAgent:
                         f"published (is worker 0 alive?)")
                 time.sleep(self.poll_interval)
         host_path = row["path"]
-        if os.path.isdir(host_path):
+        if not self._force_remote_scratch and \
+                self._nonce_matches(host_path, row.get("nonce")):
             # Same filesystem (fake/localhost substrates): the host
             # path IS the shared namespace.
             self._shared_scratch[job_id] = host_path
@@ -1031,9 +1084,8 @@ class NodeAgent:
         mount_point = os.path.join(self.work_dir, "scratch-nfs",
                                    job_id)
         os.makedirs(mount_point, exist_ok=True)
-        rc = subprocess.call(
-            ["mount", "-t", "nfs",
-             f"{row['host_ip']}:{host_path}", mount_point])
+        rc = self._scratch_mount(
+            f"{row['host_ip']}:{host_path}", mount_point)
         if rc != 0:
             raise RuntimeError(
                 f"job {job_id}: NFS mount of shared scratch "
@@ -1041,40 +1093,129 @@ class NodeAgent:
         self._shared_scratch[job_id] = mount_point
         return mount_point
 
-    def _export_shared_scratch(self, path: str) -> None:
-        """Export worker 0's scratch dir over NFS (no-op when
-        exportfs is unavailable or we lack root — the same-filesystem
-        substrates don't need it)."""
+    @staticmethod
+    def _nonce_matches(host_path: str, nonce: Optional[str]) -> bool:
+        if not nonce:
+            return False
+        try:
+            with open(os.path.join(host_path, _SCRATCH_NONCE),
+                      encoding="utf-8") as fh:
+                return fh.read().strip() == nonce
+        except OSError:
+            return False
+
+    # Default NFS plumbing (used when no runner is injected). Export
+    # and unexport are no-ops without exportfs/root — the
+    # same-filesystem substrates don't need them.
+
+    def _nfs_mount(self, remote: str, mount_point: str) -> int:
+        return subprocess.call(["mount", "-t", "nfs", remote,
+                                mount_point])
+
+    def _nfs_umount(self, mount_point: str) -> int:
+        return subprocess.call(["umount", mount_point])
+
+    def _nfs_export(self, path: str) -> int:
         import shutil as shutil_mod
         if shutil_mod.which("exportfs") is None or os.geteuid() != 0:
-            return
+            return 0
         line = f"{path} *(rw,sync,no_subtree_check,no_root_squash)"
         try:
             with open("/etc/exports", "r+", encoding="utf-8") as fh:
                 if line not in fh.read():
                     fh.write(line + "\n")
-            subprocess.call(["exportfs", "-ra"])
+            return subprocess.call(["exportfs", "-ra"])
         except OSError as exc:
             logger.warning("shared-scratch export failed: %s", exc)
+            return 1
+
+    def _nfs_unexport(self, path: str) -> int:
+        """Remove the job's line from /etc/exports and re-sync —
+        without this, root pools accumulate rw,no_root_squash exports
+        of deleted paths across jobs."""
+        import shutil as shutil_mod
+        if shutil_mod.which("exportfs") is None or os.geteuid() != 0:
+            return 0
+        try:
+            with open("/etc/exports", encoding="utf-8") as fh:
+                lines = fh.readlines()
+            keep = [ln for ln in lines
+                    if not ln.startswith(path + " ")]
+            if keep != lines:
+                with open("/etc/exports", "w", encoding="utf-8") as fh:
+                    fh.writelines(keep)
+                return subprocess.call(["exportfs", "-ra"])
+            return 0
+        except OSError as exc:
+            logger.warning("shared-scratch unexport failed: %s", exc)
+            return 1
 
     def _release_shared_scratch(self, job_id: str) -> None:
-        """End of a shared scratch's lifetime on this node: host node
-        removes the tree (+ the published record); mounters unmount."""
+        """End of a shared scratch's lifetime on this node. Mounters
+        unmount and record completion; the host node records its own
+        completion and DEFERS deletion to a finalize thread that
+        waits for every jobprep-listed node to record release — a
+        fan-out peer may still be harvesting through the mount, and
+        an early rmtree would vanish data mid-copy."""
         path = self._shared_scratch.pop(job_id, None)
+        pk = names.task_pk(self.identity.pool_id, job_id)
+        if self.identity.node_index != 0:
+            if path is not None and path.startswith(
+                    os.path.join(self.work_dir, "scratch-nfs")):
+                self._scratch_umount(path)
+        try:
+            self.store.merge_entity(names.TABLE_JOBPREP, pk,
+                                    self.identity.node_id,
+                                    {"released": True})
+        except NotFoundError:
+            pass
         if self.identity.node_index == 0:
-            import shutil as shutil_mod
-            shutil_mod.rmtree(self._job_scratch_dir(job_id),
-                              ignore_errors=True)
-            try:
-                self.store.delete_entity(
-                    names.TABLE_JOBPREP,
-                    names.task_pk(self.identity.pool_id, job_id),
-                    "#scratchhost")
-            except NotFoundError:
-                pass
-        elif path is not None and path.startswith(
-                os.path.join(self.work_dir, "scratch-nfs")):
-            subprocess.call(["umount", path])
+            thread = threading.Thread(
+                target=self._finalize_shared_scratch, args=(job_id,),
+                name=f"scratch-fin-{job_id}", daemon=True)
+            thread.start()
+            self._threads.append(thread)
+
+    def _finalize_shared_scratch(self, job_id: str) -> None:
+        """Host-side deferred teardown: delete the exported tree only
+        after the whole release fan-out has completed (or preserve it
+        on timeout — a node that never finished harvesting must not
+        lose its data)."""
+        pk = names.task_pk(self.identity.pool_id, job_id)
+        deadline = time.monotonic() + self._scratch_finalize_timeout
+        while True:
+            if self.stop_event.is_set():
+                # Agent stopping mid-wait: a peer may still be
+                # harvesting — preserve, exactly like the timeout path.
+                logger.warning(
+                    "job %s: agent stopping before release fan-out "
+                    "completed; preserving shared scratch", job_id)
+                self._scratch_unexport(self._job_scratch_dir(job_id))
+                return
+            rows = [r for r in self.store.query_entities(
+                        names.TABLE_JOBPREP, partition_key=pk)
+                    if not r["_rk"].startswith("#")]
+            if rows and all(r.get("released") for r in rows):
+                break
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "job %s: release fan-out incomplete after %.0fs "
+                    "(released: %s); preserving shared scratch for "
+                    "manual harvest", job_id,
+                    self._scratch_finalize_timeout,
+                    {r["_rk"]: bool(r.get("released")) for r in rows})
+                self._scratch_unexport(self._job_scratch_dir(job_id))
+                return
+            time.sleep(self.poll_interval)
+        import shutil as shutil_mod
+        self._scratch_unexport(self._job_scratch_dir(job_id))
+        shutil_mod.rmtree(self._job_scratch_dir(job_id),
+                          ignore_errors=True)
+        try:
+            self.store.delete_entity(names.TABLE_JOBPREP, pk,
+                                     "#scratchhost")
+        except NotFoundError:
+            pass
 
     def _terminate_running_task(self, job_id: str,
                                 task_id: str) -> None:

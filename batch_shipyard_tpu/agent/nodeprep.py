@@ -79,6 +79,13 @@ def run_node_prep(agent) -> None:
                 "docker runtime requested but docker not installed on "
                 "%s; docker tasks will fail", node_id)
         perf.emit(store, pool_id, node_id, "nodeprep", "docker_install")
+    if ("kata_containers" in pool.container_runtimes or
+            pool.container_runtime_default == "kata_containers"):
+        if shutil.which("kata-runtime") is None:
+            logger.warning(
+                "kata_containers runtime requested but kata-runtime "
+                "not installed on %s; kata tasks will fail", node_id)
+        perf.emit(store, pool_id, node_id, "nodeprep", "kata_install")
 
     if pool.is_tpu_pool:
         ok = ensure_jax(pool.jax_version, pool.libtpu_version)

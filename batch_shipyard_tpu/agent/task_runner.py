@@ -47,6 +47,11 @@ class TaskExecution:
     node_index: int
     command: str
     runtime: str = "none"  # none | docker | singularity
+    # Docker runtime for the container: runc (default) or
+    # kata_containers -> `docker run --runtime kata-runtime`
+    # (VM-isolated containers; reference shipyard_nodeprep.sh:1105
+    # install + :1133 default-runtime wiring).
+    container_runtime: str = "runc"
     image: Optional[str] = None
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     task_dir: str = "."
@@ -110,6 +115,8 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
                     "/bin/bash", "-c", execution.command]
             return argv
         argv = ["docker", "run"]
+        if execution.container_runtime == "kata_containers":
+            argv += ["--runtime", "kata-runtime"]
         if execution.remove_container_after_exit:
             argv.append("--rm")
         argv += ["--name",

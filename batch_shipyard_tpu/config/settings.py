@@ -258,6 +258,11 @@ class PoolSettings:
     task_slots_per_node: int
     inter_node_communication_enabled: bool
     container_runtimes: tuple[str, ...]
+    # Docker's default runtime for task containers: 'runc' or
+    # 'kata_containers' (VM-isolated containers via kata-runtime —
+    # reference container_runtimes.default, schemas/pool.yaml:383 +
+    # shipyard_nodeprep.sh:1105/1133).
+    container_runtime_default: str
     jax_version: Optional[str]
     libtpu_version: Optional[str]
     additional_node_prep_commands: tuple[str, ...]
@@ -369,6 +374,8 @@ def pool_settings(config: dict) -> PoolSettings:
             spec, "inter_node_communication_enabled", default=False),
         container_runtimes=tuple(
             _get(spec, "container_runtimes", default=["docker"])),
+        container_runtime_default=_get(
+            spec, "container_runtime_default", default="runc"),
         jax_version=_get(spec, "node_prep", "jax_version"),
         libtpu_version=_get(spec, "node_prep", "libtpu_version"),
         additional_node_prep_commands=tuple(

@@ -55,6 +55,8 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "retention_time_seconds": task.retention_time_seconds,
         "remove_container_after_exit": task.remove_container_after_exit,
         "shm_size": task.shm_size,
+        "container_runtime": (pool.container_runtime_default
+                              if pool is not None else "runc"),
         "additional_docker_run_options": list(
             task.additional_docker_run_options),
         "additional_singularity_options": list(

@@ -43,6 +43,10 @@ class Samples:
     active_tasks: int       # running + assigned
     pending_tasks: int      # pending (incl. waiting deps)
     current_nodes: int
+    # Nodes the provider reclaimed (spot/low-priority preemption) —
+    # the $PreemptedNodeCount sample of the reference's formulas
+    # (autoscale.py:92-104).
+    preempted_nodes: int
     task_slots_per_node: int
     now: datetime.datetime
 
@@ -63,11 +67,13 @@ def sample(store: StateStore, pool: PoolSettings,
                 active += 1
             elif state == "pending":
                 pending += 1
-    nodes = [n for n in pool_mgr.list_nodes(store, pool.id)
-             if n.state in pool_mgr.READY_STATES]
+    all_nodes = pool_mgr.list_nodes(store, pool.id)
+    nodes = [n for n in all_nodes if n.state in pool_mgr.READY_STATES]
+    preempted = [n for n in all_nodes if n.state == "preempted"]
     return Samples(
         active_tasks=active, pending_tasks=pending,
         current_nodes=len(nodes),
+        preempted_nodes=len(preempted),
         task_slots_per_node=pool.task_slots_per_node,
         now=now or util.utcnow())
 
@@ -143,9 +149,21 @@ def evaluate(store: StateStore, pool: PoolSettings,
             else:
                 dedicated = scenario.minimum_vm_count_dedicated
                 low_priority = scenario.minimum_vm_count_low_priority
+            if _rebalance_triggered(scenario, samples):
+                # Preemption pressure: the provider is reclaiming
+                # low-priority capacity faster than the threshold —
+                # shift the low-priority share of the target into
+                # dedicated (reference rebalance formula,
+                # autoscale.py:92-135).
+                dedicated = min(dedicated + low_priority,
+                                scenario.maximum_vm_count_dedicated)
+                low_priority = 0
             target = _clamp(dedicated, scenario,
                             samples.current_nodes) + low_priority
-            reason = f"{name}: in_range={in_range} at {samples.now}"
+            reason = (f"{name}: in_range={in_range} at {samples.now}"
+                      + (" [rebalanced to dedicated on preemption]"
+                         if _rebalance_triggered(scenario, samples)
+                         else ""))
         else:
             raise ValueError(f"unknown autoscale scenario {name!r}")
     target_slices = None
@@ -155,11 +173,30 @@ def evaluate(store: StateStore, pool: PoolSettings,
             0 if target == 0 else 1,
             math.ceil(target / per_slice))
         target = target_slices * per_slice
+    scenario = autoscale.scenario
     return {"target_nodes": target, "target_slices": target_slices,
             "current_nodes": samples.current_nodes,
             "active_tasks": samples.active_tasks,
             "pending_tasks": samples.pending_tasks,
+            "preempted_nodes": samples.preempted_nodes,
+            "rebalance": bool(scenario and _rebalance_triggered(
+                scenario, samples)),
             "reason": reason}
+
+
+def _rebalance_triggered(scenario: AutoscaleScenarioSettings,
+                         samples: Samples) -> bool:
+    """Preemption-pressure signal: percentage of current capacity the
+    provider has reclaimed >= rebalance_preemption_percentage
+    (reference autoscale.py:121-131 'preemptedpercent >= threshold';
+    the knob is 0-100)."""
+    rpp = scenario.rebalance_preemption_percentage
+    if rpp is None:
+        return False
+    total = samples.current_nodes + samples.preempted_nodes
+    if total == 0:
+        return False
+    return 100.0 * samples.preempted_nodes / total >= float(rpp)
 
 
 _FORMULA_BUILTINS = {"min": min, "max": max, "ceil": math.ceil,
@@ -237,6 +274,17 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
     """One evaluation + application cycle (the hosted evaluator loop the
     reference delegates to Azure Batch, batch.py:1636-1755)."""
     entity = pool_mgr.get_pool(store, pool.id)
+    # Substrates that can detect provider reclamation refresh node
+    # states first, so the preemption sample feeding
+    # rebalance_preemption_percentage is live (tpu_vm polls slice
+    # states; fake/localhost have nothing to poll).
+    refresh = getattr(substrate, "refresh_node_states", None)
+    if refresh is not None:
+        try:
+            refresh(pool)
+        except Exception:
+            logger.exception("node-state refresh failed for %s",
+                             pool.id)
     decision = evaluate(store, pool, now)
     if not entity.get("autoscale_enabled"):
         decision["applied"] = False

@@ -44,6 +44,9 @@ class FakePodSubstrate(base.ComputeSubstrate):
         self.node_stale_seconds = node_stale_seconds
         # node_id -> failure mode
         self.inject: dict[str, str] = {}
+        # Extra NodeAgent kwargs (scratch mount/export runners,
+        # force_remote_scratch, ...) for fault-injection tests.
+        self.agent_kwargs: dict = {}
         self._agents: dict[str, dict[str, NodeAgent]] = {}
         self._boot_threads: dict[str, threading.Thread] = {}
         self._boot_counts: dict[str, int] = {}
@@ -91,7 +94,8 @@ class FakePodSubstrate(base.ComputeSubstrate):
             poll_interval=0.05, gang_timeout=60.0,
             job_state_ttl=0.2,
             node_stale_seconds=self.node_stale_seconds,
-            nodeprep=self._nodeprep, substrate=self)
+            nodeprep=self._nodeprep, substrate=self,
+            **self.agent_kwargs)
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {
                 "state": "creating", "hostname": identity.hostname,

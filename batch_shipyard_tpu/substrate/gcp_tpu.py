@@ -202,6 +202,35 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
                            slice_index)
         self._create_slice(pool, slice_index)
 
+    def refresh_node_states(self, pool: PoolSettings) -> None:
+        """Poll slice states and mark nodes of reclaimed slices
+        'preempted' (gcloud_errors.is_preemption_state) — the
+        $PreemptedNodeCount sample feeding autoscale
+        rebalance_preemption_percentage and slice-recreate recovery.
+        Called by the autoscale tick; cost is one describe per
+        slice."""
+        for s in range(pool.tpu.num_slices if pool.tpu else 0):
+            name = self.slice_name(pool.id, s)
+            try:
+                desc = self._gcloud("describe", name, parse_json=True,
+                                    zone=pool.zone)
+                state = desc.get("state")
+            except RuntimeError:
+                # Slice no longer describable: treat as reclaimed.
+                state = "TERMINATED"
+            if not gcloud_errors.is_preemption_state(state):
+                continue
+            for row in list(self.store.query_entities(
+                    names.TABLE_NODES, partition_key=pool.id)):
+                if int(row.get("slice_index", -1)) == s and \
+                        row.get("state") != "preempted":
+                    logger.warning(
+                        "slice %s is %s; marking node %s preempted",
+                        name, state, row["_rk"])
+                    self.store.merge_entity(
+                        names.TABLE_NODES, pool.id, row["_rk"],
+                        {"state": "preempted"})
+
     def suspend_pool(self, pool: PoolSettings) -> None:
         """gcloud tpu-vm stop on every slice (billing pause)."""
         for s in range(pool.tpu.num_slices):

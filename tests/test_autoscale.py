@@ -153,3 +153,41 @@ def test_formula_rejects_attribute_escape():
     pool2 = make_pool(formula="[x for x in (1,)][0]")
     with pytest.raises(ValueError):
         autoscale.evaluate(store, pool2)
+
+
+def test_rebalance_preemption_shifts_low_pri_to_dedicated():
+    """rebalance_preemption_percentage (reference autoscale.py:92-135):
+    when the provider reclaims >= the threshold share of capacity,
+    the low-priority target shifts into dedicated."""
+    store = MemoryStateStore()
+    pool = make_pool(scenario={
+        "name": "workday_with_offpeak_max_low_priority",
+        "minimum_vm_count": {"dedicated": 2, "low_priority": 0},
+        "maximum_vm_count": {"dedicated": 12, "low_priority": 8},
+        "rebalance_preemption_percentage": 25})
+    # Off-peak (Sunday): target = min dedicated + max low-pri.
+    sunday = datetime.datetime(2026, 7, 26, 12, 0)
+    seed_nodes(store, "ap", 4)
+    calm = autoscale.evaluate(store, pool, now=sunday)
+    assert not calm["rebalance"]
+    assert calm["target_nodes"] == (2 + 8 + 3) // 4 * 4 or \
+        calm["target_nodes"] >= 8  # slice-quantized 2+8
+    # Preemption signal: 2 of 6 nodes reclaimed (33% >= 25%).
+    for idx in (10, 11):
+        store.upsert_entity(names.TABLE_NODES, "ap", f"px{idx}", {
+            "state": "preempted", "node_index": idx,
+            "slice_index": 2, "worker_index": idx % 4,
+            "heartbeat_at": 1e18, "hostname": f"px{idx}",
+            "internal_ip": "10.0.0.9"})
+    hot = autoscale.evaluate(store, pool, now=sunday)
+    assert hot["rebalance"]
+    assert hot["preempted_nodes"] == 2
+    # Low-pri share (8) folded into dedicated, capped at 12: target
+    # 2+8=10 dedicated + 0 low-pri (same total here, but all
+    # dedicated -> reflected in the reason).
+    assert "rebalanced to dedicated" in hot["reason"]
+    # Below threshold: 1 preempted of 9 (11% < 25%) -> no rebalance.
+    store.delete_entity(names.TABLE_NODES, "ap", "px10")
+    seed_nodes(store, "ap", 8)
+    cool = autoscale.evaluate(store, pool, now=sunday)
+    assert not cool["rebalance"]

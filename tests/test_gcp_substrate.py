@@ -31,13 +31,15 @@ class FakeGcloud:
         self.calls = []
         self.fail_create_with = None
 
-    def __call__(self, substrate, *args, parse_json=False):
+    def __call__(self, substrate, *args, parse_json=False, zone=None):
         self.calls.append(args)
+        self.last_zone = zone
         verb = args[0]
         if verb == "create" and self.fail_create_with:
             raise RuntimeError(self.fail_create_with)
         if verb == "describe" and parse_json:
-            return {"networkEndpoints": [
+            return {"state": getattr(self, "describe_state", "READY"),
+                    "networkEndpoints": [
                 {"ipAddress": f"10.1.0.{i+1}",
                  "accessConfig": {"externalIp": f"34.0.0.{i+1}"}}
                 for i in range(4)]}
@@ -140,3 +142,22 @@ def test_remote_login_prefers_external_ip(substrate):
     sub.allocate_pool(pool)
     ip, port = sub.get_remote_login("gp", "gp-s0-w0")
     assert ip == "34.0.0.1" and port == 22
+
+
+def test_refresh_node_states_marks_preempted(substrate):
+    """Spot reclamation: describe reports PREEMPTED -> every node of
+    the slice flips to 'preempted', feeding autoscale
+    rebalance_preemption_percentage (gcloud_errors.is_preemption_state)."""
+    store, sub, fake = substrate
+    pool = make_pool()
+    store.insert_entity(names.TABLE_POOLS, "pools", "gp",
+                        {"state": "creating", "spec": {}})
+    sub.allocate_pool(pool)
+    sub.refresh_node_states(pool)  # READY: nothing changes
+    assert all(n.state != "preempted"
+               for n in pool_mgr.list_nodes(store, "gp"))
+    fake.describe_state = "PREEMPTED"
+    sub.refresh_node_states(pool)
+    states = {n.node_id: n.state
+              for n in pool_mgr.list_nodes(store, "gp")}
+    assert set(states.values()) == {"preempted"}, states

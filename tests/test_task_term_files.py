@@ -134,3 +134,36 @@ def test_output_cap_keeps_head_tail_with_marker(tmp_path):
     assert data.startswith(b"H" * 512)
     assert data.endswith(b"T" * 512)
     assert b"output truncated, 10000 bytes total, cap 1024" in data
+
+
+def test_kata_runtime_in_docker_argv():
+    """container_runtime_default: kata_containers plumbs end-to-end
+    into `docker run --runtime kata-runtime` (reference
+    shipyard_nodeprep.sh:1105 kata install + :1133 default-runtime)."""
+    from batch_shipyard_tpu.agent import task_runner
+    from batch_shipyard_tpu.config import settings as sm
+    from batch_shipyard_tpu.jobs.manager import _task_spec
+    execution = task_runner.TaskExecution(
+        pool_id="p", job_id="j", task_id="t", node_id="n",
+        node_index=0, command="echo x", runtime="docker",
+        image="busybox", container_runtime="kata_containers",
+        env={}, task_dir="/tmp/kata-test")
+    argv = task_runner.synthesize_command(execution)
+    k = argv.index("--runtime")
+    assert argv[k + 1] == "kata-runtime"
+    # Default runc: no --runtime flag injected.
+    plain = task_runner.TaskExecution(
+        pool_id="p", job_id="j", task_id="t", node_id="n",
+        node_index=0, command="echo x", runtime="docker",
+        image="busybox", env={}, task_dir="/tmp/kata-test")
+    assert "--runtime" not in task_runner.synthesize_command(plain)
+    # Pool-level default reaches the task spec.
+    pool = sm.pool_settings({"pool_specification": {
+        "id": "kp", "substrate": "fake",
+        "container_runtime_default": "kata_containers",
+        "tpu": {"accelerator_type": "v5litepod-4"}}})
+    jobs = sm.job_settings_list({"job_specifications": [{
+        "id": "kj", "tasks": [{"command": "echo"}]}]})
+    task = sm.task_settings({"command": "echo"}, jobs[0], pool)
+    spec = _task_spec(task, jobs[0], pool)
+    assert spec["container_runtime"] == "kata_containers"
