@@ -1190,7 +1190,6 @@ class NodeAgent:
                 logger.warning(
                     "job %s: agent stopping before release fan-out "
                     "completed; preserving shared scratch", job_id)
-                self._scratch_unexport(self._job_scratch_dir(job_id))
                 return
             rows = [r for r in self.store.query_entities(
                         names.TABLE_JOBPREP, partition_key=pk)
@@ -1198,13 +1197,15 @@ class NodeAgent:
             if rows and all(r.get("released") for r in rows):
                 break
             if time.monotonic() > deadline:
+                # Preserve AND keep the export up: a merely-slow
+                # peer may still be copying through its NFS mount;
+                # revoking the export would kill its in-flight reads.
                 logger.warning(
                     "job %s: release fan-out incomplete after %.0fs "
-                    "(released: %s); preserving shared scratch for "
-                    "manual harvest", job_id,
+                    "(released: %s); preserving shared scratch (and "
+                    "its export) for manual harvest", job_id,
                     self._scratch_finalize_timeout,
                     {r["_rk"]: bool(r.get("released")) for r in rows})
-                self._scratch_unexport(self._job_scratch_dir(job_id))
                 return
             time.sleep(self.poll_interval)
         import shutil as shutil_mod

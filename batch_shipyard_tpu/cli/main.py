@@ -1221,6 +1221,28 @@ def misc():
     """Miscellaneous utilities."""
 
 
+@misc.command("tunnel")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--remote-port", type=int, required=True,
+              help="Port the task's service listens on (e.g. the "
+                   "serving front end)")
+@click.option("--local-port", type=int, default=None)
+@click.option("--ssh-private-key", default=None)
+@click.option("--output-dir", default=".")
+@click.pass_context
+def misc_tunnel(click_ctx, job_id, task_id, remote_port, local_port,
+                ssh_private_key, output_dir):
+    """Write an ssh port-forward script to a task's service port."""
+    from batch_shipyard_tpu.utils import misc as misc_mod
+    ctx = _ctx(click_ctx)
+    plan = misc_mod.plan_port_tunnel(
+        ctx.store, ctx.substrate(), ctx.pool.id, job_id, task_id,
+        remote_port, local_port=local_port,
+        ssh_private_key=ssh_private_key, output_dir=output_dir)
+    fleet._emit(plan, click_ctx.obj["raw"])
+
+
 @misc.command("tensorboard")
 @click.argument("job_id")
 @click.argument("task_id")

@@ -603,7 +603,8 @@ class FederationProcessor:
                 return True
             return self._merge_into_placed_job(
                 federation_id, job, placed, action_id,
-                target.get("node_id"))
+                self._effective_node_pin(federation_id, job,
+                                         target.get("node_id")))
         required_node = None
         if target.get("pool_id"):
             # Required-target select (:2030 analog): pin to THIS pool
@@ -612,7 +613,8 @@ class FederationProcessor:
                 federation_id, fed, job, facts, target)
             if choice is None:
                 return False
-            required_node = target.get("node_id")
+            required_node = self._effective_node_pin(
+                federation_id, job, target.get("node_id"))
         else:
             eligible = filter_pools_hard_constraints(facts, constraints)
             eligible = filter_pool_nodes(
@@ -644,6 +646,22 @@ class FederationProcessor:
         logger.info("federation %s: job %s -> pool %s",
                     federation_id, job.id, pool.id)
         return True
+
+    def _effective_node_pin(self, federation_id: str, job,
+                            node_id: Optional[str]) -> Optional[str]:
+        """A gang task pinned to ONE node could never rendezvous its
+        other instances — honor the pool pin only (applies to first
+        placement AND repeat-action merges)."""
+        if node_id and any(
+                (raw.get("multi_instance") or {}).get(
+                    "num_instances") not in (None, 1)
+                for raw in job.tasks):
+            logger.warning(
+                "federation %s: job %s has multi-instance tasks; "
+                "ignoring required_target.node_id=%s (pool pin kept)",
+                federation_id, job.id, node_id)
+            return None
+        return node_id
 
     def _select_required_target(self, federation_id: str, fed: dict,
                                 job, facts: list[dict],

@@ -111,6 +111,7 @@ def evaluate(store: StateStore, pool: PoolSettings,
     {target_nodes, target_slices, reason} without applying it."""
     autoscale = pool.autoscale
     samples = sample(store, pool, now)
+    rebalance_applied = False
     if autoscale.formula:
         target = _eval_formula(autoscale.formula, samples)
         reason = "user formula"
@@ -158,12 +159,12 @@ def evaluate(store: StateStore, pool: PoolSettings,
                 dedicated = min(dedicated + low_priority,
                                 scenario.maximum_vm_count_dedicated)
                 low_priority = 0
+                rebalance_applied = True
             target = _clamp(dedicated, scenario,
                             samples.current_nodes) + low_priority
             reason = (f"{name}: in_range={in_range} at {samples.now}"
                       + (" [rebalanced to dedicated on preemption]"
-                         if _rebalance_triggered(scenario, samples)
-                         else ""))
+                         if rebalance_applied else ""))
         else:
             raise ValueError(f"unknown autoscale scenario {name!r}")
     target_slices = None
@@ -173,14 +174,15 @@ def evaluate(store: StateStore, pool: PoolSettings,
             0 if target == 0 else 1,
             math.ceil(target / per_slice))
         target = target_slices * per_slice
-    scenario = autoscale.scenario
     return {"target_nodes": target, "target_slices": target_slices,
             "current_nodes": samples.current_nodes,
             "active_tasks": samples.active_tasks,
             "pending_tasks": samples.pending_tasks,
             "preempted_nodes": samples.preempted_nodes,
-            "rebalance": bool(scenario and _rebalance_triggered(
-                scenario, samples)),
+            # True only when the dedicated/low-priority shift was
+            # actually applied (the workday-family branch) — backlog
+            # scenarios and user formulas have no class mix to shift.
+            "rebalance": rebalance_applied,
             "reason": reason}
 
 
@@ -274,6 +276,10 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
     """One evaluation + application cycle (the hosted evaluator loop the
     reference delegates to Azure Batch, batch.py:1636-1755)."""
     entity = pool_mgr.get_pool(store, pool.id)
+    if not entity.get("autoscale_enabled"):
+        decision = evaluate(store, pool, now)
+        decision["applied"] = False
+        return decision
     # Substrates that can detect provider reclamation refresh node
     # states first, so the preemption sample feeding
     # rebalance_preemption_percentage is live (tpu_vm polls slice
@@ -286,9 +292,6 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
             logger.exception("node-state refresh failed for %s",
                              pool.id)
     decision = evaluate(store, pool, now)
-    if not entity.get("autoscale_enabled"):
-        decision["applied"] = False
-        return decision
     if decision["target_slices"] is not None:
         current_slices = len({
             n.slice_index for n in pool_mgr.list_nodes(store, pool.id)})

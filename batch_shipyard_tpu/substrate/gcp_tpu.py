@@ -209,21 +209,34 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
         rebalance_preemption_percentage and slice-recreate recovery.
         Called by the autoscale tick; cost is one describe per
         slice."""
+        rows_by_slice: dict[int, list[dict]] = {}
+        for row in self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool.id):
+            rows_by_slice.setdefault(
+                int(row.get("slice_index", -1)), []).append(row)
         for s in range(pool.tpu.num_slices if pool.tpu else 0):
             name = self.slice_name(pool.id, s)
             try:
                 desc = self._gcloud("describe", name, parse_json=True,
                                     zone=pool.zone)
                 state = desc.get("state")
-            except RuntimeError:
-                # Slice no longer describable: treat as reclaimed.
-                state = "TERMINATED"
+            except RuntimeError as exc:
+                if "not found" in str(exc).lower():
+                    # Slice resource is gone: reclaimed.
+                    state = "TERMINATED"
+                else:
+                    # Transient describe failure (network/API/auth) is
+                    # NOT evidence of preemption — marking healthy
+                    # nodes preempted would empty the pool's
+                    # schedulable set on a blip.
+                    logger.warning(
+                        "describe of %s failed (%s); skipping "
+                        "preemption check this tick", name, exc)
+                    continue
             if not gcloud_errors.is_preemption_state(state):
                 continue
-            for row in list(self.store.query_entities(
-                    names.TABLE_NODES, partition_key=pool.id)):
-                if int(row.get("slice_index", -1)) == s and \
-                        row.get("state") != "preempted":
+            for row in rows_by_slice.get(s, []):
+                if row.get("state") != "preempted":
                     logger.warning(
                         "slice %s is %s; marking node %s preempted",
                         name, state, row["_rk"])

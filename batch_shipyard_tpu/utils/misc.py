@@ -20,6 +20,21 @@ logger = util.get_logger(__name__)
 TENSORBOARD_PORT = 6006
 
 
+def _resolve_task_login(store: StateStore, substrate, pool_id: str,
+                        job_id: str, task_id: str
+                        ) -> tuple[str, str, int]:
+    """(node_id, ip, ssh_port) of the node a task is assigned to."""
+    task = jobs_mgr.get_task(store, pool_id, job_id, task_id)
+    node_id = task.get("node_id")
+    if not node_id:
+        raise ValueError(f"task {task_id} has no assigned node yet")
+    login = substrate.get_remote_login(pool_id, node_id)
+    if login is None:
+        raise ValueError(f"no remote login for node {node_id}")
+    ip, port = login
+    return node_id, ip, port
+
+
 def plan_tensorboard_tunnel(
         store: StateStore, substrate, pool_id: str, job_id: str,
         task_id: str, logdir: Optional[str] = None,
@@ -30,14 +45,8 @@ def plan_tensorboard_tunnel(
     """Resolve the task's node, synthesize the remote TensorBoard
     launch command and the local tunnel script (tunnel_tensorboard
     analog). Returns the plan; execution is the caller's choice."""
-    task = jobs_mgr.get_task(store, pool_id, job_id, task_id)
-    node_id = task.get("node_id")
-    if not node_id:
-        raise ValueError(f"task {task_id} has no assigned node yet")
-    login = substrate.get_remote_login(pool_id, node_id)
-    if login is None:
-        raise ValueError(f"no remote login for node {node_id}")
-    ip, port = login
+    node_id, ip, port = _resolve_task_login(store, substrate,
+                                            pool_id, job_id, task_id)
     node = store.get_entity(names.TABLE_NODES, pool_id, node_id)
     if logdir is None:
         # Default: the task's working directory on the node.
@@ -92,6 +101,34 @@ def tunnel_tensorboard(store: StateStore, substrate, pool_id: str,
     if wait:
         proc.wait()
     return plan
+
+
+def plan_port_tunnel(store: StateStore, substrate, pool_id: str,
+                     job_id: str, task_id: str, remote_port: int,
+                     local_port: Optional[int] = None,
+                     ssh_username: str = "shipyard",
+                     ssh_private_key: Optional[str] = None,
+                     output_dir: str = ".") -> dict:
+    """Generic task-port tunnel (the TensorBoard-tunnel pattern for
+    any service a task exposes — e.g. the serving front end's HTTP
+    port from workloads/serve.py): resolve the task's node and write
+    the local ssh port-forward script. Unlike the TensorBoard
+    variant, nothing is launched remotely — the task is already
+    listening."""
+    node_id, ip, port = _resolve_task_login(store, substrate,
+                                            pool_id, job_id, task_id)
+    local_port = local_port or remote_port
+    script_path = crypto.ssh_tunnel_script(
+        ip, port, local_port, remote_port, ssh_username,
+        ssh_private_key,
+        os.path.join(output_dir,
+                     f"tunnel-{task_id}-{remote_port}.sh"))
+    return {
+        "node_id": node_id, "node_ip": ip, "ssh_port": port,
+        "remote_port": remote_port, "local_port": local_port,
+        "tunnel_script": script_path,
+        "local_url": f"http://localhost:{local_port}",
+    }
 
 
 def mirror_images_plan(images: list[str],

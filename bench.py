@@ -131,6 +131,50 @@ def bench_transformer(batch_size: int = 16, seq_len: int = 2048,
     }
 
 
+def bench_serving(num_requests: int = 48, rate_hz: float = 16.0,
+                  num_slots: int = 8, max_decode_len: int = 512,
+                  d_model: int = 1024, n_layers: int = 12,
+                  n_heads: int = 16, d_ff: int = 2816) -> dict:
+    """Serving TTFT/TPOT under Poisson load through the HTTP front
+    end (models/server.py + models/loadgen.py) — the latency surface
+    an Orca/vLLM-class engine is judged by. Runs the d_model=1024
+    12-layer model single-host on whatever accelerator is present."""
+    import jax
+    import jax.numpy as jnp
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import serving
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.models.loadgen import run_load
+    from batch_shipyard_tpu.models.server import ServingFrontEnd
+    config = tfm.TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, d_head=d_model // n_heads, d_ff=d_ff,
+        max_seq_len=max_decode_len, dtype=jnp.bfloat16)
+    model = tfm.TransformerLM(config)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = serving.ContinuousBatcher(
+        config, params, num_slots=num_slots,
+        max_decode_len=max_decode_len,
+        sampling=inf.SamplingConfig())
+    front = ServingFrontEnd(engine, port=0).start()
+    try:
+        # Warmup outside the measurement so compiles don't pollute
+        # TTFT.
+        front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
+        # Load profile scales with the decode budget: prompt+generation
+        # stays within max_decode_len so no request is rejected.
+        quarter = max(8, max_decode_len // 4)
+        report = run_load(
+            front.url, num_requests, rate_hz=rate_hz,
+            prompt_len=(quarter // 2, quarter),
+            max_new_tokens=(quarter // 2, quarter),
+            vocab_size=32000, seed=0)
+    finally:
+        front.shutdown()
+    return report
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -327,6 +371,10 @@ def main() -> int:
         details["transformer_int8"] = bench_transformer(quantize=True)
     except Exception as exc:  # noqa: BLE001 - experimental path
         details["transformer_int8"] = {"error": str(exc)}
+    try:
+        details["serving"] = bench_serving()
+    except Exception as exc:  # noqa: BLE001 - secondary metric
+        details["serving"] = {"error": str(exc)}
     try:
         details["orchestration"] = bench_orchestration_latency()
     except Exception as exc:  # noqa: BLE001 - secondary metric

@@ -26,6 +26,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Belt-and-braces: deregister the accelerator plugin's backend factory.
+# A wedged TPU relay can make the plugin's client creation BLOCK (not
+# fail) inside xla_bridge.backends() — observed live: runs without the
+# jax_platforms config update hung in make_pjrt_c_api_client. With the
+# factory gone, nothing in the suite can ever dial the relay.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+
 import pytest  # noqa: E402
 
 

@@ -372,3 +372,27 @@ def test_cli_secrets_put_get(tmp_path):
               "fetch-credentials", "secret://file/allcreds"])
     assert fetched.exit_code == 0, fetched.output
     assert "localfs" in fetched.output
+
+
+def test_generic_port_tunnel_plan(tmp_path):
+    """misc tunnel: ssh port-forward plan to any task service port
+    (e.g. the serving front end from workloads/serve.py)."""
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    try:
+        pool = make_pool(store, substrate, "svp", "v5litepod-4")
+        from batch_shipyard_tpu.jobs import manager as jobs_mgr
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "svjob", "tasks": [{"command": "echo serving"}]}]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        jobs_mgr.wait_for_tasks(store, "svp", "svjob", timeout=30)
+        plan = misc.plan_port_tunnel(
+            store, substrate, "svp", "svjob", "task-00000",
+            remote_port=8900, output_dir=str(tmp_path))
+        assert plan["local_url"] == "http://localhost:8900"
+        assert plan["remote_port"] == 8900
+        assert os.path.exists(plan["tunnel_script"])
+        script = open(plan["tunnel_script"]).read()
+        assert "8900" in script
+    finally:
+        substrate.stop_all()

@@ -645,3 +645,34 @@ def test_merge_tasks_into_job_collision_fixup():
                                           "mpool")
     finally:
         substrate.stop_all()
+
+
+def test_migrate_preserves_priority_band():
+    """A migrated high-priority job's pending tasks land on the
+    DESTINATION pool's hi band (not the normal band, where they would
+    queue behind sweeps)."""
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    confs = {}
+    for pid in ("mig-src", "mig-dst"):
+        conf = {"pool_specification": {
+            "id": pid, "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}}
+        confs[pid] = settings_mod.pool_settings(conf)
+        pool_mgr.create_pool(store, substrate, confs[pid], GLOBAL,
+                             conf)
+    # Quiesce agents so tasks stay pending for the migration.
+    substrate.stop_all()
+    jobs = settings_mod.job_settings_list({"job_specifications": [{
+        "id": "mjob", "priority": 50,
+        "tasks": [{"command": "echo hi-pri"}]}]})
+    jobs_mgr.add_jobs(store, confs["mig-src"], jobs)
+    assert store.queue_length(
+        names.task_queue("mig-src", 0, "hi")) == 1
+    jobs_mgr.disable_job(store, "mig-src", "mjob")
+    moved = jobs_mgr.migrate_job(store, "mig-src", "mjob", "mig-dst")
+    assert moved == 1
+    assert store.queue_length(
+        names.task_queue("mig-dst", 0, "hi")) == 1
+    assert store.queue_length(names.task_queue("mig-dst", 0)) == 0
