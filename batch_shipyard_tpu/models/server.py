@@ -18,9 +18,14 @@ Architecture:
 
 Endpoints:
   POST /v1/generate   {"prompt": [ids], "max_new_tokens": n,
-                       "request_id"?: str, "eos_id"?: int}
+                       "request_id"?: str, "eos_id"?: int,
+                       "stream"?: bool}
       -> {"request_id", "tokens", "num_tokens", "ttft_ms",
           "tpot_ms", "latency_ms"}
+      With "stream": true the response is newline-delimited JSON
+      (chunked transfer): one {"token": t, "index": i} line per
+      generated token as it decodes, then a final line with the full
+      result object — the client observes TTFT directly.
   GET  /v1/stats      aggregate counters + latency percentiles
   GET  /healthz       liveness
 """
@@ -44,9 +49,10 @@ logger = util.get_logger(__name__)
 
 class _Pending:
     __slots__ = ("request", "event", "submitted_at", "first_token_at",
-                 "finished_at", "tokens", "error")
+                 "finished_at", "tokens", "error", "token_queue")
 
-    def __init__(self, request: Request) -> None:
+    def __init__(self, request: Request,
+                 stream: bool = False) -> None:
         self.request = request
         self.event = threading.Event()
         self.submitted_at = time.perf_counter()
@@ -54,6 +60,10 @@ class _Pending:
         self.finished_at: Optional[float] = None
         self.tokens: Optional[list[int]] = None
         self.error: Optional[str] = None
+        # Streaming mode: the engine thread feeds (index, token)
+        # pairs here as they decode; None terminates the stream.
+        self.token_queue: Optional["queue.Queue"] = (
+            queue.Queue() if stream else None)
 
 
 def percentile(values: list[float], pct: float) -> float:
@@ -78,6 +88,14 @@ class ServingFrontEnd:
         self._submit_q: "queue.Queue[_Pending]" = queue.Queue()
         self._inflight: dict[str, _Pending] = {}
         self._inflight_lock = threading.Lock()
+        # Engine-side run ownership: request_id -> the _Pending whose
+        # submission the engine is actually decoding. Written ONLY by
+        # the engine thread; _engine_active mirrors its keys under
+        # _inflight_lock so _make_pending can reject an id that is
+        # still decoding (a client that timed out/disconnected and
+        # retried must not receive the stale run's completion).
+        self._active_runs: dict[str, _Pending] = {}
+        self._engine_active: set[str] = set()
         self._stop = threading.Event()
         self._stats_lock = threading.Lock()
         self._completed: list[dict] = []
@@ -87,6 +105,13 @@ class ServingFrontEnd:
         front = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 is REQUIRED for the chunked streaming path:
+            # chunked framing is invalid on 1.0 and strict clients
+            # would deliver raw chunk-size lines as body bytes. All
+            # non-streaming replies carry Content-Length, so
+            # keep-alive is safe.
+            protocol_version = "HTTP/1.1"
+
             # Silence per-request stderr logging.
             def log_message(self, fmt, *args):  # noqa: N802
                 pass
@@ -114,6 +139,15 @@ class ServingFrontEnd:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     spec = json.loads(self.rfile.read(length))
+                except (ValueError, OSError) as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                if spec.get("stream"):
+                    # Owns its response lifecycle end-to-end; nothing
+                    # here may write a second reply after its headers.
+                    self._stream_generate(spec)
+                    return
+                try:
                     result = front.generate(spec)
                 except ValueError as exc:
                     self._reply(400, {"error": str(exc)})
@@ -123,6 +157,46 @@ class ServingFrontEnd:
                     self._reply(500, {"error": str(exc)})
                     return
                 self._reply(200, result)
+
+            def _stream_generate(self, spec: dict) -> None:
+                """Newline-delimited JSON token stream over chunked
+                transfer: the client sees each token the engine step
+                that produced it, then the final result object.
+                Validation errors before headers -> plain 400; errors
+                AFTER the 200/chunked headers are emitted as a final
+                {"error": ...} NDJSON line + clean terminating chunk
+                (a second HTTP response inside the open stream would
+                corrupt the framing)."""
+                try:
+                    stream = front.generate_stream(spec)
+                except ValueError as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def _chunk(obj: dict) -> None:
+                    line = json.dumps(obj).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(line):x}\r\n".encode() + line +
+                        b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    try:
+                        for event in stream:
+                            _chunk(event)
+                    except (ValueError, TimeoutError) as exc:
+                        _chunk({"error": str(exc)})
+                    except Exception as exc:  # defensive
+                        logger.exception("stream failed")
+                        _chunk({"error": str(exc)})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away; engine finishes anyway
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -153,9 +227,8 @@ class ServingFrontEnd:
 
     # ------------------------------ serving ----------------------------
 
-    def generate(self, spec: dict, timeout: float = 300.0) -> dict:
-        """Blocking generate: enqueue to the engine thread, wait for
-        completion, return tokens + latency breakdown."""
+    def _make_pending(self, spec: dict,
+                      stream: bool = False) -> _Pending:
         prompt = spec.get("prompt")
         if not isinstance(prompt, list) or not all(
                 isinstance(t, int) for t in prompt):
@@ -165,21 +238,16 @@ class ServingFrontEnd:
             request_id=request_id, prompt=prompt,
             max_new_tokens=int(spec.get("max_new_tokens", 16)),
             eos_id=spec.get("eos_id"))
-        pending = _Pending(request)
+        pending = _Pending(request, stream=stream)
         with self._inflight_lock:
-            if request_id in self._inflight:
+            if (request_id in self._inflight or
+                    request_id in self._engine_active):
                 raise ValueError(f"request_id {request_id} in flight")
             self._inflight[request_id] = pending
-        self._submit_q.put(pending)
-        try:
-            if not pending.event.wait(timeout):
-                raise TimeoutError(
-                    f"request {request_id} timed out after {timeout}s")
-        finally:
-            with self._inflight_lock:
-                self._inflight.pop(request_id, None)
-        if pending.error is not None:
-            raise ValueError(pending.error)
+        return pending
+
+    def _result(self, pending: _Pending) -> dict:
+        request_id = pending.request.request_id
         n = len(pending.tokens)
         ttft = (pending.first_token_at or pending.finished_at) - \
             pending.submitted_at
@@ -204,6 +272,58 @@ class ServingFrontEnd:
             })
         return result
 
+    def generate_stream(self, spec: dict, timeout: float = 300.0):
+        """Streaming generate: yields {"token", "index"} per decoded
+        token, then the final result object (generate()'s payload).
+        Validation happens HERE (before any bytes hit the wire) — the
+        returned iterator only pulls tokens."""
+        pending = self._make_pending(spec, stream=True)
+        self._submit_q.put(pending)
+        return self._stream_tokens(pending, timeout)
+
+    def _stream_tokens(self, pending: _Pending, timeout: float):
+        request_id = pending.request.request_id
+        try:
+            while True:
+                try:
+                    item = pending.token_queue.get(timeout=timeout)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"request {request_id} timed out after "
+                        f"{timeout}s")
+                if item is None:
+                    break
+                index, token = item
+                yield {"token": token, "index": index}
+            self._wait_complete(pending, timeout)
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(request_id, None)
+        yield self._result(pending)
+
+    def _wait_complete(self, pending: _Pending,
+                       timeout: float) -> None:
+        """Shared completion protocol: wait for the engine to finish
+        the run, surface engine-side errors."""
+        if not pending.event.wait(timeout):
+            raise TimeoutError(
+                f"request {pending.request.request_id} timed out "
+                f"after {timeout}s")
+        if pending.error is not None:
+            raise ValueError(pending.error)
+
+    def generate(self, spec: dict, timeout: float = 300.0) -> dict:
+        """Blocking generate: enqueue to the engine thread, wait for
+        completion, return tokens + latency breakdown."""
+        pending = self._make_pending(spec)
+        self._submit_q.put(pending)
+        try:
+            self._wait_complete(pending, timeout)
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(pending.request.request_id, None)
+        return self._result(pending)
+
     def stats(self) -> dict:
         with self._stats_lock:
             done = list(self._completed)
@@ -223,11 +343,17 @@ class ServingFrontEnd:
     # --------------------------- engine thread -------------------------
 
     def _on_token(self, request_id: str, token: int, index: int) -> None:
-        if index == 0:
-            with self._inflight_lock:
-                pending = self._inflight.get(request_id)
-            if pending is not None and pending.first_token_at is None:
-                pending.first_token_at = time.perf_counter()
+        # _active_runs is engine-thread-owned and this hook runs on
+        # the engine thread (inside engine.step) — no lock needed,
+        # and completions can never be attributed to a retried
+        # request's NEW pending while the old run still decodes.
+        pending = self._active_runs.get(request_id)
+        if pending is None:
+            return
+        if index == 0 and pending.first_token_at is None:
+            pending.first_token_at = time.perf_counter()
+        if pending.token_queue is not None:
+            pending.token_queue.put((index, token))
 
     def _engine_loop(self) -> None:
         while not self._stop.is_set():
@@ -253,12 +379,15 @@ class ServingFrontEnd:
                 continue
             now = time.perf_counter()
             for request_id, tokens in finished:
+                pending = self._active_runs.pop(request_id, None)
                 with self._inflight_lock:
-                    pending = self._inflight.get(request_id)
+                    self._engine_active.discard(request_id)
                 if pending is None:
                     continue
                 pending.tokens = tokens
                 pending.finished_at = now
+                if pending.token_queue is not None:
+                    pending.token_queue.put(None)  # end of stream
                 pending.event.set()
 
     def _submit(self, pending: _Pending) -> None:
@@ -267,4 +396,11 @@ class ServingFrontEnd:
         except ValueError as exc:
             pending.error = str(exc)
             pending.finished_at = time.perf_counter()
+            if pending.token_queue is not None:
+                pending.token_queue.put(None)
             pending.event.set()
+            return
+        request_id = pending.request.request_id
+        self._active_runs[request_id] = pending
+        with self._inflight_lock:
+            self._engine_active.add(request_id)

@@ -129,3 +129,65 @@ def test_percentile_nearest_rank():
     vals = [float(v) for v in range(1, 101)]
     assert percentile(vals, 50) == 50.0
     assert percentile(vals, 99) == 99.0
+
+
+def test_streaming_generate_ndjson(front, params):
+    """stream: true returns one NDJSON line per token as it decodes,
+    then the final result object; tokens match the blocking path."""
+    import http.client
+    host, port = front.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    body = json.dumps({"prompt": [5, 17, 31, 2],
+                       "max_new_tokens": 5, "stream": True})
+    conn.request("POST", "/v1/generate", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "application/x-ndjson"
+    lines = [json.loads(ln) for ln in
+             resp.read().decode().strip().split("\n")]
+    conn.close()
+    token_events = [e for e in lines if "token" in e]
+    final = lines[-1]
+    assert [e["index"] for e in token_events] == list(
+        range(len(token_events)))
+    assert final["tokens"] == [e["token"] for e in token_events]
+    assert final["num_tokens"] == 5
+    assert final["ttft_ms"] > 0
+    # Same tokens as the blocking path (greedy, same prompt).
+    blocking = _post(front.url, {"prompt": [5, 17, 31, 2],
+                                 "max_new_tokens": 5})
+    assert blocking["tokens"] == final["tokens"]
+    # Bad streaming request -> clean 400 before any stream bytes.
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", "/v1/generate",
+                 body=json.dumps({"prompt": "bad", "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    conn.close()
+
+
+def test_streaming_engine_error_emitted_as_ndjson_line(front):
+    """An engine-side rejection surfacing AFTER the chunked headers
+    (e.g. prompt+generation exceeding max_decode_len) arrives as an
+    {"error": ...} NDJSON line with a clean stream termination — not
+    a second HTTP response corrupting the framing."""
+    import http.client
+    host, port = front.address
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("POST", "/v1/generate",
+                 body=json.dumps({"prompt": [1, 2, 3],
+                                  "max_new_tokens": 100000,
+                                  "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200  # headers already committed
+    lines = [json.loads(ln) for ln in
+             resp.read().decode().strip().split("\n")]
+    conn.close()
+    assert len(lines) == 1 and "error" in lines[0]
+    assert "max_decode_len" in lines[0]["error"]
+    # Server is still healthy afterwards.
+    out = _post(front.url, {"prompt": [3], "max_new_tokens": 2})
+    assert len(out["tokens"]) == 2
