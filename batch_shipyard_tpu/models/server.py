@@ -26,6 +26,8 @@ Endpoints:
       (chunked transfer): one {"token": t, "index": i} line per
       generated token as it decodes, then a final line with the full
       result object — the client observes TTFT directly.
+  DELETE /v1/requests/<request_id>   abort a queued/decoding request
+      (202 accepted; the waiter completes with a 'cancelled' error)
   GET  /v1/stats      aggregate counters + latency percentiles
   GET  /healthz       liveness
 """
@@ -47,9 +49,14 @@ from batch_shipyard_tpu.utils import util
 logger = util.get_logger(__name__)
 
 
+class RequestCancelled(Exception):
+    """The request was aborted via the cancel API."""
+
+
 class _Pending:
     __slots__ = ("request", "event", "submitted_at", "first_token_at",
-                 "finished_at", "tokens", "error", "token_queue")
+                 "finished_at", "tokens", "error", "token_queue",
+                 "cancelled")
 
     def __init__(self, request: Request,
                  stream: bool = False) -> None:
@@ -60,6 +67,7 @@ class _Pending:
         self.finished_at: Optional[float] = None
         self.tokens: Optional[list[int]] = None
         self.error: Optional[str] = None
+        self.cancelled = False
         # Streaming mode: the engine thread feeds (index, token)
         # pairs here as they decode; None terminates the stream.
         self.token_queue: Optional["queue.Queue"] = (
@@ -96,6 +104,9 @@ class ServingFrontEnd:
         # retried must not receive the stale run's completion).
         self._active_runs: dict[str, _Pending] = {}
         self._engine_active: set[str] = set()
+        # Cancellations cross onto the engine thread here (the engine
+        # is single-threaded by design; cancel mutates slot state).
+        self._cancel_q: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
         self._stats_lock = threading.Lock()
         self._completed: list[dict] = []
@@ -124,6 +135,16 @@ class ServingFrontEnd:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_DELETE(self):  # noqa: N802
+                prefix = "/v1/requests/"
+                if not self.path.startswith(prefix):
+                    self._reply(404, {"error": "not found"})
+                    return
+                request_id = self.path[len(prefix):]
+                front.cancel(request_id)
+                self._reply(202, {"request_id": request_id,
+                                  "cancelling": True})
+
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True})
@@ -142,6 +163,10 @@ class ServingFrontEnd:
                 except (ValueError, OSError) as exc:
                     self._reply(400, {"error": str(exc)})
                     return
+                if not isinstance(spec, dict):
+                    self._reply(400, {"error": "body must be a JSON "
+                                               "object"})
+                    return
                 if spec.get("stream"):
                     # Owns its response lifecycle end-to-end; nothing
                     # here may write a second reply after its headers.
@@ -149,6 +174,9 @@ class ServingFrontEnd:
                     return
                 try:
                     result = front.generate(spec)
+                except RequestCancelled as exc:
+                    self._reply(409, {"error": str(exc)})
+                    return
                 except ValueError as exc:
                     self._reply(400, {"error": str(exc)})
                     return
@@ -168,15 +196,28 @@ class ServingFrontEnd:
                 (a second HTTP response inside the open stream would
                 corrupt the framing)."""
                 try:
-                    stream = front.generate_stream(spec)
+                    request_id, stream = front.generate_stream(spec)
                 except ValueError as exc:
                     self._reply(400, {"error": str(exc)})
                     return
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "application/x-ndjson")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
+                except Exception as exc:  # defensive, like do_POST
+                    logger.exception("stream setup failed")
+                    self._reply(500, {"error": str(exc)})
+                    return
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                except OSError:
+                    # Client vanished before headers: the iterator
+                    # never runs, so ITS cleanup never runs — drop
+                    # the front-end registration explicitly (the
+                    # engine-side guard still protects the id until
+                    # decode completes).
+                    front.abandon(request_id)
+                    return
 
                 def _chunk(obj: dict) -> None:
                     line = json.dumps(obj).encode() + b"\n"
@@ -189,7 +230,8 @@ class ServingFrontEnd:
                     try:
                         for event in stream:
                             _chunk(event)
-                    except (ValueError, TimeoutError) as exc:
+                    except (ValueError, TimeoutError,
+                            RequestCancelled) as exc:
                         _chunk({"error": str(exc)})
                     except Exception as exc:  # defensive
                         logger.exception("stream failed")
@@ -197,6 +239,8 @@ class ServingFrontEnd:
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away; engine finishes anyway
+                finally:
+                    stream.close()  # run the iterator's cleanup NOW
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -279,7 +323,15 @@ class ServingFrontEnd:
         returned iterator only pulls tokens."""
         pending = self._make_pending(spec, stream=True)
         self._submit_q.put(pending)
-        return self._stream_tokens(pending, timeout)
+        return (pending.request.request_id,
+                self._stream_tokens(pending, timeout))
+
+    def abandon(self, request_id: str) -> None:
+        """Drop the front-end registration of a request whose client
+        went away before its stream ever started (the engine keeps
+        decoding; _engine_active still blocks id reuse meanwhile)."""
+        with self._inflight_lock:
+            self._inflight.pop(request_id, None)
 
     def _stream_tokens(self, pending: _Pending, timeout: float):
         request_id = pending.request.request_id
@@ -309,8 +361,15 @@ class ServingFrontEnd:
             raise TimeoutError(
                 f"request {pending.request.request_id} timed out "
                 f"after {timeout}s")
+        if pending.cancelled:
+            raise RequestCancelled(pending.error)
         if pending.error is not None:
             raise ValueError(pending.error)
+
+    def cancel(self, request_id: str) -> None:
+        """Request an abort; the engine thread performs it and the
+        waiting client completes with a 'cancelled' error."""
+        self._cancel_q.put(request_id)
 
     def generate(self, spec: dict, timeout: float = 300.0) -> dict:
         """Blocking generate: enqueue to the engine thread, wait for
@@ -370,6 +429,11 @@ class ServingFrontEnd:
                     self._submit(self._submit_q.get_nowait())
                 except queue.Empty:
                     break
+            while True:
+                try:
+                    self._cancel(self._cancel_q.get_nowait())
+                except queue.Empty:
+                    break
             if not self.engine.pending():
                 continue
             try:
@@ -389,6 +453,21 @@ class ServingFrontEnd:
                 if pending.token_queue is not None:
                     pending.token_queue.put(None)  # end of stream
                 pending.event.set()
+
+    def _cancel(self, request_id: str) -> None:
+        if not self.engine.cancel(request_id):
+            return  # unknown/already finished
+        pending = self._active_runs.pop(request_id, None)
+        with self._inflight_lock:
+            self._engine_active.discard(request_id)
+        if pending is None:
+            return
+        pending.error = f"request {request_id} cancelled"
+        pending.cancelled = True
+        pending.finished_at = time.perf_counter()
+        if pending.token_queue is not None:
+            pending.token_queue.put(None)
+        pending.event.set()
 
     def _submit(self, pending: _Pending) -> None:
         try:

@@ -310,6 +310,24 @@ class ContinuousBatcher:
         return len(self._queue) + sum(
             1 for s in self._slots if s.request is not None)
 
+    def cancel(self, request_id: str) -> bool:
+        """Abort a queued or actively-decoding request (the vLLM-class
+        abort operation). Queued entries are removed; an active slot
+        is freed immediately (its pages return to the pool). Must be
+        called from the engine's stepping thread — it mutates slot
+        state like step() does. Returns False when the id is unknown
+        (already finished)."""
+        for k, entry in enumerate(self._queue):
+            if entry.request.request_id == request_id:
+                del self._queue[k]
+                return True
+        for i, slot in enumerate(self._slots):
+            if slot.request is not None and \
+                    slot.request.request_id == request_id:
+                self._free_slot(i)
+                return True
+        return False
+
     def step(self) -> list[tuple[str, list[int]]]:
         """Admit queued requests into free slots, decode one token for
         every active slot, emit finished requests."""

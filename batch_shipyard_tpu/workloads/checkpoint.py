@@ -27,12 +27,16 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _step_path(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir),
+                        f"step_{step:08d}")
+
+
 def save(checkpoint_dir: str, step: int, params: Any,
          opt_state: Any) -> str:
     """Write checkpoint step N; returns its path."""
     import jax
-    path = os.path.join(os.path.abspath(checkpoint_dir),
-                        f"step_{step:08d}")
+    path = _step_path(checkpoint_dir, step)
     state = {"params": params, "opt_state": opt_state,
              "step": step}
     if jax.process_index() == 0:
@@ -55,6 +59,20 @@ def latest_step(checkpoint_dir: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def restore_params(checkpoint_dir: str) -> Optional[tuple]:
+    """Restore only the params of the latest checkpoint (serving:
+    the optimizer state is irrelevant and its template unavailable).
+    Returns (params, step) or None. Arrays land unsharded on the
+    default device — single-host serving replicas."""
+    step = latest_step(checkpoint_dir)
+    if step is None:
+        return None
+    path = _step_path(checkpoint_dir, step)
+    restored = _checkpointer().restore(path)
+    logger.info("checkpoint params restored: %s", path)
+    return restored["params"], restored.get("step", step)
+
+
 def restore(checkpoint_dir: str, params_template: Any,
             opt_state_template: Any) -> Optional[tuple]:
     """Restore the latest checkpoint matching the given pytree
@@ -63,8 +81,7 @@ def restore(checkpoint_dir: str, params_template: Any,
     step = latest_step(checkpoint_dir)
     if step is None:
         return None
-    path = os.path.join(os.path.abspath(checkpoint_dir),
-                        f"step_{step:08d}")
+    path = _step_path(checkpoint_dir, step)
     template = {"params": params_template,
                 "opt_state": opt_state_template, "step": step}
     import orbax.checkpoint as ocp

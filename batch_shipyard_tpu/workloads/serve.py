@@ -37,6 +37,39 @@ def build_engine(args) -> serving.ContinuousBatcher:
     params = model.init(
         jax.random.PRNGKey(args.seed),
         jnp.zeros((1, 8), jnp.int32))["params"]
+    if args.checkpoint_dir:
+        # Serve trained weights (train_transformer --checkpoint-dir
+        # artifacts); dims must match the model args.
+        from batch_shipyard_tpu.workloads import checkpoint
+        restored = checkpoint.restore_params(args.checkpoint_dir)
+        if restored is None:
+            raise SystemExit(
+                f"no checkpoint found in {args.checkpoint_dir}")
+        restored_params, step = restored
+        import jax.tree_util as jtu
+        want = jtu.tree_structure(params)
+        got = jtu.tree_structure(restored_params)
+        if want != got:
+            raise SystemExit(
+                "checkpoint params do not match the model "
+                "architecture flags (tree structure differs)")
+        mismatched = [
+            f"{jtu.keystr(path)}: {tuple(t.shape)} != "
+            f"{tuple(r.shape)}"
+            for (path, t), (_path2, r) in zip(
+                jtu.tree_flatten_with_path(params)[0],
+                jtu.tree_flatten_with_path(restored_params)[0])
+            if tuple(t.shape) != tuple(r.shape)]
+        if mismatched:
+            raise SystemExit(
+                "checkpoint params do not match the model "
+                "architecture flags (shape mismatch): "
+                + "; ".join(mismatched[:4]))
+        params = jax.tree_util.tree_map(
+            lambda t, r: jnp.asarray(r, t.dtype), params,
+            restored_params)
+        print(f"serving checkpoint step {step} from "
+              f"{args.checkpoint_dir}", flush=True)
     return serving.ContinuousBatcher(
         config, params, num_slots=args.num_slots,
         max_decode_len=args.max_decode_len,
@@ -75,6 +108,9 @@ def main() -> int:
     parser.add_argument("--gen-tokens", type=int, nargs=2,
                         default=(8, 32), metavar=("MIN", "MAX"))
     parser.add_argument("--report", default="latency_report.json")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="Serve params from the latest Orbax "
+                             "checkpoint (train_transformer output)")
     args = parser.parse_args()
 
     engine = build_engine(args)

@@ -191,3 +191,85 @@ def test_streaming_engine_error_emitted_as_ndjson_line(front):
     # Server is still healthy afterwards.
     out = _post(front.url, {"prompt": [3], "max_new_tokens": 2})
     assert len(out["tokens"]) == 2
+
+
+def test_cancel_queued_and_running_requests(params):
+    """DELETE /v1/requests/<id> aborts both a decoding request and a
+    queued one; waiters complete with a 'cancelled' error and the
+    slot frees for new work (the vLLM-class abort operation)."""
+    import threading
+    import time as time_mod
+    import urllib.error
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=1,
+                                       max_decode_len=64)
+    fe = ServingFrontEnd(engine, port=0).start()
+    try:
+        # Warm the compile, then throttle the engine step so the
+        # running request decodes for seconds — the cancel race is
+        # deterministic regardless of CPU speed.
+        _post(fe.url, {"prompt": [1], "max_new_tokens": 2})
+        orig_step = engine.step
+
+        def slow_step():
+            time_mod.sleep(0.05)
+            return orig_step()
+
+        engine.step = slow_step
+        results = {}
+
+        def _gen(rid):
+            try:
+                results[rid] = _post(fe.url, {
+                    "request_id": rid, "prompt": [2, 3],
+                    "max_new_tokens": 60})
+            except urllib.error.HTTPError as exc:
+                results[rid] = {"status": exc.code,
+                                "body": json.loads(exc.read())}
+
+        threads = [threading.Thread(target=_gen, args=(rid,),
+                                    daemon=True)
+                   for rid in ("running-r", "queued-r")]
+        threads[0].start()
+        time_mod.sleep(0.5)  # running-r holds the single slot
+        threads[1].start()
+        time_mod.sleep(0.3)  # queued-r sits in the engine queue
+        for rid in ("queued-r", "running-r"):
+            req = urllib.request.Request(
+                f"{fe.url}/v1/requests/{rid}", method="DELETE")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 202
+        for t in threads:
+            t.join(60)
+        for rid in ("running-r", "queued-r"):
+            out = results[rid]
+            assert out.get("status") == 409 and \
+                "cancelled" in out["body"]["error"], out
+        engine.step = orig_step
+        # Slot is free again.
+        out = _post(fe.url, {"prompt": [9], "max_new_tokens": 2})
+        assert len(out["tokens"]) == 2
+    finally:
+        fe.shutdown()
+
+
+def test_serve_checkpoint_restore_roundtrip(tmp_path):
+    """workloads.serve --checkpoint-dir serves trained weights: save
+    params via the checkpoint module, restore-params them, and check
+    array equality through the serving build path."""
+    import numpy as np_mod
+    from batch_shipyard_tpu.workloads import checkpoint
+    model = tfm.TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(3),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    import optax
+    opt_state = optax.adam(1e-3).init(params)
+    checkpoint.save(str(tmp_path), 7, params, opt_state)
+    restored = checkpoint.restore_params(str(tmp_path))
+    assert restored is not None
+    rparams, step = restored
+    assert step == 7
+    flat = jax.tree_util.tree_leaves(params)
+    rflat = jax.tree_util.tree_leaves(rparams)
+    assert len(flat) == len(rflat)
+    for a, b in zip(flat, rflat):
+        assert np_mod.allclose(np_mod.asarray(a), np_mod.asarray(b))
