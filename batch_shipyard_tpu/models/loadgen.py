@@ -16,7 +16,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import Optional, Sequence, Union
 
 from batch_shipyard_tpu.models.server import percentile
 from batch_shipyard_tpu.utils import util
@@ -49,7 +49,8 @@ def _post_generate(base_url: str, payload: dict,
         return json.loads(resp.read())
 
 
-def run_load(base_url: str, num_requests: int,
+def run_load(base_url: Union[str, Sequence[str]],
+             num_requests: int,
              rate_hz: float = 8.0,
              prompt_len: tuple[int, int] = (4, 32),
              max_new_tokens: tuple[int, int] = (8, 32),
@@ -59,16 +60,24 @@ def run_load(base_url: str, num_requests: int,
              request_timeout: float = 300.0) -> dict:
     """Fire ``num_requests`` at Poisson arrivals of ``rate_hz`` and
     return the latency report: TTFT/TPOT/latency p50/p95/p99,
-    tokens/sec, and a fixed-bucket TTFT histogram."""
+    tokens/sec, and a fixed-bucket TTFT histogram.
+
+    ``base_url`` may be a single URL or a list of replica URLs (a
+    serving fleet — one server task per pool node); requests then
+    round-robin across replicas and the report adds a per-replica
+    completion breakdown."""
+    urls = ([base_url] if isinstance(base_url, str)
+            else list(base_url))
     rng = random.Random(seed)
     results: list[Optional[dict]] = [None] * num_requests
     errors: list[Optional[str]] = [None] * num_requests
     threads = []
 
-    def _one(k: int, payload: dict) -> None:
+    def _one(k: int, url: str, payload: dict) -> None:
         try:
-            results[k] = _post_generate(base_url, payload,
-                                        request_timeout)
+            result = _post_generate(url, payload, request_timeout)
+            result["_replica"] = url
+            results[k] = result
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             errors[k] = str(exc)
 
@@ -82,8 +91,9 @@ def run_load(base_url: str, num_requests: int,
         }
         if eos_id is not None:
             payload["eos_id"] = eos_id
-        thread = threading.Thread(target=_one, args=(k, payload),
-                                  daemon=True)
+        thread = threading.Thread(
+            target=_one, args=(k, urls[k % len(urls)], payload),
+            daemon=True)
         thread.start()
         threads.append(thread)
         if k < num_requests - 1:
@@ -114,6 +124,54 @@ def run_load(base_url: str, num_requests: int,
                        for p in (50, 95, 99)},
         "ttft_histogram": _histogram(ttfts),
     }
+    if len(urls) > 1:
+        by_replica: dict[str, int] = {}
+        for r in done:
+            by_replica[r["_replica"]] = by_replica.get(
+                r["_replica"], 0) + 1
+        report["replicas"] = len(urls)
+        report["completed_by_replica"] = by_replica
     if failed:
         report["errors"] = failed[:8]
     return report
+
+
+def main() -> int:
+    """Standalone benchmark CLI against running server(s):
+
+        python -m batch_shipyard_tpu.models.loadgen \\
+            http://node0:8900 http://node1:8900 \\
+            --num 128 --rate 32 --report fleet_report.json
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("urls", nargs="+",
+                        help="Serving front end base URL(s)")
+    parser.add_argument("--num", type=int, default=64)
+    parser.add_argument("--rate", type=float, default=8.0)
+    parser.add_argument("--prompt-len", type=int, nargs=2,
+                        default=(4, 32), metavar=("MIN", "MAX"))
+    parser.add_argument("--gen-tokens", type=int, nargs=2,
+                        default=(8, 32), metavar=("MIN", "MAX"))
+    parser.add_argument("--vocab", type=int, default=32000)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--report", default=None,
+                        help="Also write the JSON report here")
+    args = parser.parse_args()
+    report = run_load(
+        args.urls, args.num, rate_hz=args.rate,
+        prompt_len=tuple(args.prompt_len),
+        max_new_tokens=tuple(args.gen_tokens),
+        vocab_size=args.vocab, seed=args.seed)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+    print(json.dumps(report))
+    return 1 if report["failed"] else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -273,3 +273,25 @@ def test_serve_checkpoint_restore_roundtrip(tmp_path):
     assert len(flat) == len(rflat)
     for a, b in zip(flat, rflat):
         assert np_mod.allclose(np_mod.asarray(a), np_mod.asarray(b))
+
+
+def test_loadgen_round_robins_across_replicas(params):
+    """A serving fleet: run_load spreads requests across replica
+    URLs and reports the per-replica completion breakdown."""
+    engines = [serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                         max_decode_len=64)
+               for _ in range(2)]
+    fronts = [ServingFrontEnd(e, port=0).start() for e in engines]
+    try:
+        report = loadgen.run_load(
+            [f.url for f in fronts], num_requests=8, rate_hz=100.0,
+            prompt_len=(2, 4), max_new_tokens=(2, 4), vocab_size=97,
+            seed=5)
+        assert report["completed"] == 8 and report["failed"] == 0
+        assert report["replicas"] == 2
+        per = report["completed_by_replica"]
+        assert sorted(per.values()) == [4, 4], per
+        assert set(per) == {f.url for f in fronts}
+    finally:
+        for f in fronts:
+            f.shutdown()
