@@ -50,6 +50,13 @@ _OUTPUT_STREAM_CHUNK = 4 * 1024 * 1024
 _SCRATCH_NONCE = ".shipyard_scratch_nonce"
 
 
+class TaskEnvError(Exception):
+    """Task environment synthesis failed (unresolvable secret,
+    malformed env block): the task must FAIL with the reason — an
+    exception escaping to the worker loop would bounce its queue
+    message forever."""
+
+
 class NodeUnusableError(Exception):
     """Raised by a nodeprep callable to mark the node unusable (as
     opposed to start-task-failed): the node finished booting but cannot
@@ -135,6 +142,9 @@ class NodeAgent:
         # (job_id, task_id) -> last gang-health probe (rate limiting
         # the claim-failure bounce path).
         self._gang_probe_at: dict[tuple[str, str], float] = {}
+        # (job_id, secret_id) -> resolved env block: one provider
+        # round trip per job per node, not per task launch.
+        self._env_block_cache: dict[tuple[str, str], dict] = {}
 
     # ------------------------- node lifecycle --------------------------
 
@@ -566,7 +576,16 @@ class NodeAgent:
                 self.store.delete_message(msg)
                 return
             self._ensure_images(spec)
-            execution = self._build_execution(slot, job_id, task_id, spec)
+            try:
+                execution = self._build_execution(slot, job_id,
+                                                  task_id, spec)
+            except TaskEnvError as exc:
+                self._merge_task(job_id, task_id, {
+                    "state": "failed", "exit_code": -4,
+                    "error": str(exc)})
+                self.store.delete_message(msg)
+                self._maybe_autocomplete_job(job_id)
+                return
             try:
                 self._stage_inputs(spec, execution)
             except Exception as exc:
@@ -794,11 +813,29 @@ class NodeAgent:
         with self._message_keepalive(msg):
             jp_ok = self._ensure_job_prep(job_id, spec)
             self._ensure_images(spec)
-            execution = self._build_execution(
-                slot, job_id, task_id, spec, instance=instance,
-                instances=num_instances,
-                host_list=tuple(m.internal_ip for m in gang_members),
-                extra_env=gang_env)
+            try:
+                execution = self._build_execution(
+                    slot, job_id, task_id, spec, instance=instance,
+                    instances=num_instances,
+                    host_list=tuple(m.internal_ip
+                                    for m in gang_members),
+                    extra_env=gang_env)
+            except TaskEnvError as exc:
+                # Record the instance failure through the normal gang
+                # aggregation (a raise here would bounce the message
+                # forever — the same hazard as the scratch-mount
+                # failure above).
+                logger.error("gang %s/%s i%d: %s", job_id, task_id,
+                             instance, exc)
+                jp_ok = False
+                execution = self._build_execution(
+                    slot, job_id, task_id,
+                    {**spec, "environment_variables": {},
+                     "environment_variables_secret_id": None},
+                    instance=instance, instances=num_instances,
+                    host_list=tuple(m.internal_ip
+                                    for m in gang_members),
+                    extra_env=gang_env)
             try:
                 self._stage_inputs(spec, execution)
             except Exception as exc:
@@ -891,13 +928,68 @@ class NodeAgent:
             resolved[key] = value
         return resolved
 
+    def _resolve_env_block(self, job_id: str,
+                           secret_id: str) -> dict:
+        """Resolve a secret holding a WHOLE env-var map (YAML/JSON
+        mapping, or KEY=VALUE lines) — the reference's
+        environment_variables_keyvault_secret_id (keyvault.py:176).
+        Explicit per-key env always wins over the block. Cached per
+        (job, secret) so a 1000-task job costs one provider round
+        trip per node. Raises ValueError on an unparseable/empty
+        block — running a task silently missing its env vars is
+        worse than failing it."""
+        cache_key = (job_id, secret_id)
+        cached = self._env_block_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        from batch_shipyard_tpu.utils import secrets as secrets_mod
+        raw = secrets_mod.resolve_secret(
+            secret_id,
+            secrets_file=os.environ.get("SHIPYARD_SECRETS_FILE"))
+        import yaml
+        block = None
+        try:
+            # YAML is a JSON superset: one parse covers both
+            # documented map formats.
+            parsed = yaml.safe_load(raw)
+            if isinstance(parsed, dict):
+                block = parsed
+        except yaml.YAMLError:
+            pass
+        if block is None:
+            block = {}
+            for line in raw.splitlines():
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                key, _, value = line.partition("=")
+                block[key.strip()] = value.strip()
+        if not block:
+            raise ValueError(
+                f"env-block secret {secret_id} resolved to no "
+                f"variables (expect a YAML/JSON mapping or KEY=VALUE "
+                f"lines)")
+        resolved = {str(k): str(v) for k, v in block.items()}
+        self._env_block_cache[cache_key] = resolved
+        return resolved
+
     def _build_execution(self, slot: int, job_id: str, task_id: str,
                          spec: dict, instance: int = 0, instances: int = 1,
                          host_list: tuple[str, ...] = (),
                          extra_env: Optional[dict] = None,
                          ) -> task_runner.TaskExecution:
-        env = self._resolve_env_secrets(
-            dict(spec.get("environment_variables", {})))
+        from batch_shipyard_tpu.utils import secrets as secrets_mod
+        try:
+            env = self._resolve_env_secrets(
+                dict(spec.get("environment_variables", {})))
+            env_secret = spec.get("environment_variables_secret_id")
+            if env_secret:
+                for key, value in self._resolve_env_block(
+                        job_id, env_secret).items():
+                    env.setdefault(key, value)
+        except (secrets_mod.SecretResolutionError, ValueError) as exc:
+            raise TaskEnvError(
+                f"environment synthesis failed: {exc}") from exc
         env["SHIPYARD_JOB_SHARED_DIR"] = self._job_shared_dir(job_id)
         if spec.get("auto_scratch"):
             try:
@@ -1312,6 +1404,9 @@ class NodeAgent:
                             stderr=subprocess.DEVNULL)
 
     def _run_job_release(self, job_id: str) -> None:
+        for key in [k for k in self._env_block_cache
+                    if k[0] == job_id]:
+            self._env_block_cache.pop(key, None)
         try:
             job = self.store.get_entity(
                 names.TABLE_JOBS, self.identity.pool_id, job_id)

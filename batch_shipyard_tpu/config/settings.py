@@ -511,6 +511,11 @@ class JobSettings:
     max_wall_time_seconds: Optional[int]
     allow_run_on_missing_image: bool
     environment_variables: dict
+    # secret:// ref whose resolved value is a JSON/YAML map of extra
+    # env vars, resolved ON NODE at task launch (the reference's
+    # environment_variables_keyvault_secret_id, keyvault.py:176 —
+    # whole env blocks ride KeyVault, never the state store).
+    environment_variables_secret_id: Optional[str]
     recurrence: Optional[RecurrenceSettings]
     job_preparation_command: Optional[str]
     job_release_command: Optional[str]
@@ -569,6 +574,8 @@ def _job_settings(job: dict) -> JobSettings:
             job, "allow_run_on_missing_image", default=False),
         environment_variables=_get(
             job, "environment_variables", default={}),
+        environment_variables_secret_id=_get(
+            job, "environment_variables_keyvault_secret_id"),
         recurrence=recurrence,
         job_preparation_command=_get(job, "job_preparation", "command"),
         job_release_command=_get(job, "job_release", "command"),

@@ -64,6 +64,8 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "input_data": list(task.input_data),
         "output_data": list(task.output_data),
         "resource_files": list(task.resource_files),
+        "environment_variables_secret_id":
+            job.environment_variables_secret_id,
         "job_preparation_command": job.job_preparation_command,
         "job_input_data": list(job.input_data),
         "auto_scratch": job.auto_scratch,

@@ -676,3 +676,75 @@ def test_migrate_preserves_priority_band():
     assert store.queue_length(
         names.task_queue("mig-dst", 0, "hi")) == 1
     assert store.queue_length(names.task_queue("mig-dst", 0)) == 0
+
+
+def test_job_env_block_from_secret(monkeypatch):
+    """environment_variables_keyvault_secret_id: a secret holding a
+    WHOLE env map (JSON) resolves on node and merges into task env,
+    with explicit per-key env winning (reference keyvault.py:176 —
+    env blocks ride the vault, never the state store)."""
+    monkeypatch.setenv(
+        "JOB_ENV_BLOCK",
+        json.dumps({"FROM_BLOCK": "vault-value", "SHARED": "block"}))
+    store, substrate, pool = make_env("envsecret")
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "ej",
+            "environment_variables": {"SHARED": "explicit"},
+            "environment_variables_keyvault_secret_id":
+                "secret://env/JOB_ENV_BLOCK",
+            "tasks": [{"id": "t",
+                       "command": "sh -c 'echo $FROM_BLOCK:$SHARED'"}],
+        }]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "envsecret", "ej",
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed"
+        out = jobs_mgr.get_task_output(store, "envsecret", "ej", "t")
+        assert out.strip() == b"vault-value:explicit"
+        # The state store never saw the plaintext — only the ref.
+        spec = tasks[0]["spec"]
+        assert spec["environment_variables_secret_id"] == \
+            "secret://env/JOB_ENV_BLOCK"
+        assert "vault-value" not in json.dumps(spec)
+    finally:
+        substrate.stop_all()
+
+
+def test_env_block_dotenv_lines(monkeypatch, tmp_path):
+    """The env-block secret also accepts KEY=VALUE lines."""
+    from batch_shipyard_tpu.agent.node_agent import (
+        NodeAgent, NodeIdentity)
+    monkeypatch.setenv("DOTENV_BLOCK",
+                       "# comment\nA=1\nB = two \n\nbad-line\n")
+    conf = {"pool_specification": {
+        "id": "x", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"}}}
+    agent = NodeAgent(
+        MemoryStateStore(),
+        NodeIdentity(pool_id="x", node_id="n", node_index=0,
+                     hostname="h", internal_ip="ip"),
+        settings_mod.pool_settings(conf),
+        work_dir=str(tmp_path))
+    block = agent._resolve_env_block("j", "secret://env/DOTENV_BLOCK")
+    assert block == {"A": "1", "B": "two"}
+
+
+def test_env_block_secret_failure_fails_task_cleanly():
+    """An unresolvable env-block secret FAILS the task with the
+    reason instead of bouncing its queue message forever."""
+    store, substrate, pool = make_env("envfail")
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "fj",
+            "environment_variables_keyvault_secret_id":
+                "secret://env/DOES_NOT_EXIST_ANYWHERE",
+            "tasks": [{"id": "t", "command": "echo never"}],
+        }]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        tasks = jobs_mgr.wait_for_tasks(store, "envfail", "fj",
+                                        timeout=30)
+        assert tasks[0]["state"] == "failed"
+        assert "environment synthesis failed" in tasks[0]["error"]
+    finally:
+        substrate.stop_all()
