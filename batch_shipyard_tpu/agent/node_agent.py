@@ -145,6 +145,18 @@ class NodeAgent:
         # (job_id, secret_id) -> resolved env block: one provider
         # round trip per job per node, not per task launch.
         self._env_block_cache: dict[tuple[str, str], dict] = {}
+        # Pool image-manifest cache for the strict
+        # allow_run_on_missing_image gate: (expires_at, image set)
+        # per runtime kind — the hot launch path must not query the
+        # whole images table per task.
+        self._image_manifest_cache: dict[str, tuple[float, set]] = {}
+        # Retention sweeps: (monotonic deadline, task dir) for
+        # completed tasks whose spec sets retention_time_seconds —
+        # the Azure Batch task-constraint retention_time analog
+        # (reference batch.py:4859): working files stay on the node
+        # for the window, then a heartbeat-loop sweep removes them.
+        self._retention: list[tuple[float, str]] = []
+        self._retention_lock = threading.Lock()
 
     # ------------------------- node lifecycle --------------------------
 
@@ -274,6 +286,7 @@ class NodeAgent:
     def _heartbeat_loop(self) -> None:
         while not self.stop_event.wait(self.heartbeat_interval):
             self._heartbeat()
+            self._sweep_retention()
         # Final state write must NOT resurrect a node entity the
         # substrate already deleted (teardown race) — _heartbeat
         # merges and tolerates a missing row.
@@ -360,6 +373,7 @@ class NodeAgent:
         elif kind == "job_release":
             self._run_job_release(control["job_id"])
         elif kind == "load_images":
+            self._image_manifest_cache.clear()
             if self._image_provisioner is not None:
                 self._image_provisioner(
                     self, control.get("images", []),
@@ -574,9 +588,10 @@ class NodeAgent:
                     "error": "job preparation failed on node "
                              f"{self.identity.node_id}"})
                 self.store.delete_message(msg)
+                self._maybe_autocomplete_job(job_id)
                 return
-            self._ensure_images(spec)
             try:
+                self._ensure_images(spec)
                 execution = self._build_execution(slot, job_id,
                                                   task_id, spec)
             except TaskEnvError as exc:
@@ -637,9 +652,38 @@ class NodeAgent:
                     priority=int(spec.get("priority", 0) or 0)),
                 json.dumps({"job_id": job_id, "task_id": task_id}).encode())
             return
+        self._schedule_retention(spec, job_id, task_id)
         self._finish_task(job_id, task_id, result)
         self.store.delete_message(msg)
         self._maybe_autocomplete_job(job_id)
+
+    def _schedule_retention(self, spec: dict, job_id: str,
+                            task_id: str) -> None:
+        seconds = spec.get("retention_time_seconds")
+        if seconds is None:
+            return
+        task_dir = os.path.join(self.work_dir, "tasks", job_id,
+                                task_id)
+        with self._retention_lock:
+            self._retention.append(
+                (time.monotonic() + float(seconds), task_dir))
+
+    def _sweep_retention(self) -> None:
+        now = time.monotonic()
+        expired: list[str] = []
+        with self._retention_lock:
+            keep: list[tuple[float, str]] = []
+            for deadline, task_dir in self._retention:
+                if deadline <= now:
+                    expired.append(task_dir)
+                else:
+                    keep.append((deadline, task_dir))
+            self._retention = keep
+        if expired:
+            import shutil as shutil_mod
+            for task_dir in expired:
+                shutil_mod.rmtree(task_dir, ignore_errors=True)
+                logger.info("retention expired; removed %s", task_dir)
 
     def _finish_task(self, job_id: str, task_id: str,
                      result: task_runner.TaskResult) -> None:
@@ -812,8 +856,8 @@ class NodeAgent:
             gang_members, me, mi, self.pool)
         with self._message_keepalive(msg):
             jp_ok = self._ensure_job_prep(job_id, spec)
-            self._ensure_images(spec)
             try:
+                self._ensure_images(spec)
                 execution = self._build_execution(
                     slot, job_id, task_id, spec, instance=instance,
                     instances=num_instances,
@@ -824,9 +868,15 @@ class NodeAgent:
                 # Record the instance failure through the normal gang
                 # aggregation (a raise here would bounce the message
                 # forever — the same hazard as the scratch-mount
-                # failure above).
+                # failure above), and surface the REASON on the task
+                # entity so the user doesn't have to grep node logs.
                 logger.error("gang %s/%s i%d: %s", job_id, task_id,
                              instance, exc)
+                try:
+                    self._merge_task(job_id, task_id,
+                                     {"error": str(exc)})
+                except NotFoundError:
+                    pass
                 jp_ok = False
                 execution = self._build_execution(
                     slot, job_id, task_id,
@@ -870,6 +920,7 @@ class NodeAgent:
             {"state": "done", "exit_code": result.exit_code})
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
+        self._schedule_retention(spec, job_id, task_id)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -950,9 +1001,13 @@ class NodeAgent:
         block = None
         try:
             # YAML is a JSON superset: one parse covers both
-            # documented map formats.
+            # documented map formats. A dotenv line like
+            # 'MSG=hello: world' also parses as a YAML mapping — but
+            # with '=' inside the key, which no real env map has; in
+            # that case fall through to the KEY=VALUE parser.
             parsed = yaml.safe_load(raw)
-            if isinstance(parsed, dict):
+            if isinstance(parsed, dict) and not any(
+                    "=" in str(k) for k in parsed):
                 block = parsed
         except yaml.YAMLError:
             pass
@@ -970,6 +1025,12 @@ class NodeAgent:
                 f"variables (expect a YAML/JSON mapping or KEY=VALUE "
                 f"lines)")
         resolved = {str(k): str(v) for k, v in block.items()}
+        # Bounded: jobs that never trigger a release fan-out on this
+        # node (no prep/inputs/scratch) must not pin secret material
+        # in memory for the process lifetime.
+        if len(self._env_block_cache) >= 32:
+            self._env_block_cache.pop(
+                next(iter(self._env_block_cache)))
         self._env_block_cache[cache_key] = resolved
         return resolved
 
@@ -1493,11 +1554,34 @@ class NodeAgent:
             exclude_rels=exclude)
 
     def _ensure_images(self, spec: dict) -> None:
-        if self._image_provisioner is None:
-            return
+        """Provision the task's image; with allow_run_on_missing_image
+        false (the default), an image absent from the pool's
+        replicated global resources FAILS the task instead of being
+        pulled ad hoc (reference batch.py:4747 — missing images only
+        run when the job opts in)."""
         image = spec.get("image")
         runtime = spec.get("runtime")
-        if image and runtime in ("docker", "singularity"):
+        if not image or runtime not in ("docker", "singularity"):
+            return
+        if not spec.get("allow_run_on_missing_image", False):
+            cached = self._image_manifest_cache.get(runtime)
+            if cached is not None and cached[0] > time.monotonic():
+                manifest = cached[1]
+            else:
+                manifest = {
+                    row.get("image")
+                    for row in self.store.query_entities(
+                        names.TABLE_IMAGES,
+                        partition_key=self.identity.pool_id)
+                    if row.get("kind") == runtime}
+                self._image_manifest_cache[runtime] = (
+                    time.monotonic() + 30.0, manifest)
+            if image not in manifest:
+                raise TaskEnvError(
+                    f"image {image} is not in the pool's global "
+                    f"resources and the job does not set "
+                    f"allow_run_on_missing_image")
+        if self._image_provisioner is not None:
             self._image_provisioner(self, [image], kind=runtime)
 
     def _upload_outputs(self, job_id: str, task_id: str,

@@ -66,6 +66,7 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "resource_files": list(task.resource_files),
         "environment_variables_secret_id":
             job.environment_variables_secret_id,
+        "allow_run_on_missing_image": job.allow_run_on_missing_image,
         "job_preparation_command": job.job_preparation_command,
         "job_input_data": list(job.input_data),
         "auto_scratch": job.auto_scratch,

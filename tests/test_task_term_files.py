@@ -167,3 +167,94 @@ def test_kata_runtime_in_docker_argv():
     task = sm.task_settings({"command": "echo"}, jobs[0], pool)
     spec = _task_spec(task, jobs[0], pool)
     assert spec["container_runtime"] == "kata_containers"
+
+
+def test_allow_run_on_missing_image_gate():
+    """A docker task whose image is NOT in the pool's global
+    resources fails cleanly under the strict default and runs when
+    the job opts in (reference batch.py:4747)."""
+    import json as json_mod
+    from batch_shipyard_tpu.config import settings as sm
+    from batch_shipyard_tpu.jobs import manager as jm
+    from batch_shipyard_tpu.pool import manager as pm
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    conf = {"pool_specification": {
+        "id": "imgpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30}}
+    pool = sm.pool_settings(conf)
+    pm.create_pool(store, substrate, pool, sm.global_settings({}),
+                   conf)
+    try:
+        jobs = sm.job_settings_list({"job_specifications": [{
+            "id": "strict",
+            "tasks": [{"id": "t", "runtime": "docker",
+                       "docker_image": "ghost/image:latest",
+                       "command": "echo x"}]}]})
+        jm.add_jobs(store, pool, jobs)
+        tasks = jm.wait_for_tasks(store, "imgpool", "strict",
+                                  timeout=30)
+        assert tasks[0]["state"] == "failed"
+        assert "allow_run_on_missing_image" in tasks[0]["error"]
+        # Opt-in: the gate passes (execution still fails later only
+        # if docker itself is absent — fake nodes have no docker, so
+        # just assert the spec carries the opt-in and the gate logic
+        # passes via the agent method).
+        from batch_shipyard_tpu.agent.node_agent import (
+            NodeAgent, TaskEnvError)
+        agent = list(substrate._agents["imgpool"].values())[0]
+        spec = {"image": "ghost/image:latest", "runtime": "docker",
+                "allow_run_on_missing_image": True}
+        agent._ensure_images(spec)  # no raise
+        import pytest as pytest_mod
+        spec["allow_run_on_missing_image"] = False
+        with pytest_mod.raises(TaskEnvError):
+            agent._ensure_images(spec)
+    finally:
+        substrate.stop_all()
+
+
+def test_retention_time_removes_task_dir():
+    """retention_time_seconds: a completed task's working dir is
+    swept after the window (Azure Batch retention_time analog)."""
+    import os as os_mod
+    import time as time_mod
+    from batch_shipyard_tpu.config import settings as sm
+    from batch_shipyard_tpu.jobs import manager as jm
+    from batch_shipyard_tpu.pool import manager as pm
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.2)
+    conf = {"pool_specification": {
+        "id": "retpool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-4"},
+        "max_wait_time_seconds": 30}}
+    pool = sm.pool_settings(conf)
+    pm.create_pool(store, substrate, pool, sm.global_settings({}),
+                   conf)
+    try:
+        jobs = sm.job_settings_list({"job_specifications": [{
+            "id": "rj",
+            "tasks": [{"id": "t", "command": "echo kept",
+                       "retention_time_seconds": 1}]}]})
+        jm.add_jobs(store, pool, jobs)
+        tasks = jm.wait_for_tasks(store, "retpool", "rj", timeout=30)
+        assert tasks[0]["state"] == "completed"
+        node_id = FakePodSubstrate.node_id("retpool", 0, 0)
+        task_dir = os_mod.path.join(substrate.work_root, "retpool",
+                                    node_id, "tasks", "rj", "t")
+        assert os_mod.path.isdir(task_dir)
+        deadline = time_mod.monotonic() + 15
+        while os_mod.path.isdir(task_dir):
+            assert time_mod.monotonic() < deadline, \
+                "task dir never swept after retention"
+            time_mod.sleep(0.25)
+        # Outputs in the store survive the node-side sweep.
+        assert jm.get_task_output(store, "retpool", "rj",
+                                  "t").strip() == b"kept"
+    finally:
+        substrate.stop_all()
