@@ -212,6 +212,7 @@ class NodeAgent:
             self._set_node_state("start_task_failed", error=str(exc))
             return
         self._set_node_state("idle")
+        self._rescan_retention_markers()
         for slot in range(self.pool.task_slots_per_node):
             thread = threading.Thread(
                 target=self._worker_loop, args=(slot,),
@@ -453,11 +454,18 @@ class NodeAgent:
             return
         spec = entity["spec"]
         # Node-pinned task (federation required-target select): only
-        # the named node may claim it; everyone else re-hides the
-        # message so the pinned node finds it on its next poll.
+        # the named node may claim it. Everyone else makes the message
+        # immediately visible again and backs off THEIR OWN polling —
+        # re-hiding it for seconds would let a fast non-pinned poller
+        # starve the pinned node of visibility windows.
         required = spec.get("required_node")
         if required and required != self.identity.node_id:
-            self.store.update_message(msg, visibility_timeout=2.0)
+            # Hide only for one poll interval: long hides starve the
+            # pinned node of visibility windows, while zero-hide plus
+            # an in-handler sleep would park worker slots on the
+            # queue-head pinned message instead of the work behind it.
+            self.store.update_message(
+                msg, visibility_timeout=self.poll_interval)
             return
         deps = self._deps_status(job_id, spec)
         if deps == "blocked":
@@ -657,6 +665,8 @@ class NodeAgent:
         self.store.delete_message(msg)
         self._maybe_autocomplete_job(job_id)
 
+    _RETENTION_MARKER = ".shipyard_retention_deadline"
+
     def _schedule_retention(self, spec: dict, job_id: str,
                             task_id: str) -> None:
         seconds = spec.get("retention_time_seconds")
@@ -664,9 +674,50 @@ class NodeAgent:
             return
         task_dir = os.path.join(self.work_dir, "tasks", job_id,
                                 task_id)
+        # Marker survives agent restarts: startup rescans for them so
+        # pending sweeps are never orphaned (disk would otherwise
+        # leak until the node dies).
+        try:
+            with open(os.path.join(task_dir, self._RETENTION_MARKER),
+                      "w", encoding="utf-8") as fh:
+                fh.write(str(time.time() + float(seconds)))
+        except OSError:
+            pass
         with self._retention_lock:
             self._retention.append(
                 (time.monotonic() + float(seconds), task_dir))
+
+    def _rescan_retention_markers(self) -> None:
+        """Re-register sweeps recorded by a previous agent process
+        (markers hold wall-clock deadlines)."""
+        root = os.path.join(self.work_dir, "tasks")
+        if not os.path.isdir(root):
+            return
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        found = 0
+        for job_id in os.listdir(root):
+            job_dir = os.path.join(root, job_id)
+            if not os.path.isdir(job_dir):
+                continue
+            for task_id in os.listdir(job_dir):
+                marker = os.path.join(job_dir, task_id,
+                                      self._RETENTION_MARKER)
+                try:
+                    with open(marker, encoding="utf-8") as fh:
+                        wall_deadline = float(fh.read().strip())
+                except (OSError, ValueError):
+                    continue
+                mono_deadline = now_mono + max(
+                    0.0, wall_deadline - now_wall)
+                with self._retention_lock:
+                    self._retention.append(
+                        (mono_deadline,
+                         os.path.join(job_dir, task_id)))
+                found += 1
+        if found:
+            logger.info("re-registered %d retention sweeps from "
+                        "markers", found)
 
     def _sweep_retention(self) -> None:
         now = time.monotonic()
@@ -920,7 +971,6 @@ class NodeAgent:
             {"state": "done", "exit_code": result.exit_code})
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
-        self._schedule_retention(spec, job_id, task_id)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -928,6 +978,7 @@ class NodeAgent:
                              job_id, task_id)
             self._merge_task(job_id, task_id,
                              {"output_error": str(exc)})
+        self._schedule_retention(spec, job_id, task_id)
         self.store.delete_message(msg)
         self._gang_finalize(job_id, task_id, num_instances)
         self._maybe_autocomplete_job(job_id)
@@ -1553,6 +1604,17 @@ class NodeAgent:
             self.identity.pool_id, job_id, task_id,
             exclude_rels=exclude)
 
+    def _load_image_manifest(self, runtime: str) -> set:
+        manifest = {
+            row.get("image")
+            for row in self.store.query_entities(
+                names.TABLE_IMAGES,
+                partition_key=self.identity.pool_id)
+            if row.get("kind") == runtime}
+        self._image_manifest_cache[runtime] = (
+            time.monotonic() + 30.0, manifest)
+        return manifest
+
     def _ensure_images(self, spec: dict) -> None:
         """Provision the task's image; with allow_run_on_missing_image
         false (the default), an image absent from the pool's
@@ -1568,14 +1630,12 @@ class NodeAgent:
             if cached is not None and cached[0] > time.monotonic():
                 manifest = cached[1]
             else:
-                manifest = {
-                    row.get("image")
-                    for row in self.store.query_entities(
-                        names.TABLE_IMAGES,
-                        partition_key=self.identity.pool_id)
-                    if row.get("kind") == runtime}
-                self._image_manifest_cache[runtime] = (
-                    time.monotonic() + 30.0, manifest)
+                manifest = self._load_image_manifest(runtime)
+            if image not in manifest:
+                # The image may have been added moments ago (pool
+                # images update racing the submit): refresh once
+                # before declaring terminal failure.
+                manifest = self._load_image_manifest(runtime)
             if image not in manifest:
                 raise TaskEnvError(
                     f"image {image} is not in the pool's global "

@@ -648,10 +648,23 @@ def monitor_create(click_ctx, output_dir, start):
     ctx = _ctx(click_ctx)
     mon = ctx.configs.get("monitor", {}).get("monitoring", {})
     le = (mon.get("services", {}) or {}).get("lets_encrypt", {}) or {}
+    from batch_shipyard_tpu.utils import secrets as secrets_mod
+    mon_creds = (ctx.configs.get("credentials", {})
+                 .get("credentials", {}).get("monitoring", {}) or {})
+    password = (mon_creds.get("grafana_admin_password_secret_id")
+                or mon_creds.get("grafana_admin_password")
+                or "admin")
+    if secrets_mod.is_secret_id(password):
+        password = secrets_mod.resolve_secret(password)
     bundle = provision.generate_monitoring_bundle(
         output_dir,
         prometheus_port=mon.get("prometheus", {}).get("port", 9090),
         grafana_port=mon.get("grafana", {}).get("port", 3000),
+        grafana_password=password,
+        scrape_interval=mon.get("prometheus", {}).get(
+            "scrape_interval_seconds", 15),
+        additional_dashboards=mon.get("grafana", {}).get(
+            "additional_dashboards"),
         lets_encrypt_fqdn=(le.get("fqdn")
                            if le.get("enabled") else None),
         lets_encrypt_staging=le.get("use_staging_environment", False))
@@ -667,13 +680,17 @@ def monitor_create(click_ctx, output_dir, start):
 @click.pass_context
 def monitor_create_vm(click_ctx, project, zone, vm_size):
     """Provision a GCE VM running the monitoring bundle (reference
-    `shipyard monitor create` provisions the monitoring VM)."""
+    `shipyard monitor create` provisions the monitoring VM).
+    public_ip honors monitor.yaml monitoring.public_ip.enabled
+    (default true)."""
     from batch_shipyard_tpu.monitor import provision
     ctx = _ctx(click_ctx)
     mon = ctx.configs.get("monitor", {}).get("monitoring", {})
     le = (mon.get("services", {}) or {}).get("lets_encrypt", {}) or {}
     ip = provision.provision_monitoring_vm(
         ctx.store, project, zone=zone, vm_size=vm_size,
+        public_ip=(mon.get("public_ip", {}) or {}).get(
+            "enabled", True),
         prometheus_port=mon.get("prometheus", {}).get("port", 9090),
         grafana_port=mon.get("grafana", {}).get("port", 3000),
         lets_encrypt_fqdn=(le.get("fqdn")
@@ -728,12 +745,19 @@ def monitor_list(click_ctx):
 @monitor.command("heimdall")
 @click.option("--output-dir", default="./monitoring/file_sd")
 @click.option("--once", is_flag=True, default=False)
-@click.option("--poll-interval", type=float, default=15.0)
+@click.option("--poll-interval", type=float, default=None,
+              help="Default: monitor.yaml services."
+                   "resource_polling_interval_seconds (15)")
 @click.pass_context
 def monitor_heimdall(click_ctx, output_dir, once, poll_interval):
     """Run the service-discovery daemon (writes prometheus file_sd)."""
     from batch_shipyard_tpu.monitor import heimdall
     ctx = _ctx(click_ctx)
+    if poll_interval is None:
+        poll_interval = float(
+            ctx.configs.get("monitor", {}).get("monitoring", {})
+            .get("services", {})
+            .get("resource_polling_interval_seconds", 15))
     if once:
         click.echo(heimdall.write_file_sd(ctx.store, output_dir))
     else:
@@ -886,11 +910,15 @@ def fed_create_vm(click_ctx, federation_id, project, zone, replicas,
     from batch_shipyard_tpu.federation import provision as fed_prov
     ctx = _ctx(click_ctx)
     store_config = _yaml.safe_dump(ctx.configs.get("credentials", {}))
+    fed_conf = ctx.configs.get("federation", {}).get("federation",
+                                                     {}) or {}
     for replica in range(replicas):
         ip = fed_prov.provision_proxy_vm(
             ctx.store, federation_id, project, zone=zone,
             replica=replica, package_source=package_source,
-            store_config_yaml=store_config)
+            store_config_yaml=store_config,
+            public_ip=(fed_conf.get("public_ip", {}) or {}).get(
+                "enabled", True))
         click.echo(f"proxy{replica}: {ip}")
 
 
@@ -907,13 +935,23 @@ def fed_destroy_vm(click_ctx, federation_id, project, zone):
 
 
 @fed.command("proxy")
-@click.option("--poll-interval", type=float, default=1.0)
+@click.option("--poll-interval", type=float, default=None,
+              help="Default: federation.yaml proxy_options."
+                   "polling_interval (1.0)")
 @click.pass_context
 def fed_proxy(click_ctx, poll_interval):
     """Run the federation scheduler daemon."""
     from batch_shipyard_tpu.federation import federation as fed_mod
+    ctx = _ctx(click_ctx)
+    opts = (ctx.configs.get("federation", {}).get("federation", {})
+            .get("proxy_options", {}) or {})
+    if poll_interval is None:
+        poll_interval = float(opts.get("polling_interval", 1.0))
+    sched = opts.get("scheduling", {}) or {}
     proc = fed_mod.FederationProcessor(
-        _ctx(click_ctx).store, poll_interval=poll_interval)
+        ctx.store, poll_interval=poll_interval,
+        after_success_blackout=float(
+            sched.get("after_success_blackout_interval", 0.0)))
     proc.run()
 
 
@@ -932,9 +970,12 @@ def slurm_conf(click_ctx):
     ctx = _ctx(click_ctx)
     sconf = ctx.configs.get("slurm", {}).get("slurm", {})
     cluster_id = sconf.get("cluster_id", "shipyard")
-    partitions = sconf.get("slurm_options", {}).get(
-        "elastic_partitions", {})
-    click.echo(burst.generate_slurm_conf(cluster_id, partitions))
+    opts = sconf.get("slurm_options", {}) or {}
+    click.echo(burst.generate_slurm_conf(
+        cluster_id, opts.get("elastic_partitions", {}),
+        idle_reclaim_seconds=opts.get(
+            "idle_reclaim_time_seconds", 300),
+        unmanaged_partitions=opts.get("unmanaged_partitions", ())))
 
 
 @slurm.command("resume")
@@ -1000,17 +1041,24 @@ def slurm_cluster_create(click_ctx, project, zone, db_password,
     ctx = _ctx(click_ctx)
     sconf = ctx.configs.get("slurm", {}).get("slurm", {})
     cluster_id = sconf.get("cluster_id", "shipyard")
-    partitions = sconf.get("slurm_options", {}).get(
-        "elastic_partitions", {})
+    opts = sconf.get("slurm_options", {}) or {}
+    partitions = opts.get("elastic_partitions", {})
     # The VMs reach the same state store this CLI uses: ship our
     # credentials config into their bootstrap.
     store_config = _yaml.safe_dump(ctx.configs.get("credentials", {}))
     record = slurm_prov.create_slurm_cluster(
         ctx.store, cluster_id,
-        burst.generate_slurm_conf(cluster_id, partitions),
+        burst.generate_slurm_conf(
+            cluster_id, partitions,
+            idle_reclaim_seconds=opts.get(
+                "idle_reclaim_time_seconds", 300),
+            unmanaged_partitions=opts.get(
+                "unmanaged_partitions", ())),
         db_password, project, zone=zone, login_count=login_count,
         package_source=package_source,
-        store_config_yaml=store_config)
+        store_config_yaml=store_config,
+        public_ip=(sconf.get("controller", {}) or {}).get(
+            "public_ip", {}).get("enabled", True))
     fleet._emit(record, click_ctx.obj["raw"])
 
 
@@ -1106,16 +1154,32 @@ def fs_bucket_mount_args(click_ctx, name):
 
 @fs_cluster.command("add")
 @click.argument("cluster_id")
-@click.option("--disk-count", type=int, default=2)
-@click.option("--disk-size-gb", type=int, default=256)
-@click.option("--vm-size", default="n2-standard-8")
+@click.option("--disk-count", type=int, default=None)
+@click.option("--disk-size-gb", type=int, default=None)
+@click.option("--vm-size", default=None)
 @click.pass_context
 def fs_cluster_add(click_ctx, cluster_id, disk_count, disk_size_gb,
                    vm_size):
+    """Register a storage cluster. Defaults come from fs.yaml's
+    remote_fs.storage_clusters.<cluster_id> block (the reference's
+    config-driven `fs cluster add` flow); CLI options override."""
     from batch_shipyard_tpu.remotefs import manager as remotefs
+    ctx = _ctx(click_ctx)
+    remote_fs = (ctx.configs.get("fs", {}).get("remote_fs", {})
+                 or {})
+    spec = (remote_fs.get("storage_clusters", {}) or {}).get(
+        cluster_id, {})
+    disks = remote_fs.get("managed_disks", {}) or {}
     remotefs.create_storage_cluster_record(
-        _ctx(click_ctx).store, cluster_id, disk_count=disk_count,
-        disk_size_gb=disk_size_gb, vm_size=vm_size)
+        ctx.store, cluster_id,
+        disk_count=disk_count if disk_count is not None else
+        int(spec.get("disk_count", 2)),
+        disk_size_gb=disk_size_gb if disk_size_gb is not None else
+        int(spec.get("disk_size_gb",
+                     disks.get("disk_size_gb", 256))),
+        disk_type=spec.get("disk_type",
+                           disks.get("disk_type", "pd-ssd")),
+        vm_size=vm_size or spec.get("vm_size", "n2-standard-8"))
 
 
 @fs_cluster.command("del")

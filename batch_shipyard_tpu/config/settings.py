@@ -318,8 +318,13 @@ def pool_settings(config: dict) -> PoolSettings:
             provisioning_model=_get(
                 spec, "tpu", "provisioning_model", default="on_demand"),
             reservation_name=_get(spec, "tpu", "reservation_name"),
-            network=_get(spec, "tpu", "network"),
-            subnetwork=_get(spec, "tpu", "subnetwork"),
+            # The pool-level virtual_network block (reference
+            # pool.yaml vnet) is the fallback for the tpu-level
+            # network/subnetwork overrides.
+            network=_get(spec, "tpu", "network") or _get(
+                spec, "virtual_network", "name"),
+            subnetwork=_get(spec, "tpu", "subnetwork") or _get(
+                spec, "virtual_network", "subnet_name"),
         )
     scenario = None
     if _get(spec, "autoscale", "scenario") is not None:

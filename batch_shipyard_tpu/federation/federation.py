@@ -476,12 +476,21 @@ class FederationProcessor:
     def __init__(self, store: StateStore, owner: Optional[str] = None,
                  poll_interval: float = 1.0,
                  action_retry_delay: float = 5.0,
-                 gc_interval: float = 300.0) -> None:
+                 gc_interval: float = 300.0,
+                 after_success_blackout: float = 0.0) -> None:
         self.store = store
         self.owner = owner or f"fedproc-{uuid.uuid4().hex[:8]}"
         self.poll_interval = poll_interval
         self.action_retry_delay = action_retry_delay
         self.gc_interval = gc_interval
+        # proxy_options.scheduling.after_success_blackout_interval: a
+        # pool that just received a job is deprioritized for this many
+        # seconds, spreading rapid-fire placements across members
+        # (reference federation.py blackout semantics). Soft: when
+        # every eligible pool is blacked out, placement proceeds —
+        # capacity beats spreading.
+        self.after_success_blackout = after_success_blackout
+        self._blackout_until: dict[str, float] = {}
         self.stop_event = threading.Event()
         self._lease = None
         self._last_gc = 0.0
@@ -620,6 +629,7 @@ class FederationProcessor:
             eligible = filter_pool_nodes(
                 eligible, constraints,
                 required_nodes=_job_required_nodes(job))
+            eligible = self._apply_blackout(eligible)
             choice = greedy_best_fit(eligible)
         if choice is None:
             logger.info(
@@ -643,9 +653,24 @@ class FederationProcessor:
                               required_node=required_node)
         except jobs_mgr.JobExistsError:
             pass  # already scheduled by a previous attempt
+        self._note_placement(pool.id)
         logger.info("federation %s: job %s -> pool %s",
                     federation_id, job.id, pool.id)
         return True
+
+    def _apply_blackout(self, eligible: list[dict]) -> list[dict]:
+        if self.after_success_blackout <= 0 or not eligible:
+            return eligible
+        now = time.monotonic()
+        open_pools = [f for f in eligible
+                      if self._blackout_until.get(
+                          f["pool_id"], 0.0) <= now]
+        return open_pools or eligible
+
+    def _note_placement(self, pool_id: str) -> None:
+        if self.after_success_blackout > 0:
+            self._blackout_until[pool_id] = (
+                time.monotonic() + self.after_success_blackout)
 
     def _effective_node_pin(self, federation_id: str, job,
                             node_id: Optional[str]) -> Optional[str]:

@@ -68,6 +68,7 @@ def provision_proxy_vm(store: StateStore, federation_id: str,
                        replica: int = 0,
                        package_source: str = "batch-shipyard-tpu",
                        store_config_yaml: Optional[str] = None,
+                       public_ip: bool = True,
                        vms=None) -> str:
     """Create a proxy VM replica; returns its internal IP. Run more
     than one replica for HA — the store lease serializes them."""
@@ -78,7 +79,7 @@ def provision_proxy_vm(store: StateStore, federation_id: str,
         vms = GceVmManager(project, zone=zone, network=network)
     name = f"shipyard-fed-{federation_id}-proxy{replica}"
     ip = vms.create_vm(
-        name, vm_size,
+        name, vm_size, public_ip=public_ip,
         startup_script=generate_proxy_bootstrap(
             federation_id, package_source=package_source,
             store_config_yaml=store_config_yaml),

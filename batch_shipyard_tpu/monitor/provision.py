@@ -51,7 +51,7 @@ services:
     ports:
       - "{grafana_bind}{grafana_port}:3000"
     environment:
-      - GF_SECURITY_ADMIN_PASSWORD={grafana_password}
+      - "GF_SECURITY_ADMIN_PASSWORD={grafana_password}"
     volumes:
       - ./grafana/provisioning:/etc/grafana/provisioning:ro
       - ./grafana/dashboards:/var/lib/grafana/dashboards:ro
@@ -180,6 +180,7 @@ def generate_monitoring_bundle(
         grafana_port: int = 3000,
         grafana_password: str = "admin",
         scrape_interval: int = 15,
+        additional_dashboards: Optional[dict] = None,
         lets_encrypt_fqdn: Optional[str] = None,
         lets_encrypt_email: str = "admin@example.com",
         lets_encrypt_staging: bool = False) -> str:
@@ -202,6 +203,10 @@ def generate_monitoring_bundle(
     # With the TLS front enabled, bind Grafana/Prometheus to loopback
     # only so the nginx HTTPS proxy (and its HTTP->HTTPS redirect)
     # cannot be bypassed over plaintext host ports.
+    if any(c in grafana_password for c in "\n\r\""):
+        raise ValueError(
+            "grafana password must not contain newlines or double "
+            "quotes (it is embedded in docker-compose.yml)")
     bind = "127.0.0.1:" if lets_encrypt_fqdn else ""
     compose = _DOCKER_COMPOSE_YML.format(
         prom_port=prometheus_port, grafana_port=grafana_port,
@@ -229,6 +234,19 @@ def generate_monitoring_bundle(
                            "shipyard.json"), "w",
               encoding="utf-8") as fh:
         json.dump(_dashboard_json(), fh, indent=2)
+    # Extra dashboards (monitor.yaml grafana.additional_dashboards:
+    # name -> local JSON path or URL-less inline dict; reference
+    # additional_dashboards ship alongside the canned one).
+    for name, source in (additional_dashboards or {}).items():
+        dest = os.path.join(output_dir, "grafana", "dashboards",
+                            name if name.endswith(".json")
+                            else f"{name}.json")
+        if isinstance(source, dict):
+            with open(dest, "w", encoding="utf-8") as fh:
+                json.dump(source, fh, indent=2)
+        else:
+            import shutil as shutil_mod
+            shutil_mod.copyfile(source, dest)
     with open(os.path.join(output_dir, "shipyard-monitoring.service"),
               "w", encoding="utf-8") as fh:
         fh.write(_SYSTEMD_UNIT.format(bundle_dir=output_dir))
@@ -256,6 +274,7 @@ def provision_monitoring_vm(
         network: Optional[str] = None,
         vm_size: str = "e2-standard-2",
         name: str = "shipyard-monitor",
+        public_ip: bool = True,
         vms=None, **bundle_kwargs) -> str:
     """Create a GCE VM running the monitoring bundle end-to-end
     (reference convoy/monitor.py:126 create_monitoring_resource: the
@@ -301,6 +320,7 @@ systemctl daemon-reload
 systemctl enable --now shipyard-monitoring.service
 """
     ip = vms.create_vm(name, vm_size, startup_script=startup,
+                       public_ip=public_ip,
                        tags=("shipyard-monitor",))
     store.upsert_entity(_names.TABLE_MONITOR, "vms", name, {
         "internal_ip": ip, "state": "running",

@@ -194,15 +194,23 @@ def _parse_iso(value: str) -> float:
 
 
 def generate_slurm_conf(cluster_id: str, partitions: dict,
-                        controller_host: str = "localhost") -> str:
+                        controller_host: str = "localhost",
+                        idle_reclaim_seconds: int = 300,
+                        unmanaged_partitions: list = ()) -> str:
     """Generate slurm.conf elastic-partition stanzas with our
     Resume/Suspend programs (reference slurm.conf:101-103 + generated
-    wrappers, shipyard_slurm_master_bootstrap.sh:637-668)."""
+    wrappers, shipyard_slurm_master_bootstrap.sh:637-668).
+    ``idle_reclaim_seconds`` becomes SuspendTime (how long a node
+    sits idle before power-save reclaims it — slurm_options.
+    idle_reclaim_time_seconds); ``unmanaged_partitions`` are
+    passed-through static stanzas for nodes outside the burst
+    (reference unmanaged_partitions: each {partition: <line>,
+    nodes: [<NodeName lines>]})."""
     lines = [
         f"ClusterName={cluster_id}",
         f"SlurmctldHost={controller_host}",
         "SelectType=select/cons_tres",
-        "SuspendTime=300",
+        f"SuspendTime={int(idle_reclaim_seconds)}",
         "ResumeTimeout=900",
         "SuspendProgram=/opt/shipyard/slurm_suspend.sh",
         "ResumeProgram=/opt/shipyard/slurm_resume.sh",
@@ -218,4 +226,9 @@ def generate_slurm_conf(cluster_id: str, partitions: dict,
             f"PartitionName={name} Nodes={name}-[0-{count - 1}] "
             f"Default={'YES' if part.get('default') else 'NO'} "
             f"MaxTime=INFINITE State=UP")
+    for part in unmanaged_partitions or ():
+        for node_line in part.get("nodes", []):
+            lines.append(str(node_line))
+        if part.get("partition"):
+            lines.append(f"PartitionName={part['partition']}")
     return "\n".join(lines) + "\n"

@@ -293,6 +293,7 @@ def create_slurm_cluster(store: StateStore, cluster_id: str,
                          login_count: int = 0,
                          package_source: str = "batch-shipyard-tpu",
                          store_config_yaml: Optional[str] = None,
+                         public_ip: bool = True,
                          vms=None) -> dict:
     """Provision the control plane: controller VM (+ optional login
     VMs), record the cluster (reference slurm.py create_slurm_* +
@@ -306,7 +307,7 @@ def create_slurm_cluster(store: StateStore, cluster_id: str,
         vms = GceVmManager(project, zone=zone, network=network)
     controller_name = f"shipyard-slurm-{cluster_id}-controller"
     controller_ip = vms.create_vm(
-        controller_name, controller_vm_size,
+        controller_name, controller_vm_size, public_ip=public_ip,
         startup_script=generate_controller_bootstrap(
             cluster_id, slurm_conf, db_password,
             package_source=package_source,
@@ -316,7 +317,7 @@ def create_slurm_cluster(store: StateStore, cluster_id: str,
     for i in range(login_count):
         name = f"shipyard-slurm-{cluster_id}-login{i}"
         logins[name] = vms.create_vm(
-            name, login_vm_size,
+            name, login_vm_size, public_ip=public_ip,
             startup_script=generate_login_bootstrap(
                 cluster_id, slurm_conf,
                 package_source=package_source,

@@ -82,14 +82,20 @@ class GceVmManager:
                   startup_script: Optional[str] = None,
                   disks: Sequence[tuple[str, str]] = (),
                   tags: Sequence[str] = (),
-                  boot_disk_size_gb: int = 64) -> str:
+                  boot_disk_size_gb: int = 64,
+                  public_ip: bool = True) -> str:
         """Create a VM; returns its internal IP.
 
         disks: (disk_name, device_name) pairs to attach at create.
+        public_ip=False creates the VM with no external address
+        (monitor/federation/slurm yaml public_ip.enabled: false —
+        private-VPC-only service VMs).
         """
         args = ["instances", "create", name,
                 f"--machine-type={machine_type}",
                 f"--boot-disk-size={boot_disk_size_gb}GB"]
+        if not public_ip:
+            args.append("--no-address")
         if self.network:
             args.append(f"--network={self.network}")
         if tags:

@@ -128,6 +128,24 @@ def test_slurm_conf_generation():
     assert "NodeName=tpu-[0-3] State=CLOUD" in conf
     assert "ResumeProgram=" in conf
     assert "PartitionName=tpu" in conf
+    assert "SuspendTime=300" in conf  # default idle reclaim
+
+
+def test_slurm_conf_idle_reclaim_and_unmanaged_partitions():
+    """slurm_options.idle_reclaim_time_seconds -> SuspendTime;
+    unmanaged_partitions pass through as static stanzas (reference
+    unmanaged_partitions semantics)."""
+    conf = burst.generate_slurm_conf(
+        "clus", {"tpu": {"max_nodes": 2}},
+        idle_reclaim_seconds=900,
+        unmanaged_partitions=[{
+            "partition": "onprem Nodes=static-[0-3] Default=NO "
+                         "MaxTime=INFINITE State=UP",
+            "nodes": ["NodeName=static-[0-3] CPUs=64 State=UNKNOWN"],
+        }])
+    assert "SuspendTime=900" in conf
+    assert "NodeName=static-[0-3] CPUs=64 State=UNKNOWN" in conf
+    assert "PartitionName=onprem Nodes=static-[0-3]" in conf
 
 
 # ------------------------------ remotefs -------------------------------

@@ -110,7 +110,6 @@ def test_fs_bucket_mount_args(tmp_path):
             "storage": {"backend": "localfs",
                         "root": str(tmp_path / "store")}}},
         "fs": {"remote_fs": {
-            "resource_group": "rg",
             "gcs_buckets": {"shared-data": {
                 "bucket": "my-bucket",
                 "mount_options": ["implicit-dirs", "file-mode=644"],

@@ -329,3 +329,29 @@ def test_gc_removes_stale_placement_rows(env):
                                   grace_seconds=0.0) == ["gjob"]
     with pytest.raises(ValueError):
         fed.locate_federation_job(store, "fgc", "gjob")
+
+
+def test_after_success_blackout_spreads_placements(env):
+    """proxy_options.scheduling.after_success_blackout_interval: a
+    pool that just took a job is deprioritized for the window, so
+    rapid-fire submissions spread across members; with every pool
+    blacked out, placement still proceeds (capacity beats
+    spreading)."""
+    store, substrate = env
+    make_pool(store, substrate, "ba", "v5litepod-16")
+    make_pool(store, substrate, "bb", "v5litepod-16")
+    fed.create_federation(store, "fbo")
+    fed.add_pool_to_federation(store, "fbo", "ba")
+    fed.add_pool_to_federation(store, "fbo", "bb")
+    proc = fed.FederationProcessor(store, after_success_blackout=60.0)
+    for jid in ("j1", "j2", "j3"):
+        fed.submit_job_to_federation(store, "fbo", {
+            "job_specifications": [{
+                "id": jid, "tasks": [{"command": "echo b"}]}]})
+        assert proc.process_once() >= 1
+    placements = {row["_rk"]: row["pool_id"]
+                  for row in fed.list_federation_jobs(store, "fbo")}
+    # First two spread across both pools; third lands despite both
+    # being blacked out.
+    assert len(placements) == 3
+    assert set(placements.values()) == {"ba", "bb"}

@@ -384,3 +384,15 @@ def test_gcs_bucket_mount_commands_quote_user_values():
     assert "rm -rf" not in stripped
     assert "$(whoami)" not in stripped
     assert "reboot" not in stripped
+
+
+def test_create_vm_no_public_ip(vms):
+    """public_ip=False (monitor/federation/slurm public_ip.enabled:
+    false) creates the VM with --no-address."""
+    mgr, runner = vms
+    mgr.create_vm("private-vm", "e2-small", public_ip=False)
+    create = runner.calls[0]
+    assert "--no-address" in create
+    runner.calls.clear()
+    mgr.create_vm("public-vm", "e2-small")
+    assert "--no-address" not in runner.calls[0]
