@@ -278,10 +278,17 @@ class ServingFrontEnd:
                 isinstance(t, int) for t in prompt):
             raise ValueError("prompt must be a list of token ids")
         request_id = str(spec.get("request_id") or uuid.uuid4().hex[:12])
+        try:
+            max_new_tokens = int(spec.get("max_new_tokens", 16))
+            priority = int(spec.get("priority") or 0)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"max_new_tokens/priority must be integers: {exc}")
         request = Request(
             request_id=request_id, prompt=prompt,
-            max_new_tokens=int(spec.get("max_new_tokens", 16)),
-            eos_id=spec.get("eos_id"))
+            max_new_tokens=max_new_tokens,
+            eos_id=spec.get("eos_id"),
+            priority=priority)
         pending = _Pending(request, stream=stream)
         with self._inflight_lock:
             if (request_id in self._inflight or

@@ -38,6 +38,11 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    # Admission priority among QUEUED requests (higher admits first;
+    # ties FIFO). Active slots are never preempted for priority —
+    # this orders the wait line, like job.priority orders task
+    # queues.
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -304,7 +309,7 @@ class ContinuousBatcher:
                 f"{request.request_id}: prompt+generation "
                 f"{len(request.prompt)}+{request.max_new_tokens} "
                 f"exceeds max_decode_len {self.max_decode_len}")
-        self._queue.append(_QueueEntry(request))
+        self._enqueue(_QueueEntry(request))
 
     def pending(self) -> int:
         return len(self._queue) + sum(
@@ -433,8 +438,18 @@ class ContinuousBatcher:
         victim = min(candidates,
                      key=lambda j: len(self._slots[j].generated))
         slot = self._slots[victim]
-        self._queue.insert(
-            0, _QueueEntry(slot.request, list(slot.generated)))
+        # Preempted work resumes at the HEAD of its own priority
+        # class: ahead of waiting peers (it owns partial progress) but
+        # never ahead of strictly higher-priority entries — a plain
+        # head insert would let a low-priority victim starve a queued
+        # high-priority request under sustained page pressure.
+        entry = _QueueEntry(slot.request, list(slot.generated))
+        pos = 0
+        while (pos < len(self._queue) and
+               self._queue[pos].request.priority >
+               slot.request.priority):
+            pos += 1
+        self._queue.insert(pos, entry)
         self.preemptions += 1
         self._free_slot(victim)
         return victim
@@ -464,6 +479,16 @@ class ContinuousBatcher:
         while bucket < n:
             bucket *= 2
         return min(bucket, self.max_decode_len)
+
+    def _enqueue(self, entry: "_QueueEntry") -> None:
+        """Insert keeping the queue sorted by descending priority,
+        FIFO within a priority class."""
+        priority = entry.request.priority
+        for k in range(len(self._queue) - 1, -1, -1):
+            if self._queue[k].request.priority >= priority:
+                self._queue.insert(k + 1, entry)
+                return
+        self._queue.insert(0, entry)
 
     def _admit(self) -> None:
         for i, slot in enumerate(self._slots):

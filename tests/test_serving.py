@@ -321,3 +321,52 @@ def test_overcommit_beats_reservation_when_generations_are_short():
     # waves); overcommit admits all four at once.
     assert steps_o < steps_r, (steps_o, steps_r)
     assert preempts == 0
+
+
+def test_admission_priority_orders_the_wait_line(params):
+    """Queued requests admit in priority order (FIFO within a class);
+    active slots are never preempted for priority."""
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=1,
+                                       max_decode_len=64)
+    engine.submit(serving.Request("first", [1, 2],
+                                  max_new_tokens=8))
+    engine.step()  # 'first' occupies the single slot
+    engine.submit(serving.Request("low-a", [3], max_new_tokens=1))
+    engine.submit(serving.Request("low-b", [4], max_new_tokens=1))
+    engine.submit(serving.Request("hi", [5], max_new_tokens=1,
+                                  priority=9))
+    order = []
+    for _ in range(40):
+        for request_id, _tokens in engine.step():
+            order.append(request_id)
+        if len(order) == 4:
+            break
+    assert order[0] == "first"          # never preempted
+    assert order[1] == "hi"             # overtakes the queue
+    assert order[2:] == ["low-a", "low-b"]  # FIFO within class
+
+
+def test_preempted_victim_resumes_within_its_priority_class(params):
+    """A preempted low-priority request resumes ahead of its peers
+    but never ahead of a queued HIGHER-priority request."""
+    engine = serving.ContinuousBatcher(
+        CFG, params, num_slots=2, max_decode_len=64,
+        kv_page_size=4, kv_num_pages=4, overcommit=True)
+    engine.submit(serving.Request("low1", [1, 2],
+                                  max_new_tokens=12))
+    engine.submit(serving.Request("low2", [3, 4],
+                                  max_new_tokens=12))
+    engine.step()  # both lows admit and start decoding
+    engine.submit(serving.Request("hi", [5], max_new_tokens=1,
+                                  priority=5))
+    order = []
+    for _ in range(200):
+        for request_id, _tokens in engine.step():
+            order.append(request_id)
+        if len(order) == 3:
+            break
+    assert engine.preemptions >= 1, order  # page pressure DID preempt
+    # The high-priority request admitted into the freed capacity
+    # before the preempted low resumed.
+    assert order[0] == "hi", (order, engine.preemptions)
+    assert set(order[1:]) == {"low1", "low2"}
