@@ -101,6 +101,22 @@ def pool_resize(click_ctx, num_slices):
     fleet.action_pool_resize(_ctx(click_ctx), num_slices)
 
 
+@pool.command("exists")
+@click.option("--pool-id", default=None)
+@click.pass_context
+def pool_exists(click_ctx, pool_id):
+    """Exit 0 if the pool exists, 1 otherwise (reference
+    `pool exists`)."""
+    from batch_shipyard_tpu.pool import manager as pool_mgr
+    ctx = _ctx(click_ctx)
+    target = pool_id or ctx.pool.id
+    if pool_mgr.pool_exists(ctx.store, target):
+        click.echo(f"pool {target} exists")
+    else:
+        click.echo(f"pool {target} does not exist")
+        raise SystemExit(1)
+
+
 @pool.command("stats")
 @click.pass_context
 def pool_stats(click_ctx):
@@ -380,6 +396,23 @@ def jobs_tasks_del(click_ctx, job_id, task_id):
         jobs_mgr.delete_task(ctx.store, ctx.pool.id, job_id, task_id)
     except (jobs_mgr.JobNotFoundError, ValueError) as exc:
         raise click.ClickException(str(exc))
+
+
+@tasks.command("count")
+@click.argument("job_id")
+@click.pass_context
+def tasks_count(click_ctx, job_id):
+    """Task counts by state for a job (reference `jobs tasks
+    count`)."""
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    ctx = _ctx(click_ctx)
+    try:
+        stats = jobs_mgr.job_stats(ctx.store, ctx.pool.id, job_id)
+    except jobs_mgr.JobNotFoundError:
+        raise click.ClickException(f"job {job_id} does not exist")
+    fleet._emit({"job_id": job_id, "total": stats["tasks"],
+                 "by_state": stats["by_state"]},
+                click_ctx.obj["raw"])
 
 
 @tasks.command("term")

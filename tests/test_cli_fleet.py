@@ -129,3 +129,73 @@ def test_fs_bucket_mount_args(tmp_path):
         cli, ["--configdir", str(tmp_path), "fs", "bucket",
               "mount-args", "nope"])
     assert missing.exit_code != 0
+
+
+def test_pool_exists_and_tasks_count(tmp_path):
+    """`pool exists` exit semantics and `jobs tasks count` aggregation
+    (reference shipyard.py pool exists / tasks count verbs)."""
+    import yaml
+    from click.testing import CliRunner
+    from batch_shipyard_tpu.cli.main import cli
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "config": {"global_resources": {"docker_images": []}},
+        "pool": {"pool_specification": {
+            "id": "clip", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}},
+        "jobs": {"job_specifications": [{
+            "id": "cj", "tasks": [{"command": "echo one"},
+                                  {"command": "echo two"}]}]},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    runner = CliRunner()
+    base = ["--configdir", str(tmp_path)]
+    missing = runner.invoke(cli, base + ["pool", "exists"])
+    assert missing.exit_code == 1, missing.output
+    assert runner.invoke(
+        cli, base + ["pool", "add"]).exit_code == 0
+    present = runner.invoke(cli, base + ["pool", "exists"])
+    assert present.exit_code == 0, present.output
+    assert runner.invoke(
+        cli, base + ["jobs", "add"]).exit_code == 0
+    import time
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        out = runner.invoke(
+            cli, base + ["--raw", "jobs", "tasks", "count", "cj"])
+        assert out.exit_code == 0, out.output
+        import json as json_mod
+        counts = json_mod.loads(out.output)
+        if counts["by_state"].get("completed") == 2:
+            break
+        time.sleep(0.5)
+    assert counts["total"] == 2
+    assert counts["by_state"] == {"completed": 2}
+
+
+def test_tasks_count_unknown_job_errors(tmp_path):
+    import yaml
+    from click.testing import CliRunner
+    from batch_shipyard_tpu.cli.main import cli
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "pool": {"pool_specification": {
+            "id": "cx", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-4"},
+            "max_wait_time_seconds": 30}},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    out = CliRunner().invoke(
+        cli, ["--configdir", str(tmp_path), "jobs", "tasks", "count",
+              "ghost"])
+    assert out.exit_code != 0
+    assert "does not exist" in out.output
