@@ -199,3 +199,51 @@ def test_tasks_count_unknown_job_errors(tmp_path):
               "ghost"])
     assert out.exit_code != 0
     assert "does not exist" in out.output
+
+
+def test_data_ingress_cli_filters_unready_nodes(tmp_path, monkeypatch):
+    """'data ingress' with a shared-fs spec only shards onto READY
+    nodes (a booting/failed node must not receive transfer work)."""
+    from batch_shipyard_tpu.data import movement
+    src_dir = tmp_path / "payload"
+    src_dir.mkdir()
+    (src_dir / "x.bin").write_bytes(b"z" * 128)
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "config": {"global_resources": {"files": [{
+            "source": {"path": str(src_dir)},
+            "destination": {"path": "/mnt/shared"}}]}},
+        "pool": {"pool_specification": {
+            "id": "ingp", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-16"},
+            "max_wait_time_seconds": 30}},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    runner = CliRunner()
+    base = ["--configdir", str(tmp_path)]
+    assert runner.invoke(cli, base + ["pool", "add"]).exit_code == 0
+    # Mark one node unready out-of-band.
+    from batch_shipyard_tpu.state import names
+    from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+    store = LocalFSStateStore(str(tmp_path / "store"))
+    rows = list(store.query_entities(names.TABLE_NODES,
+                                     partition_key="ingp"))
+    store.merge_entity(names.TABLE_NODES, "ingp", rows[0]["_rk"],
+                       {"state": "start_task_failed"})
+    captured = {}
+
+    def fake_ingress(store_, conf, pool_id=None, node_logins=None,
+                     ssh_username="shipyard", ssh_private_key=None):
+        captured["logins"] = node_logins
+        return 0
+
+    monkeypatch.setattr(movement, "ingress_data", fake_ingress)
+    out = runner.invoke(cli, base + ["data", "ingress"])
+    assert out.exit_code == 0, out.output
+    login_ids = {n for n, _ip, _p in captured["logins"]}
+    assert rows[0]["_rk"] not in login_ids
+    assert len(login_ids) == len(rows) - 1

@@ -355,3 +355,26 @@ def test_after_success_blackout_spreads_placements(env):
     # being blacked out.
     assert len(placements) == 3
     assert set(placements.values()) == {"ba", "bb"}
+
+
+def test_destroy_federation_drops_placement_and_zap_rows(env):
+    store, substrate = env
+    make_pool(store, substrate, "dp1", "v5litepod-4")
+    fed.create_federation(store, "fdel")
+    fed.add_pool_to_federation(store, "fdel", "dp1")
+    fed.submit_job_to_federation(store, "fdel", {
+        "job_specifications": [{
+            "id": "dj", "tasks": [{"command": "echo d"}]}]})
+    fed.FederationProcessor(store).process_once()
+    jobs_mgr.wait_for_tasks(store, "dp1", "dj", timeout=30)
+    fed.zap_action(store, "fdel", "someaction")
+    from batch_shipyard_tpu.state import names
+    assert list(store.query_entities(names.TABLE_FEDJOBS,
+                                     partition_key="fdel"))
+    fed.destroy_federation(store, "fdel")
+    # Every row (placement + zap) went with the federation
+    # (reference gc on destroy, convoy/storage.py:898).
+    assert list(store.query_entities(names.TABLE_FEDJOBS,
+                                     partition_key="fdel")) == []
+    with pytest.raises(ValueError):
+        fed.get_federation(store, "fdel")
