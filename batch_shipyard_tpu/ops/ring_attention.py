@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,28 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from batch_shipyard_tpu.ops import attention as attn_ops
+from batch_shipyard_tpu.ops import kernel_select
+
+
+def resolve_ring_impl(impl: str = "auto") -> str:
+    """Resolve 'auto' to a concrete ring implementation.
+
+    Priority: explicit impl > SHIPYARD_RING_IMPL env > the
+    KERNEL_VALIDATION.json marker via ops/kernel_select ('flash' only
+    when the flash_ring check passed on a TPU backend AND the current
+    backend is tpu) > 'xla'. CPU always resolves to 'xla' — pallas
+    interpret mode aborts inside shard_map there.
+    """
+    if impl != "auto":
+        return impl
+    env = os.environ.get("SHIPYARD_RING_IMPL")
+    if env:
+        if env not in ("flash", "xla"):
+            raise ValueError(
+                f"SHIPYARD_RING_IMPL={env!r}: must be flash or xla")
+        return env
+    return kernel_select.resolve_auto("flash_ring",
+                                      pallas_impl="flash")
 
 
 def _flash_ring_rotation(q, k_cur, v_cur, my_idx, src, causal: bool):
@@ -160,14 +183,12 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """Global-view entry: q/k/v are [B, T, H, D] global arrays; returns
     the exact attention output with T sharded over axis_name.
 
-    impl: 'flash' (Pallas kernels per rotation — the TPU fast path;
-    its building blocks are oracle-tested but the in-shard_map
-    composition awaits multi-chip pod validation, see ROADMAP.md),
-    'xla' (pure-XLA online softmax — runs anywhere; the default), or
-    'auto' (currently 'xla'; flips to flash once pod-validated).
+    impl: 'flash' (Pallas kernels per rotation — the TPU fast path),
+    'xla' (pure-XLA online softmax — runs anywhere), or 'auto'
+    (resolved by resolve_ring_impl: flash on a TPU backend once the
+    KERNEL_VALIDATION.json marker records an on-chip pass, else xla).
     """
-    if impl == "auto":
-        impl = "xla"
+    impl = resolve_ring_impl(impl)
     if impl == "flash":
         t_local = q.shape[1] // mesh.shape[axis_name]
         if not attn_ops.flash_shapes_ok(t_local, t_local):

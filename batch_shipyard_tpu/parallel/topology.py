@@ -170,3 +170,46 @@ def is_tpu_accelerator(accelerator_type: str) -> bool:
         return True
     except ValueError:
         return False
+
+
+# jax device_kind substrings -> generation key. Checked in order, so
+# more specific strings ("v5p", "v5 lite") precede bare version
+# matches. Covers the public PJRT device_kind spellings ("TPU v4",
+# "TPU v5 lite", "TPU v5p", "TPU v6 lite" / "TPU v6e" aka Trillium).
+_DEVICE_KIND_PATTERNS: tuple[tuple[str, str], ...] = (
+    ("v5 lite", "v5litepod"),
+    ("v5lite", "v5litepod"),
+    ("v5e", "v5litepod"),
+    ("v5p", "v5p"),
+    ("v6 lite", "v6e"),
+    ("v6e", "v6e"),
+    ("trillium", "v6e"),
+    ("v2", "v2"),
+    ("v3", "v3"),
+    ("v4", "v4"),
+    ("v5", "v5p"),
+    ("v6", "v6e"),
+)
+
+
+def generation_for_device_kind(device_kind: str
+                               ) -> Optional[TpuGeneration]:
+    """Map a jax ``device.device_kind`` string (e.g. ``"TPU v5 lite"``)
+    to its generation table entry, or None for non-TPU backends (cpu
+    "cpu", gpu device names). Used by bench MFU accounting to pick the
+    peak-FLOPs denominator for whatever chip answered."""
+    kind = device_kind.strip().lower()
+    if "tpu" not in kind:
+        return None
+    for pattern, gen_name in _DEVICE_KIND_PATTERNS:
+        if pattern in kind:
+            return _GENERATIONS[gen_name]
+    return None
+
+
+def peak_bf16_tflops_for_device_kind(device_kind: str
+                                     ) -> Optional[float]:
+    """Per-chip bf16 peak TFLOP/s for a jax device_kind, or None when
+    the backend is not a recognized TPU (MFU is then unreportable)."""
+    gen = generation_for_device_kind(device_kind)
+    return None if gen is None else gen.bf16_tflops_per_chip

@@ -1,0 +1,166 @@
+"""Chunked cross-entropy tests: Pallas kernel (interpret mode) and
+scan-chunked XLA path vs the dense oracle — forward and gradients —
+plus the lm_loss_chunked delegation, validation-marker-gated auto
+dispatch (ops/kernel_select), and the silicon-proof dry-run."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batch_shipyard_tpu.ops import chunked_loss as cl
+from batch_shipyard_tpu.ops import kernel_select, ring_attention
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _dense_loss(h, e, t, ignore_id=-1):
+    d = h.shape[-1]
+    logits = (h.reshape(-1, d).astype(jnp.float32)
+              @ e.astype(jnp.float32).T)
+    tg = t.reshape(-1)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, tg[:, None].clip(0), axis=-1)[:, 0]
+    mask = (tg != ignore_id)
+    return jnp.sum((lse - gold) * mask) / jnp.maximum(
+        jnp.sum(mask), 1)
+
+
+def _rand(b, t, d, v, seed=0):
+    rng = np.random.RandomState(seed)
+    h = jnp.asarray(rng.randn(b, t, d), jnp.float32)
+    e = jnp.asarray(rng.randn(v, d) / np.sqrt(d), jnp.float32)
+    tg = jnp.asarray(rng.randint(0, v, (b, t)), jnp.int32)
+    return h, e, tg
+
+
+@pytest.mark.parametrize("impl", ["xla", "interpret"])
+@pytest.mark.parametrize(
+    # Ragged rows (b*t % 128 != 0) and ragged vocab (v % v_chunk != 0)
+    # exercise the padding + in-kernel tail-mask paths.
+    "b,t,d,v", [(2, 128, 128, 1024), (2, 96, 128, 700),
+                (1, 64, 256, 512)])
+def test_loss_matches_dense_oracle(impl, b, t, d, v):
+    h, e, tg = _rand(b, t, d, v)
+    tg = tg.at[0, :5].set(-1)  # exercise the ignore mask
+    got = jax.jit(lambda h, e: cl.chunked_softmax_xent(
+        h, e, tg, impl=impl))(h, e)
+    want = _dense_loss(h, e, tg)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "interpret"])
+def test_grads_match_dense_oracle(impl):
+    h, e, tg = _rand(2, 96, 128, 700, seed=3)
+    tg = tg.at[1, -9:].set(-1)
+
+    def loss(h, e):
+        return cl.chunked_softmax_xent(h, e, tg, impl=impl)
+
+    gh, ge = jax.grad(loss, argnums=(0, 1))(h, e)
+    rh, re = jax.grad(lambda h, e: _dense_loss(h, e, tg),
+                      argnums=(0, 1))(h, e)
+    for a, b_ in ((gh, rh), (ge, re)):
+        rel = (np.linalg.norm(np.asarray(a - b_))
+               / max(np.linalg.norm(np.asarray(b_)), 1e-30))
+        assert rel < 1e-5
+
+
+def test_all_tokens_ignored_is_finite():
+    h, e, tg = _rand(1, 128, 128, 512, seed=5)
+    tg = jnp.full_like(tg, -1)
+    for impl in ("xla", "interpret"):
+        got = cl.chunked_softmax_xent(h, e, tg, impl=impl)
+        assert float(got) == 0.0
+        gh = jax.grad(lambda h: cl.chunked_softmax_xent(
+            h, e, tg, impl=impl))(h)
+        assert np.all(np.isfinite(np.asarray(gh)))
+        assert float(jnp.sum(jnp.abs(gh))) == 0.0
+
+
+def test_lm_loss_chunked_delegates_and_matches():
+    from batch_shipyard_tpu.models import transformer as tfm
+    h, e, tg = _rand(2, 64, 128, 512, seed=7)
+    got = tfm.lm_loss_chunked(h, e, tg)
+    want = _dense_loss(h, e, tg)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_lane_misaligned_dim_falls_back_to_xla():
+    # d % 128 != 0 must silently take the XLA path, not crash.
+    h, e, tg = _rand(1, 64, 96, 300, seed=9)
+    got = cl.chunked_softmax_xent(h, e, tg, impl="pallas")
+    want = _dense_loss(h, e, tg)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+# -- validation-marker dispatch (ops/kernel_select) -----------------
+
+def test_auto_resolves_xla_on_cpu_even_with_marker(tmp_path,
+                                                   monkeypatch):
+    marker = tmp_path / "KERNEL_VALIDATION.json"
+    marker.write_text(json.dumps({
+        "flash_ring": {"ok": True, "backend": "tpu"},
+        "chunked_cross_entropy": {"ok": True, "backend": "tpu"}}))
+    monkeypatch.setenv(kernel_select.MARKER_ENV, str(marker))
+    # kernel_validated sees the tpu-backed pass...
+    assert kernel_select.kernel_validated("flash_ring")
+    # ...but auto still refuses Pallas paths on the cpu backend.
+    assert kernel_select.resolve_auto("flash_ring",
+                                      pallas_impl="flash") == "xla"
+    assert ring_attention.resolve_ring_impl("auto") == "xla"
+
+
+def test_cpu_backed_marker_does_not_validate(tmp_path, monkeypatch):
+    marker = tmp_path / "KERNEL_VALIDATION.json"
+    marker.write_text(json.dumps({
+        "flash_ring": {"ok": True, "backend": "cpu"}}))
+    monkeypatch.setenv(kernel_select.MARKER_ENV, str(marker))
+    assert not kernel_select.kernel_validated("flash_ring")
+
+
+def test_ring_impl_env_override_and_priority(monkeypatch):
+    monkeypatch.setenv("SHIPYARD_RING_IMPL", "flash")
+    assert ring_attention.resolve_ring_impl("auto") == "flash"
+    # Explicit impl beats the env var.
+    assert ring_attention.resolve_ring_impl("xla") == "xla"
+    monkeypatch.setenv("SHIPYARD_RING_IMPL", "bogus")
+    with pytest.raises(ValueError):
+        ring_attention.resolve_ring_impl("auto")
+
+
+def test_missing_marker_means_not_validated(monkeypatch, tmp_path):
+    monkeypatch.setenv(kernel_select.MARKER_ENV,
+                       str(tmp_path / "absent.json"))
+    assert kernel_select.kernel_validation() == {}
+    assert not kernel_select.kernel_validated("flash_ring")
+
+
+# -- silicon-proof pipeline dry run ---------------------------------
+
+def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools/silicon_proof.py"),
+         "--dry-run", "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(
+        (tmp_path / "SILICON_PROOF.json").read_text())
+    assert report["dry_run"] is True
+    names = [p["phase"] for p in report["phases"]]
+    assert names == ["probe", "kernel_checks", "flash_flip",
+                     "tuning_ab", "final_bench"]
+    assert all(p["status"] == "dry_run" for p in report["phases"])
+    # The tuning plan must cover every profile with a runnable command.
+    plan = report["phases"][3]["plan"]
+    from batch_shipyard_tpu.parallel.tuning import PROFILES
+    assert set(plan) == set(PROFILES)
+    assert all("bench.py --quick" in cmd for cmd in plan.values())

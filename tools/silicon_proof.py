@@ -1,0 +1,303 @@
+#!/usr/bin/env python3
+"""One-shot silicon proof pipeline (VERDICT r4 next #1).
+
+The TPU relay has been wedged for three rounds; the moment it answers,
+everything the rounds have been waiting to prove must happen in ONE
+unattended pass, with no builder in the loop. tools/bench_retry.sh
+invokes this script on the first successful probe; it:
+
+  1. probe          — subprocess device probe with a hard timeout
+                      (utils/util.probe_default_devices).
+  2. kernel_checks  — tools/tpu_checks.py --write-marker: every Pallas
+                      kernel (flash fwd/bwd, flash-ring, paged
+                      attention, int8, fused norm, chunked
+                      cross-entropy) vs its oracle ON THE CHIP,
+                      results persisted as KERNEL_VALIDATION.json.
+  3. flash_flip     — confirms ops/ring_attention.resolve_ring_impl
+                      and ops/chunked_loss impl='auto' now resolve to
+                      their Pallas paths (the marker is the flip: no
+                      code edit).
+  4. tuning_ab      — bench.py --quick per parallel/tuning.py profile
+                      (fresh subprocess each: XLA_FLAGS are read at
+                      backend init); winner by throughput geomean
+                      persisted as TUNING_SELECTED.json, which
+                      bench.py auto-applies from then on.
+  5. final_bench    — full bench.py under the winning profile; the
+                      one-line JSON lands in BENCH_LATEST.json and
+                      BENCH_DETAILS.json carries explicit per-workload
+                      MFU%% (parallel/mfu.py).
+
+Every phase's outcome is recorded in SILICON_PROOF.json; --dry-run
+writes the complete report skeleton on CPU (each phase records the
+exact command it would run) so the pipeline itself is CI-testable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+PROBE_TIMEOUT = 240
+CHECKS_TIMEOUT = 1800
+BENCH_QUICK_TIMEOUT = 1800
+BENCH_FULL_TIMEOUT = 2400
+
+
+def _run(cmd: list[str], timeout: int, env: dict | None = None,
+         log_path: pathlib.Path | None = None) -> tuple[int, str]:
+    """Run a child with a hard timeout, capturing combined output."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    try:
+        proc = subprocess.run(
+            cmd, cwd=str(REPO_ROOT), env=full_env,
+            capture_output=True, timeout=timeout, text=True)
+        out = proc.stdout + proc.stderr
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as exc:
+        out = ((exc.stdout or b"").decode(errors="replace")
+               if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+               ) + f"\nTIMEOUT after {timeout}s"
+        rc = 124
+    if log_path is not None:
+        log_path.write_text(out, encoding="utf-8")
+    return rc, out
+
+
+class Pipeline:
+    def __init__(self, out_dir: pathlib.Path, dry_run: bool,
+                 skip_tuning: bool):
+        self.out = out_dir
+        self.dry = dry_run
+        self.skip_tuning = skip_tuning
+        self.marker = self.out / "KERNEL_VALIDATION.json"
+        self.phases: list[dict] = []
+        # Children must consult OUR marker (tests point out_dir at a
+        # tmp dir; production uses the repo root ops read by default).
+        self.child_env = {"SHIPYARD_KERNEL_VALIDATION":
+                          str(self.marker)}
+
+    def record(self, name: str, status: str, **extra) -> dict:
+        entry = {"phase": name, "status": status, **extra}
+        self.phases.append(entry)
+        print(f"[silicon-proof] {name}: {status} "
+              + json.dumps({k: v for k, v in extra.items()
+                            if k != "output_tail"}))
+        return entry
+
+    # -- phases ----------------------------------------------------
+    def probe(self) -> bool:
+        cmd_doc = "probe_default_devices(timeout=%d)" % PROBE_TIMEOUT
+        if self.dry:
+            self.record("probe", "dry_run", command=cmd_doc)
+            return True
+        from batch_shipyard_tpu.utils.util import probe_default_devices
+        count, reason = probe_default_devices(timeout=PROBE_TIMEOUT)
+        if reason is not None or count < 1:
+            self.record("probe", "failed",
+                        error=reason or "no devices")
+            return False
+        self.record("probe", "ok", device_count=count)
+        return True
+
+    def kernel_checks(self) -> dict:
+        cmd = [sys.executable, "tools/tpu_checks.py",
+               "--write-marker", str(self.marker)]
+        if self.dry:
+            self.record("kernel_checks", "dry_run",
+                        command=" ".join(cmd))
+            return {}
+        rc, out = _run(cmd, CHECKS_TIMEOUT,
+                       log_path=self.out / "TPU_CHECKS_r05.txt")
+        try:
+            with open(self.marker, encoding="utf-8") as fh:
+                results = json.load(fh)
+        except (OSError, ValueError):
+            results = {}
+        self.record(
+            "kernel_checks", "ok" if rc == 0 else "partial",
+            rc=rc, results={k: v.get("ok") for k, v in
+                            results.items()},
+            output_tail=out[-2000:])
+        return results
+
+    def flash_flip(self, results: dict) -> None:
+        if self.dry:
+            self.record(
+                "flash_flip", "dry_run",
+                note="resolve_ring_impl('auto') + chunked-loss auto "
+                     "re-checked in a TPU subprocess once the marker "
+                     "exists")
+            return
+        # Resolution must be observed on the TPU backend — a fresh
+        # subprocess, exactly as a user training run would see it.
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from batch_shipyard_tpu.ops import ring_attention as r\n"
+            "from batch_shipyard_tpu.ops import kernel_select as ks\n"
+            "print('ring=' + r.resolve_ring_impl('auto'))\n"
+            "print('xent=' + ks.resolve_auto('chunked_cross_entropy'"
+            ", pallas_impl='pallas'))\n" % str(REPO_ROOT))
+        rc, out = _run([sys.executable, "-c", code], PROBE_TIMEOUT,
+                       env=self.child_env)
+        ring = "flash" if "ring=flash" in out else "xla"
+        xent = "pallas" if "xent=pallas" in out else "xla"
+        expect_ring = bool(results.get("flash_ring", {}).get("ok"))
+        expect_xent = bool(
+            results.get("chunked_cross_entropy", {}).get("ok"))
+        ok = (rc == 0
+              and (ring == "flash") == expect_ring
+              and (xent == "pallas") == expect_xent)
+        self.record("flash_flip", "ok" if ok else "failed",
+                    ring_impl=ring, chunked_xent_impl=xent,
+                    rc=rc, output_tail=out[-500:])
+
+    def tuning_ab(self) -> str | None:
+        from batch_shipyard_tpu.parallel.tuning import PROFILES
+        plan = {
+            profile: (f"SHIPYARD_XLA_TUNING={profile} {sys.executable}"
+                      f" bench.py --quick --workloads "
+                      f"resnet,transformer --details-out "
+                      f"{self.out}/tuning_{profile}.json")
+            for profile in PROFILES
+        }
+        if self.dry or self.skip_tuning:
+            self.record("tuning_ab",
+                        "dry_run" if self.dry else "skipped",
+                        plan=plan)
+            return None
+        measurements: dict = {}
+        for profile in PROFILES:
+            details_path = self.out / f"tuning_{profile}.json"
+            rc, out = _run(
+                [sys.executable, "bench.py", "--quick", "--workloads",
+                 "resnet,transformer", "--details-out",
+                 str(details_path)],
+                BENCH_QUICK_TIMEOUT,
+                env={**self.child_env,
+                     "SHIPYARD_XLA_TUNING": profile})
+            entry: dict = {"rc": rc}
+            try:
+                with open(details_path, encoding="utf-8") as fh:
+                    det = json.load(fh)
+                entry["resnet_img_s"] = det.get("resnet50", {}).get(
+                    "images_per_sec_per_chip")
+                entry["transformer_tok_s"] = det.get(
+                    "transformer", {}).get("tokens_per_sec_per_chip")
+            except (OSError, ValueError):
+                entry["error"] = out[-400:]
+            measurements[profile] = entry
+
+        def score(m: dict) -> float:
+            r = m.get("resnet_img_s") or 0.0
+            t = m.get("transformer_tok_s") or 0.0
+            return (r * t) ** 0.5 if r and t else max(r, t)
+
+        winner = max(measurements, key=lambda p:
+                     score(measurements[p]))
+        if score(measurements[winner]) <= 0:
+            self.record("tuning_ab", "failed",
+                        measurements=measurements)
+            return None
+        selected = {"winner": winner, "measurements": measurements,
+                    "selected_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        with open(self.out / "TUNING_SELECTED.json", "w",
+                  encoding="utf-8") as fh:
+            json.dump(selected, fh, indent=2)
+        self.record("tuning_ab", "ok", winner=winner,
+                    measurements=measurements)
+        return winner
+
+    def final_bench(self, winner: str | None) -> None:
+        env = dict(self.child_env)
+        if winner:
+            env["SHIPYARD_XLA_TUNING"] = winner
+        cmd = [sys.executable, "bench.py", "--details-out",
+               str(self.out / "BENCH_DETAILS.json")]
+        if self.dry:
+            self.record("final_bench", "dry_run",
+                        command=" ".join(cmd))
+            return
+        rc, out = _run(cmd, BENCH_FULL_TIMEOUT, env=env)
+        last = out.strip().splitlines()[-1] if out.strip() else ""
+        parsed = None
+        try:
+            parsed = json.loads(last)
+            with open(self.out / "BENCH_LATEST.json", "w",
+                      encoding="utf-8") as fh:
+                fh.write(last + "\n")
+        except ValueError:
+            pass
+        mfu = {}
+        try:
+            with open(self.out / "BENCH_DETAILS.json",
+                      encoding="utf-8") as fh:
+                det = json.load(fh)
+            for k in ("resnet50", "transformer", "transformer_int8"):
+                if isinstance(det.get(k), dict):
+                    mfu[k] = det[k].get("mfu_pct")
+        except (OSError, ValueError):
+            pass
+        self.record("final_bench",
+                    "ok" if rc == 0 and parsed else "failed",
+                    rc=rc, headline=parsed, mfu_pct=mfu,
+                    output_tail=out[-1000:])
+
+    # -- driver ----------------------------------------------------
+    def run(self) -> int:
+        started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        ok = self.probe()
+        results: dict = {}
+        if ok:
+            results = self.kernel_checks()
+            self.flash_flip(results)
+            winner = self.tuning_ab()
+            self.final_bench(winner)
+        report = {
+            "started_at": started,
+            "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "dry_run": self.dry,
+            "phases": self.phases,
+        }
+        with open(self.out / "SILICON_PROOF.json", "w",
+                  encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+        bad = [p for p in self.phases
+               if p["status"] in ("failed", "partial")]
+        print(f"[silicon-proof] report: "
+              f"{self.out / 'SILICON_PROOF.json'} "
+              f"({len(self.phases)} phases, {len(bad)} not ok)")
+        return 1 if bad else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dry-run", action="store_true",
+                        help="write the full report skeleton without "
+                        "touching an accelerator (CI path)")
+    parser.add_argument("--out-dir", default=str(REPO_ROOT),
+                        help="where reports land (default: repo "
+                        "root)")
+    parser.add_argument("--skip-tuning", action="store_true",
+                        help="skip the profile A/B (bench under the "
+                        "default profile only)")
+    args = parser.parse_args(argv)
+    out_dir = pathlib.Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    return Pipeline(out_dir, args.dry_run, args.skip_tuning).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

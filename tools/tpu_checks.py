@@ -18,6 +18,7 @@ are meaningful (the TPU default is bf16-pass matmuls, ~1e-3 relative).
 
 from __future__ import annotations
 
+import json
 import pathlib
 import sys
 
@@ -33,10 +34,11 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 
-def check_flash_ring_virtual_shards() -> None:
+def check_flash_ring_virtual_shards() -> bool:
     from batch_shipyard_tpu.ops import attention as attn
     from batch_shipyard_tpu.ops import ring_attention as ring
 
+    all_ok = True
     rng = np.random.RandomState(3)
     shape = (1, 512, 2, 64)  # unit scale: no atol masking
     q = jnp.asarray(rng.randn(*shape), jnp.float32)
@@ -73,15 +75,16 @@ def check_flash_ring_virtual_shards() -> None:
                   f"fwd_rel={rel_f:.2e} "
                   f"grad_rels={[f'{r:.2e}' for r in rels]} "
                   f"{'OK' if ok else 'FAIL'}")
-            if not ok:
-                raise SystemExit(1)
+            all_ok = all_ok and ok
+    return all_ok
 
 
-def check_flash_single_chip() -> None:
+def check_flash_single_chip() -> bool:
     """flash_attention (Pallas fwd+bwd kernels) vs the dense oracle on
     the real MXU — the single-chip kernel the training path runs."""
     from batch_shipyard_tpu.ops import attention as attn
 
+    all_ok = True
     rng = np.random.RandomState(7)
     shape = (2, 1024, 4, 64)
     q = jnp.asarray(rng.randn(*shape), jnp.float32)
@@ -111,11 +114,11 @@ def check_flash_single_chip() -> None:
         print(f"flash single-chip causal={causal}: fwd_rel={rel_f:.2e}"
               f" grad_rels={[f'{r:.2e}' for r in rels]} "
               f"{'OK' if ok else 'FAIL'}")
-        if not ok:
-            raise SystemExit(1)
+        all_ok = all_ok and ok
+    return all_ok
 
 
-def check_paged_attention() -> None:
+def check_paged_attention() -> bool:
     """Pallas paged-decode kernel vs the XLA gather oracle with random
     block tables and ragged lengths — the serving engine's headline
     kernel, previously validated only in interpret mode (VERDICT r2
@@ -145,11 +148,10 @@ def check_paged_attention() -> None:
     ok = rel < 1e-4
     print(f"paged-attention kernel vs xla: rel={rel:.2e} "
           f"{'OK' if ok else 'FAIL'}")
-    if not ok:
-        raise SystemExit(1)
+    return ok
 
 
-def check_int8_matmul() -> None:
+def check_int8_matmul() -> bool:
     """quantize_int8 + int8_matmul on the real MXU: the quantized
     product must sit within the per-element quantization error bound
     of the fp32 product."""
@@ -167,11 +169,10 @@ def check_int8_matmul() -> None:
     ok = rel < 0.02
     print(f"int8 quantized_linear vs fp32: rel={rel:.2e} "
           f"{'OK' if ok else 'FAIL'}")
-    if not ok:
-        raise SystemExit(1)
+    return ok
 
 
-def check_fused_norm() -> None:
+def check_fused_norm() -> bool:
     """Pallas fused RMSNorm+matmul vs the unfused XLA composition on
     the real chip (fwd; bwd is shared XLA code)."""
     from batch_shipyard_tpu.ops import fused_norm as fn
@@ -189,19 +190,99 @@ def check_fused_norm() -> None:
     ok = rel < 1e-4
     print(f"fused rmsnorm_matmul pallas vs xla: rel={rel:.2e} "
           f"{'OK' if ok else 'FAIL'}")
-    if not ok:
-        raise SystemExit(1)
+    return ok
 
 
-def main() -> None:
-    print(f"backend={jax.default_backend()} devices={jax.devices()}")
-    check_flash_single_chip()
-    check_flash_ring_virtual_shards()
-    check_paged_attention()
-    check_int8_matmul()
-    check_fused_norm()
-    print("ALL TPU CHECKS OK")
+# Check name -> callable; names are the KERNEL_VALIDATION.json keys
+# that ops/ring_attention.resolve_ring_impl (flash_ring) and the
+# silicon-proof report consume.
+CHECKS = {
+    "flash_single_chip": check_flash_single_chip,
+    "flash_ring": check_flash_ring_virtual_shards,
+    "paged_attention": check_paged_attention,
+    "int8_matmul": check_int8_matmul,
+    "fused_norm": check_fused_norm,
+    "chunked_cross_entropy": None,  # bound below (round-5 kernel)
+}
+
+
+def check_chunked_cross_entropy() -> bool:
+    """Pallas chunked cross-entropy vs the XLA chunked loss on the
+    real chip (fwd + grad wrt hidden/embedding)."""
+    from batch_shipyard_tpu.ops import chunked_loss as cl
+
+    rng = np.random.RandomState(19)
+    batch, t_len, d, vocab = 2, 256, 128, 1024
+    hidden = jnp.asarray(rng.randn(batch, t_len, d), jnp.float32)
+    embed = jnp.asarray(rng.randn(vocab, d) / 11.3, jnp.float32)
+    targets = jnp.asarray(rng.randint(0, vocab, (batch, t_len)),
+                          jnp.int32)
+    targets = targets.at[0, :7].set(-1)  # exercise the ignore mask
+
+    def loss_pl(h, e):
+        return cl.chunked_softmax_xent(h, e, targets, impl="pallas")
+
+    def loss_ref(h, e):
+        return cl.chunked_softmax_xent(h, e, targets, impl="xla")
+
+    out = jax.jit(loss_pl)(hidden, embed)
+    ref = jax.jit(loss_ref)(hidden, embed)
+    rel_f = abs(float(out - ref)) / max(abs(float(ref)), 1e-30)
+    g_pl = jax.jit(jax.grad(loss_pl, argnums=(0, 1)))(hidden, embed)
+    g_rf = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(hidden, embed)
+    rels = [np.linalg.norm(np.asarray(a - b)) /
+            max(np.linalg.norm(np.asarray(b)), 1e-30)
+            for a, b in zip(g_pl, g_rf)]
+    ok = rel_f < 1e-5 and all(r < 1e-4 for r in rels)
+    print(f"chunked cross-entropy pallas vs xla: fwd_rel={rel_f:.2e} "
+          f"grad_rels={[f'{r:.2e}' for r in rels]} "
+          f"{'OK' if ok else 'FAIL'}")
+    return ok
+
+
+CHECKS["chunked_cross_entropy"] = check_chunked_cross_entropy
+
+
+def run_all(write_marker: str | None = None) -> dict:
+    """Run every check, returning {name: {ok, error?, backend}}. When
+    write_marker is a path, persist the results there — that file is
+    the KERNEL_VALIDATION.json consumed by resolve_ring_impl, so a
+    passing run flips impl='auto' rings to flash durably."""
+    import traceback
+
+    backend = jax.default_backend()
+    print(f"backend={backend} devices={jax.devices()}")
+    results: dict = {}
+    for name, fn in CHECKS.items():
+        try:
+            ok = bool(fn())
+            results[name] = {"ok": ok, "backend": backend}
+        except Exception as exc:  # noqa: BLE001 - record, keep going
+            traceback.print_exc()
+            results[name] = {"ok": False, "backend": backend,
+                             "error": f"{type(exc).__name__}: {exc}"}
+            print(f"{name}: EXCEPTION {exc}")
+    if write_marker:
+        with open(write_marker, "w", encoding="utf-8") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"wrote {write_marker}")
+    n_ok = sum(1 for r in results.values() if r["ok"])
+    print(f"{n_ok}/{len(results)} TPU checks OK"
+          + ("" if n_ok < len(results) else " — ALL TPU CHECKS OK"))
+    return results
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write-marker", metavar="PATH", default=None,
+        help="persist per-check results as KERNEL_VALIDATION.json")
+    args = parser.parse_args(argv)
+    results = run_all(write_marker=args.write_marker)
+    return 0 if all(r["ok"] for r in results.values()) else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
