@@ -391,6 +391,128 @@ class NodeAgent:
         elif kind == "term_task":
             self._terminate_running_task(control["job_id"],
                                          control["task_id"])
+        elif kind in ("ps", "zap", "prune"):
+            # A verb that outlived its caller's wait must not execute:
+            # a zap landing minutes after the operator saw "offline"
+            # would kill tasks nobody asked about anymore (the reply
+            # would also never be read — skip writing it).
+            expires_at = control.get("expires_at")
+            if expires_at is not None and time.time() > expires_at:
+                logger.warning("dropping expired %s control "
+                               "(%.0fs past deadline)", kind,
+                               time.time() - expires_at)
+                return
+            if kind == "ps":
+                self._control_reply(control, self._ps_report())
+            elif kind == "zap":
+                self._control_reply(control, self._zap())
+            else:
+                self._control_reply(control, self._prune_images())
+
+    def _control_reply(self, control: dict, payload: dict) -> None:
+        """Write a request/reply control verb's result to the object
+        store under the caller-supplied reply key (pool/manager.py
+        send_control_and_wait polls it). Fire-and-forget when the
+        caller did not ask for a reply."""
+        reply_key = control.get("reply_key")
+        if not reply_key:
+            return
+        payload = dict(payload,
+                       node_id=self.identity.node_id,
+                       replied_at=util.datetime_utcnow_iso())
+        self.store.put_object(reply_key,
+                              json.dumps(payload).encode())
+
+    def _ps_report(self) -> dict:
+        """Live task/container inventory (pool nodes ps analog:
+        reference docker-ps-over-ssh, convoy/fleet.py:2468 — here the
+        agent answers directly over the control channel, no ssh)."""
+        import shutil as shutil_mod
+        tasks = []
+        for (job_id, task_id), proc in list(self._live_procs.items()):
+            entry = {"job_id": job_id, "task_id": task_id,
+                     "pid": getattr(proc, "pid", None)}
+            tasks.append(entry)
+        report = {"running_tasks": tasks,
+                  "task_slots": self.pool.task_slots_per_node}
+        if shutil_mod.which("docker"):
+            rc, out, _err = util.subprocess_capture(
+                ["docker", "ps", "--filter", "name=shipyard-",
+                 "--format", "{{.Names}}\t{{.Image}}\t{{.Status}}"])
+            if rc == 0:
+                report["containers"] = [
+                    dict(zip(("name", "image", "status"),
+                             line.split("\t")))
+                    for line in out.splitlines() if line.strip()]
+        return report
+
+    def _zap(self) -> dict:
+        """Kill every live task process group and running shipyard
+        container (pool nodes zap analog, reference
+        shipyard.py:1906)."""
+        import shutil as shutil_mod
+        import signal as signal_mod
+        import subprocess as subprocess_mod
+        killed = []
+        for (job_id, task_id), proc in list(self._live_procs.items()):
+            try:
+                os.killpg(os.getpgid(proc.pid), signal_mod.SIGKILL)
+                killed.append({"job_id": job_id, "task_id": task_id})
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+        containers = []
+        if shutil_mod.which("docker"):
+            rc, out, _err = util.subprocess_capture(
+                ["docker", "ps", "--filter", "name=shipyard-",
+                 "--format", "{{.Names}}"])
+            for name in (out.split() if rc == 0 else []):
+                subprocess_mod.call(
+                    ["docker", "kill", name],
+                    stdout=subprocess_mod.DEVNULL,
+                    stderr=subprocess_mod.DEVNULL)
+                containers.append(name)
+        return {"killed_tasks": killed, "killed_containers": containers}
+
+    def _prune_images(self) -> dict:
+        """Remove cached image tarballs whose image left the pool's
+        global-resources manifest, plus `docker image prune` when
+        docker is present (pool nodes prune analog, reference
+        shipyard.py:1919 — TPU-native: the cascade direct-download
+        cache is this node's image store when docker is absent)."""
+        import shutil as shutil_mod
+        import subprocess as subprocess_mod
+        removed: list[str] = []
+        freed = 0
+        prov = self._image_provisioner
+        cache_dir = getattr(prov, "_cache_dir", None)
+        if prov is not None and cache_dir and os.path.isdir(cache_dir):
+            keep = set()
+            for row in self.store.query_entities(
+                    names.TABLE_IMAGES,
+                    partition_key=self.identity.pool_id):
+                blob = row.get("source_blob") or ""
+                if blob:
+                    keep.add(os.path.basename(blob))
+                    keep.add(os.path.basename(blob) + ".sif")
+            for fname in os.listdir(cache_dir):
+                if fname.endswith(".part") or fname in keep:
+                    continue
+                path = os.path.join(cache_dir, fname)
+                try:
+                    freed += os.path.getsize(path)
+                    os.remove(path)
+                    removed.append(fname)
+                except OSError:
+                    pass
+        report = {"removed_cached": sorted(removed),
+                  "freed_bytes": freed}
+        if shutil_mod.which("docker"):
+            rc = subprocess_mod.call(
+                ["docker", "image", "prune", "-f"],
+                stdout=subprocess_mod.DEVNULL,
+                stderr=subprocess_mod.DEVNULL)
+            report["docker_prune_rc"] = rc
+        return report
 
     # ------------------------ task processing --------------------------
 

@@ -135,6 +135,83 @@ def pool_nodes_list(click_ctx):
                                  raw=click_ctx.obj["raw"])
 
 
+@nodes.command("count")
+@click.pass_context
+def pool_nodes_count(click_ctx):
+    """Node-state histogram (reference `pool nodes count`)."""
+    fleet.action_pool_nodes_count(_ctx(click_ctx),
+                                  raw=click_ctx.obj["raw"])
+
+
+@nodes.command("grls")
+@click.option("--node-id", default=None)
+@click.pass_context
+def pool_nodes_grls(click_ctx, node_id):
+    """Remote-login settings (ip/port) for nodes (reference
+    `pool nodes grls`)."""
+    fleet.action_pool_nodes_grls(_ctx(click_ctx), node_id,
+                                 raw=click_ctx.obj["raw"])
+
+
+@nodes.command("ps")
+@click.option("--node-id", default=None)
+@click.pass_context
+def pool_nodes_ps(click_ctx, node_id):
+    """List running tasks/containers on nodes (reference
+    `pool nodes ps`)."""
+    fleet.action_pool_nodes_ps(_ctx(click_ctx), node_id,
+                               raw=click_ctx.obj["raw"])
+
+
+@nodes.command("zap")
+@click.option("--node-id", default=None)
+@click.option("-y", "--yes", is_flag=True)
+@click.pass_context
+def pool_nodes_zap(click_ctx, node_id, yes):
+    """Kill all live task processes/containers on nodes (reference
+    `pool nodes zap`)."""
+    if not yes:
+        click.confirm(
+            f"zap all running work on "
+            f"{node_id or 'ALL nodes'}?", abort=True)
+    fleet.action_pool_nodes_zap(_ctx(click_ctx), node_id,
+                                raw=click_ctx.obj["raw"])
+
+
+@nodes.command("prune")
+@click.option("--node-id", default=None)
+@click.pass_context
+def pool_nodes_prune(click_ctx, node_id):
+    """Prune unreferenced image-cache entries on nodes (reference
+    `pool nodes prune`)."""
+    fleet.action_pool_nodes_prune(_ctx(click_ctx), node_id,
+                                  raw=click_ctx.obj["raw"])
+
+
+@nodes.command("reboot")
+@click.argument("node_id")
+@click.option("-y", "--yes", is_flag=True)
+@click.pass_context
+def pool_nodes_reboot(click_ctx, node_id, yes):
+    """Reboot a node (recreates its whole TPU slice; reference
+    `pool nodes reboot`)."""
+    if not yes:
+        click.confirm(f"reboot {node_id}'s slice?", abort=True)
+    fleet.action_pool_nodes_reboot(_ctx(click_ctx), node_id)
+
+
+@nodes.command("del")
+@click.argument("node_id")
+@click.option("-y", "--yes", is_flag=True)
+@click.pass_context
+def pool_nodes_del(click_ctx, node_id, yes):
+    """Delete a node (deallocates its whole TPU slice without
+    replacement; reference `pool nodes del`)."""
+    if not yes:
+        click.confirm(f"deallocate {node_id}'s slice?", abort=True)
+    fleet.action_pool_nodes_del(_ctx(click_ctx), node_id)
+
+
 @pool.command("ssh")
 @click.argument("node_id")
 @click.pass_context
@@ -558,6 +635,28 @@ def account_info(click_ctx):
                               raw=click_ctx.obj["raw"])
 
 
+@account.command("quota")
+@click.option("--zone", default=None,
+              help="Zone to inspect (default: credentials gcp.zone)")
+@click.pass_context
+def account_quota(click_ctx, zone):
+    """TPU capacity/quota for a zone: offered accelerator types +
+    project chip quota limits (reference `account quota` /
+    `account images`, shipyard.py:1009-1078)."""
+    from batch_shipyard_tpu.substrate import quota as quota_mod
+    ctx = _ctx(click_ctx)
+    if ctx.credentials.gcp is None:
+        raise click.ClickException(
+            "account quota requires credentials.gcp")
+    zone = zone or ctx.credentials.gcp.zone
+    if not zone:
+        raise click.ClickException(
+            "no zone: pass --zone or set credentials gcp.zone")
+    client = quota_mod.TpuQuotaClient(ctx.credentials.gcp.project)
+    fleet._emit(quota_mod.quota_report(client, zone),
+                click_ctx.obj["raw"])
+
+
 # ------------------------------ secrets --------------------------------
 
 def _secret_io_params(click_ctx):
@@ -651,6 +750,43 @@ def storage():
     """State store management."""
 
 
+@storage.command("sas")
+@click.argument("key")
+@click.option("--method", default="GET",
+              type=click.Choice(["GET", "PUT", "DELETE"]))
+@click.option("--expires-seconds", type=float, default=3600.0)
+@click.option("--prefix", "as_prefix", is_flag=True,
+              help="Treat KEY as a prefix: sign every object under "
+                   "it (GET only)")
+@click.pass_context
+def storage_sas(click_ctx, key, method, expires_seconds, as_prefix):
+    """Mint time-limited signed URL(s) for an object or prefix —
+    hand a task output or ingress prefix to a third party without
+    sharing credentials (reference `storage sas create`,
+    shipyard.py:1327; GCS V4 signed URLs here)."""
+    ctx = _ctx(click_ctx)
+    if as_prefix:
+        if method != "GET":
+            raise click.ClickException(
+                "--prefix signing is GET-only (a PUT prefix would "
+                "grant arbitrary-name writes)")
+        keys = ctx.store.list_objects(prefix=key)
+        if not keys:
+            raise click.ClickException(
+                f"no objects under prefix {key!r}")
+    else:
+        keys = [key]
+    try:
+        urls = {k: ctx.store.generate_signed_url(
+            k, method=method, expires_seconds=expires_seconds)
+            for k in keys}
+    except NotImplementedError as exc:
+        raise click.ClickException(str(exc))
+    fleet._emit({"method": method,
+                 "expires_seconds": expires_seconds,
+                 "urls": urls}, click_ctx.obj["raw"])
+
+
 @storage.command("clear")
 @click.option("-y", "--yes", is_flag=True, default=False)
 @click.pass_context
@@ -741,6 +877,67 @@ def monitor_destroy_vm(click_ctx, project, zone):
     provision.destroy_monitoring_vm(_ctx(click_ctx).store, project,
                                     zone=zone)
     click.echo("monitoring VM destroyed")
+
+
+@monitor.command("status")
+@click.option("--project", default=None)
+@click.option("--zone", default=None)
+@click.pass_context
+def monitor_status(click_ctx, project, zone):
+    """Monitoring VM record + live instance status (reference
+    `monitor status`)."""
+    from batch_shipyard_tpu.monitor import provision
+    fleet._emit(provision.monitoring_vm_status(
+        _ctx(click_ctx).store, project, zone=zone),
+        click_ctx.obj["raw"])
+
+
+@monitor.command("suspend")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def monitor_suspend(click_ctx, project, zone):
+    """Stop the monitoring VM in place (reference
+    `monitor suspend`)."""
+    from batch_shipyard_tpu.monitor import provision
+    provision.suspend_monitoring_vm(_ctx(click_ctx).store, project,
+                                    zone=zone)
+    click.echo("monitoring VM suspended")
+
+
+@monitor.command("start")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def monitor_start(click_ctx, project, zone):
+    """Restart a suspended monitoring VM (reference
+    `monitor start`)."""
+    from batch_shipyard_tpu.monitor import provision
+    provision.start_monitoring_vm(_ctx(click_ctx).store, project,
+                                  zone=zone)
+    click.echo("monitoring VM started")
+
+
+@monitor.command("ssh")
+@click.option("--username", default=None)
+@click.option("--ssh-private-key", default=None)
+@click.option("--command", "remote_command", default=None)
+@click.option("--no-exec", is_flag=True,
+              help="Print the ssh command instead of running it")
+@click.pass_context
+def monitor_ssh(click_ctx, username, ssh_private_key, remote_command,
+                no_exec):
+    """ssh into the monitoring VM (reference `monitor ssh`)."""
+    import subprocess as _subprocess
+
+    from batch_shipyard_tpu.monitor import provision
+    argv = provision.monitoring_vm_ssh_argv(
+        _ctx(click_ctx).store, username, ssh_private_key,
+        command=remote_command)
+    if no_exec:
+        click.echo(" ".join(argv))
+    else:
+        raise SystemExit(_subprocess.call(argv))
 
 
 @monitor.command("add")
@@ -967,13 +1164,16 @@ def fed_destroy_vm(click_ctx, federation_id, project, zone):
     click.echo(f"destroyed {count} proxy VM(s)")
 
 
-@fed.command("proxy")
+@fed.group("proxy", invoke_without_command=True)
 @click.option("--poll-interval", type=float, default=None,
               help="Default: federation.yaml proxy_options."
                    "polling_interval (1.0)")
 @click.pass_context
 def fed_proxy(click_ctx, poll_interval):
-    """Run the federation scheduler daemon."""
+    """Run the federation scheduler daemon (bare invocation), or
+    manage proxy VMs (ssh/suspend/start/status subcommands)."""
+    if click_ctx.invoked_subcommand is not None:
+        return
     from batch_shipyard_tpu.federation import federation as fed_mod
     ctx = _ctx(click_ctx)
     opts = (ctx.configs.get("federation", {}).get("federation", {})
@@ -986,6 +1186,79 @@ def fed_proxy(click_ctx, poll_interval):
         after_success_blackout=float(
             sched.get("after_success_blackout_interval", 0.0)))
     proc.run()
+
+
+@fed_proxy.command("status")
+@click.argument("federation_id")
+@click.option("--project", default=None)
+@click.option("--zone", default=None)
+@click.pass_context
+def fed_proxy_status(click_ctx, federation_id, project, zone):
+    """Proxy VM records + live status (reference
+    `fed proxy status`)."""
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    fleet._emit({"proxies": fed_prov.proxy_vm_status(
+        _ctx(click_ctx).store, federation_id, project, zone=zone)},
+        click_ctx.obj["raw"])
+
+
+@fed_proxy.command("suspend")
+@click.argument("federation_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.option("--replica", type=int, default=None,
+              help="Suspend one replica (default: all)")
+@click.pass_context
+def fed_proxy_suspend(click_ctx, federation_id, project, zone,
+                      replica):
+    """Stop proxy VM(s) in place (reference `fed proxy suspend`)."""
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    count = fed_prov.suspend_proxy_vms(
+        _ctx(click_ctx).store, federation_id, project, zone=zone,
+        replica=replica)
+    click.echo(f"suspended {count} proxy VM(s)")
+
+
+@fed_proxy.command("start")
+@click.argument("federation_id")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.option("--replica", type=int, default=None,
+              help="Start one replica (default: all)")
+@click.pass_context
+def fed_proxy_start(click_ctx, federation_id, project, zone, replica):
+    """Restart suspended proxy VM(s) (reference
+    `fed proxy start`)."""
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    count = fed_prov.start_proxy_vms(
+        _ctx(click_ctx).store, federation_id, project, zone=zone,
+        replica=replica)
+    click.echo(f"started {count} proxy VM(s)")
+
+
+@fed_proxy.command("ssh")
+@click.argument("federation_id")
+@click.option("--replica", type=int, default=0)
+@click.option("--username", default=None)
+@click.option("--ssh-private-key", default=None)
+@click.option("--command", "remote_command", default=None)
+@click.option("--no-exec", is_flag=True,
+              help="Print the ssh command instead of running it")
+@click.pass_context
+def fed_proxy_ssh(click_ctx, federation_id, replica, username,
+                  ssh_private_key, remote_command, no_exec):
+    """ssh into a proxy VM replica (reference `fed proxy ssh`)."""
+    import subprocess as _subprocess
+
+    from batch_shipyard_tpu.federation import provision as fed_prov
+    argv = fed_prov.proxy_vm_ssh_argv(
+        _ctx(click_ctx).store, federation_id, replica=replica,
+        username=username, ssh_private_key=ssh_private_key,
+        command=remote_command)
+    if no_exec:
+        click.echo(" ".join(argv))
+    else:
+        raise SystemExit(_subprocess.call(argv))
 
 
 # ------------------------------- slurm ---------------------------------
@@ -1121,6 +1394,74 @@ def slurm_cluster_status(click_ctx, project, zone):
     fleet._emit(slurm_prov.slurm_cluster_status(
         ctx.store, cluster_id, project=project, zone=zone),
         click_ctx.obj["raw"])
+
+
+@slurm.command("cluster-suspend")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def slurm_cluster_suspend(click_ctx, project, zone):
+    """Stop the controller + login VMs in place (reference
+    `slurm cluster suspend`; compute nodes are pool slices — use
+    `pool suspend` for those)."""
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    cluster_id = ctx.configs.get("slurm", {}).get("slurm", {}).get(
+        "cluster_id", "shipyard")
+    stopped = slurm_prov.suspend_slurm_cluster(
+        ctx.store, cluster_id, project=project, zone=zone)
+    click.echo(f"suspended: {', '.join(stopped)}")
+
+
+@slurm.command("cluster-start")
+@click.option("--project", required=True)
+@click.option("--zone", default=None)
+@click.pass_context
+def slurm_cluster_start(click_ctx, project, zone):
+    """Restart suspended control-plane VMs (reference
+    `slurm cluster start`)."""
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    cluster_id = ctx.configs.get("slurm", {}).get("slurm", {}).get(
+        "cluster_id", "shipyard")
+    started = slurm_prov.start_slurm_cluster(
+        ctx.store, cluster_id, project=project, zone=zone)
+    click.echo(f"started: {', '.join(started)}")
+
+
+@slurm.command("ssh")
+@click.argument("target",
+                type=click.Choice(["controller", "login", "node"]))
+@click.option("--index", type=int, default=0,
+              help="Login VM index (target=login)")
+@click.option("--partition", default=None,
+              help="Slurm partition (target=node)")
+@click.option("--host", default=None,
+              help="Slurm hostname (target=node)")
+@click.option("--username", default=None)
+@click.option("--ssh-private-key", default=None)
+@click.option("--command", "remote_command", default=None)
+@click.option("--no-exec", is_flag=True,
+              help="Print the ssh command instead of running it")
+@click.pass_context
+def slurm_ssh(click_ctx, target, index, partition, host, username,
+              ssh_private_key, remote_command, no_exec):
+    """ssh into the controller, a login VM, or a compute node
+    (reference `slurm ssh controller|login|node`)."""
+    import subprocess as _subprocess
+
+    from batch_shipyard_tpu.slurm import provision as slurm_prov
+    ctx = _ctx(click_ctx)
+    cluster_id = ctx.configs.get("slurm", {}).get("slurm", {}).get(
+        "cluster_id", "shipyard")
+    argv = slurm_prov.slurm_ssh_argv(
+        ctx.store, cluster_id, target=target, index=index,
+        partition=partition, host=host, username=username,
+        ssh_private_key=ssh_private_key, command=remote_command)
+    if no_exec:
+        click.echo(" ".join(argv))
+    else:
+        raise SystemExit(_subprocess.call(argv))
 
 
 @slurm.command("join-script")

@@ -125,3 +125,86 @@ def destroy_proxy_vms(store: StateStore, federation_id: str,
             pass
         count += 1
     return count
+
+
+def _proxy_rows(store: StateStore, federation_id: str) -> list[dict]:
+    rows = [row for row in store.query_entities(
+        names.TABLE_FEDERATIONS, partition_key="proxies")
+        if row.get("federation_id") == federation_id]
+    if not rows:
+        raise ValueError(
+            f"no proxy VMs registered for federation {federation_id}")
+    return sorted(rows, key=lambda r: r["_rk"])
+
+
+def _proxy_vms(project, zone, vms):
+    from batch_shipyard_tpu.utils import service_vm
+    return service_vm.default_vms(project, zone, vms)
+
+
+def proxy_vm_status(store: StateStore, federation_id: str,
+                    project: Optional[str] = None,
+                    zone: Optional[str] = None,
+                    vms=None) -> list[dict]:
+    """Stored record + live status per proxy replica (reference
+    `fed proxy status`, shipyard.py:2573+)."""
+    from batch_shipyard_tpu.utils import service_vm
+    vms = _proxy_vms(project, zone, vms)
+    return [service_vm.vm_status(vms, row["_rk"], row)
+            for row in _proxy_rows(store, federation_id)]
+
+
+def suspend_proxy_vms(store: StateStore, federation_id: str,
+                      project: Optional[str] = None,
+                      zone: Optional[str] = None,
+                      replica: Optional[int] = None,
+                      vms=None) -> int:
+    """Stop proxy replica(s) in place (reference `fed proxy
+    suspend`). replica=None suspends every replica."""
+    from batch_shipyard_tpu.utils import service_vm
+    vms = _proxy_vms(project, zone, vms)
+    count = 0
+    for row in _proxy_rows(store, federation_id):
+        if replica is not None and not row["_rk"].endswith(
+                f"proxy{replica}"):
+            continue
+        service_vm.suspend_vm(vms, row["_rk"], store,
+                              names.TABLE_FEDERATIONS, "proxies")
+        count += 1
+    return count
+
+
+def start_proxy_vms(store: StateStore, federation_id: str,
+                    project: Optional[str] = None,
+                    zone: Optional[str] = None,
+                    replica: Optional[int] = None,
+                    vms=None) -> int:
+    """Restart suspended proxy replica(s) (reference `fed proxy
+    start`)."""
+    from batch_shipyard_tpu.utils import service_vm
+    vms = _proxy_vms(project, zone, vms)
+    count = 0
+    for row in _proxy_rows(store, federation_id):
+        if replica is not None and not row["_rk"].endswith(
+                f"proxy{replica}"):
+            continue
+        service_vm.start_vm(vms, row["_rk"], store,
+                            names.TABLE_FEDERATIONS, "proxies")
+        count += 1
+    return count
+
+
+def proxy_vm_ssh_argv(store: StateStore, federation_id: str,
+                      replica: int = 0,
+                      username: Optional[str] = None,
+                      ssh_private_key: Optional[str] = None,
+                      command: Optional[str] = None) -> list[str]:
+    """ssh argv to one proxy replica (reference `fed proxy ssh`)."""
+    from batch_shipyard_tpu.utils import service_vm
+    suffix = f"proxy{replica}"
+    for row in _proxy_rows(store, federation_id):
+        if row["_rk"].endswith(suffix):
+            return service_vm.ssh_argv(row["internal_ip"], username,
+                                       ssh_private_key, command)
+    raise ValueError(
+        f"federation {federation_id} has no replica {replica}")

@@ -165,14 +165,45 @@ def _emit(payload: Any, raw: bool = False) -> None:
 
 # ------------------------------ pool actions ---------------------------
 
-def action_pool_add(ctx: Context, wait: bool = True) -> list:
-    """pool add (fleet.py:3390 analog)."""
+def action_pool_add(ctx: Context, wait: bool = True,
+                    quota_client=None) -> list:
+    """pool add (fleet.py:3390 analog), preceded by an advisory
+    quota/capacity preflight on real-cloud pools (reference `account
+    quota` + resize-error classification, shipyard.py:1009,
+    batch.py:661 — here the warning lands BEFORE allocation burns
+    minutes). ``quota_client`` injects a fake for tests."""
     pool = ctx.pool
+    for warning in _quota_preflight(ctx, quota_client):
+        logger.warning("pool add preflight: %s", warning)
     nodes = pool_mgr.create_pool(
         ctx.store, ctx.substrate(), pool, ctx.global_settings,
         ctx.configs.get("pool"), wait=wait)
     logger.info("pool %s ready with %d nodes", pool.id, len(nodes))
     return nodes
+
+
+def _quota_preflight(ctx: Context, quota_client=None) -> list[str]:
+    """Advisory-only: never raises, never blocks (substrate/quota.py
+    module doc)."""
+    pool = ctx.pool
+    if pool.substrate != "tpu_vm" or pool.tpu is None:
+        return []
+    try:
+        from batch_shipyard_tpu.substrate import quota as quota_mod
+        if quota_client is None:
+            import shutil as shutil_mod
+            if shutil_mod.which("gcloud") is None or \
+                    ctx.credentials.gcp is None:
+                return []
+            quota_client = quota_mod.TpuQuotaClient(
+                ctx.credentials.gcp.project)
+        zone = pool.zone or (ctx.credentials.gcp.zone
+                             if ctx.credentials.gcp else None)
+        return quota_mod.preflight_pool(pool, quota_client,
+                                        zone=zone)
+    except Exception as exc:  # noqa: BLE001 - advisory only
+        logger.debug("quota preflight skipped: %s", exc)
+        return []
 
 
 def action_pool_list(ctx: Context, raw: bool = False) -> None:
@@ -202,6 +233,69 @@ def action_pool_nodes_list(ctx: Context, raw: bool = False) -> None:
 
 def action_pool_stats(ctx: Context, raw: bool = False) -> None:
     _emit(pool_mgr.pool_stats(ctx.store, ctx.pool.id), raw)
+
+
+def action_pool_nodes_count(ctx: Context, raw: bool = False) -> None:
+    """Node-state histogram (reference shipyard.py:1868)."""
+    _emit(pool_mgr.node_counts(ctx.store, ctx.pool.id), raw)
+
+
+def action_pool_nodes_grls(ctx: Context,
+                           node_id: Optional[str] = None,
+                           raw: bool = False) -> None:
+    """Remote-login settings for node(s) (reference
+    convoy/batch.py:3074)."""
+    _emit({"remote_login": pool_mgr.remote_login_settings(
+        ctx.store, ctx.substrate(), ctx.pool.id, node_id)}, raw)
+
+
+def action_pool_nodes_ps(ctx: Context,
+                         node_id: Optional[str] = None,
+                         raw: bool = False) -> None:
+    """Running tasks/containers per node via the agent control
+    channel (reference docker-ps-over-ssh, convoy/fleet.py:2468)."""
+    # Fake-substrate agents live in-process: revive them so the
+    # request/reply verbs have someone listening (no-op on real
+    # substrates, whose agents run on the nodes).
+    ctx.substrate().ensure_attached(ctx.pool)
+    _emit({"nodes": pool_mgr.nodes_ps(ctx.store, ctx.pool.id,
+                                      node_id)}, raw)
+
+
+def action_pool_nodes_zap(ctx: Context,
+                          node_id: Optional[str] = None,
+                          raw: bool = False) -> None:
+    """Kill all live task processes/containers on node(s)
+    (reference shipyard.py:1906)."""
+    ctx.substrate().ensure_attached(ctx.pool)
+    _emit({"nodes": pool_mgr.nodes_zap(ctx.store, ctx.pool.id,
+                                       node_id)}, raw)
+
+
+def action_pool_nodes_prune(ctx: Context,
+                            node_id: Optional[str] = None,
+                            raw: bool = False) -> None:
+    """Prune unreferenced image-cache entries on node(s)
+    (reference shipyard.py:1919)."""
+    ctx.substrate().ensure_attached(ctx.pool)
+    _emit({"nodes": pool_mgr.nodes_prune(ctx.store, ctx.pool.id,
+                                         node_id)}, raw)
+
+
+def action_pool_nodes_reboot(ctx: Context, node_id: str) -> None:
+    """Reboot a node by recreating its slice (reference
+    shipyard.py:1882; TPU recovery granularity is the slice)."""
+    s = pool_mgr.reboot_node(ctx.store, ctx.substrate(), ctx.pool,
+                             node_id)
+    _emit({"node_id": node_id, "recreated_slice": s})
+
+
+def action_pool_nodes_del(ctx: Context, node_id: str) -> None:
+    """Delete a node by deallocating its slice without replacement
+    (reference shipyard.py:1795)."""
+    s = pool_mgr.delete_node(ctx.store, ctx.substrate(), ctx.pool,
+                             node_id)
+    _emit({"node_id": node_id, "deallocated_slice": s})
 
 
 def action_pool_ssh(ctx: Context, node_id: str) -> Optional[tuple]:

@@ -330,6 +330,71 @@ systemctl enable --now shipyard-monitoring.service
     return ip
 
 
+def _monitor_vms(project, zone, vms):
+    from batch_shipyard_tpu.utils import service_vm
+    return service_vm.default_vms(project, zone, vms)
+
+
+def _monitor_record(store, name: str) -> dict:
+    from batch_shipyard_tpu.state import names as _names
+    from batch_shipyard_tpu.state.base import NotFoundError
+    try:
+        return store.get_entity(_names.TABLE_MONITOR, "vms", name)
+    except NotFoundError:
+        raise ValueError(f"monitoring VM {name} is not registered")
+
+
+def monitoring_vm_status(store, project: Optional[str] = None,
+                         zone: Optional[str] = None,
+                         name: str = "shipyard-monitor",
+                         vms=None) -> dict:
+    """Stored record + live instance status (reference
+    `monitor status`, shipyard.py:2540)."""
+    from batch_shipyard_tpu.utils import service_vm
+    record = _monitor_record(store, name)
+    return service_vm.vm_status(_monitor_vms(project, zone, vms),
+                                name, record)
+
+
+def suspend_monitoring_vm(store, project: Optional[str] = None,
+                          zone: Optional[str] = None,
+                          name: str = "shipyard-monitor",
+                          vms=None) -> None:
+    """Stop the monitoring VM in place (reference `monitor suspend`,
+    convoy/fleet.py:4735)."""
+    from batch_shipyard_tpu.state import names as _names
+    from batch_shipyard_tpu.utils import service_vm
+    _monitor_record(store, name)
+    service_vm.suspend_vm(_monitor_vms(project, zone, vms), name,
+                          store, _names.TABLE_MONITOR, "vms")
+
+
+def start_monitoring_vm(store, project: Optional[str] = None,
+                        zone: Optional[str] = None,
+                        name: str = "shipyard-monitor",
+                        vms=None) -> None:
+    """Restart a suspended monitoring VM (reference `monitor start`,
+    convoy/fleet.py:4749)."""
+    from batch_shipyard_tpu.state import names as _names
+    from batch_shipyard_tpu.utils import service_vm
+    _monitor_record(store, name)
+    service_vm.start_vm(_monitor_vms(project, zone, vms), name,
+                        store, _names.TABLE_MONITOR, "vms")
+
+
+def monitoring_vm_ssh_argv(store, username: Optional[str] = None,
+                           ssh_private_key: Optional[str] = None,
+                           name: str = "shipyard-monitor",
+                           command: Optional[str] = None
+                           ) -> list[str]:
+    """ssh argv to the monitoring VM (reference `monitor ssh`,
+    convoy/fleet.py:4721)."""
+    from batch_shipyard_tpu.utils import service_vm
+    record = _monitor_record(store, name)
+    return service_vm.ssh_argv(record["internal_ip"], username,
+                               ssh_private_key, command)
+
+
 def destroy_monitoring_vm(store, project: str,
                           zone: Optional[str] = None,
                           name: str = "shipyard-monitor",
@@ -339,9 +404,8 @@ def destroy_monitoring_vm(store, project: str,
     from batch_shipyard_tpu.state import names as _names
     from batch_shipyard_tpu.state.base import NotFoundError
 
-    if vms is None:
-        from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
-        vms = GceVmManager(project, zone=zone)
+    from batch_shipyard_tpu.utils import service_vm
+    vms = service_vm.default_vms(project, zone, vms)
     vms.delete_vm(name)
     try:
         store.delete_entity(_names.TABLE_MONITOR, "vms", name)

@@ -254,3 +254,190 @@ def send_control(store: StateStore, pool_id: str, node_id: str,
                  message: dict) -> None:
     store.put_message(names.control_queue(pool_id, node_id),
                       json.dumps(message).encode())
+
+
+def _send_control_request(store: StateStore, pool_id: str,
+                          node_id: str, message: dict,
+                          timeout: float) -> str:
+    """Enqueue a request/reply control verb and return its reply key.
+    The message carries expires_at so a verb that outlives its caller
+    is DROPPED by the agent instead of executing minutes later — a
+    timed-out zap must not kill tasks after the operator moved on."""
+    import uuid as uuid_mod
+    reply_key = names.control_reply_key(pool_id, node_id,
+                                        uuid_mod.uuid4().hex[:12])
+    send_control(store, pool_id, node_id,
+                 dict(message, reply_key=reply_key,
+                      expires_at=time.time() + timeout))
+    return reply_key
+
+
+def _poll_reply(store: StateStore, reply_key: str) -> Optional[dict]:
+    try:
+        payload = store.get_object(reply_key)
+    except NotFoundError:
+        return None
+    try:
+        store.delete_object(reply_key)
+    except NotFoundError:
+        pass
+    return json.loads(payload.decode())
+
+
+def send_control_and_wait(store: StateStore, pool_id: str,
+                          node_id: str, message: dict,
+                          timeout: float = 30.0,
+                          poll_interval: float = 0.1) -> dict:
+    """Request/reply control verb: attach a reply key, enqueue, poll
+    the object store for the agent's answer (nodes ps/zap/prune ride
+    this — the agent answers over the state store, no ssh needed;
+    reference equivalent is docker-ps-over-ssh, convoy/fleet.py:2468).
+    Raises TimeoutError if the node never answers (offline node); the
+    queued verb then expires unexecuted (see _send_control_request)."""
+    reply_key = _send_control_request(store, pool_id, node_id,
+                                      message, timeout)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reply = _poll_reply(store, reply_key)
+        if reply is not None:
+            return reply
+        time.sleep(poll_interval)
+    raise TimeoutError(
+        f"node {node_id} did not answer {message.get('type')} "
+        f"within {timeout:.0f}s (offline?)")
+
+
+def get_node(store: StateStore, pool_id: str, node_id: str) -> NodeInfo:
+    for node in list_nodes(store, pool_id):
+        if node.node_id == node_id:
+            return node
+    raise PoolNotFoundError(f"node {node_id} not found in {pool_id}")
+
+
+def node_counts(store: StateStore, pool_id: str) -> dict:
+    """Node-state histogram (pool nodes count analog, reference
+    shipyard.py:1868 / convoy/fleet.py node state counts)."""
+    counts: dict = {}
+    nodes = list_nodes(store, pool_id)
+    for node in nodes:
+        counts[node.state] = counts.get(node.state, 0) + 1
+    return {"pool_id": pool_id, "total": len(nodes),
+            "by_state": dict(sorted(counts.items()))}
+
+
+def remote_login_settings(store: StateStore,
+                          substrate: ComputeSubstrate,
+                          pool_id: str,
+                          node_id: Optional[str] = None) -> list[dict]:
+    """(node, ip, port) for every node — or one — in the pool
+    (pool nodes grls analog, reference convoy/batch.py:3074
+    get_remote_login_settings)."""
+    nodes = list_nodes(store, pool_id)
+    if node_id is not None:
+        nodes = [n for n in nodes if n.node_id == node_id]
+        if not nodes:
+            raise PoolNotFoundError(
+                f"node {node_id} not found in {pool_id}")
+    out = []
+    for node in nodes:
+        login = substrate.get_remote_login(pool_id, node.node_id)
+        out.append({
+            "node_id": node.node_id, "state": node.state,
+            "ip": login[0] if login else None,
+            "port": login[1] if login else None,
+        })
+    return out
+
+
+def reboot_node(store: StateStore, substrate: ComputeSubstrate,
+                pool: PoolSettings, node_id: str) -> int:
+    """Reboot a node (pool nodes reboot analog, reference
+    shipyard.py:1882). TPU recovery granularity is the pod slice —
+    all workers of the node's slice are recreated together (a lone
+    worker VM cannot rejoin an ICI mesh). Returns the slice index."""
+    node = get_node(store, pool.id, node_id)
+    logger.info("rebooting node %s => recreating slice %d",
+                node_id, node.slice_index)
+    substrate.recreate_slice(pool, node.slice_index)
+    return node.slice_index
+
+
+def delete_node(store: StateStore, substrate: ComputeSubstrate,
+                pool: PoolSettings, node_id: str) -> int:
+    """Remove a node from the pool (pool nodes del analog, reference
+    shipyard.py:1795). Slice-granular like reboot: the node's whole
+    slice is deallocated and NOT replaced — the pool shrinks by one
+    slice (use pool resize to grow back). Returns the slice index."""
+    node = get_node(store, pool.id, node_id)
+    logger.info("deleting node %s => deallocating slice %d",
+                node_id, node.slice_index)
+    substrate.deallocate_slice(pool, node.slice_index)
+    return node.slice_index
+
+
+def _control_fanout(store: StateStore, pool_id: str, message: dict,
+                    node_id: Optional[str] = None,
+                    timeout: float = 30.0,
+                    poll_interval: float = 0.1) -> list[dict]:
+    """Fan a request/reply verb to node(s): non-ready nodes are
+    reported immediately instead of waited on, all requests are
+    enqueued up front, and the replies poll under ONE shared deadline
+    — wall clock is O(timeout), not O(nodes x timeout)."""
+    nodes = list_nodes(store, pool_id)
+    if node_id is not None:
+        nodes = [n for n in nodes if n.node_id == node_id]
+        if not nodes:
+            raise PoolNotFoundError(
+                f"node {node_id} not found in {pool_id}")
+    replies: dict[str, dict] = {}
+    pending: dict[str, str] = {}
+    for node in nodes:
+        if node.state not in READY_STATES:
+            replies[node.node_id] = {
+                "node_id": node.node_id,
+                "error": f"node not ready (state={node.state})"}
+            continue
+        pending[node.node_id] = _send_control_request(
+            store, pool_id, node.node_id, dict(message), timeout)
+    deadline = time.monotonic() + timeout
+    while pending and time.monotonic() < deadline:
+        for nid, reply_key in list(pending.items()):
+            reply = _poll_reply(store, reply_key)
+            if reply is not None:
+                replies[nid] = reply
+                del pending[nid]
+        if pending:
+            time.sleep(poll_interval)
+    for nid in pending:
+        replies[nid] = {
+            "node_id": nid,
+            "error": (f"node {nid} did not answer "
+                      f"{message.get('type')} within {timeout:.0f}s "
+                      f"(offline?)")}
+    return [replies[n.node_id] for n in nodes]
+
+
+def nodes_ps(store: StateStore, pool_id: str,
+             node_id: Optional[str] = None,
+             timeout: float = 30.0) -> list[dict]:
+    """Running tasks/containers per node (pool nodes ps analog)."""
+    return _control_fanout(store, pool_id, {"type": "ps"},
+                           node_id, timeout)
+
+
+def nodes_zap(store: StateStore, pool_id: str,
+              node_id: Optional[str] = None,
+              timeout: float = 30.0) -> list[dict]:
+    """Kill all live task processes/containers per node (pool nodes
+    zap analog, reference shipyard.py:1906)."""
+    return _control_fanout(store, pool_id, {"type": "zap"},
+                           node_id, timeout)
+
+
+def nodes_prune(store: StateStore, pool_id: str,
+                node_id: Optional[str] = None,
+                timeout: float = 30.0) -> list[dict]:
+    """Prune unreferenced image cache entries per node (pool nodes
+    prune analog, reference shipyard.py:1919)."""
+    return _control_fanout(store, pool_id, {"type": "prune"},
+                           node_id, timeout)

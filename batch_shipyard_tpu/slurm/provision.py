@@ -373,3 +373,96 @@ def slurm_cluster_status(store: StateStore, cluster_id: str,
         except Exception as exc:  # noqa: BLE001 - live probe optional
             status["controller_status"] = f"unknown ({exc})"
     return status
+
+
+def _cluster_record(store: StateStore, cluster_id: str) -> dict:
+    try:
+        return store.get_entity(names.TABLE_SLURM, _CLUSTERS_PK,
+                                cluster_id)
+    except NotFoundError:
+        raise ValueError(f"slurm cluster {cluster_id} not found")
+
+
+def suspend_slurm_cluster(store: StateStore, cluster_id: str,
+                          project: Optional[str] = None,
+                          zone: Optional[str] = None,
+                          vms=None) -> list[str]:
+    """Stop the control-plane VMs in place (reference `slurm cluster
+    suspend`, shipyard.py:2918+): controller + every login VM.
+    Compute nodes are pool slices — `pool suspend` owns those."""
+    from batch_shipyard_tpu.utils import service_vm
+    vms = service_vm.default_vms(project, zone, vms)
+    record = _cluster_record(store, cluster_id)
+    stopped = []
+    for name in [record["controller"], *record.get("logins", {})]:
+        service_vm.suspend_vm(vms, name)
+        stopped.append(name)
+    store.merge_entity(names.TABLE_SLURM, _CLUSTERS_PK, cluster_id,
+                       {"state": "suspended"})
+    return stopped
+
+
+def start_slurm_cluster(store: StateStore, cluster_id: str,
+                        project: Optional[str] = None,
+                        zone: Optional[str] = None,
+                        vms=None) -> list[str]:
+    """Restart suspended control-plane VMs (reference `slurm cluster
+    start`)."""
+    from batch_shipyard_tpu.utils import service_vm
+    vms = service_vm.default_vms(project, zone, vms)
+    record = _cluster_record(store, cluster_id)
+    started = []
+    for name in [record["controller"], *record.get("logins", {})]:
+        service_vm.start_vm(vms, name)
+        started.append(name)
+    store.merge_entity(names.TABLE_SLURM, _CLUSTERS_PK, cluster_id,
+                       {"state": "provisioned"})
+    return started
+
+
+def slurm_ssh_argv(store: StateStore, cluster_id: str,
+                   target: str = "controller", index: int = 0,
+                   partition: Optional[str] = None,
+                   host: Optional[str] = None,
+                   username: Optional[str] = None,
+                   ssh_private_key: Optional[str] = None,
+                   command: Optional[str] = None) -> list[str]:
+    """ssh argv into the cluster (reference `slurm ssh controller|
+    login|node`, shipyard.py:2918+). target='node' resolves a slurm
+    compute host to its pool node ip via the burst daemon's
+    assignment rows (host= the slurm hostname, partition= its
+    partition)."""
+    from batch_shipyard_tpu.utils import service_vm
+    record = _cluster_record(store, cluster_id)
+    if target == "controller":
+        ip = record.get("controller_ip")
+        if not ip:
+            raise ValueError(f"cluster {cluster_id} has no "
+                             f"controller ip recorded")
+    elif target == "login":
+        logins = sorted(record.get("logins", {}).items())
+        if index >= len(logins):
+            raise ValueError(
+                f"cluster {cluster_id} has {len(logins)} login "
+                f"VM(s); no index {index}")
+        ip = logins[index][1]
+    elif target == "node":
+        if not (partition and host):
+            raise ValueError(
+                "slurm ssh node requires partition and host")
+        pk = f"{cluster_id}${partition}"
+        try:
+            row = store.get_entity(names.TABLE_SLURM, pk, host)
+        except NotFoundError:
+            raise ValueError(
+                f"slurm host {host} has no pool node assigned "
+                f"(partition {partition})")
+        ip = row.get("internal_ip")
+        if not ip:
+            raise ValueError(f"slurm host {host} has no recorded ip")
+    else:
+        raise ValueError(
+            f"unknown ssh target {target!r} "
+            f"(controller|login|node)")
+    return service_vm.ssh_argv(ip, username, ssh_private_key,
+                               command)

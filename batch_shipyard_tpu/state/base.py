@@ -135,6 +135,17 @@ class StateStore(abc.ABC):
         except NotFoundError:
             return False
 
+    def generate_signed_url(self, key: str, method: str = "GET",
+                            expires_seconds: float = 3600.0) -> str:
+        """Time-limited signed URL for one object (the `storage sas
+        create` analog, reference shipyard.py:1327 + SAS generation in
+        convoy/storage.py). Only cloud backends can mint these; the
+        local/memory stores raise a clear error instead of minting a
+        URL nobody outside this process could dereference."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot mint signed URLs — "
+            f"signed access requires the gcs backend")
+
     # ------------------------------ leases -----------------------------
 
     @abc.abstractmethod

@@ -134,6 +134,33 @@ class GCSStateStore(base.StateStore):
         except Exception as exc:  # pragma: no cover - network
             raise self._wrap_precondition(exc, key)
 
+    def generate_signed_url(self, key: str, method: str = "GET",
+                            expires_seconds: float = 3600.0) -> str:
+        """V4 signed URL for a single object (the `storage sas create`
+        analog, reference shipyard.py:1327). Requires service-account
+        credentials (ADC user credentials cannot sign); the
+        google-auth error in that case is re-raised with the fix."""
+        import datetime
+        if method not in ("GET", "PUT", "DELETE", "HEAD"):
+            raise ValueError(f"unsupported method {method!r}")
+        blob = self._blob(f"objects/{key}")
+        if method in ("GET", "HEAD") and not self.object_exists(key):
+            raise NotFoundError(key)
+        try:
+            return blob.generate_signed_url(
+                version="v4", method=method,
+                expiration=datetime.timedelta(
+                    seconds=expires_seconds))
+        except Exception as exc:  # pragma: no cover - auth-specific
+            if "private key" in str(exc).lower() or \
+                    "credentials" in str(exc).lower():
+                raise RuntimeError(
+                    "signing requires service-account credentials "
+                    "(credentials.storage.credentials_file or "
+                    "service-account impersonation); user ADC "
+                    f"cannot sign: {exc}") from exc
+            raise
+
     def get_object(self, key: str) -> bytes:
         blob = self._blob(f"objects/{key}")
         try:

@@ -102,6 +102,12 @@ def federation_queue(federation_id: str) -> str:
     return f"fedq-{federation_id}"
 
 
+def control_reply_key(pool_id: str, node_id: str, nonce: str) -> str:
+    """Object key where a node agent parks the reply to a
+    request/reply control verb (nodes ps/zap/prune)."""
+    return f"ctrlreply/{pool_id}/{node_id}/{nonce}.json"
+
+
 # Object key prefixes
 def resource_file_key(pool_id: str, filename: str) -> str:
     return f"resourcefiles/{pool_id}/{filename}"

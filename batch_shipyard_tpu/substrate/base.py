@@ -56,6 +56,15 @@ class ComputeSubstrate(abc.ABC):
     def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
         """Tear down and re-allocate one slice ('reboot' analog)."""
 
+    def deallocate_slice(self, pool: PoolSettings,
+                         slice_index: int) -> None:
+        """Tear down one slice WITHOUT replacement ('pool nodes del'
+        analog — TPU removal granularity is the slice; the pool
+        shrinks until a resize grows it back)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support slice "
+            f"deallocation")
+
     @abc.abstractmethod
     def get_remote_login(self, pool_id: str,
                          node_id: str) -> Optional[tuple[str, int]]:

@@ -216,6 +216,18 @@ class FakePodSubstrate(base.ComputeSubstrate):
                 agents.pop(node_id, None)
             self.store.delete_entity(names.TABLE_NODES, pool_id,
                                      node_id)
+        # Rows may exist without an in-process agent (fresh CLI
+        # process attaching to an existing fake pool): the slice's
+        # node entities must go regardless, like a real substrate's
+        # teardown would take the machines' registrations with it.
+        for row in list(self.store.query_entities(
+                names.TABLE_NODES, partition_key=pool_id)):
+            if int(row.get("slice_index", -1)) == slice_index:
+                try:
+                    self.store.delete_entity(names.TABLE_NODES,
+                                             pool_id, row["_rk"])
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
 
     def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
         self._teardown_slice(pool.id, slice_index)
@@ -223,6 +235,10 @@ class FakePodSubstrate(base.ComputeSubstrate):
         for w in range(workers):
             self._spawn_agent(pool, slice_index, w,
                               slice_index * workers + w)
+
+    def deallocate_slice(self, pool: PoolSettings,
+                         slice_index: int) -> None:
+        self._teardown_slice(pool.id, slice_index)
 
     def suspend_pool(self, pool: PoolSettings) -> None:
         """Stop agents but keep node entities (marked suspended)."""

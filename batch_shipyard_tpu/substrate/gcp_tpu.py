@@ -106,15 +106,38 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
             self._gcloud(*args, zone=pool.zone)
         except RuntimeError as exc:
             err = gcloud_errors.classify(str(exc))
+            record = {
+                "allocation_error": str(exc),
+                "allocation_error_kind": err.kind,
+                "allocation_error_fatal": err.fatal,
+                "allocation_error_retry": err.retry}
+            if err.retry == "other_zone":
+                advisory = self._stockout_advisory(pool)
+                if advisory:
+                    record["allocation_error_advisory"] = advisory
             self.store.merge_entity(
-                names.TABLE_POOLS, "pools", pool.id, {
-                    "allocation_error": str(exc),
-                    "allocation_error_kind": err.kind,
-                    "allocation_error_fatal": err.fatal,
-                    "allocation_error_retry": err.retry})
+                names.TABLE_POOLS, "pools", pool.id, record)
             raise
         self._register_workers(pool, slice_index)
         self._bootstrap_agents(pool, slice_index)
+
+    def _stockout_advisory(self, pool: PoolSettings) -> Optional[str]:
+        """On stockout, name sibling zones still offering the type
+        (substrate/quota.py; advisory only — never raises).
+        ``quota_client`` attribute injects a fake for tests."""
+        try:
+            from batch_shipyard_tpu.substrate import quota as quota_mod
+            client = getattr(self, "quota_client", None)
+            if client is None:
+                client = quota_mod.TpuQuotaClient(self.project)
+            failed_zone = pool.zone or self.zone or ""
+            region = quota_mod._zone_region(failed_zone)
+            candidates = [f"{region}-{s}" for s in "abcdef"]
+            return quota_mod.stockout_advisory(
+                client, pool.tpu.accelerator_type, failed_zone,
+                candidates)
+        except Exception:  # noqa: BLE001 - advisory only
+            return None
 
     def _register_workers(self, pool: PoolSettings,
                           slice_index: int) -> None:
@@ -201,6 +224,10 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
             logger.warning("delete of slice %d failed; recreating anyway",
                            slice_index)
         self._create_slice(pool, slice_index)
+
+    def deallocate_slice(self, pool: PoolSettings,
+                         slice_index: int) -> None:
+        self._delete_slice(pool.id, slice_index)
 
     def refresh_node_states(self, pool: PoolSettings) -> None:
         """Poll slice states and mark nodes of reclaimed slices

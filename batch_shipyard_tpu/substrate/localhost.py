@@ -15,6 +15,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Optional
 
 from batch_shipyard_tpu.config.settings import (
@@ -130,25 +131,60 @@ class LocalhostSubstrate(base.ComputeSubstrate):
         raise NotImplementedError(
             "localhost pools are fixed-size; delete and re-add")
 
+    def _stop_slice_nodes(self, pool_id: str,
+                          slice_index: int) -> list[dict]:
+        """Stop every agent of a slice and return its node rows.
+        Agents spawned by THIS process are terminated directly; rows
+        without a live in-process handle (fresh CLI attaching to an
+        existing pool) get a shutdown control message instead — the
+        agent subprocess exits on its next control poll."""
+        procs = self._procs.get(pool_id, {})
+        rows = [row for row in self.store.query_entities(
+            names.TABLE_NODES, partition_key=pool_id)
+            if int(row.get("slice_index", -1)) == slice_index]
+        for row in rows:
+            node_id = row["_rk"]
+            proc = procs.pop(node_id, None)
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            else:
+                self.store.put_message(
+                    names.control_queue(pool_id, node_id),
+                    json.dumps({"type": "shutdown"}).encode())
+                # Wait for the agent's final offline heartbeat so a
+                # replacement spawned onto the same node_id cannot
+                # race it for the shared control queue (it would eat
+                # the shutdown meant for its predecessor).
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    try:
+                        cur = self.store.get_entity(
+                            names.TABLE_NODES, pool_id, node_id)
+                    except KeyError:
+                        break
+                    if cur.get("state") == "offline":
+                        break
+                    time.sleep(0.2)
+        return rows
+
     def recreate_slice(self, pool: PoolSettings, slice_index: int) -> None:
-        procs = self._procs.get(pool.id, {})
-        for node_id, proc in list(procs.items()):
-            try:
-                row = self.store.get_entity(
-                    names.TABLE_NODES, pool.id, node_id)
-            except KeyError:
-                continue
-            if int(row.get("slice_index", 0)) != slice_index:
-                continue
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-            procs.pop(node_id)
+        for row in self._stop_slice_nodes(pool.id, slice_index):
             self._spawn_node(pool, slice_index,
                              int(row.get("worker_index", 0)),
                              int(row.get("node_index", 0)))
+
+    def deallocate_slice(self, pool: PoolSettings,
+                         slice_index: int) -> None:
+        for row in self._stop_slice_nodes(pool.id, slice_index):
+            try:
+                self.store.delete_entity(names.TABLE_NODES, pool.id,
+                                         row["_rk"])
+            except KeyError:
+                pass
 
     def get_remote_login(self, pool_id: str,
                          node_id: str) -> Optional[tuple[str, int]]:

@@ -1,0 +1,85 @@
+"""Shared lifecycle verbs for service VMs (monitoring, federation
+proxies, slurm control plane).
+
+The reference gives each service resource its own ssh/suspend/start/
+status verb family (monitor: shipyard.py:2416-2573 +
+convoy/fleet.py:4721-4878; fed proxy: shipyard.py:2573+; slurm:
+shipyard.py:2918+). Here all of them ride one helper set over
+substrate/gce_vm.GceVmManager and the service's registration row, so
+every family behaves identically: suspend = instance stop (state
+preserved, billing stops), start = instance start + state refresh,
+status = live instance status next to the stored record, ssh = the
+argv to reach the VM (callers exec it; tests assert on it)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+
+def default_vms(project: Optional[str], zone: Optional[str] = None,
+                vms=None, network: Optional[str] = None):
+    """The shared ``vms``-injection fallback: tests pass a fake
+    manager, production constructs a GceVmManager lazily (the import
+    stays local so gcloud-less environments never pay for it)."""
+    if vms is not None:
+        return vms
+    from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+    return GceVmManager(project, zone=zone, network=network)
+
+
+def ssh_argv(ip: str, username: Optional[str] = None,
+             ssh_private_key: Optional[str] = None,
+             command: Optional[str] = None) -> list[str]:
+    """ssh argv for a service VM (reference _monitor_ssh analog:
+    convoy/fleet.py:4721). Strict host checking is off because
+    service VMs are recreated freely and their host keys churn."""
+    argv = ["ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null"]
+    if ssh_private_key:
+        argv += ["-i", ssh_private_key]
+    argv.append(f"{username}@{ip}" if username else ip)
+    if command:
+        argv.append(command)
+    return argv
+
+
+def suspend_vm(vms, name: str, store=None, table: str = "",
+               pk: str = "", rk: str = "") -> None:
+    """Stop a service VM in place (reference suspend_monitoring_
+    resource analog, convoy/fleet.py:4735)."""
+    vms.stop_vm(name)
+    if store is not None and table:
+        try:
+            store.merge_entity(table, pk, rk or name,
+                               {"state": "suspended"})
+        except Exception:  # noqa: BLE001 - registration row optional
+            logger.warning("no registration row to mark suspended "
+                           "for %s", name)
+
+
+def start_vm(vms, name: str, store=None, table: str = "",
+             pk: str = "", rk: str = "") -> None:
+    """Restart a suspended service VM."""
+    vms.start_vm(name)
+    if store is not None and table:
+        try:
+            store.merge_entity(table, pk, rk or name,
+                               {"state": "running"})
+        except Exception:  # noqa: BLE001
+            logger.warning("no registration row to mark running "
+                           "for %s", name)
+
+
+def vm_status(vms, name: str, record: Optional[dict] = None) -> dict:
+    """Stored record + live instance status (unknown when the probe
+    fails — status must degrade, not raise, for a deleted VM)."""
+    out = {"name": name, "record": record or {}}
+    try:
+        out["vm_status"] = vms.vm_status(name)
+    except Exception as exc:  # noqa: BLE001 - live probe optional
+        out["vm_status"] = f"unknown ({exc})"
+    return out

@@ -79,6 +79,15 @@ class FakeBlob:
             self.updated = datetime.datetime.now(
                 datetime.timezone.utc)
 
+    def generate_signed_url(self, version="v4", method="GET",
+                            expiration=None):
+        # Deterministic fake: enough for the store-level contract
+        # (URL embeds blob, method and expiry seconds).
+        secs = int(expiration.total_seconds()) if expiration else 0
+        return (f"https://storage.googleapis.example/{self.name}"
+                f"?X-Goog-Method={method}&X-Goog-Expires={secs}"
+                f"&X-Goog-Signature=fake")
+
     def delete(self, if_generation_match=None):
         with self._store.lock:
             if self.name not in self._store.blobs:

@@ -1,0 +1,200 @@
+"""pool nodes operator verbs (VERDICT r4 next #2) on the FakePod and
+localhost substrates: count, grls, ps, zap, prune via agent control
+messages; reboot/del via slice-granular substrate ops. Reference:
+shipyard.py:1795-1945, convoy/fleet.py:2468, convoy/batch.py:3074."""
+
+import time
+
+import pytest
+
+from batch_shipyard_tpu.agent import cascade
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def make_env(slices=2):
+    conf = {"pool_specification": {
+        "id": "pool1", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-8",
+                "num_slices": slices},
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    return store, substrate, pool
+
+
+@pytest.fixture()
+def env():
+    store, substrate, pool = make_env()
+    yield store, substrate, pool
+    substrate.stop_all()
+
+
+def test_nodes_count_histogram(env):
+    store, _substrate, pool = env
+    counts = pool_mgr.node_counts(store, pool.id)
+    # v5litepod-8 = 2 workers per slice, 2 slices.
+    assert counts["total"] == 4
+    assert sum(counts["by_state"].values()) == 4
+    assert set(counts["by_state"]) <= {"idle", "running"}
+
+
+def test_nodes_grls_all_and_single(env):
+    store, substrate, pool = env
+    rows = pool_mgr.remote_login_settings(store, substrate, pool.id)
+    assert len(rows) == 4
+    assert all(r["ip"] for r in rows)
+    one = pool_mgr.remote_login_settings(
+        store, substrate, pool.id, rows[0]["node_id"])
+    assert len(one) == 1 and one[0]["node_id"] == rows[0]["node_id"]
+    with pytest.raises(pool_mgr.PoolNotFoundError):
+        pool_mgr.remote_login_settings(store, substrate, pool.id,
+                                       "nope")
+
+
+def test_nodes_ps_shows_running_task_and_zap_kills_it(env):
+    store, _substrate, pool = env
+    jobs_mgr.add_jobs(store, pool, settings_mod.job_settings_list(
+        {"job_specifications": [{
+            "id": "job1", "tasks": [{"command": "sleep 60"}]}]}))
+    # Wait for the task to actually start somewhere.
+    deadline = time.monotonic() + 15
+    busy = []
+    while time.monotonic() < deadline and not busy:
+        replies = pool_mgr.nodes_ps(store, pool.id, timeout=10)
+        busy = [r for r in replies if r.get("running_tasks")]
+        time.sleep(0.1)
+    assert busy, f"no node reported the running task: {replies}"
+    entry = busy[0]["running_tasks"][0]
+    assert entry["job_id"] == "job1"
+    assert entry["pid"]
+
+    zapped = pool_mgr.nodes_zap(store, pool.id,
+                                node_id=busy[0]["node_id"],
+                                timeout=10)
+    assert zapped[0]["killed_tasks"] == [
+        {"job_id": "job1", "task_id": entry["task_id"]}]
+    # The killed task completes as failed (nonzero exit).
+    tasks = jobs_mgr.wait_for_tasks(store, pool.id, "job1",
+                                    timeout=30)
+    assert tasks[0]["state"] in ("failed", "completed")
+    assert tasks[0]["exit_code"] != 0
+
+
+def test_nodes_ps_idle_pool_is_empty(env):
+    store, _substrate, pool = env
+    replies = pool_mgr.nodes_ps(store, pool.id, timeout=10)
+    assert len(replies) == 4
+    assert all(r["running_tasks"] == [] for r in replies)
+    assert all("replied_at" in r for r in replies)
+
+
+def test_nodes_prune_removes_unreferenced_cache(env):
+    store, substrate, pool = env
+    # Preload two tarballs, then rewrite the manifest to reference
+    # only one — prune must drop exactly the orphan.
+    cascade.preload_image_tarball(store, pool.id, "img/keep:1",
+                                  (b"x" * 1024 for _ in range(2)))
+    cascade.preload_image_tarball(store, pool.id, "img/drop:1",
+                                  (b"y" * 1024 for _ in range(2)))
+    nodes = pool_mgr.list_nodes(store, pool.id)
+    agent = substrate.agent(pool.id, nodes[0].node_id)
+    prov = cascade.CascadeImageProvisioner(store)
+    agent._image_provisioner = prov
+    # Force both tarballs into this node's cache.
+    prov(agent, ["img/keep:1", "img/drop:1"])
+    import os
+    cache = prov._cache_dir
+    assert len(os.listdir(cache)) == 2
+    # Orphan img/drop: remove its manifest row.
+    from batch_shipyard_tpu.state import names as names_mod
+    from batch_shipyard_tpu.utils import util as util_mod
+    drop_key = util_mod.hash_string("docker:img/drop:1")[:24]
+    store.delete_entity(names_mod.TABLE_IMAGES, pool.id, drop_key)
+
+    reply = pool_mgr.nodes_prune(store, pool.id,
+                                 node_id=nodes[0].node_id,
+                                 timeout=10)[0]
+    assert reply["removed_cached"] == [f"{drop_key}.tar"]
+    assert reply["freed_bytes"] == 2048
+    assert len(os.listdir(cache)) == 1
+
+
+def test_reboot_node_recreates_its_slice(env):
+    store, substrate, pool = env
+    before = pool_mgr.list_nodes(store, pool.id)
+    victim = [n for n in before if n.slice_index == 1][0]
+    s = pool_mgr.reboot_node(store, substrate, pool, victim.node_id)
+    assert s == 1
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        after = pool_mgr.list_nodes(store, pool.id)
+        ready = [n for n in after
+                 if n.state in pool_mgr.READY_STATES]
+        if len(after) == 4 and len(ready) == 4:
+            break
+        time.sleep(0.1)
+    assert len(pool_mgr.list_nodes(store, pool.id)) == 4
+
+
+def test_delete_node_deallocates_slice_without_replacement(env):
+    store, substrate, pool = env
+    before = pool_mgr.list_nodes(store, pool.id)
+    victim = [n for n in before if n.slice_index == 0][0]
+    s = pool_mgr.delete_node(store, substrate, pool, victim.node_id)
+    assert s == 0
+    after = pool_mgr.list_nodes(store, pool.id)
+    assert len(after) == 2
+    assert all(n.slice_index == 1 for n in after)
+    with pytest.raises(pool_mgr.PoolNotFoundError):
+        pool_mgr.get_node(store, pool.id, victim.node_id)
+
+
+def test_send_control_and_wait_times_out_on_dead_node(env):
+    store, _substrate, pool = env
+    with pytest.raises(TimeoutError):
+        pool_mgr.send_control_and_wait(
+            store, pool.id, "no-such-node", {"type": "ps"},
+            timeout=1.0)
+
+
+def test_fanout_reports_non_ready_nodes_without_waiting(env):
+    store, _substrate, pool = env
+    from batch_shipyard_tpu.state import names as names_mod
+    store.upsert_entity(names_mod.TABLE_NODES, pool.id, "ghost", {
+        "state": "suspended", "node_index": 99, "slice_index": 9,
+        "worker_index": 0})
+    start = time.monotonic()
+    replies = pool_mgr.nodes_ps(store, pool.id, timeout=10)
+    elapsed = time.monotonic() - start
+    ghost = [r for r in replies if r.get("node_id") == "ghost"][0]
+    assert "not ready" in ghost["error"]
+    # The suspended node must not consume the timeout: live nodes
+    # answer fast and the ghost is reported immediately.
+    assert elapsed < 8
+    assert sum(1 for r in replies if "error" not in r) == 4
+
+
+def test_expired_destructive_control_is_dropped(env):
+    store, substrate, pool = env
+    node = pool_mgr.list_nodes(store, pool.id)[0]
+    agent = substrate.agent(pool.id, node.node_id)
+    from batch_shipyard_tpu.state import names as names_mod
+    reply_key = names_mod.control_reply_key(pool.id, node.node_id,
+                                            "deadbeef")
+    agent._handle_control({"type": "zap", "reply_key": reply_key,
+                           "expires_at": time.time() - 5.0})
+    # Dropped: no reply object written, nothing executed.
+    assert not store.object_exists(reply_key)
+    # A live (unexpired) one still answers.
+    agent._handle_control({"type": "zap", "reply_key": reply_key,
+                           "expires_at": time.time() + 30.0})
+    assert store.object_exists(reply_key)

@@ -1,0 +1,199 @@
+"""account quota + allocation preflight (VERDICT r4 next #4): pinned
+gcloud payloads through the injectable runner; pool-add advisory
+warnings; stockout advisory folded into the allocation error record.
+Reference: shipyard.py:1009-1078 (account quota/images),
+convoy/batch.py:661-672 (resize error classification)."""
+
+import json
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.substrate import quota as quota_mod
+
+# Pinned payload: gcloud compute tpus accelerator-types list
+# --format=json (full resource names, current gcloud shape).
+ACCEL_TYPES = json.dumps([
+    {"name": "projects/p/locations/us-central1-a/acceleratorTypes/"
+             "v5litepod-16", "acceleratorType": "v5litepod-16"},
+    {"name": "projects/p/locations/us-central1-a/acceleratorTypes/"
+             "v5litepod-8", "acceleratorType": "v5litepod-8"},
+    {"name": "projects/p/locations/us-central1-a/acceleratorTypes/"
+             "v3-8"},
+])
+
+# Pinned payload: gcloud alpha services quota list (ServiceQuota
+# shape: metric -> consumerQuotaLimits -> quotaBuckets).
+QUOTAS = json.dumps([
+    {"metric": "tpu.googleapis.com/v5litepod_chips",
+     "consumerQuotaLimits": [{
+         "unit": "1/{project}/{region}",
+         "quotaBuckets": [
+             {"effectiveLimit": "16",
+              "dimensions": {"region": "us-central1"}},
+             {"defaultLimit": "8"},
+         ]}]},
+    {"metric": "tpu.googleapis.com/v4_chips",
+     "consumerQuotaLimits": [{
+         "unit": "1/{project}/{region}",
+         "quotaBuckets": [
+             {"effectiveLimit": "0",
+              "dimensions": {"region": "us-central1"}}]}]},
+])
+
+
+class FakeGcloudRunner:
+    def __init__(self, accel_by_zone=None, quotas=QUOTAS):
+        self.accel_by_zone = accel_by_zone or {
+            "us-central1-a": ACCEL_TYPES}
+        self.quotas = quotas
+        self.calls = []
+
+    def __call__(self, argv, **_kw):
+        self.calls.append(argv)
+        joined = " ".join(argv)
+        if "accelerator-types" in joined:
+            zone = [a for a in argv if a.startswith("--zone=")][0]
+            zone = zone.split("=", 1)[1]
+            payload = self.accel_by_zone.get(zone)
+            if payload is None:
+                return 1, "", "zone not found"
+            return 0, payload, ""
+        if "services quota" in joined or "quota" in joined:
+            return 0, self.quotas, ""
+        return 1, "", f"unexpected argv {argv}"
+
+
+def client(**kw):
+    return quota_mod.TpuQuotaClient("proj",
+                                    runner=FakeGcloudRunner(**kw))
+
+
+def make_pool(accel="v5litepod-16", slices=1, zone=None):
+    spec = {"pool_specification": {
+        "id": "qp", "substrate": "tpu_vm",
+        "tpu": {"accelerator_type": accel, "num_slices": slices}}}
+    if zone:
+        spec["pool_specification"]["zone"] = zone
+    return settings_mod.pool_settings(spec)
+
+
+def test_accelerator_types_parses_both_shapes():
+    types = client().accelerator_types("us-central1-a")
+    assert types == ["v3-8", "v5litepod-16", "v5litepod-8"]
+
+
+def test_quota_limits_filtered_by_region():
+    rows = client().quota_limits(region="us-central1")
+    metrics = {r["metric"]: r["limit"] for r in rows
+               if r["region"] == "us-central1"}
+    assert metrics["tpu.googleapis.com/v5litepod_chips"] == 16
+    assert metrics["tpu.googleapis.com/v4_chips"] == 0
+    # The dimensionless default bucket also passes the filter.
+    assert any(r["region"] == "" and r["limit"] == 8 for r in rows)
+
+
+def test_quota_report_shape():
+    report = quota_mod.quota_report(client(), "us-central1-a")
+    assert report["project"] == "proj"
+    assert "v5litepod-16" in report["accelerator_types"]
+    assert report["quota_limits"]
+
+
+def test_preflight_ok_is_silent():
+    pool = make_pool(zone="us-central1-a")
+    assert quota_mod.preflight_pool(pool, client()) == []
+
+
+def test_preflight_warns_on_unoffered_type():
+    pool = make_pool(accel="v5p-8", zone="us-central1-a")
+    warnings = quota_mod.preflight_pool(pool, client())
+    assert len(warnings) == 1
+    assert "not offered in zone us-central1-a" in warnings[0]
+
+
+def test_preflight_warns_when_request_exceeds_quota():
+    # 2 slices of v5litepod-16 = 32 chips > 16 chip quota.
+    pool = make_pool(slices=2, zone="us-central1-a")
+    warnings = quota_mod.preflight_pool(pool, client())
+    assert any("needs 32 v5litepod chips" in w and "is 16" in w
+               for w in warnings)
+
+
+def test_preflight_degrades_when_gcloud_fails():
+    pool = make_pool(zone="europe-west4-a")  # zone not in fake
+    warnings = quota_mod.preflight_pool(pool, client())
+    assert len(warnings) == 1
+    assert "preflight unavailable" in warnings[0]
+
+
+def test_preflight_no_zone_is_silent():
+    assert quota_mod.preflight_pool(make_pool(), client()) == []
+
+
+def test_stockout_advisory_names_sibling_zones():
+    c = quota_mod.TpuQuotaClient("proj", runner=FakeGcloudRunner(
+        accel_by_zone={"us-central1-a": ACCEL_TYPES,
+                       "us-central1-b": ACCEL_TYPES,
+                       "us-central1-c": json.dumps([])}))
+    advisory = quota_mod.stockout_advisory(
+        c, "v5litepod-16", "us-central1-a",
+        ["us-central1-b", "us-central1-c", "us-central1-d"])
+    assert "us-central1-b" in advisory
+    assert "us-central1-c" not in advisory
+    # No zone offers it -> no advisory at all.
+    assert quota_mod.stockout_advisory(
+        c, "v6e-8", "us-central1-a", ["us-central1-b"]) is None
+
+
+def test_pool_add_preflight_via_fleet(monkeypatch, tmp_path):
+    """fleet.action_pool_add surfaces preflight warnings without
+    blocking the (fake-substrate-backed) allocation."""
+    from batch_shipyard_tpu import fleet as fleet_mod
+
+    class Ctx:  # minimal Context duck
+        pool = make_pool(slices=2, zone="us-central1-a")
+        credentials = settings_mod.credentials_settings(
+            {"credentials": {"gcp": {"project": "proj",
+                                     "zone": "us-central1-a"},
+                             "storage": {"backend": "memory"}}})
+
+    warnings = fleet_mod._quota_preflight(Ctx(), client())
+    assert any("needs 32" in w for w in warnings)
+    # Non-tpu_vm pools skip preflight entirely.
+    Ctx.pool = settings_mod.pool_settings({"pool_specification": {
+        "id": "qp", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16",
+                "num_slices": 2}}})
+    assert fleet_mod._quota_preflight(Ctx(), client()) == []
+
+
+def test_gcp_substrate_folds_advisory_into_stockout(monkeypatch):
+    from batch_shipyard_tpu.state import names
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.gcp_tpu import GcpTpuSubstrate
+
+    monkeypatch.setattr("shutil.which", lambda _: "/usr/bin/gcloud")
+    store = MemoryStateStore()
+    creds = settings_mod.credentials_settings({"credentials": {
+        "gcp": {"project": "proj", "zone": "us-central1-a"},
+        "storage": {"backend": "memory"}}})
+    sub = GcpTpuSubstrate(store, creds)
+    sub.quota_client = quota_mod.TpuQuotaClient(
+        "proj", runner=FakeGcloudRunner(
+            accel_by_zone={"us-central1-b": ACCEL_TYPES}))
+
+    def fake_gcloud(self, *args, parse_json=False, zone=None):
+        if args[0] == "create":
+            raise RuntimeError(
+                "There is no more capacity in the zone")
+        return {} if parse_json else ""
+
+    monkeypatch.setattr(GcpTpuSubstrate, "_gcloud", fake_gcloud)
+    pool = make_pool(zone="us-central1-a")
+    store.insert_entity(names.TABLE_POOLS, "pools", pool.id, {})
+    with pytest.raises(RuntimeError):
+        sub.allocate_pool(pool)
+    row = store.get_entity(names.TABLE_POOLS, "pools", pool.id)
+    assert row["allocation_error_kind"] == "stockout"
+    assert "us-central1-b" in row["allocation_error_advisory"]

@@ -1,0 +1,161 @@
+"""Service-VM lifecycle verbs (VERDICT r4 next #3): monitor / fed
+proxy / slurm control-plane ssh, suspend, start, status over the
+injectable gcloud runner. Reference: shipyard.py:2416-2573 (monitor),
+:2573+ (fed proxy), :2918+ (slurm ssh), convoy/fleet.py:4721-4878."""
+
+import pytest
+
+from batch_shipyard_tpu.federation import federation as fed_mod
+from batch_shipyard_tpu.federation import provision as fed_prov
+from batch_shipyard_tpu.monitor import provision as mon_prov
+from batch_shipyard_tpu.slurm import provision as slurm_prov
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.substrate.gce_vm import GceVmManager
+from batch_shipyard_tpu.utils import service_vm
+
+
+class FakeRunner:
+    def __init__(self):
+        self.calls = []
+        self.status = "RUNNING"
+
+    def __call__(self, argv, **_kw):
+        self.calls.append(list(argv))
+        joined = " ".join(argv)
+        if "describe" in joined and "networkIP" in joined:
+            return 0, "10.0.0.9\n", ""
+        if "describe" in joined and "status" in joined:
+            return 0, f"{self.status}\n", ""
+        return 0, "", ""
+
+    def verbs(self):
+        return [c[2] + ":" + c[3] for c in self.calls]
+
+
+@pytest.fixture()
+def env():
+    store = MemoryStateStore()
+    runner = FakeRunner()
+    vms = GceVmManager("proj", zone="us-central1-a", runner=runner)
+    return store, vms, runner
+
+
+def test_ssh_argv_shape():
+    argv = service_vm.ssh_argv("10.0.0.9", username="ops",
+                               ssh_private_key="/k",
+                               command="uptime")
+    assert argv[0] == "ssh"
+    assert "-i" in argv and "/k" in argv
+    assert "ops@10.0.0.9" in argv
+    assert argv[-1] == "uptime"
+    assert service_vm.ssh_argv("10.0.0.9")[-1] == "10.0.0.9"
+
+
+# ------------------------------ monitor ------------------------------
+
+def test_monitor_lifecycle(env):
+    store, vms, runner = env
+    mon_prov.provision_monitoring_vm(store, "proj", vms=vms)
+    status = mon_prov.monitoring_vm_status(store, vms=vms)
+    assert status["vm_status"] == "RUNNING"
+    assert status["record"]["internal_ip"] == "10.0.0.9"
+
+    mon_prov.suspend_monitoring_vm(store, vms=vms)
+    assert "instances:stop" in runner.verbs()
+    assert mon_prov.monitoring_vm_status(
+        store, vms=vms)["record"]["state"] == "suspended"
+
+    mon_prov.start_monitoring_vm(store, vms=vms)
+    assert "instances:start" in runner.verbs()
+    assert mon_prov.monitoring_vm_status(
+        store, vms=vms)["record"]["state"] == "running"
+
+    argv = mon_prov.monitoring_vm_ssh_argv(store, username="ops")
+    assert "ops@10.0.0.9" in argv
+
+
+def test_monitor_verbs_require_registration(env):
+    store, vms, _runner = env
+    with pytest.raises(ValueError):
+        mon_prov.monitoring_vm_status(store, vms=vms)
+    with pytest.raises(ValueError):
+        mon_prov.suspend_monitoring_vm(store, vms=vms)
+    with pytest.raises(ValueError):
+        mon_prov.monitoring_vm_ssh_argv(store)
+
+
+# ----------------------------- fed proxy -----------------------------
+
+def test_fed_proxy_lifecycle(env):
+    store, vms, runner = env
+    fed_mod.create_federation(store, "fedx")
+    fed_prov.provision_proxy_vm(store, "fedx", "proj", replica=0,
+                                vms=vms)
+    fed_prov.provision_proxy_vm(store, "fedx", "proj", replica=1,
+                                vms=vms)
+    status = fed_prov.proxy_vm_status(store, "fedx", vms=vms)
+    assert [s["name"] for s in status] == [
+        "shipyard-fed-fedx-proxy0", "shipyard-fed-fedx-proxy1"]
+    assert all(s["vm_status"] == "RUNNING" for s in status)
+
+    assert fed_prov.suspend_proxy_vms(store, "fedx", vms=vms,
+                                      replica=1) == 1
+    assert runner.verbs().count("instances:stop") == 1
+    assert fed_prov.start_proxy_vms(store, "fedx", vms=vms) == 2
+    assert runner.verbs().count("instances:start") == 2
+
+    argv = fed_prov.proxy_vm_ssh_argv(store, "fedx", replica=1)
+    assert "10.0.0.9" in argv
+    with pytest.raises(ValueError):
+        fed_prov.proxy_vm_ssh_argv(store, "fedx", replica=7)
+    with pytest.raises(ValueError):
+        fed_prov.proxy_vm_status(store, "nope", vms=vms)
+
+
+# ------------------------------- slurm -------------------------------
+
+def _mk_cluster(store, vms):
+    return slurm_prov.create_slurm_cluster(
+        store, "clu", "# slurm.conf", "pw", "proj",
+        login_count=2, vms=vms)
+
+
+def test_slurm_cluster_suspend_start(env):
+    store, vms, runner = env
+    _mk_cluster(store, vms)
+    stopped = slurm_prov.suspend_slurm_cluster(store, "clu", vms=vms)
+    assert stopped == ["shipyard-slurm-clu-controller",
+                       "shipyard-slurm-clu-login0",
+                       "shipyard-slurm-clu-login1"]
+    assert runner.verbs().count("instances:stop") == 3
+    record = slurm_prov.slurm_cluster_status(store, "clu")["cluster"]
+    assert record["state"] == "suspended"
+    started = slurm_prov.start_slurm_cluster(store, "clu", vms=vms)
+    assert len(started) == 3
+    assert runner.verbs().count("instances:start") == 3
+
+
+def test_slurm_ssh_targets(env):
+    store, vms, _runner = env
+    _mk_cluster(store, vms)
+    assert "10.0.0.9" in slurm_prov.slurm_ssh_argv(
+        store, "clu", target="controller")
+    assert "10.0.0.9" in slurm_prov.slurm_ssh_argv(
+        store, "clu", target="login", index=1)
+    with pytest.raises(ValueError):
+        slurm_prov.slurm_ssh_argv(store, "clu", target="login",
+                                  index=5)
+    # node target resolves through burst assignment rows.
+    from batch_shipyard_tpu.state import names
+    store.upsert_entity(names.TABLE_SLURM, "clu$part",
+                        "part-0", {"node_id": "n0",
+                                   "internal_ip": "10.1.0.3"})
+    argv = slurm_prov.slurm_ssh_argv(
+        store, "clu", target="node", partition="part",
+        host="part-0", command="hostname")
+    assert "10.1.0.3" in argv and argv[-1] == "hostname"
+    with pytest.raises(ValueError):
+        slurm_prov.slurm_ssh_argv(store, "clu", target="node",
+                                  partition="part", host="part-9")
+    with pytest.raises(ValueError):
+        slurm_prov.slurm_ssh_argv(store, "clu", target="bogus")

@@ -1,0 +1,110 @@
+"""Signed-URL generation (VERDICT r4 next #7, `storage sas` analog,
+reference shipyard.py:1327): V4 URLs through the gcs backend's fake
+client, clear refusal on local backends, and the CLI verb incl.
+prefix mode."""
+
+import json
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from batch_shipyard_tpu.cli.main import cli
+from batch_shipyard_tpu.state.base import NotFoundError
+from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from tests.fake_gcs import make_fake_gcs_store
+
+
+@pytest.fixture()
+def gcs():
+    return make_fake_gcs_store()
+
+
+def test_signed_get_url_for_existing_object(gcs):
+    gcs.put_object("out/result.bin", b"payload")
+    url = gcs.generate_signed_url("out/result.bin",
+                                  expires_seconds=600)
+    assert url.startswith("https://")
+    assert "out/result.bin" in url
+    assert "X-Goog-Expires=600" in url
+    assert "X-Goog-Method=GET" in url
+
+
+def test_signed_get_missing_object_raises(gcs):
+    with pytest.raises(NotFoundError):
+        gcs.generate_signed_url("absent.bin")
+
+
+def test_signed_put_url_does_not_require_existence(gcs):
+    url = gcs.generate_signed_url("incoming/up.bin", method="PUT")
+    assert "X-Goog-Method=PUT" in url
+
+
+def test_unsupported_method_rejected(gcs):
+    with pytest.raises(ValueError):
+        gcs.generate_signed_url("k", method="POST")
+
+
+@pytest.mark.parametrize("store_cls", [MemoryStateStore])
+def test_local_backends_refuse_clearly(store_cls, tmp_path):
+    store = store_cls()
+    with pytest.raises(NotImplementedError) as exc:
+        store.generate_signed_url("k")
+    assert "gcs backend" in str(exc.value)
+
+
+def test_localfs_refuses_clearly(tmp_path):
+    store = LocalFSStateStore(str(tmp_path / "s"))
+    with pytest.raises(NotImplementedError):
+        store.generate_signed_url("k")
+
+
+@pytest.fixture()
+def configdir(tmp_path):
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "config": {"global_resources": {"docker_images": []}},
+        "pool": {"pool_specification": {
+            "id": "p", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-8"}}},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    return str(tmp_path)
+
+
+def test_cli_sas_on_localfs_errors_cleanly(configdir):
+    result = CliRunner().invoke(
+        cli, ["--configdir", configdir, "storage", "sas", "some/key"])
+    assert result.exit_code != 0
+    assert "gcs backend" in result.output
+
+
+def test_cli_sas_prefix_put_rejected(configdir):
+    result = CliRunner().invoke(
+        cli, ["--configdir", configdir, "storage", "sas", "p/",
+              "--prefix", "--method", "PUT"])
+    assert result.exit_code != 0
+    assert "GET-only" in result.output
+
+
+def test_cli_sas_gcs_prefix(configdir, monkeypatch):
+    """Prefix mode signs every object under the prefix (GET)."""
+    store = make_fake_gcs_store()
+    store.put_object("ingress/a.bin", b"a")
+    store.put_object("ingress/b.bin", b"b")
+    store.put_object("other/c.bin", b"c")
+    from batch_shipyard_tpu import fleet as fleet_mod
+    monkeypatch.setattr(fleet_mod, "create_statestore",
+                        lambda *_a, **_k: store)
+    result = CliRunner().invoke(
+        cli, ["--configdir", configdir, "--raw", "storage", "sas",
+              "ingress/", "--prefix"], catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    out = json.loads(result.output)
+    assert set(out["urls"]) == {"ingress/a.bin", "ingress/b.bin"}
+    assert all(u.startswith("https://") for u in out["urls"].values())
