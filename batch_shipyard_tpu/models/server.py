@@ -53,6 +53,36 @@ class RequestCancelled(Exception):
     """The request was aborted via the cancel API."""
 
 
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared handler base for the serving HTTP surfaces (this front
+    end and models/router.py): HTTP/1.1 (required for chunked
+    streaming; all non-streaming replies carry Content-Length so
+    keep-alive is safe), silenced per-request logging, and the JSON
+    reply helper."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: N802
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _delete_request_id(self) -> Optional[str]:
+        """Parse /v1/requests/<id> from a DELETE path; None (and a
+        404 reply) otherwise."""
+        prefix = "/v1/requests/"
+        if not self.path.startswith(prefix):
+            self._reply(404, {"error": "not found"})
+            return None
+        return self.path[len(prefix):]
+
+
 class _Pending:
     __slots__ = ("request", "event", "submitted_at", "first_token_at",
                  "finished_at", "tokens", "error", "token_queue",
@@ -115,32 +145,17 @@ class ServingFrontEnd:
             target=self._engine_loop, name="serving-engine", daemon=True)
         front = self
 
-        class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.1 is REQUIRED for the chunked streaming path:
-            # chunked framing is invalid on 1.0 and strict clients
-            # would deliver raw chunk-size lines as body bytes. All
-            # non-streaming replies carry Content-Length, so
-            # keep-alive is safe.
-            protocol_version = "HTTP/1.1"
-
-            # Silence per-request stderr logging.
-            def log_message(self, fmt, *args):  # noqa: N802
-                pass
-
-            def _reply(self, code: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(JsonRequestHandler):
             def do_DELETE(self):  # noqa: N802
-                prefix = "/v1/requests/"
-                if not self.path.startswith(prefix):
-                    self._reply(404, {"error": "not found"})
+                request_id = self._delete_request_id()
+                if request_id is None:
                     return
-                request_id = self.path[len(prefix):]
+                # Unknown ids 404 so a fleet router's broadcast
+                # cancel can keep probing replicas for the owner.
+                if not front.knows(request_id):
+                    self._reply(404, {"error": f"unknown request_id "
+                                               f"{request_id}"})
+                    return
                 front.cancel(request_id)
                 self._reply(202, {"request_id": request_id,
                                   "cancelling": True})
@@ -373,6 +388,13 @@ class ServingFrontEnd:
         if pending.error is not None:
             raise ValueError(pending.error)
 
+    def knows(self, request_id: str) -> bool:
+        """Whether this front end currently owns the request (in
+        flight or actively decoding)."""
+        with self._inflight_lock:
+            return (request_id in self._inflight or
+                    request_id in self._engine_active)
+
     def cancel(self, request_id: str) -> None:
         """Request an abort; the engine thread performs it and the
         waiting client completes with a 'cancelled' error."""
@@ -397,6 +419,8 @@ class ServingFrontEnd:
         tokens = sum(r["num_tokens"] for r in done)
         ttfts = [r["ttft_ms"] for r in done]
         tpots = [r["tpot_ms"] for r in done]
+        with self._inflight_lock:
+            inflight = len(self._inflight)
         return {
             "completed_requests": len(done),
             "generated_tokens": tokens,
@@ -404,6 +428,11 @@ class ServingFrontEnd:
             "tokens_per_second": tokens / elapsed if elapsed else 0.0,
             "ttft_ms": {p: percentile(ttfts, p) for p in (50, 95, 99)},
             "tpot_ms": {p: percentile(tpots, p) for p in (50, 95, 99)},
+            # Router observability (models/router.py polls these):
+            # requests this front end has accepted but not completed,
+            # and the engine's queued+active total.
+            "inflight": inflight,
+            "engine_backlog": self.engine.pending(),
         }
 
     # --------------------------- engine thread -------------------------

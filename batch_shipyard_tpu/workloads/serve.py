@@ -27,12 +27,18 @@ from batch_shipyard_tpu.models import transformer as tfm
 from batch_shipyard_tpu.models.server import ServingFrontEnd
 
 
-def build_engine(args) -> serving.ContinuousBatcher:
-    config = tfm.TransformerConfig(
+def build_config(args) -> tfm.TransformerConfig:
+    return tfm.TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=args.d_ff,
         max_seq_len=args.max_decode_len, dtype=jnp.bfloat16)
+
+
+def build_params(args, config: tfm.TransformerConfig):
+    """Init (or checkpoint-restore) ONE param tree — fleet mode
+    shares it across every replica engine rather than paying the
+    init/restore and a full weight copy per replica."""
     model = tfm.TransformerLM(config)
     params = model.init(
         jax.random.PRNGKey(args.seed),
@@ -70,6 +76,15 @@ def build_engine(args) -> serving.ContinuousBatcher:
             restored_params)
         print(f"serving checkpoint step {step} from "
               f"{args.checkpoint_dir}", flush=True)
+    return params
+
+
+def build_engine(args, config=None,
+                 params=None) -> serving.ContinuousBatcher:
+    if config is None:
+        config = build_config(args)
+    if params is None:
+        params = build_params(args, config)
     return serving.ContinuousBatcher(
         config, params, num_slots=args.num_slots,
         max_decode_len=args.max_decode_len,
@@ -111,29 +126,65 @@ def main() -> int:
     parser.add_argument("--checkpoint-dir", default=None,
                         help="Serve params from the latest Orbax "
                              "checkpoint (train_transformer output)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="Run N replica engines behind the "
+                             "queue-depth-aware fleet router "
+                             "(models/router.py); the router binds "
+                             "--host/--port")
     args = parser.parse_args()
 
-    engine = build_engine(args)
-    front = ServingFrontEnd(engine, host=args.host,
-                            port=args.port).start()
-    print(f"serving on {front.url}", flush=True)
+    fronts = []
+    router = None
+    if args.replicas > 1:
+        # Fleet mode: replicas bind ephemeral loopback ports; the
+        # router is the public surface (same wire API).
+        from batch_shipyard_tpu.models.router import ServingRouter
+        config = build_config(args)
+        params = build_params(args, config)
+        engines = [build_engine(args, config, params)
+                   for _ in range(args.replicas)]
+        fronts = [ServingFrontEnd(e, port=0).start()
+                  for e in engines]
+        router = ServingRouter([f.url for f in fronts],
+                               host=args.host,
+                               port=args.port).start()
+        url = router.url
+        print(f"fleet router on {url} over {len(fronts)} "
+              f"replica(s)", flush=True)
+    else:
+        engine = build_engine(args)
+        fronts = [ServingFrontEnd(engine, host=args.host,
+                                  port=args.port).start()]
+        url = fronts[0].url
+        print(f"serving on {url}", flush=True)
+
+    def _shutdown():
+        if router is not None:
+            router.shutdown()
+        for f in fronts:
+            f.shutdown()
+
     if not args.loadgen:
         try:
-            front._http_thread.join()
+            fronts[0]._http_thread.join()
         except KeyboardInterrupt:
             pass
         finally:
-            front.shutdown()
+            _shutdown()
         return 0
     from batch_shipyard_tpu.models.loadgen import run_load
-    # One warmup request so jit compilation doesn't pollute TTFT.
-    front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
+    # One warmup request per replica so jit compilation doesn't
+    # pollute TTFT.
+    for front in fronts:
+        front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
     report = run_load(
-        front.url, args.loadgen, rate_hz=args.rate,
+        url, args.loadgen, rate_hz=args.rate,
         prompt_len=tuple(args.prompt_len),
         max_new_tokens=tuple(args.gen_tokens),
         vocab_size=args.vocab, seed=args.seed)
-    front.shutdown()
+    if router is not None:
+        report["router"] = router.stats()
+    _shutdown()
     with open(args.report, "w", encoding="utf-8") as fh:
         json.dump(report, fh, indent=2)
     print(json.dumps(report), flush=True)

@@ -204,6 +204,62 @@ def bench_serving(num_requests: int = 48, rate_hz: float = 16.0,
     return report
 
 
+def bench_serving_fleet(num_replicas: int = 2,
+                        num_requests: int = 64,
+                        rate_hz: float = 24.0,
+                        num_slots: int = 8,
+                        max_decode_len: int = 512,
+                        d_model: int = 1024, n_layers: int = 12,
+                        n_heads: int = 16, d_ff: int = 2816) -> dict:
+    """Fleet phase: N replica engines (sharing one param set) behind
+    the queue-depth-aware router (models/router.py), loadgen pointed
+    at the single router URL — the deployment shape a real serving
+    fleet uses, measured end to end."""
+    import jax
+    import jax.numpy as jnp
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import serving
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.models.loadgen import run_load
+    from batch_shipyard_tpu.models.router import ServingRouter
+    from batch_shipyard_tpu.models.server import ServingFrontEnd
+    config = tfm.TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, d_head=d_model // n_heads, d_ff=d_ff,
+        max_seq_len=max_decode_len, dtype=jnp.bfloat16)
+    model = tfm.TransformerLM(config)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    fronts = []
+    router = None
+    try:
+        for _ in range(num_replicas):
+            engine = serving.ContinuousBatcher(
+                config, params, num_slots=num_slots,
+                max_decode_len=max_decode_len,
+                sampling=inf.SamplingConfig())
+            fronts.append(ServingFrontEnd(engine, port=0).start())
+        router = ServingRouter([f.url for f in fronts],
+                               health_interval=1.0).start()
+        # Warmup through the router so compiles stay out of TTFT.
+        for f in fronts:
+            f.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
+        quarter = max(8, max_decode_len // 4)
+        report = run_load(
+            router.url, num_requests, rate_hz=rate_hz,
+            prompt_len=(quarter // 2, quarter),
+            max_new_tokens=(quarter // 2, quarter),
+            vocab_size=32000, seed=0)
+        report["router"] = router.stats()
+        report["num_replicas"] = num_replicas
+        return report
+    finally:
+        if router is not None:
+            router.shutdown()
+        for f in fronts:
+            f.shutdown()
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -452,6 +508,10 @@ def main(argv: list[str] | None = None) -> int:
             details["serving"] = bench_serving()
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["serving"] = {"error": str(exc)}
+        try:
+            details["serving_fleet"] = bench_serving_fleet()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["serving_fleet"] = {"error": str(exc)}
     if "orchestration" in workloads:
         try:
             details["orchestration"] = bench_orchestration_latency()

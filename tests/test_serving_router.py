@@ -1,0 +1,239 @@
+"""Serving fleet router (VERDICT r4 next #6): queue-depth-aware
+dispatch across replica front ends, health-check rotation, failover,
+sticky cancel, streaming passthrough, and loadgen-through-router."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from batch_shipyard_tpu.models import loadgen, serving
+from batch_shipyard_tpu.models import transformer as tfm
+from batch_shipyard_tpu.models.router import ServingRouter
+from batch_shipyard_tpu.models.server import ServingFrontEnd
+
+CFG = tfm.TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32,
+    param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = tfm.TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(7),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _front(params):
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                       max_decode_len=64)
+    return ServingFrontEnd(engine, port=0).start()
+
+
+@pytest.fixture()
+def fleet(params):
+    fronts = [_front(params), _front(params)]
+    router = ServingRouter([f.url for f in fronts],
+                           health_interval=0.2).start()
+    yield router, fronts
+    router.shutdown()
+    for f in fronts:
+        try:
+            f.shutdown()
+        except Exception:
+            pass
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        f"{url}/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, path):
+    with urllib.request.urlopen(f"{url}{path}", timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_router_dispatches_and_balances(fleet):
+    router, fronts = fleet
+    seen = set()
+    for k in range(4):
+        out = _post(router.url, {"prompt": [1 + k, 2, 3],
+                                 "max_new_tokens": 3})
+        assert out["num_tokens"] == 3
+        seen.add(out["_replica"])
+    # Sequential idle-fleet requests alternate via the dispatched
+    # tie-break: both replicas must have served.
+    assert seen == {f.url for f in fronts}
+    status, stats = _get(router.url, "/v1/stats")
+    assert status == 200
+    assert stats["completed"] == 4
+    assert stats["healthy_replicas"] == 2
+    assert all(s["completed"] >= 1 for s in stats["per_replica"])
+
+
+def test_router_prefers_less_loaded_replica(fleet):
+    router, _fronts = fleet
+    # Occupy one replica with a long generation; concurrent short
+    # requests must land on the other.
+    long_done = {}
+
+    def _long():
+        long_done["r"] = _post(router.url, {
+            "request_id": "long-run", "prompt": [9, 9, 9],
+            "max_new_tokens": 40})
+
+    t = threading.Thread(target=_long, daemon=True)
+    t.start()
+    # Wait until the router has the long run in flight.
+    deadline = time.monotonic() + 20
+    busy_url = None
+    while time.monotonic() < deadline and busy_url is None:
+        for snap in router.replicas():
+            if snap["inflight"] > 0:
+                busy_url = snap["url"]
+        time.sleep(0.01)
+    assert busy_url is not None
+    short = _post(router.url, {"prompt": [4, 5], "max_new_tokens": 2})
+    assert short["_replica"] != busy_url
+    t.join(120)
+    assert long_done["r"]["num_tokens"] == 40
+
+
+def test_router_health_failover_and_503(fleet):
+    router, fronts = fleet
+    fronts[1].shutdown()
+    # Next probe cycle marks it unhealthy.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and router.healthy_count() != 1:
+        time.sleep(0.05)
+    assert router.healthy_count() == 1
+    status, health = _get(router.url, "/healthz")
+    assert status == 200 and health["healthy_replicas"] == 1
+    # All traffic now goes to the survivor.
+    for _ in range(3):
+        out = _post(router.url, {"prompt": [1, 2],
+                                 "max_new_tokens": 2})
+        assert out["_replica"] == fronts[0].url
+    fronts[0].shutdown()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and router.healthy_count():
+        time.sleep(0.05)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(router.url, {"prompt": [1], "max_new_tokens": 1})
+    assert exc.value.code == 503
+
+
+def test_router_dispatch_failover_marks_unhealthy(fleet, params):
+    """A replica that dies between probes: the dispatch itself fails
+    over and flags it."""
+    router, fronts = fleet
+    victim = fronts[1]
+    victim.shutdown()  # dies silently; probe hasn't run yet
+    with router._lock:
+        for r in router._replicas:
+            r.healthy = True  # simulate stale healthy state
+    for _ in range(4):
+        out = _post(router.url, {"prompt": [3, 1],
+                                 "max_new_tokens": 2})
+        assert out["_replica"] == fronts[0].url
+    snaps = {s["url"]: s for s in router.replicas()}
+    assert snaps[victim.url]["healthy"] is False
+
+
+def test_router_sticky_cancel(fleet):
+    router, _fronts = fleet
+    result = {}
+
+    def _long():
+        try:
+            result["r"] = _post(router.url, {
+                "request_id": "cancel-me", "prompt": [7, 7],
+                "max_new_tokens": 60})
+        except urllib.error.HTTPError as exc:
+            result["code"] = exc.code
+            result["body"] = json.loads(exc.read())
+
+    t = threading.Thread(target=_long, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and \
+            "cancel-me" not in router._owner:
+        time.sleep(0.01)
+    req = urllib.request.Request(
+        f"{router.url}/v1/requests/cancel-me", method="DELETE")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 202
+    t.join(60)
+    # The replica completes the waiter with 409 cancelled.
+    assert result.get("code") == 409
+    assert "cancelled" in result["body"]["error"]
+
+
+def test_router_broadcast_cancel_finds_unknown_owner(fleet):
+    """A request the router never dispatched (server-assigned or
+    submitted directly to a replica): broadcast probes replicas —
+    non-owners 404, the owner 202s."""
+    router, fronts = fleet
+    result = {}
+
+    def _long():
+        try:
+            result["r"] = _post(fronts[1].url, {
+                "request_id": "direct-long", "prompt": [8, 8],
+                "max_new_tokens": 60})
+        except urllib.error.HTTPError as exc:
+            result["code"] = exc.code
+
+    t = threading.Thread(target=_long, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and \
+            not fronts[1].knows("direct-long"):
+        time.sleep(0.01)
+    assert "direct-long" not in router._owner
+    code, payload = router.cancel("direct-long")
+    assert code == 202, payload
+    t.join(60)
+    assert result.get("code") == 409
+    # A fully unknown id 404s everywhere.
+    code, payload = router.cancel("never-existed")
+    assert code == 404
+
+
+def test_router_streaming_passthrough(fleet):
+    router, _fronts = fleet
+    req = urllib.request.Request(
+        f"{router.url}/v1/generate",
+        data=json.dumps({"prompt": [5, 6, 7], "max_new_tokens": 4,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(line) for line in resp if line.strip()]
+    tokens = [ln for ln in lines if "token" in ln]
+    finals = [ln for ln in lines if "tokens" in ln]
+    assert len(tokens) == 4
+    assert len(finals) == 1 and finals[0]["num_tokens"] == 4
+
+
+def test_loadgen_through_router(fleet):
+    router, _fronts = fleet
+    report = loadgen.run_load(router.url, num_requests=8,
+                              rate_hz=50.0, prompt_len=(2, 6),
+                              max_new_tokens=(2, 5), vocab_size=97,
+                              seed=3)
+    assert report["completed"] == 8
+    assert report["failed"] == 0
+    assert report["generated_tokens"] > 0
+    status, stats = _get(router.url, "/v1/stats")
+    assert stats["completed"] >= 8
