@@ -49,6 +49,10 @@ class NoHealthyReplicaError(RuntimeError):
     pass
 
 
+class DuplicateRequestError(ValueError):
+    """The request_id is already in flight somewhere in the fleet."""
+
+
 class _Replica:
     __slots__ = ("url", "healthy", "inflight", "backlog",
                  "last_probe_at", "last_error", "stats",
@@ -143,6 +147,9 @@ class ServingRouter:
                 except NoHealthyReplicaError as exc:
                     self._reply(503, {"error": str(exc)})
                     return
+                except DuplicateRequestError as exc:
+                    self._reply(400, {"error": str(exc)})
+                    return
                 self._reply(code, payload)
 
             def _stream(self, spec: dict) -> None:
@@ -155,6 +162,9 @@ class ServingRouter:
                         router.open_stream(spec)
                 except NoHealthyReplicaError as exc:
                     self._reply(503, {"error": str(exc)})
+                    return
+                except DuplicateRequestError as exc:
+                    self._reply(400, {"error": str(exc)})
                     return
                 except urllib.error.HTTPError as exc:
                     self._reply(exc.code,
@@ -185,7 +195,12 @@ class ServingRouter:
                         except (OSError,
                                 http_client.HTTPException) as exc:
                             upstream_ok = False
-                            router._mark_unhealthy(replica, exc)
+                            # Same 'slow is not dead' policy as
+                            # dispatch(): a read timeout on a
+                            # saturated replica is not a health
+                            # event; a reset/hangup is.
+                            if not _is_timeout(exc):
+                                router._mark_unhealthy(replica, exc)
                             break
                         if not line:
                             break
@@ -318,8 +333,23 @@ class ServingRouter:
                 replica.completed += 1
             else:
                 replica.failed += 1
-            if request_id is not None:
+            # Only the current owner clears the mapping (a failover
+            # retry may have remapped the id to another replica).
+            if request_id is not None and \
+                    self._owner.get(request_id) is replica:
                 self._owner.pop(request_id, None)
+
+    def _claim(self, request_id: Optional[str]) -> None:
+        """Router-level duplicate-id gate: the per-replica front end
+        rejects ids IT has in flight (server.py _make_pending), but
+        two replicas can't see each other — without this, a retry of
+        a live id lands on the other replica and decodes twice."""
+        if not request_id:
+            return
+        with self._lock:
+            if request_id in self._owner:
+                raise DuplicateRequestError(
+                    f"request_id {request_id} in flight")
 
     def _remember(self, request_id: Optional[str],
                   replica: _Replica) -> None:
@@ -339,6 +369,7 @@ class ServingRouter:
         """Route one non-streaming generate; fail over across
         replicas on connection errors."""
         request_id = spec.get("request_id")
+        self._claim(request_id)
         tried: set = set()
         while True:
             replica = self._pick(tried)
@@ -352,10 +383,22 @@ class ServingRouter:
             try:
                 with urllib.request.urlopen(
                         req, timeout=self._request_timeout) as resp:
-                    payload = json.loads(resp.read())
+                    body = resp.read()
+                    status = resp.status
+                try:
+                    payload = json.loads(body)
+                    if not isinstance(payload, dict):
+                        raise ValueError("non-object JSON")
+                except ValueError:
+                    # A 200 with an unparseable body is a broken
+                    # replica, not a crashed one: release the
+                    # inflight slot and relay the failure.
+                    self.finish(replica, request_id, ok=False)
+                    return 502, {"error": f"replica {replica.url} "
+                                          f"returned non-JSON body"}
                 self.finish(replica, request_id, ok=True)
                 payload["_replica"] = replica.url
-                return resp.status, payload
+                return status, payload
             except urllib.error.HTTPError as exc:
                 # The replica answered (4xx/5xx): not a health event,
                 # relay verbatim.
@@ -379,6 +422,7 @@ class ServingRouter:
         replica, request_id). Failover happens here (before any byte
         reaches the client)."""
         request_id = spec.get("request_id")
+        self._claim(request_id)
         tried: set = set()
         while True:
             replica = self._pick(tried)

@@ -27,7 +27,9 @@ Endpoints:
       generated token as it decodes, then a final line with the full
       result object — the client observes TTFT directly.
   DELETE /v1/requests/<request_id>   abort a queued/decoding request
-      (202 accepted; the waiter completes with a 'cancelled' error)
+      (202 accepted; the waiter completes with a 'cancelled' error;
+      404 for ids this front end does not currently own — a fleet
+      router's broadcast cancel probes replicas by that signal)
   GET  /v1/stats      aggregate counters + latency percentiles
   GET  /healthz       liveness
 """

@@ -164,3 +164,23 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     from batch_shipyard_tpu.parallel.tuning import PROFILES
     assert set(plan) == set(PROFILES)
     assert all("bench.py --quick" in cmd for cmd in plan.values())
+
+
+def test_benchgen_renders_from_artifacts(tmp_path):
+    """tools/benchgen.py renders the measured-numbers page from the
+    repo's real bench artifacts (docs depth pass: the page is
+    generated, so it cannot rot)."""
+    out = tmp_path / "bench.md"
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools/benchgen.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    text = out.read_text()
+    assert "# Measured performance" in text
+    assert "GENERATED" in text
+    assert "## Headline metric by round" in text
+    # The honest state renders too: either real numbers or the
+    # explicit unreachable status.
+    assert ("images/sec/chip" in text or
+            "accelerator unreachable" in text)

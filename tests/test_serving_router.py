@@ -210,6 +210,37 @@ def test_router_broadcast_cancel_finds_unknown_owner(fleet):
     assert code == 404
 
 
+def test_router_rejects_duplicate_inflight_request_id(fleet):
+    """A retry of a live id must not land on the OTHER replica and
+    decode twice — the router gates ids fleet-wide (the per-replica
+    front end can only see its own)."""
+    router, _fronts = fleet
+    result = {}
+
+    def _long():
+        result["r"] = _post(router.url, {
+            "request_id": "dup-id", "prompt": [6, 6],
+            "max_new_tokens": 50})
+
+    t = threading.Thread(target=_long, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and \
+            "dup-id" not in router._owner:
+        time.sleep(0.01)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(router.url, {"request_id": "dup-id", "prompt": [1],
+                           "max_new_tokens": 1})
+    assert exc.value.code == 400
+    assert "in flight" in json.loads(exc.value.read())["error"]
+    t.join(120)
+    assert result["r"]["num_tokens"] == 50
+    # After completion the id is reusable.
+    out = _post(router.url, {"request_id": "dup-id", "prompt": [2],
+                             "max_new_tokens": 1})
+    assert out["num_tokens"] == 1
+
+
 def test_router_streaming_passthrough(fleet):
     router, _fronts = fleet
     req = urllib.request.Request(

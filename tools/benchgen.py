@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+"""Measured-numbers page generator: bench artifacts -> markdown.
+
+VERDICT r4 next #8: a numbers page that CANNOT rot — it is rendered
+from the JSON the bench pipeline actually produced (BENCH_r*.json,
+BENCH_LATEST.json, BENCH_DETAILS.json, SILICON_PROOF.json), never
+hand-written. tools/silicon_proof.py re-runs this after every
+successful bench so docs/26-benchmarks.md always shows the latest
+silicon truth, including the honest "accelerator unreachable" state.
+
+Usage: python tools/benchgen.py [--out docs/26-benchmarks.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import pathlib
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(path: pathlib.Path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt(value, digits=1):
+    if value is None:
+        return "—"
+    if isinstance(value, float):
+        return f"{value:,.{digits}f}"
+    return str(value)
+
+
+def _round_history(out: list[str]) -> None:
+    rows = []
+    for path in sorted(glob.glob(str(REPO_ROOT / "BENCH_r*.json"))):
+        tag = os.path.basename(path)[6:-5]  # -> r01
+        data = _load(pathlib.Path(path)) or {}
+        parsed = data.get("parsed") or {}
+        rows.append((tag, parsed))
+    latest = _load(REPO_ROOT / "BENCH_LATEST.json")
+    if latest:
+        rows.append(("latest", latest))
+    if not rows:
+        return
+    out.append("## Headline metric by round\n")
+    out.append("ResNet-50 training images/sec/chip (bf16, b=256, "
+               "synthetic) vs the reference's 16xV100 recipe "
+               "(405 img/s per V100 — BASELINE.md).\n")
+    out.append("| round | value | vs V100 baseline | note |")
+    out.append("|---|---|---|---|")
+    for tag, parsed in rows:
+        note = parsed.get("error", "")
+        out.append(
+            f"| {tag} | {_fmt(parsed.get('value'), 1)} "
+            f"{parsed.get('unit', '')} | "
+            f"{_fmt(parsed.get('vs_baseline'), 2)}x | {note} |")
+    out.append("")
+
+
+def _workload(out: list[str], name: str, data: dict,
+              rate_key: str, rate_label: str) -> None:
+    if not isinstance(data, dict):
+        return
+    if "error" in data:
+        out.append(f"### {name}\n")
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    if data.get(rate_key) is None:
+        return  # nothing recorded for this workload
+    out.append(f"### {name}\n")
+    out.append("| metric | value |")
+    out.append("|---|---|")
+    out.append(f"| {rate_label} | {_fmt(data.get(rate_key))} |")
+    if data.get("step_seconds") is not None:
+        out.append(f"| step time | "
+                   f"{_fmt(data['step_seconds'] * 1e3)} ms |")
+    if data.get("mfu_pct") is not None:
+        out.append(f"| **MFU** | {_fmt(data['mfu_pct'])}% of "
+                   f"{_fmt(data.get('peak_bf16_tflops_per_chip'))} "
+                   f"bf16 TFLOP/s ({data.get('device_kind')}) |")
+    if data.get("chips") is not None:
+        out.append(f"| chips | {data['chips']} |")
+    out.append("")
+
+
+def _serving(out: list[str], name: str, data: dict) -> None:
+    if not isinstance(data, dict):
+        return
+    if "error" in data:
+        out.append(f"### {name}\n")
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    if not data.get("ttft_ms"):
+        return
+    out.append(f"### {name}\n")
+    out.append("| metric | p50 | p95 | p99 |")
+    out.append("|---|---|---|---|")
+    for key, label in (("ttft_ms", "TTFT (ms)"),
+                       ("tpot_ms", "TPOT (ms)"),
+                       ("latency_ms", "latency (ms)")):
+        pcts = data.get(key, {})
+        out.append(f"| {label} | {_fmt(pcts.get('p50'))} | "
+                   f"{_fmt(pcts.get('p95'))} | "
+                   f"{_fmt(pcts.get('p99'))} |")
+    out.append("")
+    out.append(f"Completed {data.get('completed')}/"
+               f"{data.get('num_requests')} requests at "
+               f"{_fmt(data.get('offered_rate_hz'))} req/s offered; "
+               f"{_fmt(data.get('tokens_per_second'))} tok/s "
+               f"aggregate.")
+    router = data.get("router")
+    if router:
+        out.append(f"Fleet: {router.get('replicas')} replicas, "
+                   f"dispatch {router.get('dispatched')} / completed "
+                   f"{router.get('completed')} / failed "
+                   f"{router.get('failed')} (queue-depth-aware "
+                   f"router).")
+    out.append("")
+
+
+def _orchestration(out: list[str], data: dict) -> None:
+    if not isinstance(data, dict):
+        return
+    out.append("### Orchestration latency\n")
+    if "error" in data:
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    out.append(f"Measured on: {data.get('substrate', 'unknown')}\n")
+    out.append("| phase | seconds |")
+    out.append("|---|---|")
+    for key, label in (
+            ("pool_add_to_ready_seconds", "pool add -> all ready"),
+            ("nodeprep_seconds", "nodeprep (max over nodes)"),
+            ("image_prefetch_seconds",
+             "image prefetch (max over nodes)"),
+            ("submit_to_task_complete_seconds",
+             "job submit -> task complete")):
+        if data.get(key) is not None:
+            out.append(f"| {label} | {_fmt(data[key], 2)} |")
+    out.append("")
+
+
+def _silicon_proof(out: list[str]) -> None:
+    proof = _load(REPO_ROOT / "SILICON_PROOF.json")
+    if not proof:
+        return
+    out.append("## Silicon proof pipeline (latest run)\n")
+    out.append(f"Run finished {proof.get('finished_at')} "
+               + ("(dry run)" if proof.get("dry_run") else "")
+               + ".\n")
+    out.append("| phase | status |")
+    out.append("|---|---|")
+    for phase in proof.get("phases", []):
+        out.append(f"| {phase.get('phase')} | "
+                   f"{phase.get('status')} |")
+    out.append("")
+    marker = _load(REPO_ROOT / "KERNEL_VALIDATION.json")
+    if marker:
+        out.append("Kernel validation marker "
+                   "(gates `impl='auto'` Pallas dispatch):\n")
+        out.append("| kernel | on-chip pass |")
+        out.append("|---|---|")
+        for name, record in sorted(marker.items()):
+            ok = (record.get("ok") and
+                  record.get("backend") == "tpu")
+            out.append(f"| {name} | {'yes' if ok else 'no'} |")
+        out.append("")
+
+
+def render() -> str:
+    out: list[str] = []
+    out.append("# Measured performance\n")
+    out.append("This page is GENERATED by `tools/benchgen.py` from "
+               "the bench pipeline's JSON artifacts — do not edit by "
+               "hand; re-run the generator (tools/silicon_proof.py "
+               "does so after every successful bench).\n")
+    _round_history(out)
+    details = _load(REPO_ROOT / "BENCH_DETAILS.json") or {}
+    out.append("## Latest detailed run\n")
+    if details.get("error"):
+        out.append(f"**Status**: `{details['error']}`\n")
+        stale = details.get("last_successful_run_stale")
+        if stale:
+            out.append("Figures below are the LAST SUCCESSFUL run "
+                       "(stale, kept for reference):\n")
+            details = {**details, **stale}
+    if details.get("platform"):
+        out.append(f"Platform: {details['platform']} "
+                   f"({', '.join(details.get('devices', []))}); "
+                   f"XLA tuning profile: "
+                   f"`{details.get('xla_tuning_profile')}`.\n")
+    _workload(out, "ResNet-50 training", details.get("resnet50", {}),
+              "images_per_sec_per_chip", "images/sec/chip")
+    _workload(out, "Transformer training (303M, T=2048)",
+              details.get("transformer", {}),
+              "tokens_per_sec_per_chip", "tokens/sec/chip")
+    _workload(out, "Transformer training, int8 matmuls",
+              details.get("transformer_int8", {}),
+              "tokens_per_sec_per_chip", "tokens/sec/chip")
+    _serving(out, "Serving (single replica, Poisson load)",
+             details.get("serving", {}))
+    _serving(out, "Serving fleet (router over replicas)",
+             details.get("serving_fleet", {}))
+    _orchestration(out, details.get("orchestration", {}))
+    _silicon_proof(out)
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out",
+                        default=str(REPO_ROOT /
+                                    "docs/26-benchmarks.md"))
+    args = parser.parse_args(argv)
+    content = render()
+    with open(args.out, "w", encoding="utf-8") as fh:
+        fh.write(content)
+    print(f"wrote {args.out} ({len(content.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -253,6 +253,9 @@ class Pipeline:
                     "ok" if rc == 0 and parsed else "failed",
                     rc=rc, headline=parsed, mfu_pct=mfu,
                     output_tail=out[-1000:])
+        # Regenerate the measured-numbers docs page from the fresh
+        # artifacts (docs/26-benchmarks.md cannot rot by design).
+        _run([sys.executable, "tools/benchgen.py"], 120)
 
     # -- driver ----------------------------------------------------
     def run(self) -> int:
