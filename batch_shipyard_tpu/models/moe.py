@@ -199,6 +199,87 @@ class MoEMLP(nn.Module):
             jnp.float32)
 
 
+def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
+                       capacity: int, outer_axis: str,
+                       inner_axis: str, routing: str = "top1",
+                       num_selected: int = 2,
+                       dtype=jnp.bfloat16):
+    """Explicit expert-parallel MoE body for shard_map, with the
+    cross-slice exchange on ops/collectives.hierarchical_all_to_all
+    (ROADMAP 'wire it into a shard_map MoE dispatch variant').
+
+    The flax MoEMLP leaves the exchange to XLA's sharding propagation
+    — correct, but on a multi-slice mesh a flat all-to-all over the
+    combined ep axis sends n_inner^2 small DCN messages per slice
+    pair. This body routes locally, packs destination-indexed
+    buffers, and exchanges them hierarchically (ICI phase inside the
+    slice, then ONE aggregated DCN message per slice pair), runs the
+    local expert shard, and reverses the exchange — the MoE dispatch
+    pattern for experts spanning slices.
+
+    Per-device arguments (call inside shard_map):
+      flat          [G_local, D]    this device's tokens
+      router_kernel [D, E]          replicated
+      w_gate/w_up   [E_local, D, F] local expert shard
+      w_down        [E_local, F, D] local expert shard
+    Expert e's global id is (outer * n_inner + inner) * E_local + el
+    — i.e. leading-dim sharding of [E, ...] weights over the factored
+    (outer_axis, inner_axis) mesh axes, which is exactly what
+    in_specs=P((outer, inner), ...) hands each device.
+
+    Returns ([G_local, D] combined output, aux loss averaged over the
+    ep group). Token routing/capacity is PER DEVICE GROUP (each
+    device's G_local tokens route independently) — same semantics as
+    running the dense MoEMLP on each group.
+    """
+    from batch_shipyard_tpu.ops import collectives
+
+    n_out = jax.lax.psum(1, outer_axis)
+    n_in = jax.lax.psum(1, inner_axis)
+    n_ep = n_out * n_in
+    e_local, d_model = w_gate.shape[0], w_gate.shape[1]
+    num_experts = e_local * n_ep
+
+    logits = flat.astype(jnp.float32) @ router_kernel.astype(
+        jnp.float32)
+    if routing == "expert_choice":
+        dispatch, combine, aux = expert_choice_routing(logits,
+                                                       capacity)
+    elif routing == "topk":
+        dispatch, combine, aux = topk_routing(logits, capacity,
+                                              num_selected)
+    else:
+        dispatch, combine, aux = top1_routing(logits, capacity)
+    # Pack per-expert send buffers [E, C, D], then view the expert
+    # dim as destination coordinates [n_out, n_in, E_local, C, D].
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(dtype),
+                           flat.astype(dtype))
+    x = expert_in.reshape(n_out, n_in, e_local, capacity, d_model)
+    # ICI-then-DCN exchange: arrives source-indexed (a[o, i] = the
+    # buffer device (o, i) sent to MY experts).
+    a = collectives.hierarchical_all_to_all(x, outer_axis, inner_axis)
+    # Batch all sources through the local expert shard.
+    a = a.reshape(n_ep, e_local, capacity, d_model)
+    a = a.transpose(1, 0, 2, 3).reshape(e_local, n_ep * capacity,
+                                        d_model)
+    gate_act = jnp.einsum("end,edf->enf", a, w_gate.astype(dtype))
+    up_act = jnp.einsum("end,edf->enf", a, w_up.astype(dtype))
+    out = jnp.einsum("enf,efd->end", nn.silu(gate_act) * up_act,
+                     w_down.astype(dtype))
+    # Reverse exchange: the same hierarchical a2a returns each
+    # processed buffer to its origin device (the exchange is an
+    # involution on the [n_out, n_in] block layout).
+    out = out.reshape(e_local, n_ep, capacity, d_model)
+    out = out.transpose(1, 0, 2, 3).reshape(n_out, n_in, e_local,
+                                            capacity, d_model)
+    r = collectives.hierarchical_all_to_all(out, outer_axis,
+                                            inner_axis)
+    r = r.reshape(num_experts, capacity, d_model)
+    y = jnp.einsum("gec,ecd->gd", combine.astype(dtype), r)
+    aux = jax.lax.pmean(jax.lax.pmean(aux, inner_axis), outer_axis)
+    return y, aux.astype(jnp.float32)
+
+
 def moe_param_specs():
     """PartitionSpec patterns for MoE params (merged into the
     transformer rules): experts over ep, expert-internal dims over

@@ -94,6 +94,9 @@ class ServingRouter:
         self._replicas = [_Replica(u) for u in replica_urls]
         self._lock = threading.Lock()
         self._owner: dict[str, _Replica] = {}  # request_id -> replica
+        # Timed-out dispatches whose runs may still be live on their
+        # replica (reconciled by the health loop).
+        self._orphaned: dict[str, _Replica] = {}
         self._health_interval = health_interval
         self._probe_timeout = probe_timeout
         self._request_timeout = request_timeout
@@ -298,6 +301,7 @@ class ServingRouter:
     def _health_loop(self) -> None:
         while not self._stop.wait(self._health_interval):
             self._probe_all()
+            self._reconcile_orphans()
 
     def healthy_count(self) -> int:
         with self._lock:
@@ -343,19 +347,61 @@ class ServingRouter:
         """Router-level duplicate-id gate: the per-replica front end
         rejects ids IT has in flight (server.py _make_pending), but
         two replicas can't see each other — without this, a retry of
-        a live id lands on the other replica and decodes twice."""
+        a live id lands on the other replica and decodes twice.
+        Check-and-RESERVE under one lock acquisition (a None owner =
+        claimed, replica not yet picked), so two concurrent claims of
+        the same id cannot both pass."""
         if not request_id:
             return
         with self._lock:
             if request_id in self._owner:
                 raise DuplicateRequestError(
                     f"request_id {request_id} in flight")
+            self._owner[request_id] = None  # reserved
+
+    def _release_claim(self, request_id: Optional[str]) -> None:
+        """Drop a reservation that never reached a replica (e.g. no
+        healthy replica after the claim)."""
+        if request_id:
+            with self._lock:
+                if self._owner.get(request_id) is None:
+                    self._owner.pop(request_id, None)
 
     def _remember(self, request_id: Optional[str],
                   replica: _Replica) -> None:
         if request_id:
             with self._lock:
                 self._owner[request_id] = replica
+
+    def _orphan(self, request_id: Optional[str],
+                replica: _Replica) -> None:
+        """A dispatch timed out but the run may still be live on the
+        replica: keep the ownership (duplicate gate + sticky cancel
+        stay correct) and let the health loop reconcile — the entry
+        clears once the replica no longer knows the id."""
+        if request_id:
+            with self._lock:
+                self._orphaned[request_id] = replica
+
+    def _reconcile_orphans(self) -> None:
+        with self._lock:
+            orphans = dict(self._orphaned)
+        for request_id, replica in orphans.items():
+            done = False
+            try:
+                with urllib.request.urlopen(
+                        f"{replica.url}/v1/requests/{request_id}",
+                        timeout=self._probe_timeout) as resp:
+                    done = resp.status != 200
+            except urllib.error.HTTPError as exc:
+                done = exc.code == 404
+            except (urllib.error.URLError, OSError):
+                done = True  # replica gone: the run is gone with it
+            if done:
+                with self._lock:
+                    self._orphaned.pop(request_id, None)
+                    if self._owner.get(request_id) is replica:
+                        self._owner.pop(request_id, None)
 
     def _mark_unhealthy(self, replica: _Replica, exc: Exception
                         ) -> None:
@@ -372,7 +418,11 @@ class ServingRouter:
         self._claim(request_id)
         tried: set = set()
         while True:
-            replica = self._pick(tried)
+            try:
+                replica = self._pick(tried)
+            except NoHealthyReplicaError:
+                self._release_claim(request_id)
+                raise
             tried.add(replica.url)
             self._remember(request_id, replica)
             body = json.dumps(spec).encode()
@@ -406,14 +456,23 @@ class ServingRouter:
                 return exc.code, _json_or_error(exc.read())
             except (urllib.error.URLError, OSError,
                     TimeoutError) as exc:
-                self.finish(replica, request_id, ok=False)
                 if _is_timeout(exc):
                     # A saturated-but-alive replica: generate is NOT
                     # idempotent (the run may still complete there),
                     # so re-dispatching would double the work — and
                     # slow is not dead, so no health event either.
+                    # Ownership is kept (duplicate gate + cancel stay
+                    # correct) until reconciliation sees the replica
+                    # forget the id; the load signal falls back to
+                    # the scraped engine backlog.
+                    with self._lock:
+                        replica.inflight = max(
+                            0, replica.inflight - 1)
+                        replica.failed += 1
+                    self._orphan(request_id, replica)
                     return 504, {"error": f"replica {replica.url} "
                                           f"timed out: {exc}"}
+                self.finish(replica, request_id, ok=False)
                 self._mark_unhealthy(replica, exc)
                 # loop: try the next healthy replica
 
@@ -425,7 +484,11 @@ class ServingRouter:
         self._claim(request_id)
         tried: set = set()
         while True:
-            replica = self._pick(tried)
+            try:
+                replica = self._pick(tried)
+            except NoHealthyReplicaError:
+                self._release_claim(request_id)
+                raise
             tried.add(replica.url)
             self._remember(request_id, replica)
             req = urllib.request.Request(
@@ -442,9 +505,14 @@ class ServingRouter:
                 raise
             except (urllib.error.URLError, OSError,
                     TimeoutError) as exc:
-                self.finish(replica, request_id, ok=False)
                 if _is_timeout(exc):
+                    with self._lock:
+                        replica.inflight = max(
+                            0, replica.inflight - 1)
+                        replica.failed += 1
+                    self._orphan(request_id, replica)
                     raise  # see dispatch(): slow is not dead
+                self.finish(replica, request_id, ok=False)
                 self._mark_unhealthy(replica, exc)
 
     def cancel(self, request_id: str) -> tuple[int, dict]:

@@ -167,6 +167,18 @@ class ServingFrontEnd:
                     self._reply(200, {"ok": True})
                 elif self.path == "/v1/stats":
                     self._reply(200, front.stats())
+                elif self.path.startswith("/v1/requests/"):
+                    # Liveness of one request id (the fleet router's
+                    # orphan reconciliation probes this): 200 while
+                    # the run is in flight here, 404 once finished or
+                    # never seen.
+                    request_id = self.path[len("/v1/requests/"):]
+                    if front.knows(request_id):
+                        self._reply(200, {"request_id": request_id,
+                                          "in_flight": True})
+                    else:
+                        self._reply(404, {"request_id": request_id,
+                                          "in_flight": False})
                 else:
                     self._reply(404, {"error": "not found"})
 

@@ -241,6 +241,46 @@ def test_router_rejects_duplicate_inflight_request_id(fleet):
     assert out["num_tokens"] == 1
 
 
+def test_router_timeout_orphans_and_reconciles(params):
+    """A dispatch that outlives request_timeout: 504 to the caller,
+    NO re-dispatch (the run may still be live), the id stays gated
+    until the health loop sees the replica forget it."""
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                       max_decode_len=64)
+    # Deterministic slowness: every engine step pays a fixed delay,
+    # so a 50-token decode is guaranteed to outlive the 2s timeout.
+    orig_step = engine.step
+    engine.step = lambda: (time.sleep(0.1), orig_step())[1]
+    fronts = [ServingFrontEnd(engine, port=0).start()]
+    router = ServingRouter([fronts[0].url], health_interval=0.2,
+                           request_timeout=2.0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(router.url, {"request_id": "slow", "prompt": [3, 3],
+                               "max_new_tokens": 50})
+        assert exc.value.code == 504
+        # Still owned: a retry is refused while the run may be live.
+        assert "slow" in router._owner
+        with pytest.raises(urllib.error.HTTPError) as exc2:
+            _post(router.url, {"request_id": "slow", "prompt": [1],
+                               "max_new_tokens": 1})
+        assert exc2.value.code == 400
+        # Once the replica finishes (or we cancel) and forgets the
+        # id, reconciliation releases it.
+        fronts[0].cancel("slow")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                "slow" in router._owner:
+            time.sleep(0.05)
+        assert "slow" not in router._owner
+        out = _post(router.url, {"request_id": "slow", "prompt": [2],
+                                 "max_new_tokens": 1})
+        assert out["num_tokens"] == 1
+    finally:
+        router.shutdown()
+        fronts[0].shutdown()
+
+
 def test_router_streaming_passthrough(fleet):
     router, _fronts = fleet
     req = urllib.request.Request(

@@ -20,6 +20,11 @@ import os
 import pathlib
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+# Where the live bench artifacts (BENCH_DETAILS/LATEST, SILICON_PROOF,
+# KERNEL_VALIDATION) are read from; silicon_proof passes its --out-dir
+# so a non-repo-root run still renders ITS fresh numbers. Round
+# history (BENCH_r*.json) always comes from the repo root.
+ARTIFACTS = REPO_ROOT
 
 
 def _load(path: pathlib.Path):
@@ -45,7 +50,7 @@ def _round_history(out: list[str]) -> None:
         data = _load(pathlib.Path(path)) or {}
         parsed = data.get("parsed") or {}
         rows.append((tag, parsed))
-    latest = _load(REPO_ROOT / "BENCH_LATEST.json")
+    latest = _load(ARTIFACTS / "BENCH_LATEST.json")
     if latest:
         rows.append(("latest", latest))
     if not rows:
@@ -126,9 +131,17 @@ def _serving(out: list[str], name: str, data: dict) -> None:
     out.append("")
 
 
+_ORCH_KEYS = ("pool_add_to_ready_seconds", "nodeprep_seconds",
+              "image_prefetch_seconds",
+              "submit_to_task_complete_seconds")
+
+
 def _orchestration(out: list[str], data: dict) -> None:
     if not isinstance(data, dict):
         return
+    if "error" not in data and not any(
+            data.get(k) is not None for k in _ORCH_KEYS):
+        return  # nothing recorded (training-only bench run)
     out.append("### Orchestration latency\n")
     if "error" in data:
         out.append(f"Not measured: `{data['error']}`\n")
@@ -136,20 +149,18 @@ def _orchestration(out: list[str], data: dict) -> None:
     out.append(f"Measured on: {data.get('substrate', 'unknown')}\n")
     out.append("| phase | seconds |")
     out.append("|---|---|")
-    for key, label in (
-            ("pool_add_to_ready_seconds", "pool add -> all ready"),
-            ("nodeprep_seconds", "nodeprep (max over nodes)"),
-            ("image_prefetch_seconds",
-             "image prefetch (max over nodes)"),
-            ("submit_to_task_complete_seconds",
-             "job submit -> task complete")):
+    labels = dict(zip(_ORCH_KEYS, (
+        "pool add -> all ready", "nodeprep (max over nodes)",
+        "image prefetch (max over nodes)",
+        "job submit -> task complete")))
+    for key, label in labels.items():
         if data.get(key) is not None:
             out.append(f"| {label} | {_fmt(data[key], 2)} |")
     out.append("")
 
 
 def _silicon_proof(out: list[str]) -> None:
-    proof = _load(REPO_ROOT / "SILICON_PROOF.json")
+    proof = _load(ARTIFACTS / "SILICON_PROOF.json")
     if not proof:
         return
     out.append("## Silicon proof pipeline (latest run)\n")
@@ -162,7 +173,7 @@ def _silicon_proof(out: list[str]) -> None:
         out.append(f"| {phase.get('phase')} | "
                    f"{phase.get('status')} |")
     out.append("")
-    marker = _load(REPO_ROOT / "KERNEL_VALIDATION.json")
+    marker = _load(ARTIFACTS / "KERNEL_VALIDATION.json")
     if marker:
         out.append("Kernel validation marker "
                    "(gates `impl='auto'` Pallas dispatch):\n")
@@ -183,7 +194,7 @@ def render() -> str:
                "hand; re-run the generator (tools/silicon_proof.py "
                "does so after every successful bench).\n")
     _round_history(out)
-    details = _load(REPO_ROOT / "BENCH_DETAILS.json") or {}
+    details = _load(ARTIFACTS / "BENCH_DETAILS.json") or {}
     out.append("## Latest detailed run\n")
     if details.get("error"):
         out.append(f"**Status**: `{details['error']}`\n")
@@ -215,11 +226,16 @@ def render() -> str:
 
 
 def main(argv=None) -> int:
+    global ARTIFACTS
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out",
                         default=str(REPO_ROOT /
                                     "docs/26-benchmarks.md"))
+    parser.add_argument("--artifacts-dir", default=str(REPO_ROOT),
+                        help="where BENCH_DETAILS/LATEST, "
+                        "SILICON_PROOF and KERNEL_VALIDATION live")
     args = parser.parse_args(argv)
+    ARTIFACTS = pathlib.Path(args.artifacts_dir)
     content = render()
     with open(args.out, "w", encoding="utf-8") as fh:
         fh.write(content)

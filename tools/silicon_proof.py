@@ -255,7 +255,8 @@ class Pipeline:
                     output_tail=out[-1000:])
         # Regenerate the measured-numbers docs page from the fresh
         # artifacts (docs/26-benchmarks.md cannot rot by design).
-        _run([sys.executable, "tools/benchgen.py"], 120)
+        _run([sys.executable, "tools/benchgen.py",
+              "--artifacts-dir", str(self.out)], 120)
 
     # -- driver ----------------------------------------------------
     def run(self) -> int:
