@@ -116,6 +116,167 @@ def generate(model: tfm.TransformerLM, params, cache, prompt,
     return tokens, cache
 
 
+def _rewind_cache(cache, steps):
+    """Roll every layer's write index back by ``steps`` (scalar or
+    [B]). Entries beyond the index are masked by _decode_attend and
+    overwritten by the next insert, so the index IS the cache state —
+    rewinding it un-commits speculated tokens in O(1)."""
+    def fix(path, leaf):
+        if path and getattr(path[-1], "key", None) == "index":
+            return leaf - steps
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "target_model", "draft_model", "num_tokens", "gamma"))
+def speculative_generate(target_model: tfm.TransformerLM,
+                         target_params,
+                         draft_model: tfm.TransformerLM,
+                         draft_params,
+                         prompt, num_tokens: int, gamma: int = 4):
+    """Speculative decoding (Leviathan et al.): a cheap DRAFT model
+    proposes ``gamma`` tokens autoregressively; the TARGET model
+    scores the whole block in ONE MXU-batched forward through the
+    multi-token cache-insert path and commits the longest validated
+    prefix plus one target token. Greedy acceptance: outputs are
+    BIT-IDENTICAL to target-only greedy decoding (the equivalence the
+    tests pin), while the target runs a forward every ~(accepted+1)
+    tokens instead of every token — the serving latency lever when
+    the target is much larger than the draft.
+
+    Batched: acceptance is synchronized to the batch MINIMUM each
+    round. That is still exact per slot — a slot that could have
+    accepted more receives the same tokens via the target's
+    correction logits — it only costs throughput, never correctness
+    (and keeps every shape static for jit).
+
+    prompt: [B, P] int32 (P >= 1). Returns (tokens [B, P+num_tokens],
+    stats dict: rounds, proposed, accepted — acceptance rate =
+    accepted / proposed).
+
+    Cache bookkeeping invariant: each model's cache holds every
+    committed token EXCEPT the newest (``y``); each round feeds
+    [y, d_1..d_gamma], so both caches advance gamma+1 and rewind by
+    gamma - accepted (see _rewind_cache).
+    """
+    batch, prompt_len = prompt.shape
+    cap = num_tokens + gamma + 1
+
+    t_cache = init_cache(target_model, target_params, batch)
+    d_cache = init_cache(draft_model, draft_params, batch)
+    if prompt_len > 1:
+        # Prefill both caches with prompt[:-1]; the last prompt token
+        # is the first pending y.
+        _, mut = target_model.apply(
+            {"params": target_params, "cache": t_cache},
+            prompt[:, :-1], return_hidden=True, mutable=["cache"])
+        t_cache = mut["cache"]
+        _, mut = draft_model.apply(
+            {"params": draft_params, "cache": d_cache},
+            prompt[:, :-1], return_hidden=True, mutable=["cache"])
+        d_cache = mut["cache"]
+    y0 = prompt[:, -1]
+
+    t_embed = target_params["embed"]["embedding"]
+    d_embed = draft_params["embed"]["embedding"]
+
+    def draft_step(carry, _):
+        cache, token, pos = carry
+        hidden, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache}, token[:, None],
+            return_hidden=True, positions=pos[None],
+            mutable=["cache"])
+        logits = jnp.dot(hidden[:, 0].astype(jnp.float32),
+                         d_embed.astype(jnp.float32).T)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    def round_body(state):
+        t_cache, d_cache, out, n_done, y, rounds, proposed, accepted \
+            = state
+        pos_y = prompt_len + n_done - 1
+        # Draft proposes d_1..d_gamma (the final extra step only
+        # inserts d_gamma's K/V so the draft cache can keep pace when
+        # everything is accepted).
+        (d_cache, _, _), drafts = jax.lax.scan(
+            draft_step, (d_cache, y, pos_y), None, length=gamma + 1)
+        d_tok = jnp.moveaxis(drafts, 0, 1)[:, :gamma]      # [B, g]
+        # Target scores [y, d_1..d_gamma] in one forward.
+        x_blk = jnp.concatenate([y[:, None], d_tok], axis=1)
+        positions = pos_y + jnp.arange(gamma + 1, dtype=jnp.int32)
+        hidden, mut = target_model.apply(
+            {"params": target_params, "cache": t_cache}, x_blk,
+            return_hidden=True, positions=positions,
+            mutable=["cache"])
+        t_cache = mut["cache"]
+        logits = jnp.einsum("bsd,vd->bsv",
+                            hidden.astype(jnp.float32),
+                            t_embed.astype(jnp.float32))
+        t_tok = jnp.argmax(logits, axis=-1).astype(
+            jnp.int32)                                      # [B, g+1]
+        # Longest validated prefix, synchronized to the batch min.
+        match = (d_tok == t_tok[:, :gamma])
+        a_slot = jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), axis=1), axis=1)       # [B]
+        a = jnp.min(a_slot)
+        # Commit d_1..d_a plus the target's token at position a
+        # (correction when a < gamma, bonus when a == gamma — same
+        # formula either way).
+        js = jnp.arange(gamma + 1, dtype=jnp.int32)
+        d_pad = jnp.concatenate(
+            [d_tok, jnp.zeros((batch, 1), jnp.int32)], axis=1)
+        block = jnp.where(js[None, :] < a, d_pad, t_tok)
+        out = jax.lax.dynamic_update_slice(out, block, (0, n_done))
+        rewind = gamma - a
+        return (_rewind_cache(t_cache, rewind),
+                _rewind_cache(d_cache, rewind),
+                out, n_done + a + 1, block[:, a],
+                rounds + 1, proposed + gamma, accepted + a)
+
+    def cond(state):
+        return state[3] < num_tokens
+
+    out0 = jnp.zeros((batch, cap), jnp.int32)
+    (t_cache, d_cache, out, n_done, _y, rounds, proposed, accepted
+     ) = jax.lax.while_loop(
+        cond, round_body,
+        (t_cache, d_cache, out0, jnp.int32(0), y0,
+         jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+    tokens = jnp.concatenate([prompt, out[:, :num_tokens]], axis=1)
+    stats = {"rounds": rounds, "proposed": proposed,
+             "accepted": accepted}
+    return tokens, stats
+
+
+def make_speculative_decoder(target_config: tfm.TransformerConfig,
+                             target_params,
+                             draft_config: tfm.TransformerConfig,
+                             draft_params, max_decode_len: int,
+                             gamma: int = 4):
+    """(run, target_model, draft_model) bound to decode-mode models.
+    run(prompt, num_tokens) -> (tokens, stats)."""
+    for name, cfg in (("target", target_config),
+                      ("draft", draft_config)):
+        if getattr(cfg, "kv_page_size", None):
+            raise ValueError(
+                f"speculative decoding needs the dense KV cache "
+                f"(multi-token verify + O(1) index rewind); {name} "
+                f"config sets kv_page_size={cfg.kv_page_size} — "
+                f"clear it for the speculative path")
+    t_model = tfm.TransformerLM(
+        decode_config(target_config, max_decode_len))
+    d_model = tfm.TransformerLM(
+        decode_config(draft_config, max_decode_len))
+
+    def run(prompt, num_tokens: int):
+        return speculative_generate(
+            t_model, target_params, d_model, draft_params, prompt,
+            num_tokens, gamma=gamma)
+
+    return run, t_model, d_model
+
+
 def make_decoder(config: tfm.TransformerConfig, params,
                  max_decode_len: int):
     """Convenience: (generate_fn, model) bound to a decode-mode model

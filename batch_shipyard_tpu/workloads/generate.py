@@ -32,6 +32,13 @@ def main() -> int:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--seed", type=int, default=0)
+    # Speculative decoding: a small draft model proposes, the target
+    # validates blocks (greedy-exact; models/inference.py).
+    parser.add_argument("--speculative", action="store_true")
+    parser.add_argument("--draft-d-model", type=int, default=256)
+    parser.add_argument("--draft-n-layers", type=int, default=2)
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="Draft tokens proposed per round")
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -48,6 +55,36 @@ def main() -> int:
     prompt = jnp.asarray(
         rng.randint(0, args.vocab, (args.batch, args.prompt_len)),
         jnp.int32)
+    if args.speculative:
+        if args.temperature > 0:
+            raise SystemExit("--speculative is greedy-exact; drop "
+                             "--temperature")
+        draft_config = tfm.TransformerConfig(
+            vocab_size=args.vocab, d_model=args.draft_d_model,
+            n_layers=args.draft_n_layers, n_heads=args.n_heads,
+            d_head=args.draft_d_model // args.n_heads,
+            d_ff=args.draft_d_model * 3,
+            max_seq_len=args.max_decode_len, dtype=jnp.bfloat16)
+        draft_params = tfm.TransformerLM(draft_config).init(
+            jax.random.PRNGKey(args.seed + 7),
+            jnp.zeros((1, args.prompt_len), jnp.int32))["params"]
+        run_spec, _, _ = inference.make_speculative_decoder(
+            config, params, draft_config, draft_params,
+            args.max_decode_len, gamma=args.gamma)
+        out, stats = run_spec(prompt, args.num_tokens)
+        int(out[0, -1])  # hard sync (compile + first run)
+        start = time.perf_counter()
+        out, stats = run_spec(prompt, args.num_tokens)
+        int(out[0, -1])
+        elapsed = time.perf_counter() - start
+        tokens_per_sec = args.batch * args.num_tokens / elapsed
+        acc = int(stats["accepted"]) / max(1, int(stats["proposed"]))
+        distributed.log(ctx, (
+            f"speculative generate: {tokens_per_sec:.1f} tok/s "
+            f"(batch {args.batch}, {args.num_tokens} new tokens, "
+            f"{int(stats['rounds'])} rounds, gamma={args.gamma}, "
+            f"acceptance {acc:.2f})"))
+        return 0
     run, _ = inference.make_decoder(config, params,
                                     args.max_decode_len)
     sampling = inference.SamplingConfig(

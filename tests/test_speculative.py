@@ -1,0 +1,108 @@
+"""Speculative decoding (models/inference.speculative_generate):
+greedy equivalence with the lockstep decoder across draft qualities —
+hostile draft (every token corrected), perturbed draft (partial
+acceptance), identical draft (full acceptance + bonus tokens) — plus
+the prompt-length-1 edge and stats accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batch_shipyard_tpu.models import inference as inf
+from batch_shipyard_tpu.models import transformer as tfm
+
+TCFG = tfm.TransformerConfig(
+    vocab_size=97, d_model=64, n_layers=3, n_heads=4, d_head=16,
+    d_ff=128, max_seq_len=96, dtype=jnp.float32,
+    param_dtype=jnp.float32)
+DCFG = tfm.TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=1, n_heads=2, d_head=16,
+    d_ff=64, max_seq_len=96, dtype=jnp.float32,
+    param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tparams():
+    return tfm.TransformerLM(TCFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    return tfm.TransformerLM(DCFG).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+@pytest.fixture(scope="module")
+def reference(tparams):
+    run, _ = inf.make_decoder(TCFG, tparams, max_decode_len=96)
+    return run
+
+
+PROMPT = jnp.asarray([[5, 17, 31, 2], [9, 9, 1, 42]], jnp.int32)
+N = 24
+
+
+def _spec(tparams, dcfg, dparams, gamma=4):
+    run, _, _ = inf.make_speculative_decoder(
+        TCFG, tparams, dcfg, dparams, max_decode_len=96, gamma=gamma)
+    return run
+
+
+def test_hostile_draft_still_exact(tparams, dparams, reference):
+    """A draft that almost never agrees: every round falls back to
+    the target's correction token — output must still be identical."""
+    tok, stats = _spec(tparams, DCFG, dparams)(PROMPT, N)
+    ref, _ = reference(PROMPT, N, jax.random.PRNGKey(0))
+    assert jnp.array_equal(tok, ref)
+    assert tok.shape == (2, PROMPT.shape[1] + N)
+    # Worst case: one committed token per round.
+    assert int(stats["rounds"]) <= N
+    assert int(stats["proposed"]) == int(stats["rounds"]) * 4
+
+
+def test_identical_draft_full_acceptance(tparams, reference):
+    """Draft == target: every proposal validates, rounds collapse to
+    ceil(N / (gamma+1)) and the bonus-token path is exercised."""
+    tok, stats = _spec(tparams, TCFG, tparams)(PROMPT, N)
+    ref, _ = reference(PROMPT, N, jax.random.PRNGKey(0))
+    assert jnp.array_equal(tok, ref)
+    assert int(stats["accepted"]) == int(stats["proposed"])
+    assert int(stats["rounds"]) == -(-N // 5)  # gamma+1 per round
+
+
+def test_perturbed_draft_partial_acceptance(tparams, reference):
+    """A slightly-noised target as draft: agrees often but not
+    always — exercises mixed accept/correct rounds exactly."""
+    rng = np.random.RandomState(7)
+    noisy = jax.tree_util.tree_map(
+        lambda p: p + jnp.asarray(
+            0.02 * rng.randn(*p.shape), p.dtype), tparams)
+    tok, stats = _spec(tparams, TCFG, noisy)(PROMPT, N)
+    ref, _ = reference(PROMPT, N, jax.random.PRNGKey(0))
+    assert jnp.array_equal(tok, ref)
+    acc, prop = int(stats["accepted"]), int(stats["proposed"])
+    assert 0 < acc < prop, (acc, prop)
+
+
+def test_prompt_length_one(tparams, dparams, reference):
+    prompt = jnp.asarray([[3], [77]], jnp.int32)
+    tok, _ = _spec(tparams, DCFG, dparams)(prompt, 12)
+    ref, _ = reference(prompt, 12, jax.random.PRNGKey(0))
+    assert jnp.array_equal(tok, ref)
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 7])
+def test_gamma_sweep_exact(tparams, dparams, reference, gamma):
+    tok, _ = _spec(tparams, DCFG, dparams, gamma=gamma)(PROMPT, N)
+    ref, _ = reference(PROMPT, N, jax.random.PRNGKey(0))
+    assert jnp.array_equal(tok, ref)
+
+def test_paged_kv_config_rejected(tparams, dparams):
+    import dataclasses
+    paged = dataclasses.replace(TCFG, kv_page_size=16)
+    with pytest.raises(ValueError) as exc:
+        inf.make_speculative_decoder(paged, tparams, DCFG, dparams,
+                                     max_decode_len=96)
+    assert "kv_page_size" in str(exc.value)
