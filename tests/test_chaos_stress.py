@@ -184,3 +184,83 @@ def test_submission_scale_100k_queueing():
             seen[payload["task_id"]] += 1
             store.delete_message(msg)
     assert len(seen) == 16 * 32 and max(seen.values()) == 1
+
+
+def test_soak_concurrent_pools_with_chaos():
+    """Multi-pool soak (ROADMAP Quality): three pools on ONE shared
+    state store run concurrent workloads — one of them under
+    continuous agent-kill chaos — and every task on every pool
+    completes exactly once with no cross-pool interference."""
+    import threading
+
+    store = MemoryStateStore()
+    pools = {}
+    substrates = {}
+    n_tasks = {"soak-a": 150, "soak-b": 100, "soak-c": 60}
+    stop_chaos = None
+    try:
+        for pool_id, accel, slots in (
+                ("soak-a", "v5litepod-16", 4),
+                ("soak-b", "v5litepod-8", 2),
+                ("soak-c", "v5litepod-4", 2)):
+            conf = {"pool_specification": {
+                "id": pool_id, "substrate": "fake",
+                "tpu": {"accelerator_type": accel},
+                "task_slots_per_node": slots,
+                "task_queue_shards": 4,
+                "max_wait_time_seconds": 30}}
+            substrates[pool_id] = FakePodSubstrate(
+                store, node_stale_seconds=3.0)
+            pools[pool_id] = settings_mod.pool_settings(conf)
+            pool_mgr.create_pool(store, substrates[pool_id],
+                                 pools[pool_id], GLOBAL, conf)
+        # Chaos on the middle pool only: its kills must not disturb
+        # the other pools' agents or task state.
+        stop_chaos = substrates["soak-b"].start_chaos(
+            "soak-b", kill_interval=0.8, revive_after=0.3, seed=7)
+
+        results: dict = {}
+
+        def drive(pool_id: str) -> None:
+            try:
+                jobs = settings_mod.job_settings_list(
+                    {"job_specifications": [{
+                        "id": "load",
+                        "tasks": [{"id": f"t{i:04d}",
+                                   "command": f"echo {pool_id}-{i}"}
+                                  for i in range(n_tasks[pool_id])],
+                    }]})
+                jobs_mgr.add_jobs(store, pools[pool_id], jobs)
+                results[pool_id] = jobs_mgr.wait_for_tasks(
+                    store, pool_id, "load", timeout=240,
+                    poll_interval=0.5)
+            except Exception as exc:  # noqa: BLE001
+                results[pool_id] = exc
+
+        threads = [threading.Thread(target=drive, args=(p,),
+                                    daemon=True) for p in pools]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert not any(t.is_alive() for t in threads), \
+            "soak drive thread still running after join budget"
+        assert set(results) == set(pools), results.keys()
+        for pool_id, tasks in results.items():
+            assert not isinstance(tasks, Exception), (pool_id, tasks)
+            assert len(tasks) == n_tasks[pool_id]
+            bad = {t["_rk"]: t["state"] for t in tasks
+                   if t["state"] != "completed"}
+            assert not bad, (pool_id, bad)
+        # Exactly-once effects sampled per pool, incl. the chaos one.
+        for pool_id in pools:
+            last = n_tasks[pool_id] - 1
+            for i in (0, last):
+                out = jobs_mgr.get_task_output(
+                    store, pool_id, "load", f"t{i:04d}")
+                assert out.strip() == f"{pool_id}-{i}".encode()
+    finally:
+        if stop_chaos is not None:
+            stop_chaos.set()
+        for substrate in substrates.values():
+            substrate.stop_all()
