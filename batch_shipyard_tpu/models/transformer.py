@@ -68,6 +68,13 @@ class TransformerConfig:
     # stochastic rounding, int32 MXU accumulation (2x the bf16 rate on
     # v5e), full-precision QAT backward. Opt-in — changes numerics.
     quantize_matmuls: bool = False
+    # Quantize the dense decode KV cache to int8 with per-(position,
+    # head) scales: K/V rows absmax-quantize on write and dequantize
+    # fused into the attention matmuls on read — half the HBM per
+    # cached token vs bf16, so 2x the decode slots/context per chip.
+    # Opt-in ("int8"); changes numerics within quantization noise.
+    # Dense cache only (mutually exclusive with kv_page_size).
+    kv_cache_dtype: Optional[str] = None
     # Paged KV cache for decode (vLLM-style): slots hold page-index
     # block tables into a shared page pool instead of reserving
     # max_decode_len rows each. None = dense cache.
@@ -213,6 +220,10 @@ class Attention(nn.Module):
                     "tp_axis is a training-path (shard_map pipeline) "
                     "feature; the decode path would return "
                     "un-reduced o_proj partial sums")
+            if cfg.kv_page_size and cfg.kv_cache_dtype:
+                raise ValueError(
+                    "kv_cache_dtype applies to the dense decode "
+                    "cache only; unset it (or kv_page_size)")
             attend = (self._decode_attend_paged
                       if cfg.kv_page_size else self._decode_attend)
             return dense(cfg.d_model, "o_proj")(
@@ -241,13 +252,38 @@ class Attention(nn.Module):
         requirement for continuous batching (models/serving.py).
         Multi-token inserts start at each slot's current index."""
         cfg = self.config
+        int8_kv = cfg.kv_cache_dtype == "int8"
+        if cfg.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={cfg.kv_cache_dtype!r}: only 'int8' "
+                f"(or None) is supported")
+        store_dtype = jnp.int8 if int8_kv else cfg.dtype
         batch, seq, heads, depth = q.shape
         cache_k = self.variable(
             "cache", "k", jnp.zeros,
-            (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
+            (batch, cfg.max_decode_len, heads, depth), store_dtype)
         cache_v = self.variable(
             "cache", "v", jnp.zeros,
-            (batch, cfg.max_decode_len, heads, depth), cfg.dtype)
+            (batch, cfg.max_decode_len, heads, depth), store_dtype)
+        if int8_kv:
+            # Per-(position, head) absmax scales; fp32 so dequant
+            # error is the int8 rounding alone.
+            scale_k = self.variable(
+                "cache", "k_scale", jnp.zeros,
+                (batch, cfg.max_decode_len, heads), jnp.float32)
+            scale_v = self.variable(
+                "cache", "v_scale", jnp.zeros,
+                (batch, cfg.max_decode_len, heads), jnp.float32)
+
+        def quantize(x):
+            """x: [..., D] -> (int8 rows, fp32 scales [...])."""
+            absmax = jnp.max(jnp.abs(x.astype(jnp.float32)),
+                             axis=-1)
+            scale = jnp.maximum(absmax, 1e-8) / 127.0
+            rows = jnp.round(
+                x.astype(jnp.float32) / scale[..., None])
+            return rows.astype(jnp.int8), scale
+
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((batch,), jnp.int32))
         idx = index.value  # [B]
@@ -255,33 +291,59 @@ class Attention(nn.Module):
             jnp.int32, (cfg.max_decode_len, 1), 0)[:, 0]
         if seq == 1:
             rows = jnp.arange(batch)
+            k_in, v_in = k[:, 0], v[:, 0]
+            if int8_kv:
+                k_in, ks = quantize(k_in)
+                v_in, vs = quantize(v_in)
+                scale_k.value = scale_k.value.at[rows, idx].set(ks)
+                scale_v.value = scale_v.value.at[rows, idx].set(vs)
             cache_k.value = cache_k.value.at[rows, idx].set(
-                k[:, 0].astype(cfg.dtype))
+                k_in.astype(store_dtype))
             cache_v.value = cache_v.value.at[rows, idx].set(
-                v[:, 0].astype(cfg.dtype))
+                v_in.astype(store_dtype))
             index.value = idx + 1
             mask = (key_pos[None, :] <= idx[:, None])[:, None, None, :]
         else:
             rows = jnp.arange(batch)[:, None]                 # [B, 1]
             cols = idx[:, None] + jnp.arange(seq)[None, :]    # [B, S]
+            k_in, v_in = k, v
+            if int8_kv:
+                k_in, ks = quantize(k_in)
+                v_in, vs = quantize(v_in)
+                scale_k.value = scale_k.value.at[rows, cols].set(ks)
+                scale_v.value = scale_v.value.at[rows, cols].set(vs)
             cache_k.value = cache_k.value.at[rows, cols].set(
-                k.astype(cfg.dtype))
+                k_in.astype(store_dtype))
             cache_v.value = cache_v.value.at[rows, cols].set(
-                v.astype(cfg.dtype))
+                v_in.astype(store_dtype))
             index.value = idx + seq
             # Causal over absolute cache positions: query s (absolute
             # idx+s) sees keys <= idx+s — earlier chunks AND the
             # causal prefix of this one.
             mask = (key_pos[None, None, :] <=
                     cols[:, :, None])[:, None, :, :]  # [B, 1, S, T]
+        if int8_kv:
+            # Dequant is elementwise on the matmul operands — XLA
+            # fuses it into the dots; HBM holds int8 + scales only
+            # (ops/quantization.dequantize_int8 is the shared
+            # contract partner of the quantize above).
+            from batch_shipyard_tpu.ops import quantization as qz
+            k_all = qz.dequantize_int8(
+                cache_k.value,
+                scale_k.value[..., None]).astype(cfg.dtype)
+            v_all = qz.dequantize_int8(
+                cache_v.value,
+                scale_v.value[..., None]).astype(cfg.dtype)
+        else:
+            k_all, v_all = cache_k.value, cache_v.value
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, cache_k.value,
+            "bqhd,bkhd->bhqk", q, k_all,
             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(depth))
         scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
-            "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cache_v.value,
+            "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_all,
             preferred_element_type=jnp.float32)
         return out.astype(cfg.dtype)
 

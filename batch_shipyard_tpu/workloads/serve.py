@@ -32,7 +32,8 @@ def build_config(args) -> tfm.TransformerConfig:
         vocab_size=args.vocab, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=args.d_ff,
-        max_seq_len=args.max_decode_len, dtype=jnp.bfloat16)
+        max_seq_len=args.max_decode_len, dtype=jnp.bfloat16,
+        kv_cache_dtype=args.kv_cache_dtype)
 
 
 def build_params(args, config: tfm.TransformerConfig):
@@ -109,6 +110,11 @@ def main() -> int:
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--kv-page-size", type=int, default=None)
+    parser.add_argument("--kv-cache-dtype", default=None,
+                        choices=["int8"],
+                        help="Quantize the dense decode KV cache "
+                        "(half the HBM per token -> 2x slots/context"
+                        "; dense cache only)")
     parser.add_argument("--kv-num-pages", type=int, default=None)
     parser.add_argument("--overcommit", action="store_true")
     parser.add_argument("--host", default="127.0.0.1")
