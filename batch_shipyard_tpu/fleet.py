@@ -378,11 +378,15 @@ def action_account_info(ctx: Context, raw: bool = False) -> None:
         "gcp_project": creds.gcp.project if creds.gcp else None,
         "pools": [p["_rk"] for p in pool_mgr.list_pools(ctx.store)],
     }
-    try:
-        import jax
-        info["local_accelerators"] = [str(d) for d in jax.devices()]
-    except Exception:
-        info["local_accelerators"] = []
+    # Subprocess probe with a hard timeout: a wedged accelerator
+    # relay must yield an honest "unreachable" here, not a hung CLI
+    # (in-process jax.devices() can BLOCK, not fail — see
+    # TPU_WEDGE_REPORT.md).
+    from batch_shipyard_tpu.utils.util import probe_default_devices
+    count, reason = probe_default_devices(timeout=30.0)
+    info["local_accelerator_count"] = count
+    if reason:
+        info["local_accelerator_error"] = reason
     _emit(info, raw)
 
 

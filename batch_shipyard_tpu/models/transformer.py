@@ -275,14 +275,9 @@ class Attention(nn.Module):
                 "cache", "v_scale", jnp.zeros,
                 (batch, cfg.max_decode_len, heads), jnp.float32)
 
-        def quantize(x):
-            """x: [..., D] -> (int8 rows, fp32 scales [...])."""
-            absmax = jnp.max(jnp.abs(x.astype(jnp.float32)),
-                             axis=-1)
-            scale = jnp.maximum(absmax, 1e-8) / 127.0
-            rows = jnp.round(
-                x.astype(jnp.float32) / scale[..., None])
-            return rows.astype(jnp.int8), scale
+        if int8_kv:
+            from batch_shipyard_tpu.ops.quantization import (
+                quantize_int8_rows as quantize)
 
         index = self.variable(
             "cache", "index", lambda: jnp.zeros((batch,), jnp.int32))

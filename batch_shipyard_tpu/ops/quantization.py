@@ -87,6 +87,17 @@ def quantize_int8(x, seed: int = 0, block_m: int = 256):
     )(x, bits)
 
 
+def quantize_int8_rows(x, eps: float = 1e-8):
+    """Plain-jnp absmax row quantization over the LAST axis:
+    x [..., D] -> (int8 rows, fp32 scales [...]). The jnp contract
+    partner of dequantize_int8 (the Pallas kernels implement the same
+    formula with stochastic rounding for training)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, eps) / 127.0
+    rows = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return rows.astype(jnp.int8), scale
+
+
 def dequantize_int8(values, scales):
     return values.astype(jnp.float32) * scales
 

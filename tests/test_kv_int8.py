@@ -12,7 +12,7 @@ import pytest
 
 from batch_shipyard_tpu.models import inference as inf
 from batch_shipyard_tpu.models import serving
-from batch_shipyard_tpu.models import transformer as tfm  # noqa: F401
+from batch_shipyard_tpu.models import transformer as tfm
 
 CFG = tfm.TransformerConfig(
     vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_head=16,
@@ -61,7 +61,7 @@ def test_int8_logits_within_quantization_noise(params):
     def last_logits(kv_dtype):
         model = _decode_model(kv_dtype)
         cache = inf.init_cache(model, params, 1)
-        hidden, mut = model.apply(
+        hidden, _ = model.apply(
             {"params": params, "cache": cache}, prompt,
             return_hidden=True, mutable=["cache"])
         emb = params["embed"]["embedding"]

@@ -119,9 +119,19 @@ def test_remote_scratch_export_mount_and_teardown():
                    for m in runners.mounts), runners.mounts
         assert len(runners.umounts) == 3
         assert runners.unexports == [host_scratch]
-        with pytest.raises(NotFoundError):
-            store.get_entity(names.TABLE_JOBPREP, "rscratch$rj",
-                             "#scratchhost")
+        # The finalize path removes the scratch dir BEFORE deleting
+        # the host row — poll with a FRESH budget so a loaded machine
+        # can't race this assertion into a flake.
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                store.get_entity(names.TABLE_JOBPREP, "rscratch$rj",
+                                 "#scratchhost")
+            except NotFoundError:
+                break
+            assert time.monotonic() < deadline, \
+                "#scratchhost row never deleted"
+            time.sleep(0.1)
     finally:
         substrate.stop_all()
 
