@@ -272,13 +272,29 @@ class ContinuousBatcher:
                             krows.astype(kp.dtype))
                         vp = vp.at[table_row[b]].set(
                             vrows.astype(vp.dtype))
-                    return {
+                    out = {
                         "k_pages": kp, "v_pages": vp,
                         "block_table":
                             big["block_table"].at[slot].set(table_row),
                         "length":
                             big["length"].at[slot].set(prompt_len),
                     }
+                    if "k_page_scales" in big:
+                        # int8 pool: the dense prefill cache is int8
+                        # too (same kv_cache_dtype), so its rows and
+                        # scales route straight into the page pool.
+                        ksc = big["k_page_scales"]
+                        vsc = big["v_page_scales"]
+                        for b in range(n_blocks):
+                            ksc = ksc.at[table_row[b]].set(
+                                sm["k_scale"][0,
+                                              b * page:(b + 1) * page])
+                            vsc = vsc.at[table_row[b]].set(
+                                sm["v_scale"][0,
+                                              b * page:(b + 1) * page])
+                        out["k_page_scales"] = ksc
+                        out["v_page_scales"] = vsc
+                    return out
                 return {key: scatter(big[key], sm[key]) for key in big}
 
             return scatter(cache, small), last

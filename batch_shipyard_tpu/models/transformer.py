@@ -68,12 +68,13 @@ class TransformerConfig:
     # stochastic rounding, int32 MXU accumulation (2x the bf16 rate on
     # v5e), full-precision QAT backward. Opt-in — changes numerics.
     quantize_matmuls: bool = False
-    # Quantize the dense decode KV cache to int8 with per-(position,
-    # head) scales: K/V rows absmax-quantize on write and dequantize
-    # fused into the attention matmuls on read — half the HBM per
-    # cached token vs bf16, so 2x the decode slots/context per chip.
-    # Opt-in ("int8"); changes numerics within quantization noise.
-    # Dense cache only (mutually exclusive with kv_page_size).
+    # Quantize the decode KV cache (dense rows OR the paged pool) to
+    # int8 with per-(position, head) scales: K/V absmax-quantize on
+    # write and dequantize fused into the attention matmuls on read
+    # (in-kernel per tile on the Pallas paged path) — half the HBM
+    # per cached token vs bf16, so 2x the decode slots/context per
+    # chip. Opt-in ("int8"); changes numerics within quantization
+    # noise.
     kv_cache_dtype: Optional[str] = None
     # Paged KV cache for decode (vLLM-style): slots hold page-index
     # block tables into a shared page pool instead of reserving
@@ -220,10 +221,10 @@ class Attention(nn.Module):
                     "tp_axis is a training-path (shard_map pipeline) "
                     "feature; the decode path would return "
                     "un-reduced o_proj partial sums")
-            if cfg.kv_page_size and cfg.kv_cache_dtype:
+            if cfg.kv_cache_dtype not in (None, "int8"):
                 raise ValueError(
-                    "kv_cache_dtype applies to the dense decode "
-                    "cache only; unset it (or kv_page_size)")
+                    f"kv_cache_dtype={cfg.kv_cache_dtype!r}: only "
+                    f"'int8' (or None) is supported")
             attend = (self._decode_attend_paged
                       if cfg.kv_page_size else self._decode_attend)
             return dense(cfg.d_model, "o_proj")(
@@ -252,11 +253,7 @@ class Attention(nn.Module):
         requirement for continuous batching (models/serving.py).
         Multi-token inserts start at each slot's current index."""
         cfg = self.config
-        int8_kv = cfg.kv_cache_dtype == "int8"
-        if cfg.kv_cache_dtype not in (None, "int8"):
-            raise ValueError(
-                f"kv_cache_dtype={cfg.kv_cache_dtype!r}: only 'int8' "
-                f"(or None) is supported")
+        int8_kv = cfg.kv_cache_dtype == "int8"  # validated at dispatch
         store_dtype = jnp.int8 if int8_kv else cfg.dtype
         batch, seq, heads, depth = q.shape
         cache_k = self.variable(
@@ -355,16 +352,25 @@ class Attention(nn.Module):
         identically (models/serving.py).
         """
         cfg = self.config
+        int8_kv = cfg.kv_cache_dtype == "int8"  # validated at dispatch
+        store_dtype = jnp.int8 if int8_kv else cfg.dtype
         batch, seq, heads, depth = q.shape
         assert seq == 1, "decode mode consumes one token per call"
         page = cfg.kv_page_size
         max_blocks = (cfg.max_decode_len + page - 1) // page
         k_pages = self.variable(
             "cache", "k_pages", jnp.zeros,
-            (cfg.kv_num_pages, page, heads, depth), cfg.dtype)
+            (cfg.kv_num_pages, page, heads, depth), store_dtype)
         v_pages = self.variable(
             "cache", "v_pages", jnp.zeros,
-            (cfg.kv_num_pages, page, heads, depth), cfg.dtype)
+            (cfg.kv_num_pages, page, heads, depth), store_dtype)
+        if int8_kv:
+            scale_k = self.variable(
+                "cache", "k_page_scales", jnp.zeros,
+                (cfg.kv_num_pages, page, heads), jnp.float32)
+            scale_v = self.variable(
+                "cache", "v_page_scales", jnp.zeros,
+                (cfg.kv_num_pages, page, heads), jnp.float32)
         block_table = self.variable(
             "cache", "block_table",
             lambda: jnp.zeros((batch, max_blocks), jnp.int32))
@@ -375,14 +381,24 @@ class Attention(nn.Module):
         page_idx = jnp.take_along_axis(
             block_table.value, (idx // page)[:, None], axis=1)[:, 0]
         offset = idx % page
+        k_in, v_in = k[:, 0], v[:, 0]
+        if int8_kv:
+            from batch_shipyard_tpu.ops.quantization import (
+                quantize_int8_rows)
+            k_in, ks = quantize_int8_rows(k_in)
+            v_in, vs = quantize_int8_rows(v_in)
+            scale_k.value = scale_k.value.at[page_idx, offset].set(ks)
+            scale_v.value = scale_v.value.at[page_idx, offset].set(vs)
         k_pages.value = k_pages.value.at[page_idx, offset].set(
-            k[:, 0].astype(cfg.dtype))
+            k_in.astype(store_dtype))
         v_pages.value = v_pages.value.at[page_idx, offset].set(
-            v[:, 0].astype(cfg.dtype))
+            v_in.astype(store_dtype))
         length.value = idx + 1
         return paged_ops.paged_decode_attention(
             q, k_pages.value, v_pages.value, block_table.value,
-            length.value, impl=cfg.paged_attention_impl).astype(
+            length.value, impl=cfg.paged_attention_impl,
+            k_scales=scale_k.value if int8_kv else None,
+            v_scales=scale_v.value if int8_kv else None).astype(
                 cfg.dtype)
 
 

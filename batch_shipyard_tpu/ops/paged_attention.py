@@ -36,6 +36,45 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _accumulate_page(q_row, k_tile, v_tile, j, length, o_acc, m_acc,
+                     l_acc, *, page: int, scale: float):
+    """ONE online-softmax block update over a (pre-dequantized) page
+    tile — the recurrence shared by the fp and int8 kernels (a fix to
+    the mask/correction/denominator logic lands in both)."""
+    scores = jax.lax.dot_general(
+        q_row, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [1, page]
+    pos = j * page + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page), 1)
+    scores = jnp.where(pos < length, scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1, keepdims=True)       # [1, 1]
+    m_new = jnp.maximum(m_acc[...], m_blk)
+    correction = jnp.exp(m_acc[...] - m_new)
+    p = jnp.exp(scores - m_new)                            # [1, page]
+    l_new = (l_acc[...] * correction +
+             jnp.sum(p, axis=-1, keepdims=True))
+    pv = jax.lax.dot_general(
+        p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [1, D]
+    o_acc[...] = o_acc[...] * correction + pv
+    m_acc[...] = m_new
+    l_acc[...] = l_new
+
+
+def _init_and_emit(j, num_blocks, o_ref, o_acc, m_acc, l_acc):
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    def _emit():
+        l_final = l_acc[...]
+        denom = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[...] = (o_acc[...] / denom).astype(o_ref.dtype)
+    return _emit
+
+
 def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
                          o_ref, o_acc, m_acc, l_acc, *,
                          page: int, scale: float):
@@ -51,58 +90,60 @@ def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
     j = pl.program_id(2)
     num_blocks = pl.num_programs(2)
     length = len_ref[b]
-
-    @pl.when(j == 0)
-    def _init():
-        o_acc[...] = jnp.zeros_like(o_acc)
-        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
-        l_acc[...] = jnp.zeros_like(l_acc)
+    emit = _init_and_emit(j, num_blocks, o_ref, o_acc, m_acc, l_acc)
 
     @pl.when(j * page < length)
     def _accumulate():
-        k_tile = k_ref[...]
-        v_tile = v_ref[...]
-        scores = jax.lax.dot_general(
-            q_ref[...], k_tile, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [1, page]
-        pos = j * page + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page), 1)
-        scores = jnp.where(pos < length, scores, _NEG_INF)
-        m_blk = jnp.max(scores, axis=-1, keepdims=True)   # [1, 1]
-        m_new = jnp.maximum(m_acc[...], m_blk)
-        correction = jnp.exp(m_acc[...] - m_new)
-        p = jnp.exp(scores - m_new)                        # [1, page]
-        l_new = (l_acc[...] * correction +
-                 jnp.sum(p, axis=-1, keepdims=True))
-        pv = jax.lax.dot_general(
-            p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [1, D]
-        o_acc[...] = o_acc[...] * correction + pv
-        m_acc[...] = m_new
-        l_acc[...] = l_new
+        _accumulate_page(q_ref[...], k_ref[...], v_ref[...], j,
+                         length, o_acc, m_acc, l_acc, page=page,
+                         scale=scale)
 
-    @pl.when(j == num_blocks - 1)
-    def _emit():
-        l_final = l_acc[...]
-        denom = jnp.where(l_final == 0.0, 1.0, l_final)
-        o_ref[...] = (o_acc[...] / denom).astype(o_ref.dtype)
+    pl.when(j == num_blocks - 1)(emit)
+
+
+def _paged_decode_kernel_int8(table_ref, len_ref, q_ref, k_ref,
+                              ks_ref, v_ref, vs_ref, o_ref, o_acc,
+                              m_acc, l_acc, *, page: int,
+                              scale: float):
+    """int8-page variant: the same recurrence with the K/V tiles
+    dequantized in VMEM (k int8 [page, D] * scale [page, 1]) right
+    before the dots — HBM traffic stays int8."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_blocks = pl.num_programs(2)
+    length = len_ref[b]
+    emit = _init_and_emit(j, num_blocks, o_ref, o_acc, m_acc, l_acc)
+
+    @pl.when(j * page < length)
+    def _accumulate():
+        k_tile = k_ref[...].astype(jnp.float32) * ks_ref[...]
+        v_tile = v_ref[...].astype(jnp.float32) * vs_ref[...]
+        _accumulate_page(q_ref[...].astype(jnp.float32), k_tile,
+                         v_tile, j, length, o_acc, m_acc, l_acc,
+                         page=page, scale=scale)
+
+    pl.when(j == num_blocks - 1)(emit)
 
 
 def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
-                                  lengths):
+                                  lengths, k_scales=None,
+                                  v_scales=None):
     """Pallas path. q: [B, 1, H, D]; k_pages/v_pages:
     [P, page, H, D]; block_table: [B, max_blocks] int32; lengths: [B]
     int32 valid-key counts (INCLUDING the token written this step, so
     every attended slot has length >= 1 — a length-0 slot yields zeros
     here but softmax-of-all-masked garbage from the XLA path; the
     decode contract never attends an unwritten slot).
-    Returns [B, 1, H, D] in q.dtype."""
+    k_scales/v_scales: [P, page, H] fp32 when the pages are int8
+    (dequantized in-kernel per tile). Returns [B, 1, H, D] in
+    q.dtype."""
     batch, seq, heads, depth = q.shape
     assert seq == 1, "decode consumes one token per call"
     _pages, page, _heads, _depth = k_pages.shape
     max_blocks = block_table.shape[1]
     scale = 1.0 / (depth ** 0.5)
     q_r = q.reshape(batch, heads, 1, depth)
+    int8_pages = k_scales is not None
 
     def page_index(b, h, j, tbl, ln):
         # Clamp dead steps to the slot's LAST live page: the prefetch
@@ -112,15 +153,30 @@ def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
         live = jnp.maximum((ln[b] + page - 1) // page - 1, 0)
         return (tbl[b, jnp.minimum(j, live)], 0, h, 0)
 
+    page_spec = pl.BlockSpec((None, page, None, depth), page_index)
+    in_specs = [
+        pl.BlockSpec((None, None, 1, depth),
+                     lambda b, h, j, tbl, ln: (b, h, 0, 0)),
+        page_spec,
+    ]
+    operands = [q_r, k_pages]
+    if int8_pages:
+        scale_spec = pl.BlockSpec((None, page, None, 1), page_index)
+        in_specs.append(scale_spec)
+        operands.append(
+            k_scales.reshape(*k_scales.shape, 1))
+    in_specs.append(page_spec)
+    operands.append(v_pages)
+    if int8_pages:
+        in_specs.append(scale_spec)
+        operands.append(
+            v_scales.reshape(*v_scales.shape, 1))
+    kern = (_paged_decode_kernel_int8 if int8_pages
+            else _paged_decode_kernel)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch, heads, max_blocks),
-        in_specs=[
-            pl.BlockSpec((None, None, 1, depth),
-                         lambda b, h, j, tbl, ln: (b, h, 0, 0)),
-            pl.BlockSpec((None, page, None, depth), page_index),
-            pl.BlockSpec((None, page, None, depth), page_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, 1, depth),
                                lambda b, h, j, tbl, ln: (b, h, 0, 0)),
         scratch_shapes=[
@@ -130,20 +186,23 @@ def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page=page, scale=scale),
+        functools.partial(kern, page=page, scale=scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, heads, 1, depth),
                                        q.dtype),
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q_r, k_pages, v_pages)
+      *operands)
     return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, block_table,
-                               lengths):
+                               lengths, k_scales=None,
+                               v_scales=None):
     """XLA gather formulation (the CPU/fallback path): materialize each
     slot's full logical [max_blocks*page, H, D] view, then one masked
-    softmax. Same math as the kernel; reads the whole table width."""
+    softmax. Same math as the kernel; reads the whole table width.
+    With int8 pages, only the GATHERED slices dequantize — never the
+    whole pool."""
     batch, seq, heads, depth = q.shape
     assert seq == 1
     page = k_pages.shape[1]
@@ -152,6 +211,15 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_table,
         batch, max_blocks * page, heads, depth)
     v_all = v_pages[block_table].reshape(
         batch, max_blocks * page, heads, depth)
+    if k_scales is not None:
+        ks = k_scales[block_table].reshape(
+            batch, max_blocks * page, heads)
+        vs = v_scales[block_table].reshape(
+            batch, max_blocks * page, heads)
+        k_all = (k_all.astype(jnp.float32) *
+                 ks[..., None]).astype(q.dtype)
+        v_all = (v_all.astype(jnp.float32) *
+                 vs[..., None]).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(depth))
@@ -166,15 +234,19 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_table,
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_table, lengths,
-                           impl: Optional[str] = None):
+                           impl: Optional[str] = None,
+                           k_scales=None, v_scales=None):
     """Dispatch: 'kernel' (Pallas) or 'xla'. Default: kernel on TPU,
-    xla elsewhere (mirrors ops/attention.attention's dispatch)."""
+    xla elsewhere (mirrors ops/attention.attention's dispatch).
+    k_scales/v_scales switch both paths to int8-page dequant."""
     if impl is None:
         impl = "kernel" if jax.default_backend() == "tpu" else "xla"
     if impl == "kernel":
         return paged_decode_attention_kernel(
-            q, k_pages, v_pages, block_table, lengths)
+            q, k_pages, v_pages, block_table, lengths,
+            k_scales=k_scales, v_scales=v_scales)
     if impl == "xla":
         return paged_decode_attention_xla(
-            q, k_pages, v_pages, block_table, lengths)
+            q, k_pages, v_pages, block_table, lengths,
+            k_scales=k_scales, v_scales=v_scales)
     raise ValueError(f"unknown paged attention impl {impl!r}")

@@ -112,9 +112,9 @@ def main() -> int:
     parser.add_argument("--kv-page-size", type=int, default=None)
     parser.add_argument("--kv-cache-dtype", default=None,
                         choices=["int8"],
-                        help="Quantize the dense decode KV cache "
-                        "(half the HBM per token -> 2x slots/context"
-                        "; dense cache only)")
+                        help="Quantize the decode KV cache (dense "
+                        "or paged pool) to int8: half the HBM per "
+                        "token -> 2x slots/context")
     parser.add_argument("--kv-num-pages", type=int, default=None)
     parser.add_argument("--overcommit", action="store_true")
     parser.add_argument("--host", default="127.0.0.1")

@@ -240,7 +240,48 @@ def check_chunked_cross_entropy() -> bool:
     return ok
 
 
+def check_paged_attention_int8() -> bool:
+    """int8-page paged decode: the Pallas in-kernel dequant vs the
+    XLA gathered-slice dequant (exact), and both vs the fp pages the
+    int8 was quantized from (quantization-noise bound)."""
+    from batch_shipyard_tpu.ops import paged_attention as paged
+    from batch_shipyard_tpu.ops.quantization import quantize_int8_rows
+
+    rng = np.random.RandomState(31)
+    batch, heads, depth = 8, 4, 64
+    page, num_pages, max_blocks = 16, 64, 8
+    q = jnp.asarray(rng.randn(batch, 1, heads, depth), jnp.float32)
+    k_f = jnp.asarray(
+        rng.randn(num_pages, page, heads, depth), jnp.float32)
+    v_f = jnp.asarray(
+        rng.randn(num_pages, page, heads, depth), jnp.float32)
+    kp, ks = quantize_int8_rows(k_f)
+    vp, vs = quantize_int8_rows(v_f)
+    perm = rng.permutation(num_pages)[:batch * max_blocks]
+    table = jnp.asarray(perm.reshape(batch, max_blocks), jnp.int32)
+    lengths = jnp.asarray(
+        [1, 5, page, page + 1, 3 * page - 2, 4 * page,
+         max_blocks * page - 1, max_blocks * page], jnp.int32)
+    out_k = jax.jit(lambda *a: paged.paged_decode_attention_kernel(
+        *a[:5], k_scales=a[5], v_scales=a[6]))(
+        q, kp, vp, table, lengths, ks, vs)
+    out_x = paged.paged_decode_attention_xla(
+        q, kp, vp, table, lengths, k_scales=ks, v_scales=vs)
+    ref = paged.paged_decode_attention_xla(q, k_f, v_f, table,
+                                           lengths)
+    rel_kx = (np.linalg.norm(np.asarray(out_k - out_x)) /
+              np.linalg.norm(np.asarray(out_x)))
+    rel_fp = (np.linalg.norm(np.asarray(out_x - ref)) /
+              np.linalg.norm(np.asarray(ref)))
+    ok = rel_kx < 1e-4 and rel_fp < 0.02
+    print(f"paged-attention int8 kernel vs xla: rel={rel_kx:.2e}; "
+          f"int8 vs fp pages: rel={rel_fp:.2e} "
+          f"{'OK' if ok else 'FAIL'}")
+    return ok
+
+
 CHECKS["chunked_cross_entropy"] = check_chunked_cross_entropy
+CHECKS["paged_attention_int8"] = check_paged_attention_int8
 
 
 def run_all(write_marker: str | None = None) -> dict:
