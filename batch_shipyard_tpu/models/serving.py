@@ -80,6 +80,7 @@ class ContinuousBatcher:
                  kv_page_size: Optional[int] = None,
                  kv_num_pages: Optional[int] = None,
                  overcommit: bool = False,
+                 prefill_chunk: Optional[int] = None,
                  on_token: Optional[
                      Callable[[str, int, int], None]] = None):
         """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
@@ -101,7 +102,20 @@ class ContinuousBatcher:
             generated tokens is preempted (pages reclaimed, request
             re-queued at the head) and later resumed by re-prefilling
             prompt + already-generated tokens. Short actual
-            generations then share a pool far below worst-case."""
+            generations then share a pool far below worst-case.
+
+        prefill_chunk caps the CHUNKED PREFILL segment length: long
+        prompts prefill in fixed-size multi-token inserts (each chunk
+        attends causally over the cache, so the math is identical to
+        one full-sequence pass) — peak prefill attention memory drops
+        from O(L^2) to O(chunk * L). Compilation stays per length
+        bucket (the chunk loop unrolls inside the bucket's jit). Use
+        a power of two so chunks divide the power-of-two length
+        buckets exactly."""
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         self.config = inf.decode_config(config, max_decode_len)
         self.paged = kv_page_size is not None
         self.overcommit = overcommit
@@ -198,10 +212,13 @@ class ContinuousBatcher:
 
         def dense_prefill(params, prompt, prompt_len):
             """Batch-1 BATCHED prefill over the (bucket-padded) prompt
-            [1, L]: ONE full-sequence forward (the multi-token insert
-            path of transformer._decode_attend) writes all L cache
-            rows and attends causally in a single MXU pass — prefill
-            wall-clock is one forward, not L sequential micro-steps.
+            [1, L]: the multi-token insert path of
+            transformer._decode_attend writes all L cache rows and
+            attends causally in MXU-batched passes — prefill
+            wall-clock is one forward (or ceil(L/chunk) chunked
+            forwards with self.prefill_chunk set, bounding peak
+            attention memory at O(chunk * L)), not L sequential
+            micro-steps. Compiles remain one per length bucket.
 
             prompt_len is DYNAMIC (a traced int32): rows written past
             prompt_len are garbage, but they are masked-on-read
@@ -215,15 +232,30 @@ class ContinuousBatcher:
             prompt_len-1 (return_hidden + a [d, vocab] matvec) so the
             full [L, vocab] fp32 logits tensor never materializes."""
             small = inf.init_cache(dense_model, params, 1)
-            hidden, mut = dense_model.apply(
-                {"params": params, "cache": small}, prompt,
-                return_hidden=True, mutable=["cache"])
+            total = prompt.shape[1]
+            chunk = min(self.prefill_chunk or total, total)
+            hiddens = []
+            cache = small
+            for off in range(0, total, chunk):
+                seg = prompt[:, off:off + chunk]
+                # Positions are GLOBAL offsets: RoPE for chunk c must
+                # match the full-sequence pass exactly.
+                h, mut = dense_model.apply(
+                    {"params": params, "cache": cache}, seg,
+                    return_hidden=True,
+                    positions=jnp.arange(
+                        off, off + seg.shape[1], dtype=jnp.int32),
+                    mutable=["cache"])
+                cache = mut["cache"]
+                hiddens.append(h)
+            hidden = (hiddens[0] if len(hiddens) == 1
+                      else jnp.concatenate(hiddens, axis=1))
             last_h = jnp.take(hidden[0], prompt_len - 1,
                               axis=0)                       # [d]
             embedding = params["embed"]["embedding"]
             last = jnp.dot(embedding.astype(jnp.float32),
                            last_h.astype(jnp.float32))      # [vocab]
-            return mut["cache"], last
+            return cache, last
 
         @jax.jit
         def prefill(params, cache, slot, prompt, prompt_len):

@@ -94,7 +94,8 @@ def build_engine(args, config=None,
         seed=args.seed,
         kv_page_size=args.kv_page_size,
         kv_num_pages=args.kv_num_pages,
-        overcommit=args.overcommit)
+        overcommit=args.overcommit,
+        prefill_chunk=args.prefill_chunk)
 
 
 def main() -> int:
@@ -116,6 +117,9 @@ def main() -> int:
                         "or paged pool) to int8: half the HBM per "
                         "token -> 2x slots/context")
     parser.add_argument("--kv-num-pages", type=int, default=None)
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="Chunked prefill segment length (bounds "
+                        "long-prompt prefill memory; power of two)")
     parser.add_argument("--overcommit", action="store_true")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8900)

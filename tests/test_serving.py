@@ -370,3 +370,29 @@ def test_preempted_victim_resumes_within_its_priority_class(params):
     # before the preempted low resumed.
     assert order[0] == "hi", (order, engine.preemptions)
     assert set(order[1:]) == {"low1", "low2"}
+
+
+def test_chunked_prefill_greedy_equivalent(params):
+    """prefill_chunk: chunked multi-token inserts with global RoPE
+    positions must produce tokens identical to the one-pass prefill
+    (dense and paged engines), while bounding prefill memory."""
+    prompt = [5, 17, 31, 2, 9, 40, 11, 3, 8, 22, 7, 19, 28, 33,
+              41, 6, 13, 2, 55, 60, 61, 44]  # 22 tokens -> 32 bucket
+
+    def run(prefill_chunk, page=None):
+        engine = serving.ContinuousBatcher(
+            CFG, params, num_slots=2, max_decode_len=64,
+            kv_page_size=page, prefill_chunk=prefill_chunk)
+        engine.submit(serving.Request("r", list(prompt),
+                                      max_new_tokens=10))
+        out = None
+        while engine.pending():
+            for _rid, tokens in engine.step():
+                out = tokens
+        return out
+
+    for page in (None, 16):
+        ref = run(None, page)
+        for chunk in (8, 16):
+            got = run(chunk, page)
+            assert got == ref, (page, chunk, got, ref)
