@@ -107,11 +107,13 @@ class ContinuousBatcher:
         prefill_chunk caps the CHUNKED PREFILL segment length: long
         prompts prefill in fixed-size multi-token inserts (each chunk
         attends causally over the cache, so the math is identical to
-        one full-sequence pass) — peak prefill attention memory drops
-        from O(L^2) to O(chunk * L). Compilation stays per length
-        bucket (the chunk loop unrolls inside the bucket's jit). Use
-        a power of two so chunks divide the power-of-two length
-        buckets exactly."""
+        one full-sequence pass) — the peak prefill score tensor
+        shrinks from O(L * max_decode_len) to
+        O(chunk * max_decode_len) (decode-path attention spans the
+        full cache width). Compilation stays per length bucket (the
+        chunk loop unrolls inside the bucket's jit). Use a power of
+        two so chunks divide the power-of-two length buckets
+        exactly."""
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -216,8 +218,8 @@ class ContinuousBatcher:
             transformer._decode_attend writes all L cache rows and
             attends causally in MXU-batched passes — prefill
             wall-clock is one forward (or ceil(L/chunk) chunked
-            forwards with self.prefill_chunk set, bounding peak
-            attention memory at O(chunk * L)), not L sequential
+            forwards with self.prefill_chunk set, bounding the score
+            tensor at O(chunk * max_decode_len)), not L sequential
             micro-steps. Compiles remain one per length bucket.
 
             prompt_len is DYNAMIC (a traced int32): rows written past

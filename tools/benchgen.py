@@ -218,6 +218,8 @@ def render() -> str:
               "tokens_per_sec_per_chip", "tokens/sec/chip")
     _serving(out, "Serving (single replica, Poisson load)",
              details.get("serving", {}))
+    _serving(out, "Serving, int8 paged KV + overcommit",
+             details.get("serving_paged_int8", {}))
     _serving(out, "Serving fleet (router over replicas)",
              details.get("serving_fleet", {}))
     _orchestration(out, details.get("orchestration", {}))
