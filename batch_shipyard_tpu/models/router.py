@@ -39,7 +39,8 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Optional, Sequence
 
-from batch_shipyard_tpu.models.server import JsonRequestHandler
+from batch_shipyard_tpu.models.server import (
+    JsonRequestHandler, prometheus_lines)
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -113,6 +114,8 @@ class ServingRouter:
                     self._reply(200 if healthy else 503,
                                 {"ok": healthy > 0,
                                  "healthy_replicas": healthy})
+                elif self.path == "/metrics":
+                    self._reply_metrics(router.prometheus_metrics())
                 elif self.path == "/v1/stats":
                     self._reply(200, router.stats())
                 elif self.path == "/v1/replicas":
@@ -543,6 +546,31 @@ class ServingRouter:
                 last = (503, {"error": "no replica reachable for "
                                        "cancel"})
         return last
+
+    def prometheus_metrics(self) -> list[str]:
+        """Fleet metrics in Prometheus exposition format: aggregate
+        gauges plus per-replica series labeled by replica URL — one
+        scrape target for the whole fleet."""
+        stats = self.stats()
+        lines = prometheus_lines("shipyard_router", {
+            "replicas": stats["replicas"],
+            "healthy_replicas": stats["healthy_replicas"],
+            "inflight": stats["router_inflight"],
+            "dispatched_total": stats["dispatched"],
+            "completed_total": stats["completed"],
+            "failed_total": stats["failed"],
+        })
+        for snap in stats["per_replica"]:
+            lines.extend(prometheus_lines(
+                "shipyard_router_replica", {
+                    "healthy": 1 if snap["healthy"] else 0,
+                    "inflight": snap["inflight"],
+                    "backlog": snap["backlog"],
+                    "dispatched_total": snap["dispatched"],
+                    "completed_total": snap["completed"],
+                    "failed_total": snap["failed"],
+                }, labels={"replica": snap["url"]}))
+        return lines
 
     def stats(self) -> dict:
         """Aggregate + per-replica: the fleet view of

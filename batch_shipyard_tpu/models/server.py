@@ -84,6 +84,45 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             return None
         return self.path[len(prefix):]
 
+    def _reply_metrics(self, lines: list[str]) -> None:
+        """Prometheus text exposition (the monitoring stack's scrape
+        format — docs/09-monitoring.md)."""
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _escape_label(value) -> str:
+    """Prometheus exposition label escaping (\\, \", newline) — one
+    odd replica URL must not invalidate the whole scrape."""
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
+def prometheus_lines(prefix: str, values: dict,
+                     labels: Optional[dict] = None) -> list[str]:
+    """Render {name: number} as Prometheus gauges with optional
+    labels; None values are skipped (absent metric, not zero).
+    Values render at full float64 precision — ':g' would quantize
+    counters past 1e6 and break rate()/increase()."""
+    label_str = ""
+    if labels:
+        inner = ",".join(
+            f'{k}="{_escape_label(v)}"'
+            for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    out = []
+    for name, value in values.items():
+        if value is None:
+            continue
+        out.append(f"{prefix}_{name}{label_str} "
+                   f"{float(value):.17g}")
+    return out
+
 
 class _Pending:
     __slots__ = ("request", "event", "submitted_at", "first_token_at",
@@ -165,6 +204,8 @@ class ServingFrontEnd:
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True})
+                elif self.path == "/metrics":
+                    self._reply_metrics(front.prometheus_metrics())
                 elif self.path == "/v1/stats":
                     self._reply(200, front.stats())
                 elif self.path.startswith("/v1/requests/"):
@@ -401,6 +442,27 @@ class ServingFrontEnd:
             raise RequestCancelled(pending.error)
         if pending.error is not None:
             raise ValueError(pending.error)
+
+    def prometheus_metrics(self) -> list[str]:
+        """Serving metrics in Prometheus exposition format — add this
+        front end (or the fleet router) as a scrape target of the
+        monitoring stack (docs/09-monitoring.md) to chart TTFT/TPOT
+        next to the node-exporter panels."""
+        stats = self.stats()
+        lines = prometheus_lines("shipyard_serving", {
+            "completed_requests_total": stats["completed_requests"],
+            "generated_tokens_total": stats["generated_tokens"],
+            "tokens_per_second": stats["tokens_per_second"],
+            "uptime_seconds": stats["uptime_seconds"],
+            "inflight": stats["inflight"],
+            "engine_backlog": stats["engine_backlog"],
+        })
+        for metric in ("ttft_ms", "tpot_ms"):
+            for pct, value in stats[metric].items():
+                lines.extend(prometheus_lines(
+                    "shipyard_serving", {metric: value},
+                    labels={"quantile": f"0.{pct}"}))
+        return lines
 
     def knows(self, request_id: str) -> bool:
         """Whether this front end currently owns the request (in

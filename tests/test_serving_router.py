@@ -308,3 +308,29 @@ def test_loadgen_through_router(fleet):
     assert report["generated_tokens"] > 0
     status, stats = _get(router.url, "/v1/stats")
     assert stats["completed"] >= 8
+
+def test_prometheus_metrics_endpoints(fleet):
+    """Front end and router expose Prometheus text metrics the
+    monitoring stack can scrape (docs/09-monitoring.md)."""
+    router, fronts = fleet
+    _post(router.url, {"prompt": [4, 2], "max_new_tokens": 3})
+
+    def scrape(url):
+        with urllib.request.urlopen(f"{url}/metrics",
+                                    timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            return resp.read().decode()
+
+    front_text = scrape(fronts[0].url)
+    assert "shipyard_serving_completed_requests_total" in front_text
+    assert 'shipyard_serving_ttft_ms{quantile="0.50"}' in front_text
+    router_text = scrape(router.url)
+    assert "shipyard_router_healthy_replicas 2" in router_text
+    assert "shipyard_router_dispatched_total 1" in router_text
+    assert ('shipyard_router_replica_healthy{replica="'
+            + fronts[0].url + '"} 1') in router_text
+    # Every line is NAME{labels} VALUE or NAME VALUE (parseable).
+    for line in router_text.strip().splitlines():
+        name, value = line.rsplit(" ", 1)
+        float(value)
