@@ -21,8 +21,7 @@ host-side Python, compute is two compiled functions (prefill, step).
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,6 @@ pod slice, not the single VM (substrate.recreate_slice).
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import time
 from typing import Optional
@@ -20,7 +19,7 @@ from batch_shipyard_tpu.config.settings import (
     GlobalSettings, PoolSettings)
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
-    EntityExistsError, NotFoundError, StateStore)
+    NotFoundError, StateStore)
 from batch_shipyard_tpu.substrate.base import ComputeSubstrate, NodeInfo
 from batch_shipyard_tpu.utils import util
 
