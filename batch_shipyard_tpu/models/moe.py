@@ -23,7 +23,7 @@ tokens per expert * mean gate prob per expert) * num_experts^2.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -200,7 +200,7 @@ class MoEMLP(nn.Module):
 
 
 def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
-                       capacity: int, outer_axis: str,
+                       capacity: int, outer_axis: Optional[str],
                        inner_axis: str, routing: str = "top1",
                        num_selected: int = 2,
                        dtype=jnp.bfloat16):
@@ -227,6 +227,10 @@ def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
     (outer_axis, inner_axis) mesh axes, which is exactly what
     in_specs=P((outer, inner), ...) hands each device.
 
+    outer_axis=None runs the SINGLE-AXIS case (experts sharded over
+    one mesh axis — the common single-slice ep layout): the exchange
+    degenerates to one plain all_to_all over inner_axis.
+
     Returns ([G_local, D] combined output, aux loss averaged over the
     ep group). Token routing/capacity is PER DEVICE GROUP (each
     device's G_local tokens route independently) — same semantics as
@@ -234,9 +238,19 @@ def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
     """
     from batch_shipyard_tpu.ops import collectives
 
-    n_out = jax.lax.psum(1, outer_axis)
+    n_out = 1 if outer_axis is None else jax.lax.psum(1, outer_axis)
     n_in = jax.lax.psum(1, inner_axis)
     n_ep = n_out * n_in
+
+    def exchange(x):
+        """Destination-indexed [n_out, n_in, ...] -> source-indexed
+        (an involution): hierarchical over (outer, inner), or one
+        plain all_to_all when there is no outer axis."""
+        if outer_axis is None:
+            return jax.lax.all_to_all(x, inner_axis, split_axis=1,
+                                      concat_axis=1)
+        return collectives.hierarchical_all_to_all(
+            x, outer_axis, inner_axis)
     e_local, d_model = w_gate.shape[0], w_gate.shape[1]
     num_experts = e_local * n_ep
 
@@ -257,7 +271,7 @@ def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
     x = expert_in.reshape(n_out, n_in, e_local, capacity, d_model)
     # ICI-then-DCN exchange: arrives source-indexed (a[o, i] = the
     # buffer device (o, i) sent to MY experts).
-    a = collectives.hierarchical_all_to_all(x, outer_axis, inner_axis)
+    a = exchange(x)
     # Batch all sources through the local expert shard.
     a = a.reshape(n_ep, e_local, capacity, d_model)
     a = a.transpose(1, 0, 2, 3).reshape(e_local, n_ep * capacity,
@@ -272,11 +286,12 @@ def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
     out = out.reshape(e_local, n_ep, capacity, d_model)
     out = out.transpose(1, 0, 2, 3).reshape(n_out, n_in, e_local,
                                             capacity, d_model)
-    r = collectives.hierarchical_all_to_all(out, outer_axis,
-                                            inner_axis)
+    r = exchange(out)
     r = r.reshape(num_experts, capacity, d_model)
     y = jnp.einsum("gec,ecd->gd", combine.astype(dtype), r)
-    aux = jax.lax.pmean(jax.lax.pmean(aux, inner_axis), outer_axis)
+    aux = jax.lax.pmean(aux, inner_axis)
+    if outer_axis is not None:
+        aux = jax.lax.pmean(aux, outer_axis)
     return y, aux.astype(jnp.float32)
 
 

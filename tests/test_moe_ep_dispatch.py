@@ -121,3 +121,33 @@ def test_hierarchical_ep_dispatch_differentiable():
         arr = np.asarray(g)
         assert np.all(np.isfinite(arr))
         assert np.abs(arr).sum() > 0
+
+def test_single_axis_ep_dispatch_matches_dense():
+    """outer_axis=None: the exchange degenerates to one all_to_all
+    over a single 8-way ep axis — same per-group outputs."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("ep",))
+    router, w_gate, w_up, w_down = _weights(seed=11)
+    rng = np.random.RandomState(13)
+    tokens = jnp.asarray(rng.randn(8 * G_LOCAL, D), jnp.float32)
+
+    def body(flat, router, wg, wu, wd):
+        return moe.moe_ep_apply_shard(
+            flat, router, wg, wu, wd, capacity=CAP,
+            outer_axis=None, inner_axis="ep", dtype=jnp.float32)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("ep", None), P(None, None), P("ep", None, None),
+                  P("ep", None, None), P("ep", None, None)),
+        out_specs=(P("ep", None), P()),
+        check_vma=False)
+    got, aux = jax.jit(fn)(tokens, router, w_gate, w_up, w_down)
+    outs, auxes = zip(*[
+        _dense_group(tokens[g * G_LOCAL:(g + 1) * G_LOCAL],
+                     router, w_gate, w_up, w_down, "top1")
+        for g in range(8)])
+    want = jnp.concatenate(outs, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(np.mean(auxes)),
+                               rtol=1e-5)
