@@ -22,8 +22,13 @@ def _describe(schema: dict) -> str:
     stype = schema.get("type", "any")
     parts = [stype]
     if "enum" in schema:
+        def lit(v):
+            # YAML literals, not Python reprs (True -> true); drop
+            # the scalar document-end marker safe_dump appends.
+            return yaml.safe_dump(
+                v, default_flow_style=True).strip().split("\n")[0]
         parts.append("one of: " + ", ".join(
-            f"`{v}`" for v in schema["enum"]))
+            f"`{lit(v)}`" for v in schema["enum"]))
     if "pattern" in schema:
         parts.append(f"pattern `{schema['pattern']}`")
     if "range" in schema:
@@ -77,6 +82,19 @@ def generate() -> str:
         out.write("| Key | Type / constraints |\n|---|---|\n")
         for path, desc in rows:
             out.write(f"| `{path.lstrip('.')}` | {desc} |\n")
+    # Hand-maintained nuance lives in docs/_config_notes.md and is
+    # appended verbatim: the tables above can regenerate without
+    # losing it, and a note about a key the schemas dropped sticks
+    # out instead of silently surviving inside a stale table row.
+    notes = (_SCHEMA_DIR.parent.parent.parent / "docs"
+             / "_config_notes.md")
+    if not notes.exists():
+        raise FileNotFoundError(
+            f"{notes}: the hand-maintained Key notes section is "
+            f"required — regenerating without it would silently drop "
+            f"documented caveats (incl. the registry-password "
+            f"plaintext warning)")
+    out.write("\n" + notes.read_text(encoding="utf-8"))
     return out.getvalue()
 
 

@@ -1174,12 +1174,36 @@ def fed_proxy(click_ctx, poll_interval):
     manage proxy VMs (ssh/suspend/start/status subcommands)."""
     if click_ctx.invoked_subcommand is not None:
         return
+    import logging as logging_mod
+    import time as time_mod
+
     from batch_shipyard_tpu.federation import federation as fed_mod
+    from batch_shipyard_tpu.utils import util as util_mod
     ctx = _ctx(click_ctx)
     opts = (ctx.configs.get("federation", {}).get("federation", {})
             .get("proxy_options", {}) or {})
+    # proxy_options.logging: honored, not just validated (reference
+    # federation.yaml logging block). The file handler reuses the
+    # framework's UTC format so fed-proxy.log correlates with stderr.
+    log_conf = opts.get("logging", {}) or {}
+    logger = logging_mod.getLogger("batch_shipyard_tpu")
+    if log_conf.get("level"):
+        logger.setLevel(log_conf["level"].upper())
+    if log_conf.get("persistence"):
+        handler = logging_mod.FileHandler("fed-proxy.log",
+                                          encoding="utf-8")
+        formatter = logging_mod.Formatter(
+            fmt=util_mod._LOGGER_FORMAT,
+            datefmt=util_mod._LOGGER_DATEFMT)
+        formatter.converter = time_mod.gmtime
+        handler.setFormatter(formatter)
+        logger.addHandler(handler)
     if poll_interval is None:
-        poll_interval = float(opts.get("polling_interval", 1.0))
+        # Schema shape is a map ({federations, actions} seconds —
+        # reference federation.yaml); the ACTIONS cadence drives the
+        # processor loop.
+        pi_conf = opts.get("polling_interval") or {}
+        poll_interval = float(pi_conf.get("actions", 1.0))
     sched = opts.get("scheduling", {}) or {}
     proc = fed_mod.FederationProcessor(
         ctx.store, poll_interval=poll_interval,

@@ -102,3 +102,17 @@ def test_credentials_schema():
 def test_all_schemas_parse():
     for ct in ConfigType:
         assert validator._load_schema(ct.value) is not None
+
+
+def test_federation_logging_block_placement():
+    """proxy_options.logging {level, persistence} validates; the old
+    misplaced polling_interval.level is rejected (strict unknown-key
+    rule) — the schema bug the round-5 docs sync uncovered."""
+    good = {"federation": {"proxy_options": {
+        "polling_interval": {"federations": 5, "actions": 1},
+        "logging": {"level": "debug", "persistence": True}}}}
+    validate_config(ConfigType.FEDERATION, good)
+    bad = {"federation": {"proxy_options": {
+        "polling_interval": {"actions": 1, "level": "debug"}}}}
+    with pytest.raises(ValidationError):
+        validate_config(ConfigType.FEDERATION, bad)
