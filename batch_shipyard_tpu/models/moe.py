@@ -295,6 +295,121 @@ def moe_ep_apply_shard(flat, router_kernel, w_gate, w_up, w_down,
     return y, aux.astype(jnp.float32)
 
 
+def moe_ep_stage(flat, router_kernel, w_gate, w_up, w_down,
+                 capacity: int, inner_axis: str,
+                 outer_axis: Optional[str] = None,
+                 routing: str = "top1", num_selected: int = 2,
+                 dtype=jnp.bfloat16):
+    """Expert-parallel MoE for a shard_map STAGE whose activations are
+    REPLICATED across the ep axis — the pipeline-parallel composition
+    (dp x pp x ep): pipeline stages shard over pp, activations stream
+    through replicated across ep, and this stage splits the tokens by
+    ep rank, runs the explicit dispatch (moe_ep_apply_shard) on the
+    local shard, and all_gathers the outputs back into the replicated
+    stream. Everything is unconditional collectives, so it is legal
+    inside the (non-interleaved) 1F1B tick like tp is.
+
+    CONTRACT: differentiate INSIDE the shard_map body (the pipeline
+    does — manual vjp per tick), where the cotangent arriving at the
+    region output is replicated-full by construction. Taking
+    jax.grad ACROSS the shard_map boundary instead hits shard_map's
+    replicated-output transpose (cotangent split across members) and
+    undercounts expert-shard grads.
+
+    The whole split->dispatch->gather region carries a custom VJP:
+    with replicated in/out cotangents, naive autodiff would overcount
+    the gather's transpose by the ep size and leave the replicated
+    router's (and the sliced input's) per-rank PARTIAL grads
+    un-summed. The backward here takes each rank's slice of the full
+    cotangent through the local pullback, then assembles dx from the
+    rank-disjoint scatters and psums the replicated router grad —
+    the Megatron f/g discipline applied to a replicated stream.
+
+    flat: [G, D] REPLICATED across ep (G divisible by the ep size).
+    Weights: local expert shards [E_local, ...] (ep-sharded specs).
+    Returns ([G, D] replicated, aux scalar).
+    """
+    axes = ([inner_axis] if outer_axis is None
+            else [inner_axis, outer_axis])
+
+    def _psum_all(v):
+        for ax in axes:
+            v = jax.lax.psum(v, ax)
+        return v
+
+    n_out = 1 if outer_axis is None else jax.lax.psum(1, outer_axis)
+    n_in = jax.lax.psum(1, inner_axis)
+    n_ep = n_out * n_in
+
+    def _my():
+        # axis_index is TRACED: recompute inside every custom_vjp
+        # stage (fwd and bwd trace separately under jax.grad; a
+        # closed-over tracer from one would leak into the other).
+        my_in = jax.lax.axis_index(inner_axis)
+        if outer_axis is None:
+            return my_in
+        return jax.lax.axis_index(outer_axis) * n_in + my_in
+
+    g_total, _d = flat.shape
+    if g_total % n_ep:
+        raise ValueError(
+            f"moe_ep_stage: {g_total} tokens not divisible by the "
+            f"ep size {n_ep}")
+    g_local = g_total // n_ep
+    flat_shape_dtype = jax.ShapeDtypeStruct(flat.shape, flat.dtype)
+
+    def local(mine, router, wg, wu, wd):
+        return moe_ep_apply_shard(
+            mine, router, wg, wu, wd, capacity=capacity,
+            outer_axis=outer_axis, inner_axis=inner_axis,
+            routing=routing, num_selected=num_selected, dtype=dtype)
+
+    def _gather(y_local):
+        y = jax.lax.all_gather(y_local, inner_axis, axis=0,
+                               tiled=True)
+        if outer_axis is not None:
+            y = jax.lax.all_gather(y, outer_axis, axis=0, tiled=True)
+        return y
+
+    @jax.custom_vjp
+    def region(flat, router, wg, wu, wd):
+        mine = jax.lax.dynamic_slice_in_dim(
+            flat, _my() * g_local, g_local, axis=0)
+        y_local, aux = local(mine, router, wg, wu, wd)
+        return _gather(y_local), aux
+
+    def region_fwd(flat, router, wg, wu, wd):
+        mine = jax.lax.dynamic_slice_in_dim(
+            flat, _my() * g_local, g_local, axis=0)
+        (y_local, aux), pullback = jax.vjp(local, mine, router, wg,
+                                           wu, wd)
+        return (_gather(y_local), aux), pullback
+
+    def region_bwd(pullback, cot):
+        dy, daux = cot
+        # Full (replicated) dy: every rank pulls ITS token slice back
+        # through the local region. daux is also replicated-full, but
+        # the pullback routes it through the pmean's psum transpose
+        # AND region_bwd psums the router partials below — divide by
+        # the ep size so the aux gradient is counted exactly once
+        # (empirically n_ep-times overcounted otherwise).
+        my = _my()
+        dy_local = jax.lax.dynamic_slice_in_dim(
+            dy, my * g_local, g_local, axis=0)
+        dmine, drouter, dwg, dwu, dwd = pullback(
+            (dy_local, daux / n_ep))
+        # Rank-disjoint scatters assemble the replicated dx; the
+        # replicated router grad is the sum of per-rank partials.
+        dflat = jnp.zeros(flat_shape_dtype.shape,
+                          flat_shape_dtype.dtype)
+        dflat = jax.lax.dynamic_update_slice_in_dim(
+            dflat, dmine.astype(dflat.dtype), my * g_local, axis=0)
+        return (_psum_all(dflat), _psum_all(drouter), dwg, dwu, dwd)
+
+    region.defvjp(region_fwd, region_bwd)
+    return region(flat, router_kernel, w_gate, w_up, w_down)
+
+
 def moe_param_specs():
     """PartitionSpec patterns for MoE params (merged into the
     transformer rules): experts over ep, expert-internal dims over
