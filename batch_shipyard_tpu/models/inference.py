@@ -119,9 +119,13 @@ def _rewind_cache(cache, steps):
     """Roll every layer's write index back by ``steps`` (scalar or
     [B]). Entries beyond the index are masked by _decode_attend and
     overwritten by the next insert, so the index IS the cache state —
-    rewinding it un-commits speculated tokens in O(1)."""
+    rewinding it un-commits speculated tokens in O(1). The paged
+    cache's per-slot write cursor is its "length" leaf; rewinding it
+    un-commits the same way (pages stay allocated, the next insert
+    overwrites)."""
     def fix(path, leaf):
-        if path and getattr(path[-1], "key", None) == "index":
+        if path and getattr(path[-1], "key", None) in ("index",
+                                                       "length"):
             return leaf - steps
         return leaf
     return jax.tree_util.tree_map_with_path(fix, cache)

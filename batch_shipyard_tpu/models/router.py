@@ -102,6 +102,16 @@ class ServingRouter:
         self._probe_timeout = probe_timeout
         self._request_timeout = request_timeout
         self._stop = threading.Event()
+        # One LONG-LIVED prober thread per replica (ADVICE r5): each
+        # keeps its own cadence, so a hung replica's probe (connect
+        # timeout, not refuse) cannot stretch fault detection for the
+        # rest of the fleet — and large fleets stop paying
+        # per-interval thread churn. The health thread itself only
+        # reconciles orphans.
+        self._prober_threads = [
+            threading.Thread(target=self._probe_loop, args=(r,),
+                             name=f"router-probe-{k}", daemon=True)
+            for k, r in enumerate(self._replicas)]
         self._health_thread = threading.Thread(
             target=self._health_loop, name="router-health",
             daemon=True)
@@ -183,6 +193,7 @@ class ServingRouter:
                     return
                 import http.client as http_client
                 upstream_ok = True
+                timed_out = False
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -205,7 +216,8 @@ class ServingRouter:
                             # dispatch(): a read timeout on a
                             # saturated replica is not a health
                             # event; a reset/hangup is.
-                            if not _is_timeout(exc):
+                            timed_out = _is_timeout(exc)
+                            if not timed_out:
                                 router._mark_unhealthy(replica, exc)
                             break
                         if not line:
@@ -235,8 +247,16 @@ class ServingRouter:
                         pass
                 finally:
                     upstream.close()
-                    router.finish(replica, request_id,
-                                  ok=upstream_ok)
+                    if timed_out:
+                        # The run may still be live on the (slow)
+                        # replica: keep ownership — duplicate gate +
+                        # sticky cancel stay correct — and let orphan
+                        # reconciliation release the id once the
+                        # replica forgets it (ADVICE r5).
+                        router._orphan_inflight(replica, request_id)
+                    else:
+                        router.finish(replica, request_id,
+                                      ok=upstream_ok)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -252,6 +272,8 @@ class ServingRouter:
 
     def start(self) -> "ServingRouter":
         self._probe_all()  # honest health before the first dispatch
+        for t in self._prober_threads:
+            t.start()
         self._health_thread.start()
         self._http_thread.start()
         return self
@@ -261,6 +283,10 @@ class ServingRouter:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._health_thread.join(timeout=5.0)
+        for t in self._prober_threads:
+            # Daemon probers may sit inside a probe_timeout read;
+            # don't block shutdown on them.
+            t.join(timeout=0.5)
 
     # ------------------------------ health -----------------------------
 
@@ -289,10 +315,9 @@ class ServingRouter:
             replica.last_probe_at = time.time()
 
     def _probe_all(self) -> None:
-        # Concurrent: one hung replica (connect timeout, not refuse)
-        # must not delay fault detection for the rest of the fleet —
-        # serial probing would turn a 2s health interval into
-        # O(replicas x probe_timeout) worst case.
+        # One-shot concurrent sweep for start(): honest health before
+        # the first dispatch. Steady-state probing runs in the
+        # long-lived per-replica _probe_loop threads.
         threads = [threading.Thread(target=self._probe, args=(r,),
                                     daemon=True)
                    for r in self._replicas]
@@ -301,9 +326,15 @@ class ServingRouter:
         for t in threads:
             t.join(self._probe_timeout * 2 + 1)
 
+    def _probe_loop(self, replica: _Replica) -> None:
+        """Per-replica steady-state prober: this replica's probe may
+        hang for probe_timeout without delaying any other replica's
+        cadence."""
+        while not self._stop.wait(self._health_interval):
+            self._probe(replica)
+
     def _health_loop(self) -> None:
         while not self._stop.wait(self._health_interval):
-            self._probe_all()
             self._reconcile_orphans()
 
     def healthy_count(self) -> int:
@@ -333,7 +364,13 @@ class ServingRouter:
             return best
 
     def finish(self, replica: _Replica, request_id: Optional[str],
-               ok: bool) -> None:
+               ok: bool, retrying: bool = False) -> None:
+        """Release one dispatch's accounting. ``retrying=True`` keeps
+        the duplicate-request claim alive by demoting the ownership
+        back to the reserved sentinel instead of popping it — the
+        caller is about to re-dispatch the same id to another replica,
+        and a concurrent same-id POST must NOT pass _claim() in that
+        window (ADVICE r5: the fleet would decode it twice)."""
         with self._lock:
             replica.inflight = max(0, replica.inflight - 1)
             if ok:
@@ -344,7 +381,22 @@ class ServingRouter:
             # retry may have remapped the id to another replica).
             if request_id is not None and \
                     self._owner.get(request_id) is replica:
-                self._owner.pop(request_id, None)
+                if retrying:
+                    self._owner[request_id] = None  # back to reserved
+                else:
+                    self._owner.pop(request_id, None)
+
+    def _orphan_inflight(self, replica: _Replica,
+                         request_id: Optional[str]) -> None:
+        """A dispatch (or mid-stream read) timed out while the run may
+        still be live on the replica: release the inflight slot but
+        KEEP ownership, handing the id to orphan reconciliation — the
+        duplicate gate and sticky cancel stay correct until the
+        replica demonstrably forgets the run."""
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+            replica.failed += 1
+        self._orphan(request_id, replica)
 
     def _claim(self, request_id: Optional[str]) -> None:
         """Router-level duplicate-id gate: the per-replica front end
@@ -468,14 +520,14 @@ class ServingRouter:
                     # correct) until reconciliation sees the replica
                     # forget the id; the load signal falls back to
                     # the scraped engine backlog.
-                    with self._lock:
-                        replica.inflight = max(
-                            0, replica.inflight - 1)
-                        replica.failed += 1
-                    self._orphan(request_id, replica)
+                    self._orphan_inflight(replica, request_id)
                     return 504, {"error": f"replica {replica.url} "
                                           f"timed out: {exc}"}
-                self.finish(replica, request_id, ok=False)
+                # retrying=True: the claim stays reserved through the
+                # retry loop so a concurrent duplicate POST is still
+                # rejected in the failover window.
+                self.finish(replica, request_id, ok=False,
+                            retrying=True)
                 self._mark_unhealthy(replica, exc)
                 # loop: try the next healthy replica
 
@@ -509,13 +561,10 @@ class ServingRouter:
             except (urllib.error.URLError, OSError,
                     TimeoutError) as exc:
                 if _is_timeout(exc):
-                    with self._lock:
-                        replica.inflight = max(
-                            0, replica.inflight - 1)
-                        replica.failed += 1
-                    self._orphan(request_id, replica)
+                    self._orphan_inflight(replica, request_id)
                     raise  # see dispatch(): slow is not dead
-                self.finish(replica, request_id, ok=False)
+                self.finish(replica, request_id, ok=False,
+                            retrying=True)
                 self._mark_unhealthy(replica, exc)
 
     def cancel(self, request_id: str) -> tuple[int, dict]:
@@ -592,6 +641,20 @@ class ServingRouter:
                 s.get("generated_tokens", 0) for s in stats.values()),
             "per_replica": snaps,
         }
+        # Fleet-wide speculative-decode acceptance (replicas running
+        # a draft model report per-engine counters in their stats).
+        proposed = sum(
+            s.get("speculative", {}).get("proposed", 0)
+            for s in stats.values())
+        accepted = sum(
+            s.get("speculative", {}).get("accepted", 0)
+            for s in stats.values())
+        if proposed:
+            agg["speculative"] = {
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": accepted / proposed,
+            }
         return agg
 
 

@@ -462,6 +462,14 @@ class ServingFrontEnd:
                 lines.extend(prometheus_lines(
                     "shipyard_serving", {metric: value},
                     labels={"quantile": f"0.{pct}"}))
+        spec = stats.get("speculative")
+        if spec:
+            lines.extend(prometheus_lines("shipyard_serving", {
+                "spec_rounds_total": spec["rounds"],
+                "spec_proposed_tokens_total": spec["proposed"],
+                "spec_accepted_tokens_total": spec["accepted"],
+                "spec_acceptance_rate": spec["acceptance_rate"],
+            }))
         return lines
 
     def knows(self, request_id: str) -> bool:
@@ -497,7 +505,7 @@ class ServingFrontEnd:
         tpots = [r["tpot_ms"] for r in done]
         with self._inflight_lock:
             inflight = len(self._inflight)
-        return {
+        out = {
             "completed_requests": len(done),
             "generated_tokens": tokens,
             "uptime_seconds": elapsed,
@@ -510,6 +518,14 @@ class ServingFrontEnd:
             "inflight": inflight,
             "engine_backlog": self.engine.pending(),
         }
+        # Speculative-decode counters when the engine runs a draft
+        # model (the measured acceptance rate is the tuning signal
+        # for gamma and draft sizing; the router aggregates these
+        # fleet-wide).
+        spec = self.engine.spec_stats()
+        if spec is not None:
+            out["speculative"] = spec
+        return out
 
     # --------------------------- engine thread -------------------------
 

@@ -21,6 +21,7 @@ host-side Python, compute is two compiled functions (prefill, step).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -29,6 +30,229 @@ import numpy as np
 
 from batch_shipyard_tpu.models import inference as inf
 from batch_shipyard_tpu.models import transformer as tfm
+
+
+@functools.partial(jax.jit, static_argnames=("model", "sampling"))
+def _decode_step(model, sampling, params, cache, tokens, positions,
+                 active, key):
+    """One token for every slot in one compiled call. MODULE-LEVEL
+    with the model/sampling static so identical engines — fleet
+    replicas sharing one param tree, or a test suite constructing
+    many same-config engines — share ONE compilation instead of
+    re-tracing per ContinuousBatcher instance."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        positions=positions[:, None], mutable=["cache"])
+    next_tok = inf._sample(logits[:, 0].astype(jnp.float32),
+                           key, sampling)
+    # Inactive slots DO write garbage into their cache rows,
+    # and that is fine: a freed row is never read (the
+    # per-slot mask excludes other rows) and _admit's prefill
+    # rewrites the whole row + index before reuse — restoring
+    # the full K/V trees here would double per-token HBM
+    # traffic for no observable effect. Only the cheap token/
+    # position bookkeeping needs masking.
+    next_tok = jnp.where(active, next_tok, tokens[:, 0])
+    positions = jnp.where(active, positions + 1, positions)
+    return (mutated["cache"], next_tok[:, None], positions,
+            next_tok)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "target_model", "draft_model", "gamma"))
+def _speculative_step(target_model, draft_model, gamma, t_params,
+                      d_params, t_cache, d_cache, tokens, positions,
+                      active):
+    """One ragged draft/verify round over the full slot batch.
+    tokens [B, 1] is each slot's pending token y (sampled but not yet
+    cached), positions [B] its absolute position — both caches hold
+    every committed token EXCEPT y (the speculative_generate
+    invariant, per slot).
+
+    Draft: gamma+1 batched single-token steps propose d_1..d_gamma
+    (the extra step only inserts d_gamma's K/V so the draft cache
+    keeps pace on full acceptance). Verify: ONE batched target
+    forward scores [y, d_1..d_gamma] through the multi-token
+    cache-insert path (per-slot write indices + 2-D RoPE positions
+    make the batch ragged-safe). Accept: each slot's longest
+    validated prefix a_i, commit d_1..d_{a_i} plus the target token
+    at a_i (correction or bonus), rewind both caches by gamma - a_i
+    per slot — the paged target rewinds its per-slot length the same
+    way. Inactive slots rewind the full gamma+1 so their indices
+    stay put. Module-level jit (statics as above) so same-shape
+    engines share the compilation."""
+    d_embed = d_params["embed"]["embedding"]
+    t_embed = t_params["embed"]["embedding"]
+
+    def draft_step(carry, _):
+        cache, tok, pos = carry
+        hidden, mut = draft_model.apply(
+            {"params": d_params, "cache": cache}, tok,
+            return_hidden=True, positions=pos[:, None],
+            mutable=["cache"])
+        logits = jnp.dot(
+            hidden[:, 0].astype(jnp.float32),
+            d_embed.astype(jnp.float32).T)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt[:, None], pos + 1), nxt
+
+    (d_cache, _, _), drafts = jax.lax.scan(
+        draft_step, (d_cache, tokens, positions), None,
+        length=gamma + 1)
+    d_tok = jnp.moveaxis(drafts, 0, 1)[:, :gamma]        # [B, g]
+    x_blk = jnp.concatenate([tokens, d_tok], axis=1)
+    pos_blk = positions[:, None] + jnp.arange(
+        gamma + 1, dtype=jnp.int32)[None, :]
+    hidden, mut = target_model.apply(
+        {"params": t_params, "cache": t_cache}, x_blk,
+        return_hidden=True, positions=pos_blk,
+        mutable=["cache"])
+    t_cache = mut["cache"]
+    logits = jnp.einsum(
+        "bsd,vd->bsv", hidden.astype(jnp.float32),
+        t_embed.astype(jnp.float32))
+    t_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, g+1]
+    match = (d_tok == t_tok[:, :gamma])
+    a_slot = jnp.sum(jnp.cumprod(
+        match.astype(jnp.int32), axis=1), axis=1)          # [B]
+    a_slot = jnp.where(active, a_slot, 0)
+    js = jnp.arange(gamma + 1, dtype=jnp.int32)
+    d_pad = jnp.concatenate(
+        [d_tok, jnp.zeros((d_tok.shape[0], 1), jnp.int32)], axis=1)
+    block = jnp.where(js[None, :] < a_slot[:, None], d_pad,
+                      t_tok)                               # [B, g+1]
+    rewind = jnp.where(active, gamma - a_slot, gamma + 1)
+    t_cache = inf._rewind_cache(t_cache, rewind)
+    d_cache = inf._rewind_cache(d_cache, rewind)
+    new_tok = jnp.take_along_axis(block, a_slot[:, None],
+                                  axis=1)                  # [B, 1]
+    new_tok = jnp.where(active[:, None], new_tok, tokens)
+    new_pos = jnp.where(active, positions + a_slot + 1, positions)
+    return t_cache, d_cache, new_tok, new_pos, block, a_slot
+
+
+def _dense_prefill(model, prefill_chunk, params, prompt, prompt_len):
+    """Batch-1 BATCHED prefill over the (bucket-padded) prompt
+    [1, L]: the multi-token insert path of transformer._decode_attend
+    writes all L cache rows and attends causally in MXU-batched
+    passes — prefill wall-clock is one forward (or ceil(L/chunk)
+    chunked forwards with prefill_chunk set, bounding the score
+    tensor at O(chunk * max_decode_len)), not L sequential
+    micro-steps. Compiles remain one per length bucket.
+
+    prompt_len is DYNAMIC (a traced int32): rows written past
+    prompt_len are garbage, but they are masked-on-read
+    (key_pos <= idx) and each is overwritten by the decode step that
+    first reaches its position, so only the length bookkeeping needs
+    the true value. This is what makes L bucketable: one compile per
+    BUCKET instead of one per distinct prompt length.
+
+    The last-token logits come from the final hidden state at
+    prompt_len-1 (return_hidden + a [d, vocab] matvec) so the full
+    [L, vocab] fp32 logits tensor never materializes."""
+    small = inf.init_cache(model, params, 1)
+    total = prompt.shape[1]
+    chunk = min(prefill_chunk or total, total)
+    hiddens = []
+    cache = small
+    for off in range(0, total, chunk):
+        seg = prompt[:, off:off + chunk]
+        # Positions are GLOBAL offsets: RoPE for chunk c must match
+        # the full-sequence pass exactly.
+        h, mut = model.apply(
+            {"params": params, "cache": cache}, seg,
+            return_hidden=True,
+            positions=jnp.arange(
+                off, off + seg.shape[1], dtype=jnp.int32),
+            mutable=["cache"])
+        cache = mut["cache"]
+        hiddens.append(h)
+    hidden = (hiddens[0] if len(hiddens) == 1
+              else jnp.concatenate(hiddens, axis=1))
+    last_h = jnp.take(hidden[0], prompt_len - 1, axis=0)     # [d]
+    embedding = params["embed"]["embedding"]
+    last = jnp.dot(embedding.astype(jnp.float32),
+                   last_h.astype(jnp.float32))               # [vocab]
+    return cache, last
+
+
+@functools.partial(jax.jit, static_argnames=("model",
+                                             "prefill_chunk"))
+def _prefill_dense(model, prefill_chunk, params, cache, slot, prompt,
+                   prompt_len):
+    """Fill ONE slot's cache region from a prompt [1, L] (batch-1
+    forward, scattered into the slot row), returning the last-token
+    logits for the first sample. The small cache's write index ran to
+    L (the padded length); the slot's index is corrected to the true
+    prompt_len. Module-level jit with a static model: same-config
+    engines (fleet replicas, draft/target pairs) share one compile
+    per length bucket."""
+    small, last = _dense_prefill(model, prefill_chunk, params, prompt,
+                                 prompt_len)
+
+    def scatter(big, sm, path_key):
+        if path_key == "index":
+            return big.at[slot].set(prompt_len)
+        return big.at[slot].set(sm[0])
+
+    cache = jax.tree_util.tree_map_with_path(
+        lambda kp, big, sm: scatter(
+            big, sm, kp[-1].key if hasattr(kp[-1], "key")
+            else str(kp[-1])),
+        cache, small)
+    return cache, last
+
+
+@functools.partial(jax.jit, static_argnames=("model", "prefill_chunk",
+                                             "page"))
+def _prefill_paged(model, prefill_chunk, page, params, cache, slot,
+                   prompt, table_row, prompt_len):
+    """Paged variant: dense batch-1 prefill, rows scattered
+    page-by-page into the slot's allocated pages; the slot's
+    block-table row and length are set in every layer's cache copy.
+    Full pages are written unconditionally: blocks past the
+    allocation point at the scratch page (which absorbs
+    padded-garbage writes), and partial-page garbage is
+    masked-on-read via the true length."""
+    small, last = _dense_prefill(model, prefill_chunk, params, prompt,
+                                 prompt_len)
+    # Bucket blocks, static (ceil: a bucket smaller than one page
+    # still needs its first page written; the small cache has
+    # max_decode_len >= n_blocks*page rows).
+    n_blocks = -(-prompt.shape[1] // page)
+
+    def scatter(big, sm):
+        if isinstance(big, dict) and "k_pages" in big:
+            kp, vp = big["k_pages"], big["v_pages"]
+            for b in range(n_blocks):
+                krows = sm["k"][0, b * page:(b + 1) * page]
+                vrows = sm["v"][0, b * page:(b + 1) * page]
+                kp = kp.at[table_row[b]].set(krows.astype(kp.dtype))
+                vp = vp.at[table_row[b]].set(vrows.astype(vp.dtype))
+            out = {
+                "k_pages": kp, "v_pages": vp,
+                "block_table":
+                    big["block_table"].at[slot].set(table_row),
+                "length":
+                    big["length"].at[slot].set(prompt_len),
+            }
+            if "k_page_scales" in big:
+                # int8 pool: the dense prefill cache is int8 too
+                # (same kv_cache_dtype), so its rows and scales route
+                # straight into the page pool.
+                ksc = big["k_page_scales"]
+                vsc = big["v_page_scales"]
+                for b in range(n_blocks):
+                    ksc = ksc.at[table_row[b]].set(
+                        sm["k_scale"][0, b * page:(b + 1) * page])
+                    vsc = vsc.at[table_row[b]].set(
+                        sm["v_scale"][0, b * page:(b + 1) * page])
+                out["k_page_scales"] = ksc
+                out["v_page_scales"] = vsc
+            return out
+        return {key: scatter(big[key], sm[key]) for key in big}
+
+    return scatter(cache, small), last
 
 
 @dataclasses.dataclass
@@ -42,6 +266,29 @@ class Request:
     # this orders the wait line, like job.priority orders task
     # queues.
     priority: int = 0
+
+
+@dataclasses.dataclass
+class SpeculativeConfig:
+    """Draft-model spec for ENGINE-INTEGRATED speculative decoding
+    (the Leviathan draft/verify loop lifted out of
+    models/inference.speculative_generate into the continuous batcher):
+    each engine step drafts ``gamma`` tokens per active slot with the
+    small draft model, verifies every slot's [y, d_1..d_gamma] block
+    in ONE batched target forward, then commits/rewinds PER SLOT —
+    slots advance 1..gamma+1 tokens per step, so all slot bookkeeping
+    is variable-stride. Greedy-exact: outputs equal the
+    non-speculative engine's for any draft quality (only throughput
+    changes) — bit-exact in fp32 (the equivalence the tests pin);
+    at reduced precision the usual multi-token caveat applies (the
+    verify forward scores gamma+1 positions in one block, so under
+    bf16 an argmax near-tie can resolve differently than single-step
+    decode — same as models/inference.speculative_generate, see
+    docs/15-serving.md). The draft always uses a dense KV cache
+    (O(1) index rewind); the target may be dense or paged."""
+    draft_config: tfm.TransformerConfig
+    draft_params: object
+    gamma: int = 4
 
 
 @dataclasses.dataclass
@@ -81,7 +328,8 @@ class ContinuousBatcher:
                  overcommit: bool = False,
                  prefill_chunk: Optional[int] = None,
                  on_token: Optional[
-                     Callable[[str, int, int], None]] = None):
+                     Callable[[str, int, int], None]] = None,
+                 speculative: Optional[SpeculativeConfig] = None):
         """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
         live in a shared kv_num_pages-page pool and slots hold block
         tables covering only their live tokens, so HBM is sized for
@@ -126,6 +374,31 @@ class ContinuousBatcher:
         # ends. Runs on the engine's stepping thread.
         self.on_token = on_token
         self.preemptions = 0
+        self.speculative = speculative
+        self.gamma = speculative.gamma if speculative else 0
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        if speculative is not None:
+            if speculative.gamma < 1:
+                raise ValueError(
+                    f"speculative gamma must be >= 1, got "
+                    f"{speculative.gamma}")
+            if sampling.temperature > 0:
+                raise ValueError(
+                    "speculative serving is greedy-exact (draft "
+                    "acceptance compares argmax chains); it requires "
+                    "temperature == 0 sampling")
+            if getattr(speculative.draft_config, "kv_page_size", None):
+                raise ValueError(
+                    "the draft model uses a dense KV cache (O(1) "
+                    "index rewind); clear kv_page_size on the draft "
+                    "config")
+            if speculative.draft_config.vocab_size != \
+                    config.vocab_size:
+                raise ValueError(
+                    "draft/target vocab_size must match (acceptance "
+                    "compares token ids)")
         if overcommit and not self.paged:
             raise ValueError("overcommit requires the paged KV cache "
                              "(kv_page_size)")
@@ -138,9 +411,14 @@ class ContinuousBatcher:
                     max_decode_len // kv_page_size)
             self.config = dataclasses.replace(
                 self.config, kv_page_size=kv_page_size,
-                kv_num_pages=kv_num_pages)
+                kv_num_pages=kv_num_pages, spec_window=self.gamma)
             self.page_size = kv_page_size
-            self.max_blocks = max_decode_len // kv_page_size
+            # spec_window widens the table so a speculative verify
+            # block starting near max_decode_len spills its tail
+            # writes onto scratch-backed entries instead of clamping
+            # onto a real page (transformer._decode_attend_paged).
+            self.max_blocks = (max_decode_len + self.gamma
+                               + kv_page_size - 1) // kv_page_size
             self._free_pages = list(range(kv_num_pages))
             # Reservation budget: admission reserves each request's
             # WORST-CASE page count up front (prompt + max_new_tokens)
@@ -180,160 +458,40 @@ class ContinuousBatcher:
         self._active = jnp.zeros((num_slots,), jnp.bool_)
         self._key = jax.random.PRNGKey(seed)
 
-        model = self.model
-        sampling_cfg = self.sampling
-
-        @jax.jit
-        def decode_step(params, cache, tokens, positions, active, key):
-            logits, mutated = model.apply(
-                {"params": params, "cache": cache}, tokens,
-                positions=positions[:, None], mutable=["cache"])
-            next_tok = inf._sample(logits[:, 0].astype(jnp.float32),
-                                   key, sampling_cfg)
-            # Inactive slots DO write garbage into their cache rows,
-            # and that is fine: a freed row is never read (the
-            # per-slot mask excludes other rows) and _admit's prefill
-            # rewrites the whole row + index before reuse — restoring
-            # the full K/V trees here would double per-token HBM
-            # traffic for no observable effect. Only the cheap token/
-            # position bookkeeping needs masking.
-            next_tok = jnp.where(active, next_tok, tokens[:, 0])
-            positions = jnp.where(active, positions + 1, positions)
-            return (mutated["cache"], next_tok[:, None], positions,
-                    next_tok)
-
-        self._decode_step = decode_step
+        self._decode_step = functools.partial(
+            _decode_step, self.model, self.sampling)
 
         # Prefill always runs on a DENSE batch-1 decode model sharing
         # the params; paged mode then scatters its rows into the
-        # slot's allocated pages.
+        # slot's allocated pages. The prefill fns are module-level
+        # static-model jits (the speculative path binds the same
+        # machinery to the DRAFT model, and same-config engines share
+        # compiles).
         dense_model = tfm.TransformerLM(
             inf.decode_config(config, max_decode_len))
         page = getattr(self, "page_size", 0)
+        self._prefill = functools.partial(
+            _prefill_dense, dense_model, self.prefill_chunk)
+        self._prefill_paged = functools.partial(
+            _prefill_paged, dense_model, self.prefill_chunk, page)
 
-        def dense_prefill(params, prompt, prompt_len):
-            """Batch-1 BATCHED prefill over the (bucket-padded) prompt
-            [1, L]: the multi-token insert path of
-            transformer._decode_attend writes all L cache rows and
-            attends causally in MXU-batched passes — prefill
-            wall-clock is one forward (or ceil(L/chunk) chunked
-            forwards with self.prefill_chunk set, bounding the score
-            tensor at O(chunk * max_decode_len)), not L sequential
-            micro-steps. Compiles remain one per length bucket.
-
-            prompt_len is DYNAMIC (a traced int32): rows written past
-            prompt_len are garbage, but they are masked-on-read
-            (key_pos <= idx) and each is overwritten by the decode
-            step that first reaches its position, so only the length
-            bookkeeping needs the true value. This is what makes L
-            bucketable: one compile per BUCKET instead of one per
-            distinct prompt length.
-
-            The last-token logits come from the final hidden state at
-            prompt_len-1 (return_hidden + a [d, vocab] matvec) so the
-            full [L, vocab] fp32 logits tensor never materializes."""
-            small = inf.init_cache(dense_model, params, 1)
-            total = prompt.shape[1]
-            chunk = min(self.prefill_chunk or total, total)
-            hiddens = []
-            cache = small
-            for off in range(0, total, chunk):
-                seg = prompt[:, off:off + chunk]
-                # Positions are GLOBAL offsets: RoPE for chunk c must
-                # match the full-sequence pass exactly.
-                h, mut = dense_model.apply(
-                    {"params": params, "cache": cache}, seg,
-                    return_hidden=True,
-                    positions=jnp.arange(
-                        off, off + seg.shape[1], dtype=jnp.int32),
-                    mutable=["cache"])
-                cache = mut["cache"]
-                hiddens.append(h)
-            hidden = (hiddens[0] if len(hiddens) == 1
-                      else jnp.concatenate(hiddens, axis=1))
-            last_h = jnp.take(hidden[0], prompt_len - 1,
-                              axis=0)                       # [d]
-            embedding = params["embed"]["embedding"]
-            last = jnp.dot(embedding.astype(jnp.float32),
-                           last_h.astype(jnp.float32))      # [vocab]
-            return cache, last
-
-        @jax.jit
-        def prefill(params, cache, slot, prompt, prompt_len):
-            """Fill ONE slot's cache region from a prompt [1, L]
-            (batch-1 forward, scattered into the slot row), returning
-            the last-token logits for the first sample. The small
-            cache's write index ran to L (the padded length); the
-            slot's index is corrected to the true prompt_len."""
-            small, last = dense_prefill(params, prompt, prompt_len)
-
-            def scatter(big, sm, path_key):
-                if path_key == "index":
-                    return big.at[slot].set(prompt_len)
-                return big.at[slot].set(sm[0])
-
-            cache = jax.tree_util.tree_map_with_path(
-                lambda kp, big, sm: scatter(
-                    big, sm, kp[-1].key if hasattr(kp[-1], "key")
-                    else str(kp[-1])),
-                cache, small)
-            return cache, last
-
-        @jax.jit
-        def prefill_paged(params, cache, slot, prompt, table_row,
-                          prompt_len):
-            """Paged variant: dense batch-1 prefill, rows scattered
-            page-by-page into the slot's allocated pages; the slot's
-            block-table row and length are set in every layer's cache
-            copy. Full pages are written unconditionally: blocks past
-            the allocation point at the scratch page (which absorbs
-            padded-garbage writes), and partial-page garbage is
-            masked-on-read via the true length."""
-            small, last = dense_prefill(params, prompt, prompt_len)
-            # Bucket blocks, static (ceil: a bucket smaller than one
-            # page still needs its first page written; the small
-            # cache has max_decode_len >= n_blocks*page rows).
-            n_blocks = -(-prompt.shape[1] // page)
-
-            def scatter(big, sm):
-                if isinstance(big, dict) and "k_pages" in big:
-                    kp, vp = big["k_pages"], big["v_pages"]
-                    for b in range(n_blocks):
-                        krows = sm["k"][0, b * page:(b + 1) * page]
-                        vrows = sm["v"][0, b * page:(b + 1) * page]
-                        kp = kp.at[table_row[b]].set(
-                            krows.astype(kp.dtype))
-                        vp = vp.at[table_row[b]].set(
-                            vrows.astype(vp.dtype))
-                    out = {
-                        "k_pages": kp, "v_pages": vp,
-                        "block_table":
-                            big["block_table"].at[slot].set(table_row),
-                        "length":
-                            big["length"].at[slot].set(prompt_len),
-                    }
-                    if "k_page_scales" in big:
-                        # int8 pool: the dense prefill cache is int8
-                        # too (same kv_cache_dtype), so its rows and
-                        # scales route straight into the page pool.
-                        ksc = big["k_page_scales"]
-                        vsc = big["v_page_scales"]
-                        for b in range(n_blocks):
-                            ksc = ksc.at[table_row[b]].set(
-                                sm["k_scale"][0,
-                                              b * page:(b + 1) * page])
-                            vsc = vsc.at[table_row[b]].set(
-                                sm["v_scale"][0,
-                                              b * page:(b + 1) * page])
-                        out["k_page_scales"] = ksc
-                        out["v_page_scales"] = vsc
-                    return out
-                return {key: scatter(big[key], sm[key]) for key in big}
-
-            return scatter(cache, small), last
-
-        self._prefill = prefill
-        self._prefill_paged = prefill_paged
+        if speculative is not None:
+            # Draft engine state: a dense cache with gamma+1 extra
+            # rows so a draft block starting at max_decode_len-2 never
+            # wraps (the target cache needs no extra rows — its
+            # out-of-bounds tail scatters drop, and every key a
+            # COMMITTED query reads is in bounds by construction).
+            draft_model = tfm.TransformerLM(inf.decode_config(
+                speculative.draft_config,
+                max_decode_len + self.gamma + 1))
+            self._draft_params = speculative.draft_params
+            self._draft_cache = inf.init_cache(
+                draft_model, speculative.draft_params, num_slots)
+            self._draft_prefill = functools.partial(
+                _prefill_dense, draft_model, self.prefill_chunk)
+            self._spec_step = functools.partial(
+                _speculative_step, self.model, draft_model,
+                self.gamma)
 
     # ------------------------------ public -----------------------------
 
@@ -383,8 +541,10 @@ class ContinuousBatcher:
         return False
 
     def step(self) -> list[tuple[str, list[int]]]:
-        """Admit queued requests into free slots, decode one token for
-        every active slot, emit finished requests."""
+        """Admit queued requests into free slots, decode for every
+        active slot — one token per step, or a gamma-token
+        draft/verify block per slot when speculative decoding is
+        configured — and emit finished requests."""
         self._admit()
         # Slots whose prefill-sampled first token already satisfied the
         # request (max_new_tokens == 1 or immediate eos) emit without a
@@ -401,6 +561,8 @@ class ContinuousBatcher:
                 self._free_slot(i)
         if not any(s.request is not None for s in self._slots):
             return emitted
+        if self.speculative is not None:
+            return emitted + self._step_speculative()
         if self.paged:
             self._grow_pages()
         self._key, step_key = jax.random.split(self._key)
@@ -424,6 +586,67 @@ class ContinuousBatcher:
                 self._free_slot(i)
         return emitted
 
+    def _step_speculative(self) -> list[tuple[str, list[int]]]:
+        """One ragged draft/verify/commit round (see the spec_step
+        docstring for the compute): slots advance by different amounts
+        per step, so the host bookkeeping below is variable-stride —
+        each slot appends its own 1..gamma+1 committed tokens, with
+        per-token eos/max_new checks so a slot can stop mid-block."""
+        if self.paged:
+            self._grow_pages(span=self.gamma)
+        (self.cache, self._draft_cache, self._tokens, self._positions,
+         block, a_slot) = self._spec_step(
+            self.params, self._draft_params, self.cache,
+            self._draft_cache, self._tokens, self._positions,
+            self._active)
+        block_host = np.asarray(block)
+        a_host = np.asarray(a_slot)
+        emitted: list[tuple[str, list[int]]] = []
+        n_active = 0
+        for i, slot in enumerate(self._slots):
+            req = slot.request
+            if req is None:
+                continue
+            n_active += 1
+            accepted = int(a_host[i])
+            self.spec_accepted += accepted
+            for j in range(accepted + 1):
+                token = int(block_host[i, j])
+                slot.generated.append(token)
+                if self.on_token is not None:
+                    self.on_token(req.request_id, token,
+                                  len(slot.generated) - 1)
+                if (len(slot.generated) >= req.max_new_tokens or
+                        (req.eos_id is not None and
+                         token == req.eos_id)):
+                    # Stopped mid-block: the remaining committed
+                    # tokens are discarded (their cache rows recycle
+                    # with the slot).
+                    emitted.append((req.request_id,
+                                    list(slot.generated)))
+                    self._free_slot(i)
+                    break
+        self.spec_rounds += 1
+        self.spec_proposed += self.gamma * n_active
+        return emitted
+
+    def spec_stats(self) -> Optional[dict]:
+        """Speculative-decode counters, or None when no draft model
+        is configured. acceptance_rate = accepted/proposed is the
+        measured draft quality; tokens-per-target-forward is
+        1 + acceptance_rate * gamma."""
+        if self.speculative is None:
+            return None
+        return {
+            "gamma": self.gamma,
+            "rounds": self.spec_rounds,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "acceptance_rate": (
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0),
+        }
+
     def _free_slot(self, i: int) -> None:
         self._slots[i] = _Slot()
         self._active = self._active.at[i].set(False)
@@ -438,36 +661,43 @@ class ContinuousBatcher:
             self._table[i] = self._scratch_page
             self._push_tables()
 
-    def _grow_pages(self) -> None:
-        """Allocate a fresh page for any active slot whose NEXT write
-        starts a new block, and push the updated tables into every
-        layer's cache copy. In overcommit mode an empty free list
-        preempts a victim instead of raising."""
+    def _grow_pages(self, span: int = 0) -> None:
+        """Allocate pages so every active slot's table covers its next
+        write positions pos..min(pos+span, total-1) — span=0 is the
+        plain one-token decode step (at most one new block per slot);
+        span=gamma is the speculative verify block, which can cross
+        several page boundaries in one step. Allocation is capped at
+        the slot's worst-case commit range (speculative tail writes
+        past it land on the scratch page via the table default), so it
+        never exceeds the admission reservation. Pushes the updated
+        tables into every layer's cache copy. In overcommit mode an
+        empty free list preempts a victim instead of raising."""
         positions = np.asarray(self._positions)
         active = np.asarray(self._active).copy()
         changed = False
         for i in range(self.num_slots):
             if not active[i] or self._slots[i].request is None:
                 continue
+            req = self._slots[i].request
+            total = len(req.prompt) + req.max_new_tokens
             pos = int(positions[i])
-            if pos % self.page_size != 0:
-                continue
-            block = pos // self.page_size
-            if block < len(self._slot_pages[i]):
-                continue  # prefill already covers this block
-            while not self._free_pages:
-                if not self.overcommit:
-                    raise RuntimeError(
-                        "paged KV pool exhausted mid-decode; size "
-                        "kv_num_pages >= num_slots * max_decode_len /"
-                        " page_size to rule this out, or enable "
-                        "overcommit=True for preemption")
-                victim = self._preempt(exclude=i)
-                active[victim] = False
-            pagenum = self._free_pages.pop()
-            self._slot_pages[i].append(pagenum)
-            self._table[i, block] = pagenum
-            changed = True
+            needed = min(pos + span, total - 1) // self.page_size + 1
+            while len(self._slot_pages[i]) < needed:
+                block = len(self._slot_pages[i])
+                while not self._free_pages:
+                    if not self.overcommit:
+                        raise RuntimeError(
+                            "paged KV pool exhausted mid-decode; size "
+                            "kv_num_pages >= num_slots * "
+                            "max_decode_len / page_size to rule this "
+                            "out, or enable overcommit=True for "
+                            "preemption")
+                    victim = self._preempt(exclude=i)
+                    active[victim] = False
+                pagenum = self._free_pages.pop()
+                self._slot_pages[i].append(pagenum)
+                self._table[i, block] = pagenum
+                changed = True
         if changed:
             self._push_tables()
 
@@ -588,6 +818,14 @@ class ContinuousBatcher:
                 self._queue.pop(0)
                 self.cache, last_logits = self._prefill(
                     self.params, self.cache, i, prompt, len(tokens))
+            if self.speculative is not None:
+                # The draft cache must hold the same committed prefix
+                # (the spec-step invariant); its prefill logits are
+                # discarded — the first token is always sampled from
+                # the TARGET's prefill.
+                self._draft_cache, _ = self._draft_prefill(
+                    self._draft_params, self._draft_cache, i, prompt,
+                    len(tokens))
             self._key, sample_key = jax.random.split(self._key)
             first = inf._sample(
                 last_logits[None].astype(jnp.float32), sample_key,

@@ -81,6 +81,15 @@ class TransformerConfig:
     # max_decode_len rows each. None = dense cache.
     kv_page_size: Optional[int] = None
     kv_num_pages: int = 0
+    # Speculative-decode write margin for the PAGED cache: widens each
+    # slot's block table by ceil(spec_window/page) entries so a
+    # draft/verify block starting near max_decode_len can spill its
+    # (never-committed) tail writes past the logical length without
+    # the table gather clamping onto a REAL page of the same slot.
+    # The extra entries default to the allocator's scratch page, which
+    # absorbs the garbage. Set by the serving engine to gamma; the
+    # dense cache needs no margin (out-of-bounds scatters drop).
+    spec_window: int = 0
     # Paged decode attention implementation: 'kernel' (Pallas, reads
     # only live pages via scalar-prefetched block tables), 'xla'
     # (gather over the full table width), or None = kernel on TPU and
@@ -355,9 +364,21 @@ class Attention(nn.Module):
         int8_kv = cfg.kv_cache_dtype == "int8"  # validated at dispatch
         store_dtype = jnp.int8 if int8_kv else cfg.dtype
         batch, seq, heads, depth = q.shape
-        assert seq == 1, "decode mode consumes one token per call"
         page = cfg.kv_page_size
-        max_blocks = (cfg.max_decode_len + page - 1) // page
+        if seq > cfg.spec_window + 1:
+            # Without table margin, a multi-token insert starting
+            # within seq of max_decode_len would CLAMP its tail
+            # gather onto the slot's last real page — silent cache
+            # corruption. The serving engine sizes spec_window=gamma
+            # for its gamma+1-token verify blocks; fail fast for any
+            # other caller.
+            raise ValueError(
+                f"paged decode insert of {seq} tokens needs "
+                f"spec_window >= {seq - 1} (got {cfg.spec_window}) "
+                f"so tail writes spill onto scratch-backed table "
+                f"entries instead of live pages")
+        max_blocks = (cfg.max_decode_len + cfg.spec_window
+                      + page - 1) // page
         k_pages = self.variable(
             "cache", "k_pages", jnp.zeros,
             (cfg.kv_num_pages, page, heads, depth), store_dtype)
@@ -377,11 +398,17 @@ class Attention(nn.Module):
         length = self.variable(
             "cache", "length", lambda: jnp.zeros((batch,), jnp.int32))
         idx = length.value                       # [B]
-        rows = jnp.arange(batch)
+        # Absolute write positions per token, routed through the
+        # slot's block table (seq > 1 is the speculative verify
+        # block: y + gamma drafts insert at consecutive positions;
+        # table entries past the slot's allocation point at the
+        # engine's scratch page, which absorbs never-committed tail
+        # writes — spec_window guarantees cols//page < max_blocks).
+        cols = idx[:, None] + jnp.arange(seq)[None, :]        # [B, S]
         page_idx = jnp.take_along_axis(
-            block_table.value, (idx // page)[:, None], axis=1)[:, 0]
-        offset = idx % page
-        k_in, v_in = k[:, 0], v[:, 0]
+            block_table.value, cols // page, axis=1)          # [B, S]
+        offset = cols % page
+        k_in, v_in = k, v
         if int8_kv:
             from batch_shipyard_tpu.ops.quantization import (
                 quantize_int8_rows)
@@ -393,13 +420,48 @@ class Attention(nn.Module):
             k_in.astype(store_dtype))
         v_pages.value = v_pages.value.at[page_idx, offset].set(
             v_in.astype(store_dtype))
-        length.value = idx + 1
-        return paged_ops.paged_decode_attention(
-            q, k_pages.value, v_pages.value, block_table.value,
-            length.value, impl=cfg.paged_attention_impl,
-            k_scales=scale_k.value if int8_kv else None,
-            v_scales=scale_v.value if int8_kv else None).astype(
-                cfg.dtype)
+        length.value = idx + seq
+        if seq == 1:
+            return paged_ops.paged_decode_attention(
+                q, k_pages.value, v_pages.value, block_table.value,
+                length.value, impl=cfg.paged_attention_impl,
+                k_scales=scale_k.value if int8_kv else None,
+                v_scales=scale_v.value if int8_kv else None).astype(
+                    cfg.dtype)
+        # Multi-token verify pass: gather the slot's full logical view
+        # and attend causally over absolute cache positions (query s
+        # at position idx+s sees keys <= idx+s) — the paged analog of
+        # the dense multi-token insert path above. Every key a
+        # COMMITTED query can see is either prior committed state or
+        # freshly written this block, so scratch-page garbage only
+        # ever feeds draft positions whose logits get discarded.
+        k_all = k_pages.value[block_table.value].reshape(
+            batch, max_blocks * page, heads, depth)
+        v_all = v_pages.value[block_table.value].reshape(
+            batch, max_blocks * page, heads, depth)
+        if int8_kv:
+            ks_all = scale_k.value[block_table.value].reshape(
+                batch, max_blocks * page, heads)
+            vs_all = scale_v.value[block_table.value].reshape(
+                batch, max_blocks * page, heads)
+            k_all = (k_all.astype(jnp.float32) *
+                     ks_all[..., None]).astype(cfg.dtype)
+            v_all = (v_all.astype(jnp.float32) *
+                     vs_all[..., None]).astype(cfg.dtype)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_all,
+            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(depth))
+        key_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (max_blocks * page, 1), 0)[:, 0]
+        mask = (key_pos[None, None, :] <=
+                cols[:, :, None])[:, None, :, :]      # [B, 1, S, T]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_all,
+            preferred_element_type=jnp.float32)
+        return out.astype(cfg.dtype)
 
 
 

@@ -80,12 +80,35 @@ def build_params(args, config: tfm.TransformerConfig):
     return params
 
 
-def build_engine(args, config=None,
-                 params=None) -> serving.ContinuousBatcher:
+def build_draft(args) -> serving.SpeculativeConfig:
+    """Draft model spec for --speculative: a small dense-cache
+    transformer sharing the target's vocab (random init unless
+    --draft-checkpoint-dir points at trained draft weights — a random
+    draft exercises the worst case: near-zero acceptance, every round
+    falls back to the target's correction token)."""
+    draft_config = tfm.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.draft_d_model,
+        n_layers=args.draft_n_layers, n_heads=args.n_heads,
+        d_head=args.draft_d_model // args.n_heads,
+        d_ff=args.draft_d_ff or args.draft_d_model * 3,
+        max_seq_len=args.max_decode_len, dtype=jnp.bfloat16,
+        kv_cache_dtype=args.kv_cache_dtype)
+    draft_args = argparse.Namespace(**vars(args))
+    draft_args.seed = args.seed + 7
+    draft_args.checkpoint_dir = args.draft_checkpoint_dir
+    draft_params = build_params(draft_args, draft_config)
+    return serving.SpeculativeConfig(draft_config, draft_params,
+                                     gamma=args.gamma)
+
+
+def build_engine(args, config=None, params=None,
+                 speculative=None) -> serving.ContinuousBatcher:
     if config is None:
         config = build_config(args)
     if params is None:
         params = build_params(args, config)
+    if speculative is None and args.speculative:
+        speculative = build_draft(args)
     return serving.ContinuousBatcher(
         config, params, num_slots=args.num_slots,
         max_decode_len=args.max_decode_len,
@@ -95,7 +118,8 @@ def build_engine(args, config=None,
         kv_page_size=args.kv_page_size,
         kv_num_pages=args.kv_num_pages,
         overcommit=args.overcommit,
-        prefill_chunk=args.prefill_chunk)
+        prefill_chunk=args.prefill_chunk,
+        speculative=speculative)
 
 
 def main() -> int:
@@ -121,6 +145,26 @@ def main() -> int:
                         help="Chunked prefill segment length (bounds "
                         "long-prompt prefill memory; power of two)")
     parser.add_argument("--overcommit", action="store_true")
+    # Speculative decoding inside the engine: a small draft model
+    # proposes gamma tokens per slot per step; ONE batched target
+    # forward verifies every slot's block; commits are per-slot
+    # ragged. Greedy-exact — requires --temperature 0.
+    parser.add_argument("--speculative", action="store_true",
+                        help="Enable engine-integrated speculative "
+                        "decoding (draft/verify per engine step; "
+                        "greedy-exact)")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="Draft tokens proposed per slot per "
+                        "engine step")
+    parser.add_argument("--draft-d-model", type=int, default=256)
+    parser.add_argument("--draft-n-layers", type=int, default=2)
+    parser.add_argument("--draft-d-ff", type=int, default=None,
+                        help="Draft MLP width (default 3x "
+                        "draft-d-model)")
+    parser.add_argument("--draft-checkpoint-dir", default=None,
+                        help="Serve draft params from an Orbax "
+                        "checkpoint (random init otherwise — the "
+                        "worst-case acceptance demo)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8900)
     # Benchmark mode
@@ -151,7 +195,10 @@ def main() -> int:
         from batch_shipyard_tpu.models.router import ServingRouter
         config = build_config(args)
         params = build_params(args, config)
-        engines = [build_engine(args, config, params)
+        # Like the target params, the draft tree is built once and
+        # shared across every replica engine.
+        speculative = build_draft(args) if args.speculative else None
+        engines = [build_engine(args, config, params, speculative)
                    for _ in range(args.replicas)]
         fronts = [ServingFrontEnd(e, port=0).start()
                   for e in engines]
@@ -194,6 +241,17 @@ def main() -> int:
         vocab_size=args.vocab, seed=args.seed)
     if router is not None:
         report["router"] = router.stats()
+    if args.speculative:
+        spec = [f.engine.spec_stats() for f in fronts]
+        proposed = sum(s["proposed"] for s in spec)
+        accepted = sum(s["accepted"] for s in spec)
+        report["speculative"] = {
+            "gamma": args.gamma,
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": (accepted / proposed
+                                if proposed else 0.0),
+        }
     _shutdown()
     with open(args.report, "w", encoding="utf-8") as fh:
         json.dump(report, fh, indent=2)

@@ -210,6 +210,72 @@ def bench_serving(num_requests: int = 48, rate_hz: float = 16.0,
     return report
 
 
+def bench_serving_speculative(num_requests: int = 32,
+                              rate_hz: float = 16.0,
+                              num_slots: int = 8,
+                              max_decode_len: int = 512,
+                              d_model: int = 1024, n_layers: int = 12,
+                              n_heads: int = 16, d_ff: int = 2816,
+                              draft_d_model: int = 256,
+                              draft_n_layers: int = 2,
+                              gamma: int = 4,
+                              kv_page_size=None,
+                              vocab_size: int = 32000) -> dict:
+    """Speculative serving phase: the continuous-batching engine with
+    a draft model drafting gamma tokens per slot per step and ONE
+    batched target verify — measured through the same HTTP front end
+    + Poisson loadgen as bench_serving, plus the engine's measured
+    acceptance rate. The draft is random-init (no trained draft in
+    the bench container), so acceptance is the worst case — the
+    number to watch on silicon is tokens/s at a REAL draft's
+    acceptance, which this phase measures once a draft checkpoint is
+    wired in; TTFT/TPOT and acceptance-rate accounting are real
+    either way. kv_page_size switches the target to the paged pool
+    (the speculative verify block crosses page boundaries)."""
+    import jax
+    import jax.numpy as jnp
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import serving
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.models.loadgen import run_load
+    from batch_shipyard_tpu.models.server import ServingFrontEnd
+    config = tfm.TransformerConfig(
+        vocab_size=vocab_size, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, d_head=d_model // n_heads, d_ff=d_ff,
+        max_seq_len=max_decode_len, dtype=jnp.bfloat16)
+    model = tfm.TransformerLM(config)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft_config = tfm.TransformerConfig(
+        vocab_size=vocab_size, d_model=draft_d_model,
+        n_layers=draft_n_layers, n_heads=n_heads,
+        d_head=draft_d_model // n_heads, d_ff=draft_d_model * 3,
+        max_seq_len=max_decode_len, dtype=jnp.bfloat16)
+    draft_params = tfm.TransformerLM(draft_config).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = serving.ContinuousBatcher(
+        config, params, num_slots=num_slots,
+        max_decode_len=max_decode_len,
+        kv_page_size=kv_page_size,
+        sampling=inf.SamplingConfig(),
+        speculative=serving.SpeculativeConfig(
+            draft_config, draft_params, gamma=gamma))
+    front = ServingFrontEnd(engine, port=0).start()
+    try:
+        front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
+        quarter = max(8, max_decode_len // 4)
+        report = run_load(
+            front.url, num_requests, rate_hz=rate_hz,
+            prompt_len=(quarter // 2, quarter),
+            max_new_tokens=(quarter // 2, quarter),
+            vocab_size=vocab_size, seed=0)
+        report["speculative"] = engine.spec_stats()
+        report["kv_page_size"] = kv_page_size
+    finally:
+        front.shutdown()
+    return report
+
+
 def bench_serving_fleet(num_replicas: int = 2,
                         num_requests: int = 64,
                         rate_hz: float = 24.0,
@@ -417,7 +483,9 @@ def main(argv: list[str] | None = None) -> int:
         "--workloads", default="resnet,transformer,serving,"
         "orchestration",
         help="comma-separated subset to run (resnet, transformer, "
-        "serving, orchestration)")
+        "serving, serving_speculative, orchestration; "
+        "serving_speculative is opt-in — the silicon-proof pipeline "
+        "runs it as its own phase)")
     parser.add_argument(
         "--quick", action="store_true",
         help="fewer timed iterations (tuning A/B mode)")
@@ -529,6 +597,23 @@ def main(argv: list[str] | None = None) -> int:
             details["serving_fleet"] = bench_serving_fleet()
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["serving_fleet"] = {"error": str(exc)}
+    if "serving_speculative" in workloads:
+        # Dense and paged variants: tokens/s, TTFT/TPOT, and the
+        # measured acceptance rate. Opt-in ONLY (not implied by
+        # "serving"): tools/silicon_proof.py runs it as its own
+        # serving_speculative phase, so the full final_bench doesn't
+        # pay these heavy benches a second time.
+        try:
+            details["serving_speculative"] = (
+                bench_serving_speculative())
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["serving_speculative"] = {"error": str(exc)}
+        try:
+            details["serving_speculative_paged"] = (
+                bench_serving_speculative(kv_page_size=64))
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["serving_speculative_paged"] = {
+                "error": str(exc)}
     if "orchestration" in workloads:
         try:
             details["orchestration"] = bench_orchestration_latency()

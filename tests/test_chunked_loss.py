@@ -157,8 +157,18 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     assert report["dry_run"] is True
     names = [p["phase"] for p in report["phases"]]
     assert names == ["probe", "kernel_checks", "flash_flip",
-                     "tuning_ab", "final_bench"]
+                     "tuning_ab", "final_bench",
+                     "serving_speculative"]
     assert all(p["status"] == "dry_run" for p in report["phases"])
+    # The speculative serving phase's skeleton names every metric it
+    # will emit, for both KV layouts.
+    spec = report["phases"][5]
+    assert "bench.py" in spec["command"]
+    assert "serving_speculative" in spec["command"]
+    for variant in ("dense", "paged"):
+        assert set(spec["metrics"][variant]) == {
+            "tokens_per_second", "ttft_ms_p50", "tpot_ms_p50",
+            "acceptance_rate"}
     # The tuning plan must cover every profile with a runnable command.
     plan = report["phases"][3]["plan"]
     from batch_shipyard_tpu.parallel.tuning import PROFILES

@@ -180,12 +180,21 @@ def test_paged_submit_rejects_unadmittable(params):
                                       max_new_tokens=12))
 
 
-def test_prefill_buckets_bound_compiles(params):
+def test_prefill_buckets_bound_compiles():
     """Prompts of different lengths inside one power-of-two bucket
     share a single prefill compilation; a longer prompt crossing into
-    the next bucket adds exactly one more."""
-    eng = serving.ContinuousBatcher(CFG, params, num_slots=4,
+    the next bucket adds exactly one more. The prefill jit is
+    module-level (same-config engines share compiles), so measure
+    CACHE-SIZE DELTAS with a config unique to this test."""
+    ucfg = tfm.TransformerConfig(
+        vocab_size=101, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    uparams = tfm.TransformerLM(ucfg).init(
+        jax.random.PRNGKey(5), jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = serving.ContinuousBatcher(ucfg, uparams, num_slots=4,
                                     max_decode_len=64)
+    base = serving._prefill_dense._cache_size()
     for rid, n in (("a", 3), ("b", 5), ("c", 11)):   # bucket 16
         eng.submit(serving.Request(rid, [7] * n, max_new_tokens=2))
     done = []
@@ -194,14 +203,14 @@ def test_prefill_buckets_bound_compiles(params):
         if len(done) == 3:
             break
     assert len(done) == 3
-    assert eng._prefill._cache_size() == 1
+    assert serving._prefill_dense._cache_size() == base + 1
     eng.submit(serving.Request("d", [7] * 20, max_new_tokens=2))
     for _ in range(30):
         done += eng.step()
         if len(done) == 4:
             break
     assert len(done) == 4
-    assert eng._prefill._cache_size() == 2
+    assert serving._prefill_dense._cache_size() == base + 2
 
 
 def test_paged_prefill_bucket_shorter_than_page(params):

@@ -128,6 +128,15 @@ def _serving(out: list[str], name: str, data: dict) -> None:
                    f"{router.get('completed')} / failed "
                    f"{router.get('failed')} (queue-depth-aware "
                    f"router).")
+    spec = data.get("speculative")
+    if spec:
+        rate = spec.get("acceptance_rate")
+        out.append(f"Speculative decoding: gamma={spec.get('gamma')}, "
+                   f"{spec.get('accepted')}/{spec.get('proposed')} "
+                   f"drafts accepted "
+                   f"({_fmt(None if rate is None else 100 * rate)}% "
+                   f"acceptance; tokens per target forward = "
+                   f"1 + rate x gamma).")
     out.append("")
 
 
@@ -195,6 +204,14 @@ def render() -> str:
                "does so after every successful bench).\n")
     _round_history(out)
     details = _load(ARTIFACTS / "BENCH_DETAILS.json") or {}
+    # The speculative serving benches run as their OWN silicon-proof
+    # phase (bench.py --workloads serving_speculative) with a
+    # separate details file; merge them in unless a direct bench run
+    # already recorded them.
+    spec_details = _load(ARTIFACTS / "SPEC_SERVING_DETAILS.json") or {}
+    for key in ("serving_speculative", "serving_speculative_paged"):
+        if key not in details and key in spec_details:
+            details[key] = spec_details[key]
     out.append("## Latest detailed run\n")
     if details.get("error"):
         out.append(f"**Status**: `{details['error']}`\n")
@@ -222,6 +239,10 @@ def render() -> str:
              details.get("serving_paged_int8", {}))
     _serving(out, "Serving fleet (router over replicas)",
              details.get("serving_fleet", {}))
+    _serving(out, "Serving, speculative decoding (dense KV)",
+             details.get("serving_speculative", {}))
+    _serving(out, "Serving, speculative decoding (paged KV)",
+             details.get("serving_speculative_paged", {}))
     _orchestration(out, details.get("orchestration", {}))
     _silicon_proof(out)
     return "\n".join(out).rstrip() + "\n"

@@ -26,6 +26,9 @@ invokes this script on the first successful probe; it:
                       one-line JSON lands in BENCH_LATEST.json and
                       BENCH_DETAILS.json carries explicit per-workload
                       MFU%% (parallel/mfu.py).
+  6. serving_speculative — speculative continuous-batching serving
+                      (dense + paged KV): tokens/s, TTFT/TPOT, and
+                      the measured draft acceptance rate per variant.
 
 Every phase's outcome is recorded in SILICON_PROOF.json; --dry-run
 writes the complete report skeleton on CPU (each phase records the
@@ -258,6 +261,50 @@ class Pipeline:
         _run([sys.executable, "tools/benchgen.py",
               "--artifacts-dir", str(self.out)], 120)
 
+    def serving_speculative(self) -> None:
+        """Speculative continuous-batching serving (dense + paged KV):
+        per-variant tokens/s, TTFT/TPOT p50, and the engine's measured
+        acceptance rate, via bench.py's serving_speculative workload
+        (models/serving.py draft/verify engine steps)."""
+        details_path = self.out / "SPEC_SERVING_DETAILS.json"
+        cmd = [sys.executable, "bench.py", "--workloads",
+               "serving_speculative", "--details-out",
+               str(details_path)]
+        metric_keys = ("tokens_per_second", "ttft_ms_p50",
+                       "tpot_ms_p50", "acceptance_rate")
+        if self.dry:
+            self.record(
+                "serving_speculative", "dry_run",
+                command=" ".join(cmd),
+                metrics={variant: {k: None for k in metric_keys}
+                         for variant in ("dense", "paged")})
+            return
+        rc, out = _run(cmd, BENCH_QUICK_TIMEOUT, env=self.child_env)
+        summary: dict = {}
+        try:
+            with open(details_path, encoding="utf-8") as fh:
+                det = json.load(fh)
+        except (OSError, ValueError):
+            det = {}
+        for variant, key in (("dense", "serving_speculative"),
+                             ("paged", "serving_speculative_paged")):
+            rep = det.get(key) or {}
+            if "error" in rep:
+                summary[variant] = {"error": rep["error"]}
+                continue
+            spec = rep.get("speculative") or {}
+            summary[variant] = {
+                "tokens_per_second": rep.get("tokens_per_second"),
+                "ttft_ms_p50": (rep.get("ttft_ms") or {}).get("p50"),
+                "tpot_ms_p50": (rep.get("tpot_ms") or {}).get("p50"),
+                "acceptance_rate": spec.get("acceptance_rate"),
+            }
+        ok = (rc == 0 and summary
+              and all("error" not in v for v in summary.values()))
+        self.record("serving_speculative",
+                    "ok" if ok else "failed", rc=rc,
+                    metrics=summary, output_tail=out[-800:])
+
     # -- driver ----------------------------------------------------
     def run(self) -> int:
         started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -268,6 +315,7 @@ class Pipeline:
             self.flash_flip(results)
             winner = self.tuning_ab()
             self.final_bench(winner)
+            self.serving_speculative()
         report = {
             "started_at": started,
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",

@@ -280,8 +280,63 @@ def check_paged_attention_int8() -> bool:
     return ok
 
 
+def check_int8_kv_dequant_fusion() -> bool:
+    """ADVICE r5: the dense int8 KV decode path
+    (models/transformer._decode_attend) dequantizes the full
+    [B, T, H, D] cache with an elementwise multiply OUTSIDE any
+    kernel and relies on XLA fusing it into the two attention dots.
+    If the compiler materializes the dequantized k_all/v_all instead,
+    peak HBM exceeds the bf16 cache the int8 path claims to halve.
+    Correctness is unaffected either way — this check inspects the
+    COMPILED step's buffer assignment: temp-buffer bytes must stay
+    well below one dequantized cache tensor."""
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import transformer as tfm
+
+    batch, t_len, heads, depth = 8, 2048, 4, 64
+    cfg = tfm.TransformerConfig(
+        vocab_size=1024, d_model=heads * depth, n_layers=1,
+        n_heads=heads, d_head=depth, d_ff=512, dtype=jnp.bfloat16,
+        kv_cache_dtype="int8")
+    dcfg = inf.decode_config(cfg, t_len)
+    model = tfm.TransformerLM(dcfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32),
+        positions=jnp.zeros((1,), jnp.int32))["params"]
+    cache = inf.init_cache(model, params, batch)
+    tokens = jnp.zeros((batch, 1), jnp.int32)
+    positions = jnp.zeros((batch,), jnp.int32)
+
+    def step(params, cache, tokens, positions):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, tokens,
+            positions=positions[:, None], mutable=["cache"])
+        return logits, mutated["cache"]
+
+    compiled = jax.jit(step).lower(params, cache, tokens,
+                                   positions).compile()
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if temp is None:
+        raise RuntimeError(
+            "compiled.memory_analysis() has no temp_size_in_bytes on "
+            "this backend — fusion cannot be verified")
+    # One dequantized cache tensor (K or V) in bf16. A fused step's
+    # temps are dominated by the [B, H, 1, T] fp32 scores (~0.25 MB
+    # here); materializing even ONE full dequantized cache adds 8 MB.
+    dequant_bytes = batch * t_len * heads * depth * 2
+    ok = temp < dequant_bytes
+    verdict = ("OK" if ok else
+               "FAIL — the dense int8 path is materializing the "
+               "dequantized cache")
+    print(f"int8 KV dequant fusion: temp_bytes={temp} "
+          f"(dequantized-cache threshold {dequant_bytes}) {verdict}")
+    return ok
+
+
 CHECKS["chunked_cross_entropy"] = check_chunked_cross_entropy
 CHECKS["paged_attention_int8"] = check_paged_attention_int8
+CHECKS["int8_kv_dequant_fusion"] = check_int8_kv_dequant_fusion
 
 
 def run_all(write_marker: str | None = None) -> dict:
