@@ -34,6 +34,7 @@ from typing import Callable, Optional
 from batch_shipyard_tpu.agent import task_runner
 from batch_shipyard_tpu.config.settings import (
     JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
+from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.jobs import launcher
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
@@ -150,6 +151,16 @@ class NodeAgent:
         # per runtime kind — the hot launch path must not query the
         # whole images table per task.
         self._image_manifest_cache: dict[str, tuple[float, set]] = {}
+        # Goodput accounting: wall-clock instant this node last went
+        # idle (no claimed/running work); the idle interval is emitted
+        # when work next starts. None while work is in flight.
+        # _goodput_busy_slots holds slots with a CLAIMED task
+        # (claim -> finish/abandon) so a slot mid-prep blocks idle
+        # re-arm even before its _running_tasks increment; slot-keyed
+        # so an exception path can release idempotently without ever
+        # stealing another slot's unit. Both under _running_lock.
+        self._goodput_idle_since: Optional[float] = None
+        self._goodput_busy_slots: set[int] = set()
         # Retention sweeps: (monotonic deadline, task dir) for
         # completed tasks whose spec sets retention_time_seconds —
         # the Azure Batch task-constraint retention_time analog
@@ -193,6 +204,7 @@ class NodeAgent:
         """Run node prep, then start worker + heartbeat threads."""
         self._set_node_state("starting")
         marker = os.path.join(self.work_dir, ".nodeprep_finished")
+        prep_started = time.time()
         try:
             os.makedirs(self.work_dir, exist_ok=True)
             # Idempotency marker: reboot-resume fast path (reference:
@@ -202,6 +214,11 @@ class NodeAgent:
                     self._nodeprep(self)
                 with open(marker, "w", encoding="utf-8") as fh:
                     fh.write(util.datetime_utcnow_iso())
+                goodput_events.emit(
+                    self.store, self.identity.pool_id,
+                    goodput_events.NODE_PREP,
+                    node_id=self.identity.node_id,
+                    start=prep_started, end=time.time())
         except NodeUnusableError as exc:
             logger.warning("node %s unusable: %s",
                            self.identity.node_id, exc)
@@ -212,6 +229,7 @@ class NodeAgent:
             self._set_node_state("start_task_failed", error=str(exc))
             return
         self._set_node_state("idle")
+        self._goodput_idle_since = time.time()
         self._rescan_retention_markers()
         for slot in range(self.pool.task_slots_per_node):
             thread = threading.Thread(
@@ -354,6 +372,14 @@ class NodeAgent:
                 if msg is not None:
                     break
             if msg is None:
+                # Re-arm the idle marker if a failed launch path
+                # cleared it without a task ever running (goodput:
+                # idle time must not become unaccounted forever).
+                with self._running_lock:
+                    if (not self._goodput_busy_slots
+                            and self._running_tasks == 0
+                            and self._goodput_idle_since is None):
+                        self._goodput_idle_since = time.time()
                 time.sleep(self.poll_interval)
                 continue
             stagger += 1
@@ -362,6 +388,10 @@ class NodeAgent:
                     slot, json.loads(msg.payload), msg)
             except Exception:
                 logger.exception("error processing task message; requeue")
+                # Release this slot's goodput claim (idempotent; the
+                # exception may have struck before or after the
+                # claim) so idle accounting survives the crash.
+                self._goodput_work_done(slot)
                 try:
                     self.store.update_message(msg, visibility_timeout=5.0)
                 except NotFoundError:
@@ -652,7 +682,10 @@ class NodeAgent:
         try:
             self._merge_task(
                 job_id, task_id,
-                {"state": "pending", "node_id": None},
+                {"state": "pending", "node_id": None,
+                 # Queue-time accounting restarts here: the dead
+                 # node's runtime is not queueing badput.
+                 "requeued_at": util.datetime_utcnow_iso()},
                 if_match=entity["_etag"])
         except (EtagMismatchError, NotFoundError):
             return None
@@ -689,6 +722,98 @@ class NodeAgent:
 
         return _Guard()
 
+    # ------------------------ goodput hooks ----------------------------
+
+    def _goodput_work_started(self, slot: int, job_id: str,
+                              task_id: str, entity: dict,
+                              emit_queued: bool = True) -> None:
+        """Close the node's open idle interval and emit the task's
+        queueing span (submit -> first claim; requeue -> re-claim for
+        retries) — the scheduling-leg badput of the decomposition.
+        Gang instances pass emit_queued only for instance 0 so an
+        8-wide gang doesn't report 8x queue time."""
+        with self._running_lock:
+            idle_since = self._goodput_idle_since
+            self._goodput_idle_since = None
+            self._goodput_busy_slots.add(slot)
+        now = time.time()
+        if idle_since is not None and now > idle_since:
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.NODE_IDLE,
+                node_id=self.identity.node_id,
+                start=idle_since, end=now)
+        if not emit_queued:
+            return
+        # A retried task waited since its REQUEUE, not its original
+        # submit — the first attempt's runtime is not queue time.
+        submitted = goodput_events.iso_to_epoch(
+            entity.get("requeued_at") or entity.get("submitted_at"))
+        if submitted is not None and now > submitted:
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_QUEUED, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=submitted, end=now,
+                attrs={"retries": entity.get("retries", 0)})
+
+    def _ensure_images_timed(self, job_id: str, task_id: str,
+                             spec: dict) -> None:
+        """_ensure_images under an image_pull goodput span (only when
+        the task actually names a container image)."""
+        if spec.get("image") and spec.get("runtime") in (
+                "docker", "singularity"):
+            with goodput_events.span(
+                    self.store, self.identity.pool_id,
+                    goodput_events.TASK_IMAGE_PULL, job_id=job_id,
+                    task_id=task_id, node_id=self.identity.node_id,
+                    attrs={"image": spec.get("image")}):
+                self._ensure_images(spec)
+        else:
+            self._ensure_images(spec)
+
+    def _goodput_task_finished(self, slot: int, job_id: str,
+                               task_id: str,
+                               result: task_runner.TaskResult) -> None:
+        started = goodput_events.iso_to_epoch(result.started_at)
+        if started is not None and result.wall_seconds > 0:
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_RUNNING, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=started, end=started + result.wall_seconds,
+                attrs={"exit_code": result.exit_code,
+                       "timed_out": result.timed_out})
+        self._goodput_work_done(slot)
+
+    def _goodput_work_done(self, slot: int) -> None:
+        """Release a slot's claimed-work unit (idempotent — safe to
+        call from exception handlers that can't know whether the
+        claim happened); re-arm the idle marker once the node has
+        NOTHING claimed or running."""
+        with self._running_lock:
+            self._goodput_busy_slots.discard(slot)
+            if (not self._goodput_busy_slots
+                    and self._running_tasks == 0
+                    and self._goodput_idle_since is None):
+                self._goodput_idle_since = time.time()
+
+    def _ingest_goodput(self, job_id: str, task_id: str,
+                        execution: task_runner.TaskExecution) -> None:
+        """Fold the task's process-local program-phase events (compile
+        / step windows / checkpoint spans the workload recorded to
+        $SHIPYARD_GOODPUT_FILE) into the store with the task's
+        identity attached."""
+        path = execution.env.get(goodput_events.GOODPUT_FILE_ENV)
+        if not path:
+            return
+        count = goodput_events.ingest_local_events(
+            self.store, self.identity.pool_id, path, job_id=job_id,
+            task_id=task_id, node_id=self.identity.node_id)
+        if count:
+            logger.debug("ingested %d goodput events from %s/%s",
+                         count, job_id, task_id)
+
     # ----------------------- regular task path -------------------------
 
     def _claim_regular(self, job_id: str, task_id: str,
@@ -710,6 +835,7 @@ class NodeAgent:
             # it is now terminal, else let visibility re-deliver.
             self.store.update_message(msg, visibility_timeout=10.0)
             return
+        self._goodput_work_started(slot, job_id, task_id, entity)
         spec = entity["spec"]
         with self._message_keepalive(msg):
             if not self._ensure_job_prep(job_id, spec):
@@ -719,9 +845,10 @@ class NodeAgent:
                              f"{self.identity.node_id}"})
                 self.store.delete_message(msg)
                 self._maybe_autocomplete_job(job_id)
+                self._goodput_work_done(slot)
                 return
             try:
-                self._ensure_images(spec)
+                self._ensure_images_timed(job_id, task_id, spec)
                 execution = self._build_execution(slot, job_id,
                                                   task_id, spec)
             except TaskEnvError as exc:
@@ -730,6 +857,7 @@ class NodeAgent:
                     "error": str(exc)})
                 self.store.delete_message(msg)
                 self._maybe_autocomplete_job(job_id)
+                self._goodput_work_done(slot)
                 return
             try:
                 self._stage_inputs(spec, execution)
@@ -741,6 +869,7 @@ class NodeAgent:
                     "error": f"input staging failed: {exc}"})
                 self.store.delete_message(msg)
                 self._maybe_autocomplete_job(job_id)
+                self._goodput_work_done(slot)
                 return
             self._merge_task(job_id, task_id, {
                 "state": "running",
@@ -759,6 +888,8 @@ class NodeAgent:
                 with self._running_lock:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
+        self._ingest_goodput(job_id, task_id, execution)
+        self._goodput_task_finished(slot, job_id, task_id, result)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -770,9 +901,16 @@ class NodeAgent:
         max_retries = spec.get("max_task_retries", 0)
         if result.exit_code != 0 and (
                 max_retries < 0 or retries < max_retries):
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_RETRY, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                attrs={"retries": retries + 1,
+                       "exit_code": result.exit_code})
             self._merge_task(job_id, task_id, {
                 "state": "pending", "retries": retries + 1,
                 "last_exit_code": result.exit_code,
+                "requeued_at": util.datetime_utcnow_iso(),
                 "node_id": None})
             self.store.delete_message(msg)
             self.store.put_message(
@@ -980,6 +1118,8 @@ class NodeAgent:
             self.store.update_message(msg, visibility_timeout=0.0)
             time.sleep(self.poll_interval)
             return
+        self._goodput_work_started(slot, job_id, task_id, entity,
+                                   emit_queued=(instance == 0))
         # Rendezvous: wait for all instances to join, watching for
         # members dying underneath us (preemption/crash).
         deadline = time.monotonic() + self.gang_timeout
@@ -994,6 +1134,7 @@ class NodeAgent:
                 stale = self._stale_gang_members(members)
                 if stale:
                     self._fail_broken_gang(job_id, task_id, stale, msg)
+                    self._goodput_work_done(slot)
                     return
                 last_stale_check = time.monotonic()
             if time.monotonic() > deadline:
@@ -1001,8 +1142,10 @@ class NodeAgent:
                     "state": "failed", "exit_code": -1,
                     "error": "gang rendezvous timeout"})
                 self.store.delete_message(msg)
+                self._goodput_work_done(slot)
                 return
             if self.stop_event.is_set():
+                self._goodput_work_done(slot)
                 return
             if time.monotonic() - keepalive > 30.0:
                 self.store.update_message(msg, visibility_timeout=60.0)
@@ -1030,7 +1173,7 @@ class NodeAgent:
         with self._message_keepalive(msg):
             jp_ok = self._ensure_job_prep(job_id, spec)
             try:
-                self._ensure_images(spec)
+                self._ensure_images_timed(job_id, task_id, spec)
                 execution = self._build_execution(
                     slot, job_id, task_id, spec, instance=instance,
                     instances=num_instances,
@@ -1093,6 +1236,8 @@ class NodeAgent:
             {"state": "done", "exit_code": result.exit_code})
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
+        self._ingest_goodput(job_id, task_id, execution)
+        self._goodput_task_finished(slot, job_id, task_id, result)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -1243,6 +1388,13 @@ class NodeAgent:
         task_dir = os.path.join(
             self.work_dir, "tasks", job_id, task_id,
             f"i{instance}" if instances > 1 else "")
+        # Program-phase goodput sink: workloads record compile / step
+        # windows / checkpoint spans here; the agent ingests the file
+        # into TABLE_GOODPUT after the task exits.
+        env.setdefault(
+            goodput_events.GOODPUT_FILE_ENV,
+            os.path.join(task_dir.rstrip("/"),
+                         "goodput_events.jsonl"))
         return task_runner.TaskExecution(
             pool_id=self.identity.pool_id, job_id=job_id, task_id=task_id,
             node_id=self.identity.node_id,

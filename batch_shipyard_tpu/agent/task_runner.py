@@ -17,6 +17,9 @@ Env contract exposed to every task (the $AZ_BATCH_* analog):
                            $AZ_BATCH_HOST_LIST analog, batch.py:4378)
   SHIPYARD_TASK_INSTANCES  gang size (1 for regular tasks)
   SHIPYARD_TASK_INSTANCE   this instance's index
+  SHIPYARD_GOODPUT_FILE    JSONL sink for program-phase goodput events
+                           (goodput/events.py record/phase); the agent
+                           ingests it into TABLE_GOODPUT post-task
 plus, for gang tasks with jax_distributed enabled, the launcher env from
 jobs/launcher.py (JAX_COORDINATOR_ADDRESS etc.).
 """
@@ -140,6 +143,21 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
                     "SHIPYARD_NODE_INDEX", "SHIPYARD_TASK_INSTANCES",
                     "SHIPYARD_TASK_INSTANCE", "SHIPYARD_HOST_LIST"):
             argv += ["-e", var]
+        goodput_file = execution.env.get("SHIPYARD_GOODPUT_FILE")
+        if goodput_file:
+            # The host task_dir is mounted at /shipyard/task: remap
+            # the recorder path onto the mount so the agent finds the
+            # file on the host side after exit. A sink outside this
+            # execution's task_dir (e.g. a gang coordination step
+            # whose task_dir is a subdir) is unreachable through the
+            # mount — leave the env alone; the recorder's writes are
+            # simply lost with the container, never an error.
+            host_dir = os.path.abspath(execution.task_dir)
+            host_file = os.path.abspath(goodput_file)
+            if host_file.startswith(host_dir + os.sep):
+                rel = os.path.relpath(host_file, host_dir)
+                argv += ["-e",
+                         f"SHIPYARD_GOODPUT_FILE=/shipyard/task/{rel}"]
         argv += list(execution.additional_docker_run_options)
         argv += [execution.image or "",
                  "/bin/bash", "-c", execution.command]

@@ -6,7 +6,9 @@ mirror the reference so a Batch Shipyard user finds the same verbs:
 
   shipyard-tpu pool   add | list | del | resize | nodes | stats | ssh |
                       images update | autoscale ...
-  shipyard-tpu jobs   add | list | term | del | stats | tasks list
+  shipyard-tpu jobs   add | list | term | del | stats | wait |
+                      tasks list
+  shipyard-tpu goodput job | pool | fleet
   shipyard-tpu data   stream | ingress
   shipyard-tpu diag   perf
   shipyard-tpu storage clear
@@ -389,6 +391,24 @@ def jobs_stats(click_ctx, job_id):
                             raw=click_ctx.obj["raw"])
 
 
+@jobs.command("wait")
+@click.option("--job-id", required=True)
+@click.option("--timeout", type=float, default=600.0)
+@click.option("--goodput-report", is_flag=True, default=False,
+              help="Print the job's goodput decomposition once all "
+                   "tasks are terminal")
+@click.pass_context
+def jobs_wait(click_ctx, job_id, timeout, goodput_report):
+    """Block until every task of a job is terminal."""
+    try:
+        fleet.action_jobs_wait(_ctx(click_ctx), job_id,
+                               timeout=timeout,
+                               goodput_report=goodput_report,
+                               raw=click_ctx.obj["raw"])
+    except TimeoutError as exc:
+        raise click.ClickException(str(exc))
+
+
 @jobs.command("disable")
 @click.option("--job-id", required=True)
 @click.pass_context
@@ -503,6 +523,56 @@ def jobs_tasks_term(click_ctx, job_id, task_id, wait):
     ctx = _ctx(click_ctx)
     jobs_mgr.terminate_task(ctx.store, ctx.pool.id, job_id, task_id,
                             wait=wait)
+
+
+# ------------------------------ goodput --------------------------------
+
+@cli.group()
+def goodput():
+    """ML productivity goodput accounting (arxiv 2502.06982): badput
+    waterfall + availability x resource x program decomposition over
+    the fleet-wide event log."""
+
+
+@goodput.command("job")
+@click.argument("job_id")
+@click.pass_context
+def goodput_job(click_ctx, job_id):
+    """One job's decomposition (queue/image-pull/compile/checkpoint/
+    rework badput vs productive step time)."""
+    fleet.action_goodput(_ctx(click_ctx), "job", job_id=job_id,
+                         raw=click_ctx.obj["raw"])
+
+
+@goodput.command("pool")
+@click.pass_context
+def goodput_pool(click_ctx):
+    """Pool rollup (node lifecycle included) + per-job subreports."""
+    fleet.action_goodput(_ctx(click_ctx), "pool",
+                         raw=click_ctx.obj["raw"])
+
+
+@goodput.command("fleet")
+@click.pass_context
+def goodput_fleet(click_ctx):
+    """Fleet rollup over every registered pool."""
+    fleet.action_goodput(_ctx(click_ctx), "fleet",
+                         raw=click_ctx.obj["raw"])
+
+
+@goodput.command("prune")
+@click.option("--older-than-hours", type=float, default=7 * 24.0,
+              help="Delete events that ended more than this many "
+                   "hours ago (default: one week)")
+@click.pass_context
+def goodput_prune(click_ctx, older_than_hours):
+    """Retention sweep over the pool's event log (the log is
+    append-only; accounting scans grow with fleet age)."""
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    ctx = _ctx(click_ctx)
+    removed = goodput_events.prune(ctx.store, ctx.pool.id,
+                                   older_than_hours * 3600.0)
+    click.echo(f"pruned {removed} events from pool {ctx.pool.id}")
 
 
 # ------------------------------- data ----------------------------------
@@ -990,6 +1060,8 @@ def monitor_heimdall(click_ctx, output_dir, once, poll_interval):
             .get("resource_polling_interval_seconds", 15))
     if once:
         click.echo(heimdall.write_file_sd(ctx.store, output_dir))
+        click.echo(heimdall.write_goodput_metrics(ctx.store,
+                                                  output_dir))
     else:
         heimdall.run_daemon(ctx.store, output_dir, poll_interval)
 

@@ -539,6 +539,60 @@ def action_jobs_stats(ctx: Context, job_id: Optional[str] = None,
     _emit(jobs_mgr.job_stats(ctx.store, ctx.pool.id, job_id), raw)
 
 
+def action_jobs_wait(ctx: Context, job_id: str,
+                     timeout: float = 600.0,
+                     goodput_report: bool = False,
+                     raw: bool = False) -> list[dict]:
+    """Block until every task of a job is terminal; optionally follow
+    with the job's goodput decomposition (--goodput-report)."""
+    ctx.substrate().ensure_attached(ctx.pool)
+    tasks = jobs_mgr.wait_for_tasks(ctx.store, ctx.pool.id, job_id,
+                                    timeout=timeout)
+    _emit({"tasks": [{"id": t["_rk"], "state": t.get("state"),
+                      "exit_code": t.get("exit_code")}
+                     for t in tasks]}, raw)
+    if goodput_report:
+        action_goodput(ctx, "job", job_id=job_id, raw=raw)
+    return tasks
+
+
+# ------------------------------- goodput -------------------------------
+
+def action_goodput(ctx: Context, scope: str,
+                   job_id: Optional[str] = None,
+                   raw: bool = False) -> dict:
+    """Goodput decomposition + badput waterfall for a job, the pool,
+    or the whole fleet (goodput/accounting.py over TABLE_GOODPUT)."""
+    from batch_shipyard_tpu.goodput import accounting
+    if scope == "job":
+        if not job_id:
+            raise ValueError("goodput job requires a job id")
+        report = accounting.job_report(ctx.store, ctx.pool.id, job_id)
+    elif scope == "pool":
+        report = accounting.pool_report(ctx.store, ctx.pool.id)
+    elif scope == "fleet":
+        report = accounting.fleet_report(ctx.store)
+    else:
+        raise ValueError(f"unknown goodput scope {scope!r}")
+    if raw:
+        _emit(report, raw=True)
+    else:
+        sys.stdout.write(accounting.waterfall_table(report) + "\n")
+        if scope == "fleet":
+            for pool_id in sorted(report.get("pools", {})):
+                sys.stdout.write(
+                    f"\n== pool {pool_id} ==\n"
+                    + accounting.waterfall_table(
+                        report["pools"][pool_id]) + "\n")
+        elif scope == "pool":
+            for jid in sorted(report.get("jobs", {})):
+                sys.stdout.write(
+                    f"\n== job {jid} ==\n"
+                    + accounting.waterfall_table(
+                        report["jobs"][jid]) + "\n")
+    return report
+
+
 def action_data_stream(ctx: Context, job_id: str, task_id: str,
                        filename: str = "stdout.txt") -> None:
     """data files stream (fleet.py action analog of batch.py:3243)."""

@@ -1,0 +1,15 @@
+"""ML Productivity Goodput accounting (arxiv 2502.06982).
+
+A fleet-wide productivity event log + the accounting engine that folds
+it into the paper's decomposition::
+
+    goodput = availability x resource x program
+
+``events``     — typed interval event API over the state store
+                 (TABLE_GOODPUT) plus a process-local JSONL recorder
+                 for workloads running inside tasks.
+``accounting`` — pure functions over event dicts: overlapping-interval
+                 resolution, badput breakdown by category, per-job /
+                 per-pool / fleet rollups, waterfall + Prometheus
+                 rendering.
+"""

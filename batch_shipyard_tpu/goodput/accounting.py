@@ -1,0 +1,489 @@
+"""Goodput accounting engine: events -> the paper's decomposition.
+
+"ML Productivity Goodput" (arxiv 2502.06982) decomposes the fraction
+of wall-clock time that produces useful progress as::
+
+    goodput = availability x resource x program
+
+  availability — had resources at all (scheduling leg): wall minus
+                 provisioning + queueing badput, over wall.
+  resource     — resources actually ran the program (runtime leg):
+                 minus image-pull, idle and unaccounted time.
+  program      — the running program made FRESH progress (program
+                 leg): minus compile/warm-up, checkpoint overhead and
+                 preemption-recovery rework (steps replayed since the
+                 last checkpoint).
+
+Everything here is a pure function over event dicts (the shape
+goodput/events.py produces), so the whole engine is testable on the
+in-memory store with synthetic timelines.
+
+Overlapping-interval resolution: the timeline is swept over elementary
+segments between event boundaries; each segment is charged to exactly
+one category — the highest-priority interval covering it (a checkpoint
+save inside a step window is checkpoint overhead, not productive
+time). Categories therefore PARTITION wall clock: productive +
+badput + unaccounted == wall by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from batch_shipyard_tpu.goodput import events as ev
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+
+# Badput categories (the waterfall rows). "unaccounted" is wall time
+# no event covers — surfaced explicitly instead of silently inflating
+# a real category.
+BADPUT_CATEGORIES = (
+    "provisioning", "queueing", "image_pull", "compile",
+    "checkpoint", "preemption_recovery", "idle", "unaccounted",
+)
+
+PRODUCTIVE = "productive"
+
+# kind -> category. step_window is handled specially (fresh portion is
+# productive, replayed portion is preemption_recovery rework); retry is
+# instantaneous (counted, zero duration).
+_KIND_CATEGORY = {
+    ev.NODE_PROVISIONING: "provisioning",
+    ev.NODE_PREP: "provisioning",
+    ev.NODE_PREEMPTED: "provisioning",   # reclaim -> re-provision time
+    ev.TASK_QUEUED: "queueing",
+    ev.TASK_IMAGE_PULL: "image_pull",
+    ev.TASK_CONTAINER_START: "image_pull",
+    ev.PROGRAM_COMPILE: "compile",
+    ev.PROGRAM_WARMUP: "compile",
+    ev.PROGRAM_CHECKPOINT_SAVE: "checkpoint",
+    ev.PROGRAM_CHECKPOINT_RESTORE: "checkpoint",
+    ev.NODE_IDLE: "idle",
+    ev.PROGRAM_STEP_WINDOW: PRODUCTIVE,
+    ev.PROGRAM_EVAL: PRODUCTIVE,
+    ev.TASK_RUNNING: "_running",         # container; lowest priority
+}
+
+# Decomposition legs: which categories each leg loses.
+_SCHEDULING_BADPUT = ("provisioning", "queueing")
+_RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
+_PROGRAM_BADPUT = ("compile", "checkpoint", "preemption_recovery")
+
+# Sweep priority, highest first. SAME-PROGRAM overheads (rework,
+# checkpoint, compile — instrumented as phases nested inside the
+# program's own timeline) beat productive time; productive time beats
+# CROSS-TASK waits (another task's queued/image-pull span overlapping
+# a busy node's step window is concurrency, not wasted node time —
+# ranking those above PRODUCTIVE would let one waiting task erase a
+# whole pool's productive seconds); waits beat idle beats the bare
+# running container beats nothing (unaccounted).
+_PRIORITY = (
+    "preemption_recovery", "checkpoint", "compile", PRODUCTIVE,
+    "image_pull", "provisioning", "queueing", "idle", "_running",
+)
+_PRIORITY_RANK = {c: i for i, c in enumerate(_PRIORITY)}
+
+
+def _as_int(value: Any) -> Optional[int]:
+    """Counter attrs come from task-written JSONL: coerce defensively
+    — junk degrades the window to counter-less, never a crash."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def _split_step_windows(windows: list[dict]) -> list[tuple]:
+    """Split step_window events into (start, end, category) pieces:
+    the portion covering steps already completed before (replay after
+    a checkpoint restore) is preemption-recovery rework; the rest is
+    productive. Windows without step counters are wholly productive.
+
+    This is the "lost-step rework since last checkpoint" number: a
+    job that checkpointed at step 80, was preempted at step 100 and
+    restored to 80 replays steps 80..100 — that whole replayed window
+    is badput.
+
+    Step numbering is PER JOB: the high-water mark is tracked within
+    each job_id group, so pool/fleet rollups never misprice an
+    unrelated job's fresh steps 0..N as another job's replay. Within
+    a job, only windows from STRICTLY EARLIER windows count toward
+    the high-water mark: a gang's instances all record the same
+    step range CONCURRENTLY (SPMD — that is one unit of progress,
+    not replay), while a post-restore replay necessarily starts
+    after the preempted window ended."""
+    pieces: list[tuple] = []
+    by_job: dict = {}
+    for event in windows:
+        by_job.setdefault(event.get("job_id"), []).append(event)
+    for group in by_job.values():
+        pieces.extend(_split_step_windows_one_job(group))
+    return pieces
+
+
+def _split_step_windows_one_job(windows: list[dict]) -> list[tuple]:
+    pieces: list[tuple] = []
+    completed: list[tuple] = []  # (end_time, step_end)
+    for event in sorted(windows, key=lambda e: (e.get("start", 0.0),
+                                                e.get("end", 0.0))):
+        start = float(event.get("start", 0.0))
+        end = float(event.get("end", start))
+        attrs = event.get("attrs") or {}
+        step_start = _as_int(attrs.get("step_start"))
+        step_end = _as_int(attrs.get("step_end"))
+        if step_start is None or step_end is None or \
+                step_end <= step_start:
+            pieces.append((start, end, PRODUCTIVE))
+            continue
+        # High-water mark over windows that ENDED before this one
+        # started — concurrent (overlapping) gang instances never
+        # count each other as replay.
+        done_before = [se for (et, se) in completed if et <= start]
+        replayed = 0
+        if done_before:
+            replayed = max(0, min(step_end, max(done_before))
+                           - step_start)
+        frac = min(1.0, replayed / (step_end - step_start))
+        cut = start + (end - start) * frac
+        if frac > 0:
+            pieces.append((start, cut, "preemption_recovery"))
+        if frac < 1.0:
+            pieces.append((cut, end, PRODUCTIVE))
+        completed.append((end, step_end))
+    return pieces
+
+
+def _sweep(intervals: list[tuple], wall_start: float,
+           wall_end: float) -> dict[str, float]:
+    """Charge every elementary segment of [wall_start, wall_end] to
+    the highest-priority covering category; uncovered time is
+    "unaccounted". Returns {category: seconds} partitioning wall.
+
+    Sweep line over sorted endpoints with per-category active counts:
+    O(N log N) in the interval count — periodic consumers (the
+    heimdall export) re-run this every poll, so no quadratic scans."""
+    seconds = {c: 0.0 for c in BADPUT_CATEGORIES}
+    seconds[PRODUCTIVE] = 0.0
+    seconds["_running"] = 0.0
+    boundary: list[tuple] = [(wall_start, 0, None), (wall_end, 0, None)]
+    for start, end, category in intervals:
+        start = max(start, wall_start)
+        end = min(end, wall_end)
+        if end <= start:
+            continue
+        boundary.append((start, +1, category))
+        boundary.append((end, -1, category))
+    boundary.sort(key=lambda b: b[0])
+    active = [0] * len(_PRIORITY)
+    prev = wall_start
+    for point, delta, category in boundary:
+        left = max(prev, wall_start)
+        right = min(point, wall_end)
+        if right > left:
+            winner = next((c for rank, c in enumerate(_PRIORITY)
+                           if active[rank] > 0), None)
+            seconds[winner if winner else "unaccounted"] += (
+                right - left)
+        prev = point
+        if delta:
+            active[_PRIORITY_RANK[category]] += delta
+    # The bare running container (task process alive but no program
+    # phase claimed the time) is runtime overhead the program leg
+    # can't see; fold it into unaccounted rather than invent a
+    # category the paper doesn't have.
+    seconds["unaccounted"] += seconds.pop("_running")
+    return seconds
+
+
+def decompose(event_list: list[dict],
+              wall: Optional[tuple[float, float]] = None
+              ) -> dict[str, Any]:
+    """Fold events into the goodput decomposition + badput breakdown.
+
+    ``wall`` overrides the accounting window; by default it spans
+    [min start, max end] over the events."""
+    event_list = [e for e in event_list
+                  if e.get("kind") in ev.EVENT_KINDS]
+    if not event_list:
+        return _empty_report()
+    starts = [float(e.get("start", 0.0)) for e in event_list]
+    ends = [float(e.get("end", e.get("start", 0.0)))
+            for e in event_list]
+    wall_start, wall_end = wall or (min(starts), max(ends))
+    wall_seconds = max(0.0, wall_end - wall_start)
+
+    intervals: list[tuple] = []
+    step_windows: list[dict] = []
+    retries = 0
+    preemptions = 0
+    steps = 0
+    tokens = 0
+    # Counter dedup: an N-wide SPMD gang ingests N identical step
+    # ranges per job (one per instance) — one unit of progress, so
+    # each distinct (job, step range) counts its steps/tokens once.
+    counted_ranges: set = set()
+    for event in event_list:
+        kind = event.get("kind")
+        if kind == ev.TASK_RETRY:
+            retries += 1
+            continue
+        if kind == ev.PROGRAM_STEP_WINDOW:
+            step_windows.append(event)
+            attrs = event.get("attrs") or {}
+            step_start = _as_int(attrs.get("step_start"))
+            step_end = _as_int(attrs.get("step_end"))
+            range_key = (event.get("job_id"), step_start, step_end)
+            if step_start is not None and step_end is not None and \
+                    range_key not in counted_ranges:
+                counted_ranges.add(range_key)
+                steps += max(0, step_end - step_start)
+                tokens += _as_int(attrs.get("tokens")) or 0
+            continue
+        category = _KIND_CATEGORY.get(kind)
+        if category is None:
+            continue
+        start = float(event.get("start", 0.0))
+        end = float(event.get("end", start))
+        if kind == ev.NODE_PREEMPTED and end <= start:
+            # Zero-duration observation marker (autoscale emits these
+            # as the count rises); the paired recovery SPAN carries
+            # the downtime interval.
+            preemptions += 1
+            continue
+        if end > start:
+            intervals.append((start, end, category))
+    intervals.extend(_split_step_windows(step_windows))
+
+    seconds = _sweep(intervals, wall_start, wall_end)
+    productive = seconds.pop(PRODUCTIVE)
+    badput = {c: seconds[c] for c in BADPUT_CATEGORIES}
+
+    sched = sum(badput[c] for c in _SCHEDULING_BADPUT)
+    resource = sum(badput[c] for c in _RESOURCE_BADPUT)
+    program = sum(badput[c] for c in _PROGRAM_BADPUT)
+    avail_time = max(0.0, wall_seconds - sched)
+    run_time = max(0.0, avail_time - resource)
+    fresh_time = max(0.0, run_time - program)
+    # fresh_time == productive by construction (the sweep partitions
+    # wall); keep the arithmetic on the partition so the three legs
+    # multiply out to the headline ratio exactly.
+    availability = avail_time / wall_seconds if wall_seconds else 0.0
+    resource_g = run_time / avail_time if avail_time else 0.0
+    program_g = fresh_time / run_time if run_time else 0.0
+    return {
+        "wall_seconds": wall_seconds,
+        "productive_seconds": productive,
+        "goodput_ratio": (productive / wall_seconds
+                          if wall_seconds else 0.0),
+        "availability_goodput": availability,
+        "resource_goodput": resource_g,
+        "program_goodput": program_g,
+        "badput_seconds": badput,
+        "steps": steps,
+        "tokens": tokens,
+        "retries": retries,
+        "preemptions": preemptions,
+        "events": len(event_list),
+        "window": [wall_start, wall_end],
+    }
+
+
+def _empty_report() -> dict[str, Any]:
+    return {
+        "wall_seconds": 0.0, "productive_seconds": 0.0,
+        "goodput_ratio": 0.0, "availability_goodput": 0.0,
+        "resource_goodput": 0.0, "program_goodput": 0.0,
+        "badput_seconds": {c: 0.0 for c in BADPUT_CATEGORIES},
+        "steps": 0, "tokens": 0, "retries": 0, "preemptions": 0,
+        "events": 0, "window": None,
+    }
+
+
+def decompose_by_node(event_list: list[dict],
+                      left_cutoff: Optional[float] = None
+                      ) -> dict[str, Any]:
+    """Pool-scope decomposition: events grouped per node and each
+    group swept on its OWN timeline, then summed — so wall/badput are
+    NODE-seconds and seven idle nodes can never hide behind one busy
+    node's productive window (which a single shared timeline's
+    priority sweep would let happen). Events without a node (queueing
+    spans, pool resize, ingested program phases that predate node
+    tagging) form their own group. ``left_cutoff`` clips each group's
+    wall at the trailing-window boundary."""
+    groups: dict = {}
+    for event in event_list:
+        groups.setdefault(event.get("node_id"), []).append(event)
+    total = _empty_report()
+    total["badput_seconds"] = {c: 0.0 for c in BADPUT_CATEGORIES}
+    for group in groups.values():
+        starts = [float(e.get("start", 0.0)) for e in group]
+        ends = [float(e.get("end", e.get("start", 0.0)))
+                for e in group]
+        left = min(starts)
+        if left_cutoff is not None:
+            left = max(left, left_cutoff)
+        sub = decompose(group, wall=(left, max(max(ends), left)))
+        total["wall_seconds"] += sub["wall_seconds"]
+        total["productive_seconds"] += sub["productive_seconds"]
+        for category, value in sub["badput_seconds"].items():
+            total["badput_seconds"][category] += value
+        for key in ("steps", "tokens", "retries", "preemptions",
+                    "events"):
+            total[key] += sub[key]
+    wall = total["wall_seconds"]
+    sched = sum(total["badput_seconds"][c]
+                for c in _SCHEDULING_BADPUT)
+    resource = sum(total["badput_seconds"][c]
+                   for c in _RESOURCE_BADPUT)
+    avail = max(0.0, wall - sched)
+    run = max(0.0, avail - resource)
+    total["goodput_ratio"] = (total["productive_seconds"] / wall
+                              if wall else 0.0)
+    total["availability_goodput"] = avail / wall if wall else 0.0
+    total["resource_goodput"] = run / avail if avail else 0.0
+    total["program_goodput"] = (total["productive_seconds"] / run
+                                if run else 0.0)
+    total["nodes"] = len(groups)
+    return total
+
+
+# ------------------------------- rollups -------------------------------
+
+def job_report(store: StateStore, pool_id: str,
+               job_id: str) -> dict[str, Any]:
+    """One job's decomposition (job-scoped events only: queue, task
+    lifecycle, program phases)."""
+    report = decompose(ev.query(store, pool_id, job_id=job_id))
+    report["job_id"] = job_id
+    report["pool_id"] = pool_id
+    return report
+
+
+def pool_report(store: StateStore, pool_id: str,
+                window_seconds: Optional[float] = None,
+                include_jobs: bool = True) -> dict[str, Any]:
+    """Pool rollup: ALL events of the pool (node lifecycle included)
+    folded into one timeline, plus per-job subreports.
+
+    ``window_seconds`` restricts accounting to the trailing window —
+    the append-only log grows with fleet age, and periodic consumers
+    (the heimdall gauge export) must not re-sweep history forever.
+    ``include_jobs=False`` skips the per-job subreports for callers
+    that only read the pool-level numbers (heimdall, fleet).
+
+    Pool scope aggregates PER NODE (wall/badput are node-seconds, via
+    decompose_by_node); job subreports are single-timeline (the job's
+    own wall clock)."""
+    event_list = ev.query(store, pool_id)
+    cutoff = None
+    if window_seconds is not None and event_list:
+        import time as time_mod
+        cutoff = time_mod.time() - window_seconds
+        event_list = [e for e in event_list
+                      if float(e.get("end", e.get("start", 0.0)))
+                      >= cutoff]
+    if event_list:
+        report = decompose_by_node(event_list, left_cutoff=cutoff)
+    else:
+        report = _empty_report()
+    report["pool_id"] = pool_id
+    if include_jobs:
+        job_ids = sorted({e.get("job_id") for e in event_list
+                          if e.get("job_id")})
+        report["jobs"] = {
+            job_id: decompose([e for e in event_list
+                               if e.get("job_id") == job_id])
+            for job_id in job_ids}
+    return report
+
+
+def fleet_report(store: StateStore,
+                 window_seconds: Optional[float] = None
+                 ) -> dict[str, Any]:
+    """Fleet rollup over every registered pool: per-pool reports plus
+    a wall-clock-weighted aggregate ratio."""
+    pools = {}
+    total_wall = 0.0
+    total_productive = 0.0
+    badput = {c: 0.0 for c in BADPUT_CATEGORIES}
+    for row in store.query_entities(names.TABLE_POOLS,
+                                    partition_key="pools"):
+        pool_id = row["_rk"]
+        report = pool_report(store, pool_id,
+                             window_seconds=window_seconds,
+                             include_jobs=False)
+        pools[pool_id] = report
+        total_wall += report["wall_seconds"]
+        total_productive += report["productive_seconds"]
+        for category, value in report["badput_seconds"].items():
+            badput[category] += value
+    sched = sum(badput[c] for c in _SCHEDULING_BADPUT)
+    resource = sum(badput[c] for c in _RESOURCE_BADPUT)
+    avail = max(0.0, total_wall - sched)
+    run = max(0.0, avail - resource)
+    return {
+        "pools": pools,
+        "wall_seconds": total_wall,
+        "productive_seconds": total_productive,
+        "goodput_ratio": (total_productive / total_wall
+                          if total_wall else 0.0),
+        "availability_goodput": (avail / total_wall
+                                 if total_wall else 0.0),
+        "resource_goodput": run / avail if avail else 0.0,
+        "program_goodput": (total_productive / run
+                            if run else 0.0),
+        "badput_seconds": badput,
+    }
+
+
+# ------------------------------ rendering ------------------------------
+
+def waterfall_table(report: dict[str, Any]) -> str:
+    """Badput waterfall: productive first, then every category,
+    summing to wall clock."""
+    wall = report.get("wall_seconds") or 0.0
+
+    def pct(value: float) -> str:
+        return f"{100.0 * value / wall:5.1f}%" if wall else "    -"
+
+    lines = [f"{'category':<22}{'seconds':>12}  {'share':>6}",
+             "-" * 42]
+    lines.append(f"{PRODUCTIVE:<22}"
+                 f"{report.get('productive_seconds', 0.0):>12.2f}  "
+                 f"{pct(report.get('productive_seconds', 0.0))}")
+    for category in BADPUT_CATEGORIES:
+        value = report.get("badput_seconds", {}).get(category, 0.0)
+        lines.append(f"{category:<22}{value:>12.2f}  {pct(value)}")
+    lines.append("-" * 42)
+    lines.append(f"{'wall':<22}{wall:>12.2f}  {pct(wall)}")
+    lines.append(
+        f"goodput_ratio = {report.get('goodput_ratio', 0.0):.3f} "
+        f"(availability {report.get('availability_goodput', 0.0):.3f}"
+        f" x resource {report.get('resource_goodput', 0.0):.3f}"
+        f" x program {report.get('program_goodput', 0.0):.3f})")
+    if report.get("steps"):
+        lines.append(f"steps = {report['steps']}  "
+                     f"tokens = {report.get('tokens', 0)}  "
+                     f"retries = {report.get('retries', 0)}")
+    return "\n".join(lines)
+
+
+def prometheus_lines(report: dict[str, Any],
+                     labels: dict[str, str]) -> list[str]:
+    """Gauge export for the heimdall-scraped dashboards:
+    goodput_ratio{...} and badput_seconds{...,category=...}."""
+    label_str = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(labels.items()))
+    lines = [
+        f"goodput_ratio{{{label_str}}} "
+        f"{report.get('goodput_ratio', 0.0):.6f}",
+        f"goodput_productive_seconds{{{label_str}}} "
+        f"{report.get('productive_seconds', 0.0):.3f}",
+    ]
+    for category in BADPUT_CATEGORIES:
+        value = report.get("badput_seconds", {}).get(category, 0.0)
+        sep = "," if label_str else ""
+        lines.append(
+            f"badput_seconds{{{label_str}{sep}"
+            f'category="{category}"}} {value:.3f}')
+    return lines

@@ -1,0 +1,264 @@
+"""Goodput interval event log: typed, append-only, store-persisted.
+
+Two producer surfaces feed one log:
+
+  * **Store-backed** (`emit` / `span` / `query`): used by components
+    that hold a StateStore handle — the node agent, pool autoscale,
+    jobs manager, monitor. Events land in TABLE_GOODPUT partitioned by
+    pool, RowKey = timestamp with a microsecond collision bump (the
+    perf-table scheme, agent/perf.py).
+
+  * **Process-local** (`record` / `phase`): used by workload code that
+    runs INSIDE a task subprocess (train/serve/checkpoint) and has no
+    store. Events append as JSON lines to $SHIPYARD_GOODPUT_FILE (the
+    agent exports it into every task env); after the task finishes the
+    agent ingests the file into the store with the task's identity
+    attached (`ingest_local_events`). With no file configured the
+    recorder is a no-op, so workloads run unchanged outside pools.
+
+Event dict schema (what accounting.py consumes)::
+
+    {"kind": str, "start": float, "end": float,
+     "pool_id"/"job_id"/"task_id"/"node_id": Optional[str],
+     "attrs": {...}}   # e.g. step_start/step_end/tokens counters
+
+Emission is best-effort by design: a failed goodput write must never
+fail the work being measured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Iterator, Optional
+
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.base import StateStore
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+# Env var the agent exports into every task: process-local recorder
+# sink (JSONL), ingested into the store post-task.
+GOODPUT_FILE_ENV = "SHIPYARD_GOODPUT_FILE"
+
+# Node lifecycle
+NODE_PROVISIONING = "provisioning"     # slice allocation / resize
+NODE_PREP = "nodeprep"                 # node prep (boot -> ready)
+NODE_IDLE = "idle"                     # ready but running nothing
+NODE_PREEMPTED = "preempted"           # provider reclaim -> recovered
+
+# Task lifecycle
+TASK_QUEUED = "queued"                 # submit -> first claim
+TASK_IMAGE_PULL = "image_pull"         # image provisioning on node
+TASK_CONTAINER_START = "container_start"
+TASK_RUNNING = "running"               # task process start -> exit
+TASK_RETRY = "retry"                   # instantaneous requeue marker
+
+# Program phases (emitted from inside the workload process)
+PROGRAM_COMPILE = "compile"            # jit compile / warm-up steps
+PROGRAM_WARMUP = "warmup"              # serving engine warm-up
+PROGRAM_STEP_WINDOW = "step_window"    # productive steps; attrs carry
+                                       # step_start/step_end/tokens
+PROGRAM_CHECKPOINT_SAVE = "checkpoint_save"
+PROGRAM_CHECKPOINT_RESTORE = "checkpoint_restore"
+PROGRAM_EVAL = "eval"
+
+EVENT_KINDS = frozenset({
+    NODE_PROVISIONING, NODE_PREP, NODE_IDLE, NODE_PREEMPTED,
+    TASK_QUEUED, TASK_IMAGE_PULL, TASK_CONTAINER_START, TASK_RUNNING,
+    TASK_RETRY,
+    PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
+    PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE, PROGRAM_EVAL,
+})
+
+
+def iso_to_epoch(value: Optional[str]) -> Optional[float]:
+    """Parse the framework's UTC ISO timestamps (util
+    datetime_utcnow_iso) to epoch seconds; None on junk."""
+    if not value:
+        return None
+    import datetime
+    try:
+        return datetime.datetime.strptime(
+            value, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+            tzinfo=datetime.timezone.utc).timestamp()
+    except ValueError:
+        try:
+            return datetime.datetime.fromisoformat(
+                value.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            return None
+
+
+# ----------------------------- store-backed ----------------------------
+
+def emit(store: StateStore, pool_id: str, kind: str, *,
+         job_id: Optional[str] = None, task_id: Optional[str] = None,
+         node_id: Optional[str] = None,
+         start: Optional[float] = None, end: Optional[float] = None,
+         attrs: Optional[dict] = None) -> None:
+    """Append one event. Instantaneous events omit ``end`` (it
+    defaults to ``start``). Never raises: goodput accounting is an
+    observer, not a participant."""
+    if kind not in EVENT_KINDS:
+        logger.warning("unknown goodput event kind %r dropped", kind)
+        return
+    try:
+        # Coercion INSIDE the guard: start/end/attrs may come from a
+        # task-written JSONL line (ingest path) and junk there must
+        # drop the event, never raise into the agent's task flow.
+        ts = time.time() if start is None else float(start)
+        entity = {
+            "kind": kind, "job_id": job_id, "task_id": task_id,
+            "node_id": node_id, "start": ts,
+            "end": ts if end is None else float(end),
+            "attrs": dict(attrs or {}),
+        }
+        # RowKey: timestamp (sortable, the perf-table convention) + a
+        # uuid suffix — unlike agent/perf.py's deterministic keys, no
+        # collision-bump loop is needed.
+        row_key = f"{ts:017.6f}${uuid.uuid4().hex[:8]}"
+        store.insert_entity(names.TABLE_GOODPUT, pool_id, row_key,
+                            entity)
+    except Exception:  # noqa: BLE001 - observer must not fail work
+        logger.debug("goodput emit failed", exc_info=True)
+
+
+@contextlib.contextmanager
+def span(store: StateStore, pool_id: str, kind: str, *,
+         job_id: Optional[str] = None, task_id: Optional[str] = None,
+         node_id: Optional[str] = None,
+         attrs: Optional[dict] = None) -> Iterator[dict]:
+    """Time a block as one interval event. Yields the attrs dict so
+    the body can add counters before the event is emitted."""
+    out_attrs = dict(attrs or {})
+    start = time.time()
+    try:
+        yield out_attrs
+    finally:
+        emit(store, pool_id, kind, job_id=job_id, task_id=task_id,
+             node_id=node_id, start=start, end=time.time(),
+             attrs=out_attrs)
+
+
+def query(store: StateStore, pool_id: str,
+          job_id: Optional[str] = None,
+          task_id: Optional[str] = None) -> list[dict]:
+    """Events of a pool (optionally one job/task), sorted by start."""
+    out = []
+    for row in store.query_entities(names.TABLE_GOODPUT,
+                                    partition_key=pool_id):
+        if job_id is not None and row.get("job_id") != job_id:
+            continue
+        if task_id is not None and row.get("task_id") != task_id:
+            continue
+        out.append(row)
+    return sorted(out, key=lambda e: (e.get("start", 0.0),
+                                      e.get("end", 0.0)))
+
+
+def prune(store: StateStore, pool_id: str,
+          older_than_seconds: float) -> int:
+    """Retention sweep: delete events that ENDED more than
+    ``older_than_seconds`` ago. The log is append-only by design;
+    without pruning a long-lived pool's accounting scans grow with
+    fleet age. Returns the number of rows removed."""
+    cutoff = time.time() - older_than_seconds
+    removed = 0
+    for row in list(store.query_entities(names.TABLE_GOODPUT,
+                                         partition_key=pool_id)):
+        if float(row.get("end", row.get("start", 0.0))) < cutoff:
+            try:
+                store.delete_entity(names.TABLE_GOODPUT, pool_id,
+                                    row["_rk"])
+                removed += 1
+            except Exception:  # noqa: BLE001 - best effort
+                logger.debug("goodput prune failed", exc_info=True)
+    return removed
+
+
+# ---------------------------- process-local ----------------------------
+
+def local_events_path() -> Optional[str]:
+    """The JSONL sink for THIS process, or None (recorder disabled)."""
+    return os.environ.get(GOODPUT_FILE_ENV) or None
+
+
+def record(kind: str, start: float, end: Optional[float] = None,
+           **attrs: Any) -> None:
+    """Process-local emit: append one JSONL event to
+    $SHIPYARD_GOODPUT_FILE. No-op when unset; never raises."""
+    path = local_events_path()
+    if path is None:
+        return
+    event = {"kind": kind, "start": float(start),
+             "end": float(start if end is None else end),
+             "attrs": attrs}
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(event) + "\n")
+    except OSError:
+        logger.debug("goodput local record failed", exc_info=True)
+
+
+@contextlib.contextmanager
+def phase(kind: str, **attrs: Any) -> Iterator[dict]:
+    """Time a block as a process-local event; yields the attrs dict
+    (mutable — step/token counters get filled in by the body)."""
+    out_attrs = dict(attrs)
+    start = time.time()
+    try:
+        yield out_attrs
+    finally:
+        record(kind, start, time.time(), **out_attrs)
+
+
+def ingest_local_events(store: StateStore, pool_id: str, path: str, *,
+                        job_id: Optional[str] = None,
+                        task_id: Optional[str] = None,
+                        node_id: Optional[str] = None) -> int:
+    """Fold a task's process-local JSONL into the store, attaching the
+    task's identity. Returns the number of events ingested; the file
+    is removed on success so retries don't double-count."""
+    if not os.path.exists(path):
+        return 0
+    count = 0
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                kind = event.get("kind")
+                if kind not in EVENT_KINDS:
+                    continue
+                # The file's contents are task-controlled: coerce the
+                # numeric fields here and skip junk lines so one bad
+                # event never poisons the ingest (or, downstream, the
+                # accounting of the whole pool).
+                try:
+                    start = float(event.get("start"))
+                    end = float(event.get("end", start))
+                except (TypeError, ValueError):
+                    continue
+                attrs = event.get("attrs")
+                if not isinstance(attrs, dict):
+                    attrs = {}
+                emit(store, pool_id, kind, job_id=job_id,
+                     task_id=task_id, node_id=node_id,
+                     start=start, end=end, attrs=attrs)
+                count += 1
+        os.remove(path)
+    except OSError:
+        logger.debug("goodput ingest failed for %s", path,
+                     exc_info=True)
+    return count

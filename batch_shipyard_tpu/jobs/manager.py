@@ -585,11 +585,19 @@ def delete_job(store: StateStore, pool_id: str, job_id: str) -> None:
 
 def job_stats(store: StateStore, pool_id: str,
               job_id: Optional[str] = None) -> dict:
-    """jobs stats analog (batch.py:1972)."""
+    """jobs stats analog (batch.py:1972), plus queue/run aggregates
+    sourced from the goodput event log: queue_seconds sums queued
+    spans (submit->first claim; requeue->re-claim for retries, one
+    span per gang regardless of width), run_seconds sums running
+    spans (node-seconds: gang tasks contribute one span per
+    instance)."""
+    from batch_shipyard_tpu.goodput import events as goodput_events
     jobs = ([get_job(store, pool_id, job_id)] if job_id
             else list_jobs(store, pool_id))
     stats = {"jobs": len(jobs), "tasks": 0, "by_state": {},
-             "wall_seconds_total": 0.0}
+             "wall_seconds_total": 0.0,
+             "queue_seconds": 0.0, "run_seconds": 0.0}
+    job_ids = {job["_rk"] for job in jobs}
     for job in jobs:
         for task in list_tasks(store, pool_id, job["_rk"]):
             stats["tasks"] += 1
@@ -597,4 +605,19 @@ def job_stats(store: StateStore, pool_id: str,
             stats["by_state"][state] = stats["by_state"].get(state, 0) + 1
             stats["wall_seconds_total"] += float(
                 task.get("wall_seconds", 0.0) or 0.0)
+    # One unsorted pass over the pool's event partition (no need for
+    # events.query's time ordering here; the log is bounded by
+    # `goodput prune` retention).
+    for event in store.query_entities(names.TABLE_GOODPUT,
+                                      partition_key=pool_id):
+        if event.get("job_id") not in job_ids or \
+                event.get("kind") not in (goodput_events.TASK_QUEUED,
+                                          goodput_events.TASK_RUNNING):
+            continue
+        duration = max(0.0, float(event.get("end", 0.0))
+                       - float(event.get("start", 0.0)))
+        if event.get("kind") == goodput_events.TASK_QUEUED:
+            stats["queue_seconds"] += duration
+        else:
+            stats["run_seconds"] += duration
     return stats

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import uuid
 from typing import Callable, Optional
 
 import jax
@@ -494,6 +495,24 @@ class ContinuousBatcher:
                 self.gamma)
 
     # ------------------------------ public -----------------------------
+
+    def warmup(self, prompt_len: int = 4,
+               max_new_tokens: int = 2) -> None:
+        """Drive one throwaway request through prefill + decode so
+        the jit compiles happen before real traffic, recorded as an
+        engine warm-up goodput phase (compile-leg badput; see
+        goodput/accounting.py). Serving front ends call this before
+        accepting load so warm-up never pollutes TTFT."""
+        from batch_shipyard_tpu.goodput import events as goodput_events
+        with goodput_events.phase(goodput_events.PROGRAM_WARMUP,
+                                  what="serving_engine",
+                                  prompt_len=prompt_len):
+            self.submit(Request(
+                request_id=f"__warmup__{uuid.uuid4().hex[:8]}",
+                prompt=list(range(1, prompt_len + 1)),
+                max_new_tokens=max_new_tokens))
+            while self.pending():
+                self.step()
 
     def submit(self, request: Request) -> None:
         if request.max_new_tokens < 1:

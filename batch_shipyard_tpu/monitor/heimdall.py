@@ -119,15 +119,69 @@ def write_file_sd(store: StateStore, output_dir: str) -> str:
     return path
 
 
+# The gauge export re-sweeps the event log every poll; bound the scan
+# to a trailing day so cost tracks recent activity, not fleet age
+# (operators prune history with `goodput prune` / events.prune).
+GOODPUT_EXPORT_WINDOW_SECONDS = 24 * 3600.0
+
+
+def build_goodput_metrics(store: StateStore) -> list[str]:
+    """Prometheus gauge lines for every registered-or-known pool's
+    goodput decomposition: goodput_ratio{pool=...} and
+    badput_seconds{pool=...,category=...} (plus productive seconds),
+    computed from the TABLE_GOODPUT event log over the trailing
+    export window."""
+    from batch_shipyard_tpu.goodput import accounting
+    lines = [
+        "# HELP goodput_ratio Fraction of wall-clock producing "
+        "useful progress (availability x resource x program).",
+        "# TYPE goodput_ratio gauge",
+        "# HELP badput_seconds Unproductive wall-clock seconds by "
+        "category.",
+        "# TYPE badput_seconds gauge",
+        "# HELP goodput_productive_seconds Wall-clock seconds of "
+        "fresh training/serving progress.",
+        "# TYPE goodput_productive_seconds gauge",
+    ]
+    for pool in store.query_entities(names.TABLE_POOLS,
+                                     partition_key="pools"):
+        report = accounting.pool_report(
+            store, pool["_rk"],
+            window_seconds=GOODPUT_EXPORT_WINDOW_SECONDS,
+            include_jobs=False)
+        lines.extend(accounting.prometheus_lines(
+            report, {"pool": pool["_rk"]}))
+    return lines
+
+
+def write_goodput_metrics(store: StateStore, output_dir: str) -> str:
+    """Write the goodput gauges as a node_exporter textfile-collector
+    .prom (the same atomic tmp+rename discipline as file_sd), so a
+    Prometheus already scraping heimdall's targets picks the fleet's
+    productivity up with zero extra configuration."""
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, "shipyard_goodput.prom")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(build_goodput_metrics(store)) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
 def run_daemon(store: StateStore, output_dir: str,
                poll_interval: float = 15.0,
                stop_event: Optional[threading.Event] = None) -> None:
-    """Discovery loop: refresh file_sd targets until stopped."""
+    """Discovery loop: refresh file_sd targets + goodput gauges until
+    stopped."""
     stop = stop_event or threading.Event()
     while True:
         try:
             write_file_sd(store, output_dir)
         except Exception:
             logger.exception("heimdall refresh failed")
+        try:
+            write_goodput_metrics(store, output_dir)
+        except Exception:
+            logger.exception("heimdall goodput export failed")
         if stop.wait(poll_interval):
             return

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.models import resnet as resnet_mod
 from batch_shipyard_tpu.models import transformer as tfm
 from batch_shipyard_tpu.ops import ring_attention as ring
@@ -70,10 +71,14 @@ def build_transformer_train(
     abstract = jax.eval_shape(init_fn, rng)
     param_specs = shard_rules.transformer_param_specs(abstract)
     param_shardings = shard_rules.to_shardings(mesh, param_specs)
-    params = jax.jit(init_fn, out_shardings=param_shardings)(rng)
-    opt_state = jax.jit(
-        optimizer.init,
-        out_shardings=None)(params)
+    # Param/opt-state init is jit-compile time: charge it to the
+    # compile badput category (no-op outside a pool task).
+    with goodput_events.phase(goodput_events.PROGRAM_COMPILE,
+                              what="init"):
+        params = jax.jit(init_fn, out_shardings=param_shardings)(rng)
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=None)(params)
 
     def loss_fn(params, tokens, targets):
         # Chunked tied-embedding loss: the full [B, T, vocab] fp32

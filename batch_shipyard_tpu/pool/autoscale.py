@@ -28,6 +28,7 @@ from typing import Optional
 
 from batch_shipyard_tpu.config.settings import (
     AutoscaleScenarioSettings, PoolSettings)
+from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.pool import manager as pool_mgr
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import StateStore
@@ -292,6 +293,8 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
             logger.exception("node-state refresh failed for %s",
                              pool.id)
     decision = evaluate(store, pool, now)
+    _record_preemptions(store, entity, pool.id,
+                        decision["preempted_nodes"])
     if decision["target_slices"] is not None:
         current_slices = len({
             n.slice_index for n in pool_mgr.list_nodes(store, pool.id)})
@@ -299,7 +302,12 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
             logger.info("autoscale: %s slices %d -> %d (%s)", pool.id,
                         current_slices, decision["target_slices"],
                         decision["reason"])
-            substrate.resize_pool(pool, decision["target_slices"])
+            with goodput_events.span(
+                    store, pool.id, goodput_events.NODE_PROVISIONING,
+                    attrs={"reason": "autoscale_resize",
+                           "from_slices": current_slices,
+                           "to_slices": decision["target_slices"]}):
+                substrate.resize_pool(pool, decision["target_slices"])
             decision["applied"] = True
             return decision
     else:
@@ -309,11 +317,54 @@ def autoscale_tick(store: StateStore, substrate, pool: PoolSettings,
             logger.info("autoscale: %s nodes %d -> %d (%s)", pool.id,
                         current, decision["target_nodes"],
                         decision["reason"])
-            substrate.resize_pool(pool, decision["target_nodes"])
+            with goodput_events.span(
+                    store, pool.id, goodput_events.NODE_PROVISIONING,
+                    attrs={"reason": "autoscale_resize",
+                           "from_nodes": current,
+                           "to_nodes": decision["target_nodes"]}):
+                substrate.resize_pool(pool, decision["target_nodes"])
             decision["applied"] = True
             return decision
     decision["applied"] = False
     return decision
+
+
+def _record_preemptions(store: StateStore, pool_entity: dict,
+                        pool_id: str, preempted_nodes: int) -> None:
+    """Goodput: record provider reclamation as it is OBSERVED. A
+    rising count emits an instantaneous marker (the preemption
+    counter); when the count drains back to zero the whole outage is
+    emitted as ONE preempted->recovered SPAN (tick-granular downtime,
+    priced as provisioning badput). State rides the pool entity so
+    dedupe and the open-outage start survive daemon restarts."""
+    import time as time_mod
+    last = int(pool_entity.get("goodput_preempted_nodes", 0) or 0)
+    since = pool_entity.get("goodput_preempted_since")
+    now = time_mod.time()
+    patch: dict = {}
+    if preempted_nodes != last:
+        patch["goodput_preempted_nodes"] = preempted_nodes
+    if preempted_nodes > last:
+        if since is None:
+            patch["goodput_preempted_since"] = now
+        goodput_events.emit(
+            store, pool_id, goodput_events.NODE_PREEMPTED,
+            start=now, end=now,
+            attrs={"preempted_nodes": preempted_nodes,
+                   "newly_preempted": preempted_nodes - last})
+    elif preempted_nodes == 0 and last > 0 and since is not None:
+        goodput_events.emit(
+            store, pool_id, goodput_events.NODE_PREEMPTED,
+            start=float(since), end=now,
+            attrs={"recovered": True, "nodes": last})
+        patch["goodput_preempted_since"] = None
+    if patch:
+        try:
+            store.merge_entity(names.TABLE_POOLS, "pools", pool_id,
+                               patch)
+        except Exception:  # noqa: BLE001 - accounting is advisory
+            logger.exception("preemption bookkeeping failed for %s",
+                             pool_id)
 
 
 def run_daemon(store: StateStore, substrate, pool: PoolSettings,

@@ -10,16 +10,34 @@ suspend/resume and job migration live in pool/jobs managers.
 Checkpoints go to a local path or, in a pool, typically the job's
 shared directory (SHIPYARD_JOB_SHARED_DIR) or a gcsfuse mount so every
 worker sees them.
+
+Atomic commit protocol: a save writes into a hidden staging directory
+(``.tmp_step_NNNNNNNN``), stamps a COMMITTED marker, then renames into
+place — so a crash mid-save can never leave a torn ``step_NNNNNNNN``
+that ``latest_step``/``restore`` would pick up and resume a corrupt
+state from. ``latest_step`` only considers dirs carrying the marker,
+which also skips torn dirs written by pre-marker versions. This is
+what makes the goodput "lost-step rework" number honest: resume
+always lands on the last DURABLE step, and the replayed step window
+after a preemption is exactly the badput the accounting charges.
+
+Save/restore durations are recorded as goodput program-phase events
+(checkpoint-overhead badput) through the process-local recorder when
+the task env carries SHIPYARD_GOODPUT_FILE.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Optional
 
+from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
+
+COMMIT_MARKER = "COMMITTED"
 
 
 def _checkpointer():
@@ -32,30 +50,81 @@ def _step_path(checkpoint_dir: str, step: int) -> str:
                         f"step_{step:08d}")
 
 
+def _staging_path(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir),
+                        f".tmp_step_{step:08d}")
+
+
+def _marker_path(checkpoint_dir: str, step: int) -> str:
+    # Sibling file, not inside the step dir: Orbax owns the dir's
+    # contents and must never see a foreign entry on restore.
+    return _step_path(checkpoint_dir, step) + "." + COMMIT_MARKER
+
+
+def is_committed(checkpoint_dir: str, step: int) -> bool:
+    return os.path.exists(_marker_path(checkpoint_dir, step))
+
+
 def save(checkpoint_dir: str, step: int, params: Any,
          opt_state: Any) -> str:
-    """Write checkpoint step N; returns its path."""
+    """Write checkpoint step N atomically; returns its path."""
     import jax
     path = _step_path(checkpoint_dir, step)
+    staging = _staging_path(checkpoint_dir, step)
     state = {"params": params, "opt_state": opt_state,
              "step": step}
-    if jax.process_index() == 0:
-        os.makedirs(checkpoint_dir, exist_ok=True)
-    _checkpointer().save(path, state, force=True)
+    with goodput_events.phase(
+            goodput_events.PROGRAM_CHECKPOINT_SAVE, step=step):
+        if jax.process_index() == 0:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            # A stale staging dir is a previous torn save: discard.
+            shutil.rmtree(staging, ignore_errors=True)
+        _checkpointer().save(staging, state, force=True)
+        if jax.process_index() == 0:
+            # Commit order: replace the step dir, THEN stamp the
+            # marker (atomically, tmp + rename) — a crash at any
+            # point leaves either a previously committed step or an
+            # unmarked (ignored) dir, never a torn pickup. A marker
+            # orphaned by a crash mid-overwrite is harmless:
+            # latest_step only considers EXISTING step dirs.
+            marker = _marker_path(checkpoint_dir, step)
+            shutil.rmtree(path, ignore_errors=True)
+            os.replace(staging, path)
+            marker_tmp = marker + ".tmp"
+            with open(marker_tmp, "w", encoding="utf-8") as fh:
+                fh.write(util.datetime_utcnow_iso())
+            os.replace(marker_tmp, marker)
     logger.info("checkpoint saved: %s", path)
     return path
 
 
 def latest_step(checkpoint_dir: str) -> Optional[int]:
+    """Highest COMMITTED step, skipping torn/uncommitted dirs.
+
+    Legacy compatibility: a directory written ENTIRELY by pre-marker
+    versions (no .COMMITTED files at all) keeps the old accept-all
+    behavior — upgrading must not silently discard a fleet's existing
+    resume points. As soon as one marker exists, enforcement is
+    strict: unmarked step dirs are torn saves."""
     if not os.path.isdir(checkpoint_dir):
         return None
+    entries = os.listdir(checkpoint_dir)
+    any_marker = any(name.endswith("." + COMMIT_MARKER)
+                     for name in entries)
     steps = []
-    for name in os.listdir(checkpoint_dir):
-        if name.startswith("step_"):
+    for name in entries:
+        if name.startswith("step_") and \
+                not name.endswith("." + COMMIT_MARKER):
             try:
-                steps.append(int(name.split("_", 1)[1]))
+                step = int(name.split("_", 1)[1])
             except ValueError:
                 continue
+            if any_marker and not is_committed(checkpoint_dir, step):
+                logger.warning(
+                    "skipping uncommitted checkpoint %s (torn save)",
+                    os.path.join(checkpoint_dir, name))
+                continue
+            steps.append(step)
     return max(steps) if steps else None
 
 
@@ -68,15 +137,17 @@ def restore_params(checkpoint_dir: str) -> Optional[tuple]:
     if step is None:
         return None
     path = _step_path(checkpoint_dir, step)
-    restored = _checkpointer().restore(path)
+    with goodput_events.phase(
+            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step):
+        restored = _checkpointer().restore(path)
     logger.info("checkpoint params restored: %s", path)
     return restored["params"], restored.get("step", step)
 
 
 def restore(checkpoint_dir: str, params_template: Any,
             opt_state_template: Any) -> Optional[tuple]:
-    """Restore the latest checkpoint matching the given pytree
-    structure (shardings preserved from the templates); returns
+    """Restore the latest committed checkpoint matching the given
+    pytree structure (shardings preserved from the templates); returns
     (params, opt_state, step) or None when no checkpoint exists."""
     step = latest_step(checkpoint_dir)
     if step is None:
@@ -85,9 +156,11 @@ def restore(checkpoint_dir: str, params_template: Any,
     template = {"params": params_template,
                 "opt_state": opt_state_template, "step": step}
     import orbax.checkpoint as ocp
-    restored = _checkpointer().restore(
-        path, item=template,
-        restore_args=ocp.checkpoint_utils.construct_restore_args(
-            template))
+    with goodput_events.phase(
+            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step):
+        restored = _checkpointer().restore(
+            path, item=template,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(
+                template))
     logger.info("checkpoint restored: %s", path)
     return restored["params"], restored["opt_state"], restored["step"]

@@ -200,6 +200,11 @@ def main() -> int:
         speculative = build_draft(args) if args.speculative else None
         engines = [build_engine(args, config, params, speculative)
                    for _ in range(args.replicas)]
+        # Warm every replica BEFORE it starts taking traffic (jit
+        # compiles recorded as engine warm-up goodput; must run before
+        # the front's engine thread owns the stepping).
+        for e in engines:
+            e.warmup()
         fronts = [ServingFrontEnd(e, port=0).start()
                   for e in engines]
         router = ServingRouter([f.url for f in fronts],
@@ -210,6 +215,7 @@ def main() -> int:
               f"replica(s)", flush=True)
     else:
         engine = build_engine(args)
+        engine.warmup()
         fronts = [ServingFrontEnd(engine, host=args.host,
                                   port=args.port).start()]
         url = fronts[0].url
@@ -230,8 +236,9 @@ def main() -> int:
             _shutdown()
         return 0
     from batch_shipyard_tpu.models.loadgen import run_load
-    # One warmup request per replica so jit compilation doesn't
-    # pollute TTFT.
+    # Engines were warmed before their fronts started, so jit
+    # compilation never pollutes TTFT; one tiny request per front
+    # still warms the HTTP dispatch path itself.
     for front in fronts:
         front.generate({"prompt": [1, 2, 3], "max_new_tokens": 2})
     report = run_load(

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
 from batch_shipyard_tpu.workloads import distributed
@@ -96,20 +97,47 @@ def main() -> int:
         if restored is not None:
             params, opt_state, start_step = restored
             distributed.log(ctx, f"resumed from step {start_step}")
-    for _ in range(args.warmup):
-        params, opt_state, metrics = harness.step(params, opt_state,
-                                                  batch)
-        float(metrics["loss"])  # hard sync
+    # Goodput program phases: the warm-up loop is jit compile time
+    # (compile badput); the measured loop is the productive step
+    # window, stamped with step + token counters so the accounting
+    # engine can price preemption-recovery rework after a restore.
+    with goodput_events.phase(goodput_events.PROGRAM_COMPILE,
+                              what="jit_warmup", steps=args.warmup):
+        for _ in range(args.warmup):
+            params, opt_state, metrics = harness.step(params,
+                                                      opt_state, batch)
+            float(metrics["loss"])  # hard sync
     start = time.perf_counter()
+    # Step windows are flushed INCREMENTALLY at every checkpoint
+    # boundary (not one span over the whole loop): a window recorded
+    # only on clean exit would vanish with a preempted attempt, and
+    # the accounting engine's replayed-step rework pricing needs the
+    # crashed attempt's completed progress to survive on disk.
+    window = {"step": start_step, "time": time.time()}
+
+    def _flush_window(end_step: int) -> None:
+        if end_step > window["step"]:
+            goodput_events.record(
+                goodput_events.PROGRAM_STEP_WINDOW,
+                window["time"], time.time(),
+                step_start=window["step"], step_end=end_step,
+                tokens=args.batch * args.seq_len
+                * (end_step - window["step"]))
+        window["step"] = end_step
+        window["time"] = time.time()
+
     for step_num in range(start_step, start_step + args.steps):
-        params, opt_state, metrics = harness.step(params, opt_state,
-                                                  batch)
+        params, opt_state, metrics = harness.step(params,
+                                                  opt_state, batch)
         if args.checkpoint_dir and args.checkpoint_every and (
                 (step_num + 1) % args.checkpoint_every == 0):
+            _flush_window(step_num + 1)
             from batch_shipyard_tpu.workloads import checkpoint
-            checkpoint.save(args.checkpoint_dir, step_num + 1, params,
-                            opt_state)
-    loss = float(metrics["loss"])
+            checkpoint.save(args.checkpoint_dir, step_num + 1,
+                            params, opt_state)
+            window["time"] = time.time()  # save span is not steps
+    loss = float(metrics["loss"])  # hard sync before the final flush
+    _flush_window(start_step + args.steps)
     elapsed = time.perf_counter() - start
     if args.checkpoint_dir:
         from batch_shipyard_tpu.workloads import checkpoint

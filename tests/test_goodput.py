@@ -1,0 +1,603 @@
+"""Goodput accounting subsystem: event log, decomposition engine,
+emit sites end-to-end on the fakepod substrate, and the atomic
+checkpoint commit that keeps the lost-step rework number honest.
+
+All synthetic timelines use small absolute epochs — the engine is
+pure over event dicts, so nothing here sleeps for accounting."""
+
+import json
+import os
+import time
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.goodput import events as gp
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+GLOBAL = settings_mod.global_settings({})
+
+
+def _ev(kind, start, end, job_id="j1", task_id="t1", node_id="n1",
+        **attrs):
+    return {"kind": kind, "start": float(start), "end": float(end),
+            "job_id": job_id, "task_id": task_id, "node_id": node_id,
+            "attrs": attrs}
+
+
+# ----------------------------- event log -------------------------------
+
+def test_emit_span_query_roundtrip():
+    store = MemoryStateStore()
+    gp.emit(store, "p1", gp.TASK_QUEUED, job_id="j1", task_id="t1",
+            start=10.0, end=12.5, attrs={"retries": 0})
+    with gp.span(store, "p1", gp.TASK_IMAGE_PULL, job_id="j1",
+                 task_id="t1") as attrs:
+        attrs["image"] = "img"
+    events = gp.query(store, "p1")
+    assert [e["kind"] for e in events] == [gp.TASK_QUEUED,
+                                           gp.TASK_IMAGE_PULL]
+    assert events[0]["end"] - events[0]["start"] == pytest.approx(2.5)
+    assert events[1]["attrs"]["image"] == "img"
+    assert gp.query(store, "p1", job_id="nope") == []
+
+
+def test_unknown_kind_dropped_and_emit_never_raises():
+    store = MemoryStateStore()
+    gp.emit(store, "p1", "not_a_kind", start=1.0)
+    assert gp.query(store, "p1") == []
+
+    class Broken:
+        def insert_entity(self, *a, **k):
+            raise RuntimeError("store down")
+
+    gp.emit(Broken(), "p1", gp.TASK_QUEUED, start=1.0)  # no raise
+
+
+def test_local_recorder_and_ingest(tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv(gp.GOODPUT_FILE_ENV, path)
+    with gp.phase(gp.PROGRAM_COMPILE, what="warmup"):
+        pass
+    gp.record(gp.PROGRAM_STEP_WINDOW, 5.0, 9.0, step_start=0,
+              step_end=4, tokens=1024)
+    lines = open(path, encoding="utf-8").read().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["attrs"]["tokens"] == 1024
+    store = MemoryStateStore()
+    count = gp.ingest_local_events(store, "p1", path, job_id="j1",
+                                   task_id="t1", node_id="n1")
+    assert count == 2
+    assert not os.path.exists(path)  # consumed: retries can't double
+    events = gp.query(store, "p1", job_id="j1")
+    assert {e["kind"] for e in events} == {gp.PROGRAM_COMPILE,
+                                           gp.PROGRAM_STEP_WINDOW}
+
+
+def test_ingest_skips_malformed_task_written_lines(tmp_path):
+    """The JSONL is task-controlled: junk must neither raise into the
+    agent's task flow nor poison downstream accounting."""
+    path = tmp_path / "events.jsonl"
+    path.write_text("\n".join([
+        "not json at all",
+        json.dumps({"kind": "step_window", "start": "abc"}),
+        json.dumps({"kind": "step_window", "start": 1.0, "end": 2.0,
+                    "attrs": ["not", "a", "dict"]}),
+        json.dumps({"kind": "step_window", "start": 3.0, "end": 4.0,
+                    "attrs": {"step_start": "a", "step_end": "b"}}),
+        json.dumps({"kind": "step_window", "start": 5.0, "end": 6.0,
+                    "attrs": {"step_start": 0, "step_end": 5}}),
+    ]) + "\n")
+    store = MemoryStateStore()
+    count = gp.ingest_local_events(store, "p1", str(path),
+                                   job_id="j1")
+    assert count == 3  # the two unparseable-start lines dropped
+    # Junk attrs degrade gracefully in the accounting too.
+    report = accounting.job_report(store, "p1", "j1")
+    assert report["steps"] == 5
+    total = report["productive_seconds"] + sum(
+        report["badput_seconds"].values())
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+
+
+def test_gang_identical_step_ranges_counted_once():
+    """8 SPMD instances record the same step range: steps/tokens
+    count one unit of progress, not 8."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 50.0, node_id=f"n{i}",
+            step_start=0, step_end=50, tokens=500)
+        for i in range(8)
+    ]
+    report = accounting.decompose(events)
+    assert report["steps"] == 50
+    assert report["tokens"] == 500
+
+
+def test_preemption_downtime_span_priced_as_provisioning():
+    """autoscale's preempted->recovered span carries the outage; the
+    zero-duration observation markers only bump the counter."""
+    events = [
+        _ev(gp.NODE_PREEMPTED, 10.0, 10.0, preempted_nodes=2),
+        _ev(gp.NODE_PREEMPTED, 10.0, 70.0, recovered=True, nodes=2),
+        _ev(gp.NODE_IDLE, 70.0, 100.0, node_id="n1"),
+    ]
+    report = accounting.decompose(events)
+    assert report["preemptions"] == 1
+    assert report["badput_seconds"]["provisioning"] == pytest.approx(
+        60.0)
+    assert report["badput_seconds"]["idle"] == pytest.approx(30.0)
+
+
+def test_autoscale_preemption_bookkeeping_emits_outage_span():
+    from batch_shipyard_tpu.pool import autoscale as as_mod
+    store = MemoryStateStore()
+    store.upsert_entity("pools", "pools", "p1", {"state": "ready"})
+    entity = store.get_entity("pools", "pools", "p1")
+    as_mod._record_preemptions(store, entity, "p1", 2)
+    markers = [e for e in gp.query(store, "p1")
+               if e["kind"] == gp.NODE_PREEMPTED]
+    assert len(markers) == 1 and markers[0]["end"] == \
+        markers[0]["start"]
+    # Same count again: no duplicate emission.
+    entity = store.get_entity("pools", "pools", "p1")
+    as_mod._record_preemptions(store, entity, "p1", 2)
+    assert len([e for e in gp.query(store, "p1")
+                if e["kind"] == gp.NODE_PREEMPTED]) == 1
+    # Recovery closes the outage with a downtime SPAN.
+    entity = store.get_entity("pools", "pools", "p1")
+    as_mod._record_preemptions(store, entity, "p1", 0)
+    spans = [e for e in gp.query(store, "p1")
+             if e["kind"] == gp.NODE_PREEMPTED
+             and e["end"] > e["start"]]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["recovered"] is True
+
+
+def test_local_recorder_noop_without_env(monkeypatch, tmp_path):
+    monkeypatch.delenv(gp.GOODPUT_FILE_ENV, raising=False)
+    gp.record(gp.PROGRAM_COMPILE, 1.0, 2.0)  # must not raise
+    assert list(tmp_path.iterdir()) == []
+
+
+# --------------------------- accounting core ---------------------------
+
+def test_decompose_categories_partition_wall():
+    events = [
+        _ev(gp.TASK_QUEUED, 0.0, 10.0),
+        _ev(gp.TASK_RUNNING, 10.0, 100.0),
+        _ev(gp.TASK_IMAGE_PULL, 10.0, 14.0),
+        _ev(gp.PROGRAM_COMPILE, 14.0, 24.0),
+        _ev(gp.PROGRAM_STEP_WINDOW, 24.0, 84.0, step_start=0,
+            step_end=60, tokens=6000),
+        _ev(gp.PROGRAM_CHECKPOINT_SAVE, 84.0, 90.0, step=60),
+    ]
+    report = accounting.decompose(events)
+    assert report["wall_seconds"] == pytest.approx(100.0)
+    total = report["productive_seconds"] + sum(
+        report["badput_seconds"].values())
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+    assert report["badput_seconds"]["queueing"] == pytest.approx(10.0)
+    assert report["badput_seconds"]["image_pull"] == pytest.approx(4.0)
+    assert report["badput_seconds"]["compile"] == pytest.approx(10.0)
+    assert report["badput_seconds"]["checkpoint"] == pytest.approx(6.0)
+    assert report["productive_seconds"] == pytest.approx(60.0)
+    # [90, 100] is the running container with no program phase.
+    assert report["badput_seconds"]["unaccounted"] == pytest.approx(
+        10.0)
+    assert report["steps"] == 60
+    assert report["tokens"] == 6000
+    # The three legs multiply out to the headline ratio exactly.
+    assert (report["availability_goodput"]
+            * report["resource_goodput"]
+            * report["program_goodput"]) == pytest.approx(
+        report["goodput_ratio"])
+    assert report["goodput_ratio"] == pytest.approx(0.6)
+
+
+def test_cross_task_queue_wait_does_not_mask_productive_time():
+    """T1 trains 0..100 while T2 waits in queue the whole time on a
+    busy node: the node's time is productive; T2's wait is
+    concurrency, not badput that erases T1's progress. Queue time
+    only bites where nothing productive runs."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 100.0, task_id="t1",
+            step_start=0, step_end=100),
+        _ev(gp.TASK_QUEUED, 0.0, 110.0, task_id="t2"),
+    ]
+    report = accounting.decompose(events)
+    assert report["productive_seconds"] == pytest.approx(100.0)
+    assert report["badput_seconds"]["queueing"] == pytest.approx(10.0)
+
+
+def test_overlap_resolution_checkpoint_beats_step_window():
+    # Checkpoint saved INSIDE the step window: that slice is
+    # checkpoint overhead, not productive time.
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 100.0),
+        _ev(gp.PROGRAM_CHECKPOINT_SAVE, 40.0, 50.0),
+    ]
+    report = accounting.decompose(events)
+    assert report["productive_seconds"] == pytest.approx(90.0)
+    assert report["badput_seconds"]["checkpoint"] == pytest.approx(
+        10.0)
+
+
+def test_preemption_recovery_equals_replayed_step_window():
+    """The acceptance-criteria scenario: train to step 100 with a
+    checkpoint at 80, get preempted, restore to 80 and replay 80..100
+    — the replayed window is ENTIRELY preemption-recovery badput."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 100.0, step_start=0,
+            step_end=100),
+        _ev(gp.PROGRAM_CHECKPOINT_SAVE, 100.0, 104.0, step=80),
+        _ev(gp.PROGRAM_CHECKPOINT_RESTORE, 110.0, 112.0, step=80),
+        # Replayed window: steps 80..100 were already done.
+        _ev(gp.PROGRAM_STEP_WINDOW, 112.0, 132.0, step_start=80,
+            step_end=100),
+        # Fresh progress resumes.
+        _ev(gp.PROGRAM_STEP_WINDOW, 132.0, 152.0, step_start=100,
+            step_end=120),
+    ]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(20.0)
+    assert report["productive_seconds"] == pytest.approx(100.0 + 20.0)
+    # Partial replay: window crosses the high-water mark mid-way.
+    events[3] = _ev(gp.PROGRAM_STEP_WINDOW, 112.0, 152.0,
+                    step_start=80, step_end=120)
+    del events[4]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(20.0)
+
+
+def test_step_counters_ignore_replayed_steps_in_totals():
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 10.0, step_start=0,
+            step_end=10),
+        _ev(gp.PROGRAM_STEP_WINDOW, 10.0, 20.0, step_start=0,
+            step_end=10),
+    ]
+    report = accounting.decompose(events)
+    # Whole second window is rework.
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(10.0)
+    assert report["productive_seconds"] == pytest.approx(10.0)
+
+
+def test_retry_counted_and_empty_report_shape():
+    report = accounting.decompose(
+        [_ev(gp.TASK_RETRY, 5.0, 5.0, retries=1)])
+    assert report["retries"] == 1
+    empty = accounting.decompose([])
+    assert empty["goodput_ratio"] == 0.0
+    assert set(empty["badput_seconds"]) == set(
+        accounting.BADPUT_CATEGORIES)
+
+
+def test_concurrent_gang_windows_are_not_rework():
+    """8 SPMD gang instances record the SAME step range at the same
+    time — one unit of progress, not 7 replays. Only a window that
+    starts after a prior window ENDED (post-restore replay) is
+    rework."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 50.0, task_id="t1",
+            node_id=f"n{i}", step_start=0, step_end=50)
+        for i in range(8)
+    ]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(0.0)
+    assert report["productive_seconds"] == pytest.approx(50.0)
+    # A later (disjoint) replay of the same range IS rework.
+    events.append(_ev(gp.PROGRAM_STEP_WINDOW, 60.0, 80.0,
+                      task_id="t1", step_start=0, step_end=50))
+    report = accounting.decompose(events)
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(20.0)
+
+
+def test_rework_tracking_is_per_job():
+    """Two unrelated jobs both training steps 0..50: neither is the
+    other's replay — pool rollups must not misprice job B as rework."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 50.0, job_id="jA",
+            step_start=0, step_end=50),
+        _ev(gp.PROGRAM_STEP_WINDOW, 60.0, 110.0, job_id="jB",
+            step_start=0, step_end=50),
+    ]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"][
+        "preemption_recovery"] == pytest.approx(0.0)
+    assert report["productive_seconds"] == pytest.approx(100.0)
+
+
+def test_prune_removes_only_old_events():
+    import time as time_mod
+    store = MemoryStateStore()
+    now = time_mod.time()
+    gp.emit(store, "p1", gp.NODE_IDLE, start=now - 10_000,
+            end=now - 9_000)
+    gp.emit(store, "p1", gp.NODE_IDLE, start=now - 100, end=now - 50)
+    assert gp.prune(store, "p1", older_than_seconds=3_600) == 1
+    remaining = gp.query(store, "p1")
+    assert len(remaining) == 1
+    assert remaining[0]["start"] == pytest.approx(now - 100)
+
+
+def test_pool_report_trailing_window():
+    import time as time_mod
+    store = MemoryStateStore()
+    now = time_mod.time()
+    gp.emit(store, "p1", gp.PROGRAM_STEP_WINDOW, job_id="j1",
+            start=now - 100, end=now - 40)
+    gp.emit(store, "p1", gp.NODE_IDLE, node_id="n1",
+            start=now - 50_000, end=now - 49_000)
+    # Pool wall is NODE-seconds: 60s of (storeless-group) training +
+    # 1000s of n1 idle, NOT the 50ks gap between them.
+    full = accounting.pool_report(store, "p1")
+    assert full["wall_seconds"] == pytest.approx(1060.0, abs=2.0)
+    assert full["badput_seconds"]["idle"] == pytest.approx(1000.0)
+    windowed = accounting.pool_report(store, "p1",
+                                      window_seconds=3_600)
+    assert windowed["wall_seconds"] == pytest.approx(60.0, abs=1.0)
+    assert windowed["goodput_ratio"] == pytest.approx(1.0)
+
+
+def test_pool_rollup_idle_nodes_not_shadowed_by_busy_node():
+    """1 busy node + 7 concurrently idle nodes: a shared-timeline
+    sweep would let the productive window shadow all the idle spans
+    and report goodput ~1.0; per-node aggregation must surface the
+    wasted capacity."""
+    store = MemoryStateStore()
+    gp.emit(store, "p1", gp.PROGRAM_STEP_WINDOW, job_id="j1",
+            node_id="n0", start=0.0, end=100.0)
+    for i in range(1, 8):
+        gp.emit(store, "p1", gp.NODE_IDLE, node_id=f"n{i}",
+                start=0.0, end=100.0)
+    report = accounting.pool_report(store, "p1")
+    assert report["wall_seconds"] == pytest.approx(800.0)
+    assert report["badput_seconds"]["idle"] == pytest.approx(700.0)
+    assert report["goodput_ratio"] == pytest.approx(1.0 / 8.0)
+    assert report["nodes"] == 8
+
+
+def test_pool_and_fleet_rollups():
+    store = MemoryStateStore()
+    store.upsert_entity("pools", "pools", "p1", {"state": "ready"})
+    gp.emit(store, "p1", gp.PROGRAM_STEP_WINDOW, job_id="j1",
+            start=0.0, end=50.0,
+            attrs={"step_start": 0, "step_end": 50})
+    gp.emit(store, "p1", gp.NODE_IDLE, node_id="n1", start=50.0,
+            end=100.0)
+    pool = accounting.pool_report(store, "p1")
+    assert pool["wall_seconds"] == pytest.approx(100.0)
+    assert pool["badput_seconds"]["idle"] == pytest.approx(50.0)
+    assert "j1" in pool["jobs"]
+    fleet = accounting.fleet_report(store)
+    assert fleet["goodput_ratio"] == pytest.approx(0.5)
+    assert "p1" in fleet["pools"]
+
+
+def test_waterfall_and_prometheus_rendering():
+    report = accounting.decompose([
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 60.0),
+        _ev(gp.PROGRAM_COMPILE, 60.0, 100.0),
+    ])
+    table = accounting.waterfall_table(report)
+    assert "goodput_ratio = 0.600" in table
+    for category in accounting.BADPUT_CATEGORIES:
+        assert category in table
+    lines = accounting.prometheus_lines(report, {"pool": "p1"})
+    assert any(line.startswith('goodput_ratio{pool="p1"} 0.6')
+               for line in lines)
+    assert any('badput_seconds{pool="p1",category="compile"} 40.0'
+               in line for line in lines)
+
+
+# ------------------------- e2e on fakepod ------------------------------
+
+@pytest.fixture()
+def fakepod_env():
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    conf = {"pool_specification": {
+        "id": "pool1", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16", "num_slices": 1},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool, GLOBAL, conf)
+    yield store, substrate, pool
+    substrate.stop_all()
+
+
+def test_e2e_job_goodput_report_sums_to_wall(fakepod_env):
+    """The acceptance run: a localhost-class (fakepod) job whose
+    payload records a program phase; the decomposition's categories
+    must sum to wall clock within 1%."""
+    store, substrate, pool = fakepod_env
+    payload = (
+        "python3 -c \"import json,os,time; t=time.time(); "
+        "fh=open(os.environ['SHIPYARD_GOODPUT_FILE'],'a'); "
+        "fh.write(json.dumps({'kind':'step_window','start':t,"
+        "'end':t+0.08,'attrs':{'step_start':0,'step_end':8,"
+        "'tokens':64}})+chr(10)); fh.close(); time.sleep(0.1)\"")
+    jobs_mgr.add_jobs(store, pool, settings_mod.job_settings_list(
+        {"job_specifications": [{
+            "id": "jgood", "tasks": [{"command": payload}]}]}))
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jgood",
+                                    timeout=30)
+    assert tasks[0]["state"] == "completed"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        kinds = {e["kind"] for e in gp.query(store, "pool1",
+                                             job_id="jgood")}
+        if {gp.TASK_QUEUED, gp.TASK_RUNNING,
+                gp.PROGRAM_STEP_WINDOW} <= kinds:
+            break
+        time.sleep(0.1)
+    assert {gp.TASK_QUEUED, gp.TASK_RUNNING,
+            gp.PROGRAM_STEP_WINDOW} <= kinds
+    report = accounting.job_report(store, "pool1", "jgood")
+    assert report["wall_seconds"] > 0
+    total = report["productive_seconds"] + sum(
+        report["badput_seconds"].values())
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+    assert report["productive_seconds"] > 0
+    assert report["steps"] == 8
+    assert report["tokens"] == 64
+    # Satellite: job_stats aggregates sourced from the event log.
+    stats = jobs_mgr.job_stats(store, "pool1", "jgood")
+    assert stats["queue_seconds"] > 0
+    assert stats["run_seconds"] > 0
+    # Node-lifecycle events landed too (nodeprep marker, idle span).
+    pool_kinds = {e["kind"] for e in gp.query(store, "pool1")}
+    assert gp.NODE_IDLE in pool_kinds
+
+
+def test_e2e_retry_emits_retry_events(fakepod_env):
+    store, substrate, pool = fakepod_env
+    jobs_mgr.add_jobs(store, pool, settings_mod.job_settings_list(
+        {"job_specifications": [{
+            "id": "jretry",
+            "tasks": [{"command": "exit 7",
+                       "max_task_retries": 1}]}]}))
+    jobs_mgr.wait_for_tasks(store, "pool1", "jretry", timeout=30)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        retries = [e for e in gp.query(store, "pool1",
+                                       job_id="jretry")
+                   if e["kind"] == gp.TASK_RETRY]
+        if retries:
+            break
+        time.sleep(0.1)
+    assert len(retries) == 1
+    assert retries[0]["attrs"]["exit_code"] == 7
+    report = accounting.job_report(store, "pool1", "jretry")
+    assert report["retries"] == 1
+    # The retried attempt's queue span starts at the REQUEUE, not the
+    # original submit — the first attempt's runtime is not queueing.
+    events = gp.query(store, "pool1", job_id="jretry")
+    queued = [e for e in events if e["kind"] == gp.TASK_QUEUED]
+    running = [e for e in events if e["kind"] == gp.TASK_RUNNING]
+    assert len(queued) == 2 and len(running) == 2
+    assert queued[1]["start"] >= running[0]["end"] - 0.5
+
+
+# ------------------------------ CLI surface ----------------------------
+
+def test_cli_goodput_and_jobs_wait(tmp_path):
+    import yaml
+    from click.testing import CliRunner
+
+    from batch_shipyard_tpu.cli.main import cli
+    confs = {
+        "credentials": {"credentials": {
+            "storage": {"backend": "localfs",
+                        "root": str(tmp_path / "store")}}},
+        "config": {"global_resources": {"docker_images": []}},
+        "pool": {"pool_specification": {
+            "id": "gpool", "substrate": "fake",
+            "tpu": {"accelerator_type": "v5litepod-8"},
+            "max_wait_time_seconds": 30}},
+        "jobs": {"job_specifications": [{
+            "id": "gjob",
+            "tasks": [{"command": "sleep 0.1 && echo done"}]}]},
+    }
+    for name, data in confs.items():
+        with open(tmp_path / f"{name}.yaml", "w") as fh:
+            yaml.safe_dump(data, fh)
+    configdir = str(tmp_path)
+    runner = CliRunner()
+    result = runner.invoke(cli, ["--configdir", configdir, "pool",
+                                 "add"], catch_exceptions=False)
+    assert result.exit_code == 0
+    result = runner.invoke(cli, ["--configdir", configdir, "jobs",
+                                 "add"], catch_exceptions=False)
+    assert result.exit_code == 0
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "jobs", "wait", "--job-id",
+              "gjob", "--timeout", "30", "--goodput-report"],
+        catch_exceptions=False)
+    assert result.exit_code == 0
+    assert "goodput_ratio" in result.output
+    result = runner.invoke(
+        cli, ["--configdir", configdir, "--raw", "goodput", "job",
+              "gjob"], catch_exceptions=False)
+    assert result.exit_code == 0
+    report = json.loads(result.output)
+    assert report["job_id"] == "gjob"
+    assert set(report["badput_seconds"]) == set(
+        accounting.BADPUT_CATEGORIES)
+    total = report["productive_seconds"] + sum(
+        report["badput_seconds"].values())
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+    for scope in (["goodput", "pool"], ["goodput", "fleet"]):
+        result = runner.invoke(cli, ["--configdir", configdir]
+                               + scope, catch_exceptions=False)
+        assert result.exit_code == 0
+        assert "goodput_ratio" in result.output
+
+
+# ----------------------- atomic checkpoint commit ----------------------
+
+def test_latest_step_skips_torn_checkpoints(tmp_path):
+    """Regression for the torn-save pickup: an uncommitted
+    step_NNNNNNNN dir (crash mid-save) must be invisible to
+    latest_step/restore."""
+    from batch_shipyard_tpu.workloads import checkpoint
+    ckpt = tmp_path / "ckpt"
+    committed = ckpt / "step_00000001"
+    committed.mkdir(parents=True)
+    (ckpt / ("step_00000001." + checkpoint.COMMIT_MARKER)).write_text(
+        "ts")
+    torn = ckpt / "step_00000002"  # no marker: simulated torn save
+    torn.mkdir()
+    assert checkpoint.latest_step(str(ckpt)) == 1
+    # A stale staging dir is likewise ignored.
+    (ckpt / ".tmp_step_00000003").mkdir()
+    assert checkpoint.latest_step(str(ckpt)) == 1
+
+
+def test_latest_step_accepts_legacy_pre_marker_dirs(tmp_path):
+    """A checkpoint dir written entirely by pre-marker versions (no
+    .COMMITTED files anywhere) keeps the old accept-all behavior —
+    upgrading must not discard existing resume points."""
+    from batch_shipyard_tpu.workloads import checkpoint
+    ckpt = tmp_path / "legacy"
+    (ckpt / "step_00000005").mkdir(parents=True)
+    (ckpt / "step_00000009").mkdir()
+    assert checkpoint.latest_step(str(ckpt)) == 9
+
+
+def test_checkpoint_save_commits_atomically(tmp_path, monkeypatch):
+    pytest.importorskip("orbax.checkpoint")
+    import numpy as np
+
+    from batch_shipyard_tpu.workloads import checkpoint
+    goodput_file = tmp_path / "gp.jsonl"
+    monkeypatch.setenv(gp.GOODPUT_FILE_ENV, str(goodput_file))
+    ckpt = str(tmp_path / "ckpt")
+    params = {"w": np.ones((2, 2), np.float32)}
+    opt = {"m": np.zeros((2, 2), np.float32)}
+    path = checkpoint.save(ckpt, 3, params, opt)
+    assert checkpoint.is_committed(ckpt, 3)
+    assert not os.path.exists(
+        os.path.join(ckpt, ".tmp_step_00000003"))
+    assert checkpoint.latest_step(ckpt) == 3
+    restored = checkpoint.restore(ckpt, params, opt)
+    assert restored is not None
+    assert restored[2] == 3
+    np.testing.assert_array_equal(restored[0]["w"], params["w"])
+    assert os.path.basename(path) == "step_00000003"
+    # Save + restore were recorded as checkpoint-overhead phases.
+    kinds = [json.loads(line)["kind"] for line in
+             goodput_file.read_text().splitlines()]
+    assert gp.PROGRAM_CHECKPOINT_SAVE in kinds
+    assert gp.PROGRAM_CHECKPOINT_RESTORE in kinds

@@ -1,0 +1,93 @@
+"""Heimdall service discovery: file_sd target resolution from the
+monitor table + the goodput Prometheus gauge export."""
+
+import json
+import os
+
+from batch_shipyard_tpu.goodput import events as gp
+from batch_shipyard_tpu.monitor import heimdall
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+
+def _store_with_pool_nodes():
+    store = MemoryStateStore()
+    store.upsert_entity(names.TABLE_POOLS, "pools", "pool1",
+                        {"state": "ready"})
+    for i, ip in enumerate(("10.0.0.1", "10.0.0.2")):
+        store.upsert_entity(names.TABLE_NODES, "pool1", f"n{i}",
+                            {"state": "idle", "internal_ip": ip})
+    # A node with no ip yet (booting) must be skipped.
+    store.upsert_entity(names.TABLE_NODES, "pool1", "nboot",
+                        {"state": "creating"})
+    return store
+
+
+def test_file_sd_pool_targets(tmp_path):
+    store = _store_with_pool_nodes()
+    heimdall.add_pool_to_monitor(store, "pool1",
+                                 node_exporter_port=9100,
+                                 cadvisor_port=8080)
+    path = heimdall.write_file_sd(store, str(tmp_path))
+    assert os.path.basename(path) == "shipyard_targets.json"
+    groups = json.load(open(path, encoding="utf-8"))
+    by_job = {g["labels"]["job"]: g for g in groups}
+    assert by_job["node_exporter"]["targets"] == [
+        "10.0.0.1:9100", "10.0.0.2:9100"]
+    assert by_job["node_exporter"]["labels"][
+        "shipyard_pool"] == "pool1"
+    assert by_job["cadvisor"]["targets"] == [
+        "10.0.0.1:8080", "10.0.0.2:8080"]
+
+
+def test_file_sd_remotefs_targets(tmp_path):
+    store = MemoryStateStore()
+    heimdall.add_remotefs_to_monitor(store, "nfs1",
+                                     node_exporter_port=9100)
+    store.upsert_entity(names.TABLE_REMOTEFS_NODES, "nfs1", "server0",
+                        {"internal_ip": "10.1.0.9"})
+    groups = heimdall.build_file_sd_targets(store)
+    assert groups == [{
+        "targets": ["10.1.0.9:9100"],
+        "labels": {"job": "node_exporter",
+                   "shipyard_remotefs": "nfs1"}}]
+
+
+def test_deregistered_resource_disappears_on_next_poll(tmp_path):
+    store = _store_with_pool_nodes()
+    heimdall.add_pool_to_monitor(store, "pool1")
+    path = heimdall.write_file_sd(store, str(tmp_path))
+    assert json.load(open(path, encoding="utf-8"))
+    heimdall.remove_resource_from_monitor(store, "pool$pool1")
+    path = heimdall.write_file_sd(store, str(tmp_path))
+    assert json.load(open(path, encoding="utf-8")) == []
+    # Removing twice is a no-op, not an error.
+    heimdall.remove_resource_from_monitor(store, "pool$pool1")
+
+
+def test_goodput_prom_export(tmp_path):
+    import time as time_mod
+    store = _store_with_pool_nodes()
+    # Recent epochs: the export only sweeps the trailing window.
+    base = time_mod.time() - 200.0
+    gp.emit(store, "pool1", gp.PROGRAM_STEP_WINDOW, job_id="j1",
+            start=base, end=base + 75.0,
+            attrs={"step_start": 0, "step_end": 75})
+    gp.emit(store, "pool1", gp.PROGRAM_COMPILE, job_id="j1",
+            start=base + 75.0, end=base + 100.0)
+    # An ancient event outside the export window must not skew the
+    # gauges.
+    gp.emit(store, "pool1", gp.NODE_IDLE, node_id="n1",
+            start=base - 10 * 24 * 3600, end=base - 10 * 24 * 3600
+            + 5000)
+    path = heimdall.write_goodput_metrics(store, str(tmp_path))
+    assert os.path.basename(path) == "shipyard_goodput.prom"
+    text = open(path, encoding="utf-8").read()
+    assert 'goodput_ratio{pool="pool1"} 0.750000' in text
+    assert 'badput_seconds{pool="pool1",category="compile"} 25.000' \
+        in text
+    # Every category is always present for dashboard stability.
+    from batch_shipyard_tpu.goodput.accounting import (
+        BADPUT_CATEGORIES)
+    for category in BADPUT_CATEGORIES:
+        assert f'category="{category}"' in text

@@ -1,0 +1,70 @@
+"""Static consistency: every state-store table the package touches is
+declared in state/names.py — a new table (e.g. TABLE_GOODPUT) cannot
+be typo-forked into a parallel name nobody reads.
+
+Pure AST scan over batch_shipyard_tpu/**/*.py; cheap by design (no
+imports of the scanned modules, no JAX)."""
+
+import ast
+import pathlib
+
+from batch_shipyard_tpu.state import names
+
+PACKAGE = pathlib.Path(names.__file__).resolve().parent.parent
+
+# StateStore methods whose first argument is a table name.
+_TABLE_METHODS = {
+    "insert_entity", "upsert_entity", "merge_entity", "get_entity",
+    "query_entities", "delete_entity", "insert_entities",
+}
+
+_DECLARED_ATTRS = {attr for attr in dir(names)
+                   if attr.startswith("TABLE_")}
+_DECLARED_VALUES = {getattr(names, attr) for attr in _DECLARED_ATTRS}
+
+
+def _iter_package_sources():
+    for path in sorted(PACKAGE.rglob("*.py")):
+        yield path, ast.parse(path.read_text(encoding="utf-8"),
+                              filename=str(path))
+
+
+def test_declared_table_values_are_unique():
+    assert len(_DECLARED_VALUES) == len(_DECLARED_ATTRS), (
+        "two TABLE_* constants in state/names.py share a value")
+
+
+def test_every_table_literal_is_declared():
+    problems = []
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            # Any TABLE_* attribute/name reference must resolve to a
+            # declared constant.
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("TABLE_"):
+                if node.attr not in _DECLARED_ATTRS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: undeclared "
+                        f"{node.attr}")
+            # A string literal passed as the table argument of a
+            # store call must be a declared table VALUE.
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr in _TABLE_METHODS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str):
+                    if first.value not in _DECLARED_VALUES:
+                        problems.append(
+                            f"{rel}:{node.lineno}: table literal "
+                            f"{first.value!r} not declared in "
+                            f"state/names.py")
+    assert not problems, "\n".join(problems)
+
+
+def test_goodput_table_declared():
+    # The event log's table rides the same registry as every other
+    # coordination surface.
+    assert names.TABLE_GOODPUT == "goodput"
+    assert "TABLE_GOODPUT" in _DECLARED_ATTRS

@@ -18,8 +18,11 @@ import glob
 import json
 import os
 import pathlib
+import sys
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
 # Where the live bench artifacts (BENCH_DETAILS/LATEST, SILICON_PROOF,
 # KERNEL_VALIDATION) are read from; silicon_proof passes its --out-dir
 # so a non-repo-root run still renders ITS fresh numbers. Round
@@ -168,6 +171,43 @@ def _orchestration(out: list[str], data: dict) -> None:
     out.append("")
 
 
+def _goodput(out: list[str]) -> None:
+    """ML-productivity goodput section: always names goodput_ratio,
+    the three decomposition legs, and EVERY badput category (the
+    skeleton is the contract — a dry run renders the full shape with
+    unmeasured values)."""
+    from batch_shipyard_tpu.goodput.accounting import BADPUT_CATEGORIES
+    report = _load(ARTIFACTS / "GOODPUT_REPORT.json")
+    if report is None:
+        # Fall back to the silicon-proof phase's skeleton metrics.
+        proof = _load(ARTIFACTS / "SILICON_PROOF.json") or {}
+        phase = next((p for p in proof.get("phases", [])
+                      if p.get("phase") == "goodput"), None)
+        if phase is None:
+            return
+        report = phase.get("metrics") or {
+            "goodput_ratio": phase.get("goodput_ratio"),
+            "badput_seconds": phase.get("badput_seconds") or {}}
+    out.append("## Goodput decomposition\n")
+    out.append("ML Productivity Goodput (arxiv 2502.06982): "
+               "`goodput_ratio = availability x resource x program`, "
+               "with badput attributed per category "
+               "(`shipyard goodput pool`).\n")
+    out.append("| metric | value |")
+    out.append("|---|---|")
+    out.append(f"| goodput_ratio | "
+               f"{_fmt(report.get('goodput_ratio'), 3)} |")
+    for leg in ("availability_goodput", "resource_goodput",
+                "program_goodput"):
+        if leg in report:
+            out.append(f"| {leg} | {_fmt(report.get(leg), 3)} |")
+    badput = report.get("badput_seconds") or {}
+    for category in BADPUT_CATEGORIES:
+        out.append(f"| badput_seconds{{category=\"{category}\"}} | "
+                   f"{_fmt(badput.get(category), 2)} |")
+    out.append("")
+
+
 def _silicon_proof(out: list[str]) -> None:
     proof = _load(ARTIFACTS / "SILICON_PROOF.json")
     if not proof:
@@ -244,6 +284,7 @@ def render() -> str:
     _serving(out, "Serving, speculative decoding (paged KV)",
              details.get("serving_speculative_paged", {}))
     _orchestration(out, details.get("orchestration", {}))
+    _goodput(out)
     _silicon_proof(out)
     return "\n".join(out).rstrip() + "\n"
 
@@ -267,6 +308,4 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())

@@ -29,6 +29,10 @@ invokes this script on the first successful probe; it:
   6. serving_speculative — speculative continuous-batching serving
                       (dense + paged KV): tokens/s, TTFT/TPOT, and
                       the measured draft acceptance rate per variant.
+  7. goodput        — ML-productivity goodput decomposition of the
+                      bench pool's event log (goodput/accounting.py):
+                      goodput_ratio plus badput seconds per category,
+                      persisted as GOODPUT_REPORT.json.
 
 Every phase's outcome is recorded in SILICON_PROOF.json; --dry-run
 writes the complete report skeleton on CPU (each phase records the
@@ -305,6 +309,51 @@ class Pipeline:
                     "ok" if ok else "failed", rc=rc,
                     metrics=summary, output_tail=out[-800:])
 
+    def goodput(self) -> None:
+        """Decompose whatever goodput events the bench run's state
+        store accumulated into the paper's availability x resource x
+        program legs. The dry-run skeleton names goodput_ratio, each
+        decomposition leg, and every badput category so report
+        consumers (tools/benchgen.py) can bind to the shape on CPU."""
+        from batch_shipyard_tpu.goodput import accounting
+        skeleton = {
+            "goodput_ratio": None,
+            "availability_goodput": None,
+            "resource_goodput": None,
+            "program_goodput": None,
+            "badput_seconds": {category: None for category in
+                               accounting.BADPUT_CATEGORIES},
+        }
+        cmd = (f"{sys.executable} -m batch_shipyard_tpu.cli.main "
+               f"goodput pool --raw")
+        if self.dry:
+            self.record("goodput", "dry_run", command=cmd,
+                        metrics=skeleton)
+            return
+        try:
+            from batch_shipyard_tpu.state.memory import (
+                MemoryStateStore)
+            store_path = os.environ.get("SHIPYARD_BENCH_STORE")
+            if store_path:
+                from batch_shipyard_tpu.state.localfs import (
+                    LocalFSStateStore)
+                store = LocalFSStateStore(store_path)
+            else:
+                # No orchestrated pool in this bench run: nothing to
+                # account — record the honest empty decomposition.
+                store = MemoryStateStore()
+            report = accounting.fleet_report(store)
+            with open(self.out / "GOODPUT_REPORT.json", "w",
+                      encoding="utf-8") as fh:
+                json.dump(report, fh, indent=2)
+            self.record(
+                "goodput",
+                "ok" if report["wall_seconds"] > 0 else "no_events",
+                goodput_ratio=report["goodput_ratio"],
+                badput_seconds=report["badput_seconds"])
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            self.record("goodput", "failed", error=str(exc))
+
     # -- driver ----------------------------------------------------
     def run(self) -> int:
         started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -316,6 +365,7 @@ class Pipeline:
             winner = self.tuning_ab()
             self.final_bench(winner)
             self.serving_speculative()
+            self.goodput()
         report = {
             "started_at": started,
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
