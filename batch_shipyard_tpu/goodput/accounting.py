@@ -44,6 +44,14 @@ BADPUT_CATEGORIES = (
 
 PRODUCTIVE = "productive"
 
+# Overlapped categories: shown in the waterfall but NOT charged as
+# badput. checkpoint_async is the async save pipeline's background
+# persist — when live step windows cover it the time stays productive
+# (the sweep ranks it below PRODUCTIVE); only its uncovered tail (e.g.
+# the drain at loop exit) lands in this bucket. The partition
+# invariant extends to: productive + badput + overlapped == wall.
+OVERLAPPED_CATEGORIES = ("checkpoint_async",)
+
 # kind -> category. step_window is handled specially (fresh portion is
 # productive, replayed portion is preemption_recovery rework); retry is
 # instantaneous (counted, zero duration).
@@ -58,20 +66,26 @@ _KIND_CATEGORY = {
     ev.PROGRAM_WARMUP: "compile",
     ev.PROGRAM_CHECKPOINT_SAVE: "checkpoint",
     ev.PROGRAM_CHECKPOINT_RESTORE: "checkpoint",
+    ev.PROGRAM_CHECKPOINT_ASYNC: "checkpoint_async",
     ev.NODE_IDLE: "idle",
     ev.PROGRAM_STEP_WINDOW: PRODUCTIVE,
     ev.PROGRAM_EVAL: PRODUCTIVE,
     ev.TASK_RUNNING: "_running",         # container; lowest priority
 }
 
-# Decomposition legs: which categories each leg loses.
+# Decomposition legs: which categories each leg loses. The program
+# leg (compile/checkpoint/preemption_recovery plus any uncovered
+# overlapped persist) needs no tuple — it is whatever remains of run
+# time after productive, so program goodput is computed directly as
+# productive / run time.
 _SCHEDULING_BADPUT = ("provisioning", "queueing")
 _RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
-_PROGRAM_BADPUT = ("compile", "checkpoint", "preemption_recovery")
 
 # Sweep priority, highest first. SAME-PROGRAM overheads (rework,
 # checkpoint, compile — instrumented as phases nested inside the
 # program's own timeline) beat productive time; productive time beats
+# the async persist (overlapped-by-design: a background write under a
+# live step window must not erase the step's progress) which beats
 # CROSS-TASK waits (another task's queued/image-pull span overlapping
 # a busy node's step window is concurrency, not wasted node time —
 # ranking those above PRODUCTIVE would let one waiting task erase a
@@ -79,6 +93,7 @@ _PROGRAM_BADPUT = ("compile", "checkpoint", "preemption_recovery")
 # running container beats nothing (unaccounted).
 _PRIORITY = (
     "preemption_recovery", "checkpoint", "compile", PRODUCTIVE,
+    "checkpoint_async",
     "image_pull", "provisioning", "queueing", "idle", "_running",
 )
 _PRIORITY_RANK = {c: i for i, c in enumerate(_PRIORITY)}
@@ -163,6 +178,7 @@ def _sweep(intervals: list[tuple], wall_start: float,
     O(N log N) in the interval count — periodic consumers (the
     heimdall export) re-run this every poll, so no quadratic scans."""
     seconds = {c: 0.0 for c in BADPUT_CATEGORIES}
+    seconds.update({c: 0.0 for c in OVERLAPPED_CATEGORIES})
     seconds[PRODUCTIVE] = 0.0
     seconds["_running"] = 0.0
     boundary: list[tuple] = [(wall_start, 0, None), (wall_end, 0, None)]
@@ -256,20 +272,21 @@ def decompose(event_list: list[dict],
 
     seconds = _sweep(intervals, wall_start, wall_end)
     productive = seconds.pop(PRODUCTIVE)
+    overlapped = {c: seconds.pop(c) for c in OVERLAPPED_CATEGORIES}
     badput = {c: seconds[c] for c in BADPUT_CATEGORIES}
 
     sched = sum(badput[c] for c in _SCHEDULING_BADPUT)
     resource = sum(badput[c] for c in _RESOURCE_BADPUT)
-    program = sum(badput[c] for c in _PROGRAM_BADPUT)
     avail_time = max(0.0, wall_seconds - sched)
     run_time = max(0.0, avail_time - resource)
-    fresh_time = max(0.0, run_time - program)
-    # fresh_time == productive by construction (the sweep partitions
-    # wall); keep the arithmetic on the partition so the three legs
-    # multiply out to the headline ratio exactly.
+    # The program leg is productive over run time (run time includes
+    # both program badput AND any uncovered overlapped persist), so
+    # the three legs still multiply out to the headline ratio exactly
+    # — the sweep partitions wall into productive + badput +
+    # overlapped.
     availability = avail_time / wall_seconds if wall_seconds else 0.0
     resource_g = run_time / avail_time if avail_time else 0.0
-    program_g = fresh_time / run_time if run_time else 0.0
+    program_g = productive / run_time if run_time else 0.0
     return {
         "wall_seconds": wall_seconds,
         "productive_seconds": productive,
@@ -279,6 +296,7 @@ def decompose(event_list: list[dict],
         "resource_goodput": resource_g,
         "program_goodput": program_g,
         "badput_seconds": badput,
+        "overlapped_seconds": overlapped,
         "steps": steps,
         "tokens": tokens,
         "retries": retries,
@@ -294,6 +312,7 @@ def _empty_report() -> dict[str, Any]:
         "goodput_ratio": 0.0, "availability_goodput": 0.0,
         "resource_goodput": 0.0, "program_goodput": 0.0,
         "badput_seconds": {c: 0.0 for c in BADPUT_CATEGORIES},
+        "overlapped_seconds": {c: 0.0 for c in OVERLAPPED_CATEGORIES},
         "steps": 0, "tokens": 0, "retries": 0, "preemptions": 0,
         "events": 0, "window": None,
     }
@@ -315,6 +334,8 @@ def decompose_by_node(event_list: list[dict],
         groups.setdefault(event.get("node_id"), []).append(event)
     total = _empty_report()
     total["badput_seconds"] = {c: 0.0 for c in BADPUT_CATEGORIES}
+    total["overlapped_seconds"] = {c: 0.0
+                                   for c in OVERLAPPED_CATEGORIES}
     for group in groups.values():
         starts = [float(e.get("start", 0.0)) for e in group]
         ends = [float(e.get("end", e.get("start", 0.0)))
@@ -327,6 +348,8 @@ def decompose_by_node(event_list: list[dict],
         total["productive_seconds"] += sub["productive_seconds"]
         for category, value in sub["badput_seconds"].items():
             total["badput_seconds"][category] += value
+        for category, value in sub["overlapped_seconds"].items():
+            total["overlapped_seconds"][category] += value
         for key in ("steps", "tokens", "retries", "preemptions",
                     "events"):
             total[key] += sub[key]
@@ -406,6 +429,7 @@ def fleet_report(store: StateStore,
     total_wall = 0.0
     total_productive = 0.0
     badput = {c: 0.0 for c in BADPUT_CATEGORIES}
+    overlapped = {c: 0.0 for c in OVERLAPPED_CATEGORIES}
     for row in store.query_entities(names.TABLE_POOLS,
                                     partition_key="pools"):
         pool_id = row["_rk"]
@@ -417,6 +441,9 @@ def fleet_report(store: StateStore,
         total_productive += report["productive_seconds"]
         for category, value in report["badput_seconds"].items():
             badput[category] += value
+        for category, value in report.get(
+                "overlapped_seconds", {}).items():
+            overlapped[category] += value
     sched = sum(badput[c] for c in _SCHEDULING_BADPUT)
     resource = sum(badput[c] for c in _RESOURCE_BADPUT)
     avail = max(0.0, total_wall - sched)
@@ -433,6 +460,7 @@ def fleet_report(store: StateStore,
         "program_goodput": (total_productive / run
                             if run else 0.0),
         "badput_seconds": badput,
+        "overlapped_seconds": overlapped,
     }
 
 
@@ -440,7 +468,10 @@ def fleet_report(store: StateStore,
 
 def waterfall_table(report: dict[str, Any]) -> str:
     """Badput waterfall: productive first, then every category,
-    summing to wall clock."""
+    summing to wall clock. Overlapped categories (the async
+    checkpoint persist) render as their own ``~``-marked rows: shown,
+    but not badput — the covered portion is already inside
+    productive, and only the uncovered tail carries seconds here."""
     wall = report.get("wall_seconds") or 0.0
 
     def pct(value: float) -> str:
@@ -454,6 +485,17 @@ def waterfall_table(report: dict[str, Any]) -> str:
     for category in BADPUT_CATEGORIES:
         value = report.get("badput_seconds", {}).get(category, 0.0)
         lines.append(f"{category:<22}{value:>12.2f}  {pct(value)}")
+    # Rows render only when overlapped time exists — a sync-only
+    # job's waterfall is unchanged.
+    shown = [(category, report.get("overlapped_seconds", {}).get(
+        category, 0.0)) for category in OVERLAPPED_CATEGORIES]
+    shown = [(c, v) for c, v in shown if v > 0.0]
+    for category, value in shown:
+        lines.append(f"{'~' + category:<22}{value:>12.2f}  "
+                     f"{pct(value)}")
+    if shown:
+        lines.append("(~ overlapped persist: not badput; covered "
+                     "portions already count as productive)")
     lines.append("-" * 42)
     lines.append(f"{'wall':<22}{wall:>12.2f}  {pct(wall)}")
     lines.append(
@@ -480,10 +522,16 @@ def prometheus_lines(report: dict[str, Any],
         f"goodput_productive_seconds{{{label_str}}} "
         f"{report.get('productive_seconds', 0.0):.3f}",
     ]
+    sep = "," if label_str else ""
     for category in BADPUT_CATEGORIES:
         value = report.get("badput_seconds", {}).get(category, 0.0)
-        sep = "," if label_str else ""
         lines.append(
             f"badput_seconds{{{label_str}{sep}"
+            f'category="{category}"}} {value:.3f}')
+    for category in OVERLAPPED_CATEGORIES:
+        value = report.get("overlapped_seconds", {}).get(category,
+                                                         0.0)
+        lines.append(
+            f"goodput_overlapped_seconds{{{label_str}{sep}"
             f'category="{category}"}} {value:.3f}')
     return lines

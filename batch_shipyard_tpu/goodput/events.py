@@ -65,6 +65,11 @@ PROGRAM_STEP_WINDOW = "step_window"    # productive steps; attrs carry
                                        # step_start/step_end/tokens
 PROGRAM_CHECKPOINT_SAVE = "checkpoint_save"
 PROGRAM_CHECKPOINT_RESTORE = "checkpoint_restore"
+# Overlapped persist of the async save pipeline
+# (workloads/checkpoint.AsyncCheckpointManager): runs in a background
+# writer thread UNDER live step windows, so the accounting sweep
+# scores it productive-overlapped rather than checkpoint badput.
+PROGRAM_CHECKPOINT_ASYNC = "checkpoint_async"
 PROGRAM_EVAL = "eval"
 
 EVENT_KINDS = frozenset({
@@ -72,7 +77,8 @@ EVENT_KINDS = frozenset({
     TASK_QUEUED, TASK_IMAGE_PULL, TASK_CONTAINER_START, TASK_RUNNING,
     TASK_RETRY,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
-    PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE, PROGRAM_EVAL,
+    PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
+    PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,
 })
 
 

@@ -142,6 +142,10 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "# HELP goodput_productive_seconds Wall-clock seconds of "
         "fresh training/serving progress.",
         "# TYPE goodput_productive_seconds gauge",
+        "# HELP goodput_overlapped_seconds Background work (async "
+        "checkpoint persist) not covered by productive windows; "
+        "shown, not charged as badput.",
+        "# TYPE goodput_overlapped_seconds gauge",
     ]
     for pool in store.query_entities(names.TABLE_POOLS,
                                      partition_key="pools"):

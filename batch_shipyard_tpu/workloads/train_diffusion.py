@@ -19,6 +19,7 @@ import numpy as np
 from batch_shipyard_tpu.models import diffusion as dif_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import checkpoint
 from batch_shipyard_tpu.workloads import distributed
 
 
@@ -36,6 +37,7 @@ def main() -> int:
     parser.add_argument("--sample", type=int, default=0,
                         help="generate N DDIM samples at the end")
     parser.add_argument("--sample-steps", type=int, default=50)
+    checkpoint.add_checkpoint_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -61,16 +63,22 @@ def main() -> int:
             0, args.num_classes, (local_batch,)).astype(np.int32)
     batch = loader.place_global(batch, harness.batch_sharding)
     params, opt_state = harness.params, harness.opt_state
+    ckpt = checkpoint.TrainCheckpointer.from_args(args)
+    params, opt_state, start_step = ckpt.restore(params, opt_state)
+    if start_step:
+        distributed.log(ctx, f"resumed from step {start_step}")
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)
         float(metrics["loss"])  # hard sync
     start = time.perf_counter()
-    for _ in range(args.steps):
+    for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)
+        ckpt.step_save(step_num + 1, params, opt_state)
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
+    ckpt.finalize(start_step + args.steps, params, opt_state)
     images_per_sec = batch_size * args.steps / elapsed
     distributed.log(ctx, (
         f"dit: mesh={dict(mesh.shape)} {images_per_sec:.1f} img/s "

@@ -22,6 +22,7 @@ import numpy as np
 from batch_shipyard_tpu.models import resnet as resnet_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import checkpoint
 from batch_shipyard_tpu.workloads import distributed
 
 
@@ -37,6 +38,7 @@ def main() -> int:
                              "arrays (staged via input_data or a "
                              "gcsfuse mount); synthetic when omitted")
     parser.add_argument("--prefetch", type=int, default=2)
+    checkpoint.add_checkpoint_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -81,16 +83,22 @@ def main() -> int:
         }, harness.batch_sharding)
         batches = loader.synthetic_batches(lambda step: synthetic)
     params, opt_state = harness.params, harness.opt_state
+    ckpt = checkpoint.TrainCheckpointer.from_args(args)
+    params, opt_state, start_step = ckpt.restore(params, opt_state)
+    if start_step:
+        distributed.log(ctx, f"resumed from step {start_step}")
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   next(batches))
         float(metrics["loss"])  # hard sync
     start = time.perf_counter()
-    for _ in range(args.steps):
+    for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   next(batches))
+        ckpt.step_save(step_num + 1, params, opt_state)
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
+    ckpt.finalize(start_step + args.steps, params, opt_state)
     images_per_sec = batch_size * args.steps / elapsed
     distributed.log(ctx, (
         f"resnet50: {images_per_sec:.1f} img/s total, "

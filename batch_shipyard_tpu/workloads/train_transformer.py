@@ -22,6 +22,7 @@ import numpy as np
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import checkpoint
 from batch_shipyard_tpu.workloads import distributed
 
 
@@ -50,11 +51,7 @@ def main() -> int:
                         help="int8 MXU matmuls for projections/MLP "
                              "(QAT straight-through backward)")
     parser.add_argument("--no-remat", action="store_true")
-    parser.add_argument("--checkpoint-dir", default=None,
-                        help="Orbax checkpoint dir (use the job "
-                             "shared dir or a gcsfuse mount on pools)")
-    parser.add_argument("--checkpoint-every", type=int, default=0,
-                        help="Save every N steps (0 = only at end)")
+    checkpoint.add_checkpoint_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -89,14 +86,10 @@ def main() -> int:
             np.int32),
     }, harness.batch_sharding)
     params, opt_state = harness.params, harness.opt_state
-    start_step = 0
-    if args.checkpoint_dir:
-        from batch_shipyard_tpu.workloads import checkpoint
-        restored = checkpoint.restore(args.checkpoint_dir, params,
-                                      opt_state)
-        if restored is not None:
-            params, opt_state, start_step = restored
-            distributed.log(ctx, f"resumed from step {start_step}")
+    ckpt = checkpoint.TrainCheckpointer.from_args(args)
+    params, opt_state, start_step = ckpt.restore(params, opt_state)
+    if start_step:
+        distributed.log(ctx, f"resumed from step {start_step}")
     # Goodput program phases: the warm-up loop is jit compile time
     # (compile badput); the measured loop is the productive step
     # window, stamped with step + token counters so the accounting
@@ -129,20 +122,19 @@ def main() -> int:
     for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params,
                                                   opt_state, batch)
-        if args.checkpoint_dir and args.checkpoint_every and (
-                (step_num + 1) % args.checkpoint_every == 0):
+        if ckpt.due(step_num + 1):
             _flush_window(step_num + 1)
-            from batch_shipyard_tpu.workloads import checkpoint
-            checkpoint.save(args.checkpoint_dir, step_num + 1,
-                            params, opt_state)
+            # Sync: pays the whole persist here (checkpoint badput).
+            # --async-checkpoint: pays only the snapshot; the persist
+            # overlaps the next steps' windows.
+            ckpt.step_save(step_num + 1, params, opt_state)
             window["time"] = time.time()  # save span is not steps
     loss = float(metrics["loss"])  # hard sync before the final flush
     _flush_window(start_step + args.steps)
     elapsed = time.perf_counter() - start
-    if args.checkpoint_dir:
-        from batch_shipyard_tpu.workloads import checkpoint
-        checkpoint.save(args.checkpoint_dir, start_step + args.steps,
-                        params, opt_state)
+    # Exit save dedups against the loop's cadenced save of the same
+    # step, then drains any in-flight async persist.
+    ckpt.finalize(start_step + args.steps, params, opt_state)
     tokens_per_sec = args.batch * args.seq_len * args.steps / elapsed
     distributed.log(ctx, (
         f"transformer: mesh={dict(mesh.shape)} "

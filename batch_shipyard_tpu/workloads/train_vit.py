@@ -20,6 +20,7 @@ import numpy as np
 from batch_shipyard_tpu.models import vit as vit_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
+from batch_shipyard_tpu.workloads import checkpoint
 from batch_shipyard_tpu.workloads import distributed
 
 
@@ -34,6 +35,7 @@ def main() -> int:
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--warmup", type=int, default=3)
+    checkpoint.add_checkpoint_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -60,16 +62,22 @@ def main() -> int:
             np.int32),
     }, harness.batch_sharding)
     params, opt_state = harness.params, harness.opt_state
+    ckpt = checkpoint.TrainCheckpointer.from_args(args)
+    params, opt_state, start_step = ckpt.restore(params, opt_state)
+    if start_step:
+        distributed.log(ctx, f"resumed from step {start_step}")
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)
         float(metrics["loss"])  # hard sync
     start = time.perf_counter()
-    for _ in range(args.steps):
+    for step_num in range(start_step, start_step + args.steps):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)
+        ckpt.step_save(step_num + 1, params, opt_state)
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
+    ckpt.finalize(start_step + args.steps, params, opt_state)
     images_per_sec = batch_size * args.steps / elapsed
     distributed.log(ctx, (
         f"vit: mesh={dict(mesh.shape)} {images_per_sec:.1f} img/s "

@@ -332,6 +332,62 @@ def bench_serving_fleet(num_replicas: int = 2,
             f.shutdown()
 
 
+def bench_checkpoint_overhead(num_saves: int = 3,
+                              payload_mb: int = 64) -> dict:
+    """Checkpoint stall phase: blocking ms/save of the sync
+    full-durability save vs the async double-buffered pipeline
+    (workloads/checkpoint.AsyncCheckpointManager) on a synthetic
+    large pytree. The async number is the snapshot-only cost the
+    training loop actually pays; the persist overlaps subsequent
+    steps (goodput scores it PROGRAM_CHECKPOINT_ASYNC, docs/28).
+    The drain between timed async saves keeps the depth-1 queue
+    bound out of the measurement — each sample is a clean
+    snapshot+enqueue."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from batch_shipyard_tpu.workloads import checkpoint
+
+    n_arrays = 8
+    elems = payload_mb * 1024 * 1024 // 4 // n_arrays
+    rng = np.random.RandomState(0)
+    params = {f"w{i}": jnp.asarray(
+        rng.randn(elems).astype(np.float32)) for i in range(n_arrays)}
+    opt_state = {f"m{i}": jnp.zeros((elems,), jnp.float32)
+                 for i in range(n_arrays)}
+    tmp = tempfile.mkdtemp(prefix="shipyard-ckpt-bench-")
+    try:
+        sync_ms = []
+        for i in range(num_saves):
+            t0 = time.perf_counter()
+            checkpoint.save(os.path.join(tmp, "sync"), i + 1,
+                            params, opt_state)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        async_ms = []
+        with checkpoint.AsyncCheckpointManager(
+                os.path.join(tmp, "async")) as manager:
+            for i in range(num_saves):
+                t0 = time.perf_counter()
+                manager.save(i + 1, params, opt_state)
+                async_ms.append((time.perf_counter() - t0) * 1e3)
+                manager.wait_until_finished()
+        sync_best = min(sync_ms)
+        async_best = min(async_ms)
+        return {
+            "payload_mb": payload_mb,
+            "saves": num_saves,
+            "sync_blocking_ms_per_save": round(sync_best, 2),
+            "async_blocking_ms_per_save": round(async_best, 2),
+            "blocking_speedup": (round(sync_best / async_best, 2)
+                                 if async_best else None),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -483,9 +539,10 @@ def main(argv: list[str] | None = None) -> int:
         "--workloads", default="resnet,transformer,serving,"
         "orchestration",
         help="comma-separated subset to run (resnet, transformer, "
-        "serving, serving_speculative, orchestration; "
-        "serving_speculative is opt-in — the silicon-proof pipeline "
-        "runs it as its own phase)")
+        "serving, serving_speculative, checkpoint_overhead, "
+        "orchestration; serving_speculative and checkpoint_overhead "
+        "are opt-in — the silicon-proof pipeline runs each as its "
+        "own phase)")
     parser.add_argument(
         "--quick", action="store_true",
         help="fewer timed iterations (tuning A/B mode)")
@@ -614,6 +671,14 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["serving_speculative_paged"] = {
                 "error": str(exc)}
+    if "checkpoint_overhead" in workloads:
+        # Opt-in (the silicon-proof checkpoint_overhead phase): sync
+        # vs async blocking ms/save on a synthetic large pytree.
+        try:
+            details["checkpoint_overhead"] = bench_checkpoint_overhead(
+                payload_mb=16 if args.quick else 64)
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["checkpoint_overhead"] = {"error": str(exc)}
     if "orchestration" in workloads:
         try:
             details["orchestration"] = bench_orchestration_latency()

@@ -1,0 +1,374 @@
+"""Zero-stall async checkpointing (workloads/checkpoint.py):
+equivalence with the sync path, blocking-time win, crash safety of
+the background writer, retention GC invariants, the stale-step save
+guard, the shared TrainCheckpointer driver, and the fakepod e2e
+goodput attribution of the overlapped persist.
+
+Everything runs on CPU with small pytrees; the "large" pytree for the
+blocking-time measurement is a few MB — big enough that Orbax's
+serialize+fsync dominates the device→host snapshot by orders of
+magnitude, small enough to keep the test in the tier-1 budget."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.goodput import events as gp
+from batch_shipyard_tpu.workloads import checkpoint
+
+pytest.importorskip("orbax.checkpoint")
+
+
+def _tree(seed: int, elems: int = 64):
+    rng = np.random.RandomState(seed)
+    params = {"w1": rng.randn(elems).astype(np.float32),
+              "w2": rng.randn(2, elems).astype(np.float32)}
+    opt = {"m": np.zeros((elems,), np.float32),
+           "count": np.full((1,), seed, np.int32)}
+    return params, opt
+
+
+def _commit_fake(ckpt_dir, step):
+    """A committed checkpoint shell (dir + marker) without paying an
+    Orbax write — for pure protocol/retention tests."""
+    os.makedirs(os.path.join(str(ckpt_dir), f"step_{step:08d}"),
+                exist_ok=True)
+    marker = os.path.join(str(ckpt_dir),
+                          f"step_{step:08d}." + checkpoint.COMMIT_MARKER)
+    with open(marker, "w", encoding="utf-8") as fh:
+        fh.write("ts")
+
+
+# ------------------------- sync/async equivalence ----------------------
+
+def test_async_save_restores_identical_state(tmp_path):
+    params, opt = _tree(1)
+    sync_dir = str(tmp_path / "sync")
+    async_dir = str(tmp_path / "async")
+    assert checkpoint.save(sync_dir, 1, params, opt) is not None
+    with checkpoint.AsyncCheckpointManager(async_dir) as manager:
+        assert manager.save(1, params, opt) is not None
+        manager.wait_until_finished()
+        assert checkpoint.latest_step(async_dir) == 1
+        assert checkpoint.is_committed(async_dir, 1)
+        r_sync = checkpoint.restore(sync_dir, params, opt)
+        r_async = manager.restore(params, opt)
+    assert r_sync is not None and r_async is not None
+    assert r_sync[2] == r_async[2] == 1
+    for tree_s, tree_a in ((r_sync[0], r_async[0]),
+                           (r_sync[1], r_async[1])):
+        import jax
+        leaves_s = jax.tree_util.tree_leaves(tree_s)
+        leaves_a = jax.tree_util.tree_leaves(tree_a)
+        assert len(leaves_s) == len(leaves_a)
+        for leaf_s, leaf_a in zip(leaves_s, leaves_a):
+            np.testing.assert_array_equal(np.asarray(leaf_s),
+                                          np.asarray(leaf_a))
+
+
+def test_async_blocking_time_beats_sync(tmp_path):
+    """The acceptance criterion: per-save blocking time of the async
+    pipeline (snapshot + enqueue) is strictly less than a full sync
+    save of the same synthetic large pytree."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    params = {f"w{i}": jnp.asarray(
+        rng.randn(128, 1024).astype(np.float32)) for i in range(4)}
+    opt = {f"m{i}": jnp.zeros((128, 1024), jnp.float32)
+           for i in range(4)}
+    sync_ms = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        checkpoint.save(str(tmp_path / "sync"), i + 1, params, opt)
+        sync_ms.append((time.perf_counter() - t0) * 1e3)
+    async_ms = []
+    with checkpoint.AsyncCheckpointManager(
+            str(tmp_path / "async")) as manager:
+        for i in range(2):
+            t0 = time.perf_counter()
+            manager.save(i + 1, params, opt)
+            async_ms.append((time.perf_counter() - t0) * 1e3)
+            # Drain OUTSIDE the timed region: each sample measures a
+            # clean snapshot+enqueue, not the depth-1 queue wait.
+            manager.wait_until_finished()
+    assert min(async_ms) < min(sync_ms)
+    assert checkpoint.latest_step(str(tmp_path / "async")) == 2
+
+
+# ------------------------------ crash safety ---------------------------
+
+def test_failed_background_save_reraises_and_keeps_latest(
+        tmp_path, monkeypatch):
+    """Writer dies mid-persist: the failure re-raises at the next
+    drain/enqueue, latest_step still answers the previous committed
+    step, and the torn staging dir is never pickable."""
+    params, opt = _tree(2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    assert checkpoint.save(ckpt_dir, 1, params, opt) is not None
+
+    class BoomCheckpointer:
+        def save(self, path, state, force=True):
+            # Fault-injected filesystem error mid-write: staging dir
+            # exists with partial contents when the failure hits.
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "partial"), "w") as fh:
+                fh.write("torn")
+            raise OSError("disk gone")
+
+    with checkpoint.AsyncCheckpointManager(ckpt_dir) as manager:
+        monkeypatch.setattr(checkpoint, "_checkpointer",
+                            BoomCheckpointer)
+        assert manager.save(2, params, opt) is not None  # enqueued
+        with pytest.raises(OSError, match="disk gone"):
+            manager.wait_until_finished()
+        # Disk truth is intact: previous committed step still wins,
+        # the torn staging dir is invisible.
+        assert checkpoint.latest_step(ckpt_dir) == 1
+        assert not checkpoint.is_committed(ckpt_dir, 2)
+        # Failure also surfaces at the next ENQUEUE: save 3 fails in
+        # the background, save 4 re-raises before enqueueing on top
+        # of the hole.
+        assert manager.save(3, params, opt) is not None
+        with pytest.raises(OSError, match="disk gone"):
+            manager.save(4, params, opt)
+        # After the raise the failed step is retryable (the guard
+        # fell back to disk truth), and a healed filesystem persists
+        # it durably. Restores resume from the last DURABLE step
+        # until then.
+        monkeypatch.undo()
+        restored = checkpoint.restore(ckpt_dir, params, opt)
+        assert restored is not None and restored[2] == 1
+        assert manager.save(2, params, opt) is not None
+        manager.wait_until_finished()
+    assert checkpoint.latest_step(ckpt_dir) == 2
+
+
+# ------------------------------- retention -----------------------------
+
+def test_retention_gc_keeps_newest_and_inflight(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    for step in (1, 2, 3, 4):
+        _commit_fake(ckpt, step)
+    staging = ckpt / ".tmp_step_00000005"
+    staging.mkdir()
+    removed = checkpoint.retention_gc(str(ckpt), keep_last=2)
+    assert removed == [1, 2]
+    assert checkpoint.latest_step(str(ckpt)) == 4
+    assert checkpoint.is_committed(str(ckpt), 3)
+    assert not checkpoint.is_committed(str(ckpt), 1)
+    assert staging.is_dir()  # in-flight staging never touched
+    # keep_last >= count: nothing to do.
+    assert checkpoint.retention_gc(str(ckpt), keep_last=10) == []
+    assert checkpoint.latest_step(str(ckpt)) == 4
+
+
+def test_retention_gc_spares_legacy_unmarked_dirs(tmp_path):
+    """Pre-marker dirs cannot be proven durable, so retention must
+    never delete them (they may be a fleet's only resume points)."""
+    legacy = tmp_path / "legacy"
+    (legacy / "step_00000005").mkdir(parents=True)
+    (legacy / "step_00000009").mkdir()
+    assert checkpoint.retention_gc(str(legacy), keep_last=1) == []
+    assert checkpoint.latest_step(str(legacy)) == 9
+
+
+def test_async_manager_runs_retention_in_writer(tmp_path):
+    params, opt = _tree(3)
+    ckpt_dir = str(tmp_path / "ckpt")
+    with checkpoint.AsyncCheckpointManager(ckpt_dir,
+                                           keep_last=2) as manager:
+        for step in (1, 2, 3):
+            manager.save(step, params, opt)
+        manager.wait_until_finished()
+    assert checkpoint.latest_step(ckpt_dir) == 3
+    assert checkpoint.is_committed(ckpt_dir, 2)
+    assert not checkpoint.is_committed(ckpt_dir, 1)
+    assert not os.path.isdir(os.path.join(ckpt_dir, "step_00000001"))
+
+
+# ------------------------------ save guard -----------------------------
+
+def test_sync_save_guard_skips_stale_step(tmp_path):
+    params, opt = _tree(4)
+    ckpt_dir = str(tmp_path / "ckpt")
+    assert checkpoint.save(ckpt_dir, 5, params, opt) is not None
+    # Re-saving the restore point (or older) burns a full save for
+    # nothing: log and skip.
+    assert checkpoint.save(ckpt_dir, 5, params, opt) is None
+    assert checkpoint.save(ckpt_dir, 3, params, opt) is None
+    assert checkpoint.save(ckpt_dir, 5, params, opt,
+                           force=True) is not None
+    assert checkpoint.save(ckpt_dir, 6, params, opt) is not None
+    assert checkpoint.latest_step(ckpt_dir) == 6
+
+
+def test_async_save_guard_covers_inflight_steps(tmp_path):
+    params, opt = _tree(5)
+    ckpt_dir = str(tmp_path / "ckpt")
+    with checkpoint.AsyncCheckpointManager(ckpt_dir) as manager:
+        assert manager.save(7, params, opt) is not None
+        # Same step again while (possibly) still in flight: skipped
+        # without waiting on the queue.
+        assert manager.save(7, params, opt) is None
+        assert manager.save(6, params, opt) is None
+        manager.wait_until_finished()
+        assert manager.save(7, params, opt) is None  # now committed
+    assert checkpoint.latest_step(ckpt_dir) == 7
+
+
+def test_train_checkpointer_finalize_dedups_final_save(
+        tmp_path, monkeypatch):
+    """The duplicate-final-save fix: when steps %% checkpoint_every
+    == 0 the loop's cadenced save already committed the final step —
+    the exit save must be skipped, sync and async alike."""
+    persists = []
+    real_persist = checkpoint._persist_state
+
+    def counting_persist(ckpt_dir, step, state):
+        persists.append(step)
+        return real_persist(ckpt_dir, step, state)
+
+    monkeypatch.setattr(checkpoint, "_persist_state",
+                        counting_persist)
+    params, opt = _tree(6)
+    for name, use_async in (("sync", False), ("async", True)):
+        persists.clear()
+        tc = checkpoint.TrainCheckpointer(
+            str(tmp_path / name), every=2, use_async=use_async)
+        for step_num in range(4):
+            tc.step_save(step_num + 1, params, opt)
+        tc.finalize(4, params, opt)
+        assert persists == [2, 4], name
+        assert checkpoint.latest_step(str(tmp_path / name)) == 4
+    # Off-cadence end (5 steps, every=2): finalize DOES save step 5.
+    persists.clear()
+    tc = checkpoint.TrainCheckpointer(str(tmp_path / "odd"), every=2,
+                                      use_async=True)
+    for step_num in range(5):
+        tc.step_save(step_num + 1, params, opt)
+    tc.finalize(5, params, opt)
+    assert persists == [2, 4, 5]
+
+
+def test_train_checkpointer_restore_roundtrip(tmp_path):
+    params, opt = _tree(7)
+    ckpt_dir = str(tmp_path / "ckpt")
+    tc = checkpoint.TrainCheckpointer(ckpt_dir, every=0,
+                                      use_async=True)
+    p, o, start = tc.restore(params, opt)
+    assert start == 0 and p is params  # nothing committed yet
+    tc.finalize(9, params, opt)
+    tc2 = checkpoint.TrainCheckpointer(ckpt_dir, use_async=True)
+    p2, _o2, start2 = tc2.restore(params, opt)
+    assert start2 == 9
+    np.testing.assert_array_equal(np.asarray(p2["w1"]), params["w1"])
+    tc2.finalize(9, params, opt)  # guard: no duplicate write
+    disabled = checkpoint.TrainCheckpointer(None)
+    assert disabled.restore(params, opt) == (params, opt, 0)
+    assert not disabled.due(10)
+    disabled.finalize(10, params, opt)  # no-op
+
+
+# ------------------- goodput attribution (events) ----------------------
+
+def test_async_save_emits_snapshot_and_async_phases(
+        tmp_path, monkeypatch):
+    goodput_file = tmp_path / "gp.jsonl"
+    monkeypatch.setenv(gp.GOODPUT_FILE_ENV, str(goodput_file))
+    params, opt = _tree(8)
+    with checkpoint.AsyncCheckpointManager(
+            str(tmp_path / "ckpt")) as manager:
+        manager.save(1, params, opt)
+        manager.wait_until_finished()
+    events = [json.loads(line) for line in
+              goodput_file.read_text().splitlines()]
+    by_kind = {e["kind"]: e for e in events}
+    assert gp.PROGRAM_CHECKPOINT_SAVE in by_kind
+    assert gp.PROGRAM_CHECKPOINT_ASYNC in by_kind
+    snapshot = by_kind[gp.PROGRAM_CHECKPOINT_SAVE]
+    persist = by_kind[gp.PROGRAM_CHECKPOINT_ASYNC]
+    assert snapshot["attrs"].get("mode") == "snapshot"
+    # The persist STARTS inside/at the blocking snapshot (enqueue)
+    # and runs past it in the background.
+    assert persist["end"] >= snapshot["start"]
+
+
+# --------------------------- e2e on fakepod ----------------------------
+
+@pytest.fixture()
+def fakepod_env():
+    from batch_shipyard_tpu.config import settings as settings_mod
+    from batch_shipyard_tpu.pool import manager as pool_mgr
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    conf = {"pool_specification": {
+        "id": "pool1", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16", "num_slices": 1},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool,
+                         settings_mod.global_settings({}), conf)
+    yield store, substrate, pool
+    substrate.stop_all()
+
+
+def test_e2e_async_checkpoint_badput_is_snapshot_only(fakepod_env):
+    """The acceptance run: a fakepod job whose payload records a step
+    window, a snapshot-only checkpoint_save, and an overlapped
+    checkpoint_async persist whose tail outlives the window. The
+    report must charge ONLY the snapshot as checkpoint badput, show
+    the persist in the overlapped bucket, and still partition wall
+    clock within 1%."""
+    from batch_shipyard_tpu.config import settings as settings_mod
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    store, substrate, pool = fakepod_env
+    payload = (
+        "python3 -c \"import json,os,time; t=time.time(); "
+        "fh=open(os.environ['SHIPYARD_GOODPUT_FILE'],'a'); "
+        "w=lambda k,s,e,a: fh.write(json.dumps({'kind':k,'start':s,"
+        "'end':e,'attrs':a})+chr(10)); "
+        "w('step_window',t,t+0.30,{'step_start':0,'step_end':30,"
+        "'tokens':300}); "
+        "w('checkpoint_save',t+0.10,t+0.11,{'step':10,"
+        "'mode':'snapshot'}); "
+        "w('checkpoint_async',t+0.11,t+0.40,{'step':10}); "
+        "fh.close(); time.sleep(0.1)\"")
+    jobs_mgr.add_jobs(store, pool, settings_mod.job_settings_list(
+        {"job_specifications": [{
+            "id": "jasync", "tasks": [{"command": payload}]}]}))
+    tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jasync",
+                                    timeout=30)
+    assert tasks[0]["state"] == "completed"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        kinds = {e["kind"] for e in gp.query(store, "pool1",
+                                             job_id="jasync")}
+        if gp.PROGRAM_CHECKPOINT_ASYNC in kinds:
+            break
+        time.sleep(0.1)
+    assert gp.PROGRAM_CHECKPOINT_ASYNC in kinds
+    assert gp.PROGRAM_CHECKPOINT_SAVE in kinds
+    report = accounting.job_report(store, "pool1", "jasync")
+    # Checkpoint badput is the snapshot ONLY — the overlapped persist
+    # is not a stall.
+    assert report["badput_seconds"]["checkpoint"] == pytest.approx(
+        0.01, abs=0.005)
+    # The persist's window-covered part stayed productive; its tail
+    # past the step window is the overlapped bucket.
+    assert report["overlapped_seconds"][
+        "checkpoint_async"] == pytest.approx(0.10, abs=0.02)
+    # Partition stays exact within 1%.
+    total = (report["productive_seconds"]
+             + sum(report["badput_seconds"].values())
+             + sum(report["overlapped_seconds"].values()))
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+    table = accounting.waterfall_table(report)
+    assert "~checkpoint_async" in table

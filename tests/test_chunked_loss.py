@@ -158,7 +158,8 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     names = [p["phase"] for p in report["phases"]]
     assert names == ["probe", "kernel_checks", "flash_flip",
                      "tuning_ab", "final_bench",
-                     "serving_speculative"]
+                     "serving_speculative", "checkpoint_overhead",
+                     "goodput"]
     assert all(p["status"] == "dry_run" for p in report["phases"])
     # The speculative serving phase's skeleton names every metric it
     # will emit, for both KV layouts.

@@ -268,6 +268,47 @@ def test_step_counters_ignore_replayed_steps_in_totals():
     assert report["productive_seconds"] == pytest.approx(10.0)
 
 
+def test_async_checkpoint_overlap_not_charged_as_badput():
+    """Zero-stall checkpointing attribution: the blocking snapshot is
+    checkpoint badput; the overlapped background persist under a live
+    step window stays productive, and only its uncovered tail lands
+    in the overlapped bucket — never in badput. Categories (incl.
+    overlapped) still partition wall clock exactly."""
+    events = [
+        _ev(gp.PROGRAM_STEP_WINDOW, 0.0, 100.0),
+        _ev(gp.PROGRAM_CHECKPOINT_SAVE, 40.0, 42.0, step=50,
+            mode="snapshot"),
+        # Persist overlaps the rest of the window, tail past it.
+        _ev(gp.PROGRAM_CHECKPOINT_ASYNC, 42.0, 110.0, step=50),
+    ]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"]["checkpoint"] == pytest.approx(
+        2.0)
+    assert report["productive_seconds"] == pytest.approx(98.0)
+    assert report["overlapped_seconds"][
+        "checkpoint_async"] == pytest.approx(10.0)
+    # Partition: productive + badput + overlapped == wall, exactly.
+    total = (report["productive_seconds"]
+             + sum(report["badput_seconds"].values())
+             + sum(report["overlapped_seconds"].values()))
+    assert total == pytest.approx(report["wall_seconds"])
+    assert report["wall_seconds"] == pytest.approx(110.0)
+    # The three legs still multiply out to the headline ratio.
+    assert (report["availability_goodput"]
+            * report["resource_goodput"]
+            * report["program_goodput"]) == pytest.approx(
+        report["goodput_ratio"])
+    # Waterfall renders the overlapped row distinctly, outside the
+    # badput set.
+    table = accounting.waterfall_table(report)
+    assert "~checkpoint_async" in table
+    assert "not badput" in table
+    lines = accounting.prometheus_lines(report, {"pool": "p1"})
+    assert any('goodput_overlapped_seconds{pool="p1",'
+               'category="checkpoint_async"} 10.0' in line
+               for line in lines)
+
+
 def test_retry_counted_and_empty_report_shape():
     report = accounting.decompose(
         [_ev(gp.TASK_RETRY, 5.0, 5.0, retries=1)])

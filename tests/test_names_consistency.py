@@ -68,3 +68,51 @@ def test_goodput_table_declared():
     # coordination surface.
     assert names.TABLE_GOODPUT == "goodput"
     assert "TABLE_GOODPUT" in _DECLARED_ATTRS
+
+
+def test_goodput_program_constants_are_declared():
+    """Every PROGRAM_* constant referenced at an emit site resolves
+    to a declared constant in goodput/events.py whose value is a
+    registered EVENT_KIND — a typo'd phase name cannot silently
+    produce events the accounting drops."""
+    from batch_shipyard_tpu.goodput import events as gp_events
+    problems = []
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("PROGRAM_"):
+                value = getattr(gp_events, node.attr, None)
+                if value is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} not "
+                        f"declared in goodput/events.py")
+                elif value not in gp_events.EVENT_KINDS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} value "
+                        f"{value!r} missing from EVENT_KINDS")
+    assert not problems, "\n".join(problems)
+
+
+def test_train_loops_never_call_blocking_checkpoint_save():
+    """The train workloads must drive checkpoints through
+    checkpoint.TrainCheckpointer (which routes to the async manager
+    under --async-checkpoint): a direct blocking ``checkpoint.save``
+    in a step loop reintroduces the full-persist stall the zero-stall
+    pipeline exists to remove, and skips the stale-step guard."""
+    problems = []
+    for path in sorted((PACKAGE / "workloads").glob("train_*.py")):
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "save" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "checkpoint":
+                problems.append(
+                    f"{rel}:{node.lineno}: direct blocking "
+                    f"checkpoint.save() in a train workload — use "
+                    f"checkpoint.TrainCheckpointer")
+    assert not problems, "\n".join(problems)

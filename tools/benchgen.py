@@ -143,6 +143,47 @@ def _serving(out: list[str], name: str, data: dict) -> None:
     out.append("")
 
 
+_CKPT_KEYS = (("sync_blocking_ms_per_save", "sync save blocking"),
+              ("async_blocking_ms_per_save",
+               "async save blocking (snapshot only)"),
+              ("blocking_speedup", "blocking speedup"),
+              ("payload_mb", "payload (MB)"),
+              ("saves", "saves measured"))
+
+
+def _checkpoint_overhead(out: list[str], data: dict) -> None:
+    """Zero-stall checkpointing section: blocking ms/save sync vs
+    async (docs/28-checkpointing.md). Falls back to the silicon-proof
+    phase's skeleton metrics so the dry run still renders the full
+    shape."""
+    if not isinstance(data, dict) or not data:
+        proof = _load(ARTIFACTS / "SILICON_PROOF.json") or {}
+        phase = next((p for p in proof.get("phases", [])
+                      if p.get("phase") == "checkpoint_overhead"),
+                     None)
+        if phase is None:
+            return
+        data = phase.get("metrics") or {}
+    out.append("### Checkpoint overhead (sync vs async)\n")
+    if "error" in data:
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    out.append("Blocking time per save on the training loop's "
+               "critical path: the sync path pays the full "
+               "device→host + serialize + fsync + rename; "
+               "`--async-checkpoint` pays only the snapshot and "
+               "persists in a background writer "
+               "([28-checkpointing.md](28-checkpointing.md)).\n")
+    out.append("| metric | value |")
+    out.append("|---|---|")
+    for key, label in _CKPT_KEYS:
+        value = data.get(key)
+        unit = " ms" if key.endswith("ms_per_save") and \
+            value is not None else ""
+        out.append(f"| {label} | {_fmt(value, 2)}{unit} |")
+    out.append("")
+
+
 _ORCH_KEYS = ("pool_add_to_ready_seconds", "nodeprep_seconds",
               "image_prefetch_seconds",
               "submit_to_task_complete_seconds")
@@ -205,6 +246,13 @@ def _goodput(out: list[str]) -> None:
     for category in BADPUT_CATEGORIES:
         out.append(f"| badput_seconds{{category=\"{category}\"}} | "
                    f"{_fmt(badput.get(category), 2)} |")
+    from batch_shipyard_tpu.goodput.accounting import (
+        OVERLAPPED_CATEGORIES)
+    overlapped = report.get("overlapped_seconds") or {}
+    for category in OVERLAPPED_CATEGORIES:
+        out.append(
+            f"| overlapped_seconds{{category=\"{category}\"}} "
+            f"(not badput) | {_fmt(overlapped.get(category), 2)} |")
     out.append("")
 
 
@@ -252,6 +300,12 @@ def render() -> str:
     for key in ("serving_speculative", "serving_speculative_paged"):
         if key not in details and key in spec_details:
             details[key] = spec_details[key]
+    # Same for the checkpoint-overhead phase's own details file.
+    ckpt_details = _load(ARTIFACTS / "CKPT_OVERHEAD_DETAILS.json") or {}
+    if "checkpoint_overhead" not in details and \
+            "checkpoint_overhead" in ckpt_details:
+        details["checkpoint_overhead"] = (
+            ckpt_details["checkpoint_overhead"])
     out.append("## Latest detailed run\n")
     if details.get("error"):
         out.append(f"**Status**: `{details['error']}`\n")
@@ -283,6 +337,7 @@ def render() -> str:
              details.get("serving_speculative", {}))
     _serving(out, "Serving, speculative decoding (paged KV)",
              details.get("serving_speculative_paged", {}))
+    _checkpoint_overhead(out, details.get("checkpoint_overhead", {}))
     _orchestration(out, details.get("orchestration", {}))
     _goodput(out)
     _silicon_proof(out)

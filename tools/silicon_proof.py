@@ -29,7 +29,11 @@ invokes this script on the first successful probe; it:
   6. serving_speculative — speculative continuous-batching serving
                       (dense + paged KV): tokens/s, TTFT/TPOT, and
                       the measured draft acceptance rate per variant.
-  7. goodput        — ML-productivity goodput decomposition of the
+  7. checkpoint_overhead — zero-stall checkpointing proof: blocking
+                      ms/save of the sync full-durability save vs the
+                      async double-buffered pipeline on a synthetic
+                      large pytree (workloads/checkpoint.py).
+  8. goodput        — ML-productivity goodput decomposition of the
                       bench pool's event log (goodput/accounting.py):
                       goodput_ratio plus badput seconds per category,
                       persisted as GOODPUT_REPORT.json.
@@ -309,6 +313,42 @@ class Pipeline:
                     "ok" if ok else "failed", rc=rc,
                     metrics=summary, output_tail=out[-800:])
 
+    def checkpoint_overhead(self) -> None:
+        """Sync vs async blocking ms/save (bench.py's
+        checkpoint_overhead workload): the training loop's measured
+        stall per checkpoint, before and after the async
+        double-buffered save pipeline. The dry-run skeleton names
+        every metric so report consumers bind to the shape on CPU."""
+        details_path = self.out / "CKPT_OVERHEAD_DETAILS.json"
+        cmd = [sys.executable, "bench.py", "--workloads",
+               "checkpoint_overhead", "--details-out",
+               str(details_path)]
+        metric_keys = ("sync_blocking_ms_per_save",
+                       "async_blocking_ms_per_save",
+                       "blocking_speedup", "payload_mb", "saves")
+        if self.dry:
+            self.record("checkpoint_overhead", "dry_run",
+                        command=" ".join(cmd),
+                        metrics={k: None for k in metric_keys})
+            return
+        rc, out = _run(cmd, BENCH_QUICK_TIMEOUT, env=self.child_env)
+        try:
+            with open(details_path, encoding="utf-8") as fh:
+                det = json.load(fh)
+        except (OSError, ValueError):
+            det = {}
+        rep = det.get("checkpoint_overhead") or {}
+        if "error" in rep:
+            summary = {"error": rep["error"]}
+        else:
+            summary = {k: rep.get(k) for k in metric_keys}
+        ok = (rc == 0 and "error" not in summary
+              and summary.get("sync_blocking_ms_per_save")
+              is not None)
+        self.record("checkpoint_overhead",
+                    "ok" if ok else "failed", rc=rc,
+                    metrics=summary, output_tail=out[-800:])
+
     def goodput(self) -> None:
         """Decompose whatever goodput events the bench run's state
         store accumulated into the paper's availability x resource x
@@ -323,6 +363,8 @@ class Pipeline:
             "program_goodput": None,
             "badput_seconds": {category: None for category in
                                accounting.BADPUT_CATEGORIES},
+            "overlapped_seconds": {category: None for category in
+                                   accounting.OVERLAPPED_CATEGORIES},
         }
         cmd = (f"{sys.executable} -m batch_shipyard_tpu.cli.main "
                f"goodput pool --raw")
@@ -365,6 +407,7 @@ class Pipeline:
             winner = self.tuning_ab()
             self.final_bench(winner)
             self.serving_speculative()
+            self.checkpoint_overhead()
             self.goodput()
         report = {
             "started_at": started,
