@@ -32,6 +32,8 @@ import uuid
 from typing import Callable, Optional
 
 from batch_shipyard_tpu.agent import task_runner
+from batch_shipyard_tpu.compilecache import manager as cc_manager
+from batch_shipyard_tpu.compilecache import seeding as cc_seeding
 from batch_shipyard_tpu.config.settings import (
     JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
 from batch_shipyard_tpu.goodput import events as goodput_events
@@ -161,6 +163,15 @@ class NodeAgent:
         # stealing another slot's unit. Both under _running_lock.
         self._goodput_idle_since: Optional[float] = None
         self._goodput_busy_slots: set[int] = set()
+        # Pool-wide compile-cache seeding (compilecache/seeding.py):
+        # remember the latest.json generation last seeded so the
+        # pre-task seed check costs one metadata read, not a download,
+        # when nothing changed. Exports run on a background thread
+        # (one at a time) so a multi-GB cache upload never sits on
+        # the task-completion path.
+        self._compile_cache_seen_gen: Optional[int] = None
+        self._compile_cache_export_thread: Optional[
+            threading.Thread] = None
         # Retention sweeps: (monotonic deadline, task dir) for
         # completed tasks whose spec sets retention_time_seconds —
         # the Azure Batch task-constraint retention_time analog
@@ -814,6 +825,68 @@ class NodeAgent:
             logger.debug("ingested %d goodput events from %s/%s",
                          count, job_id, task_id)
 
+    # ----------------------- compile-cache hooks -----------------------
+
+    def _compile_cache_dir(self) -> str:
+        """Node-local persistent compile cache, shared by every task
+        on this node (exported as $SHIPYARD_COMPILE_CACHE_DIR)."""
+        return os.path.join(self.work_dir, "compilecache")
+
+    def _seed_compile_cache(self) -> None:
+        """Pre-task seed: pull the pool's cache artifact so this task
+        compiles warm (the image-prefetch pattern for executables).
+        Generation-gated — an unchanged latest.json costs one
+        metadata read, never a download. Best-effort by design."""
+        try:
+            meta = self.store.get_object_meta(
+                names.compile_cache_latest_key(self.identity.pool_id))
+        except NotFoundError:
+            return
+        except Exception:  # noqa: BLE001 - warm start is optional
+            logger.debug("compile cache meta probe failed",
+                         exc_info=True)
+            return
+        if meta.generation == self._compile_cache_seen_gen:
+            return
+        status = cc_seeding.seed_cache(
+            self.store, self.identity.pool_id,
+            self._compile_cache_dir())
+        # Durable outcomes (seeded / refused-identity / already-warm)
+        # latch on the artifact generation so an unchanged latest.json
+        # is never re-downloaded; a TRANSIENT failure must not latch —
+        # the next task retries, or one store hiccup would leave this
+        # node cold until some other node publishes a newer artifact.
+        if status != cc_seeding.ERROR:
+            self._compile_cache_seen_gen = meta.generation
+
+    def _export_compile_cache(self) -> None:
+        """Post-task export: publish this node's cache subdirs as the
+        pool seed (lease-guarded inside export_cache — one uploader
+        per identity; nodes with nothing newer skip). Runs on a
+        background thread: a first cold compile can leave a cache
+        that takes real time to tar+upload, and that must not delay
+        task finish accounting (the zero-stall lesson of the async
+        checkpoint pipeline). No generation latch here — the export
+        bumps latest.json, and the NEXT pre-task seed probe
+        re-reads it: this node's own identities skip instantly on
+        entry counts, while an identity another node published
+        concurrently (whose records the export's read-modify-write
+        may have folded in) still gets seeded rather than latched
+        past."""
+        thread = self._compile_cache_export_thread
+        if thread is not None and thread.is_alive():
+            return  # one in-flight export; the next finish retries
+
+        def _run() -> None:
+            cc_seeding.export_cache(
+                self.store, self.identity.pool_id,
+                self._compile_cache_dir(), self.identity.node_id)
+
+        thread = threading.Thread(target=_run, daemon=True,
+                                  name="compilecache-export")
+        self._compile_cache_export_thread = thread
+        thread.start()
+
     # ----------------------- regular task path -------------------------
 
     def _claim_regular(self, job_id: str, task_id: str,
@@ -889,6 +962,7 @@ class NodeAgent:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
         self._ingest_goodput(job_id, task_id, execution)
+        self._export_compile_cache()
         self._goodput_task_finished(slot, job_id, task_id, result)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
@@ -1237,6 +1311,7 @@ class NodeAgent:
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
         self._ingest_goodput(job_id, task_id, execution)
+        self._export_compile_cache()
         self._goodput_task_finished(slot, job_id, task_id, result)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
@@ -1395,6 +1470,13 @@ class NodeAgent:
             goodput_events.GOODPUT_FILE_ENV,
             os.path.join(task_dir.rstrip("/"),
                          "goodput_events.jsonl"))
+        # Warm-start compilation: every task sees the node's
+        # persistent compile cache dir, seeded from the pool artifact
+        # just before launch so restarts and late pool joiners
+        # deserialize instead of compiling.
+        env.setdefault(cc_manager.CACHE_DIR_ENV,
+                       self._compile_cache_dir())
+        self._seed_compile_cache()
         return task_runner.TaskExecution(
             pool_id=self.identity.pool_id, job_id=job_id, task_id=task_id,
             node_id=self.identity.node_id,

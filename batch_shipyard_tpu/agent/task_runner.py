@@ -158,6 +158,18 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
                 rel = os.path.relpath(host_file, host_dir)
                 argv += ["-e",
                          f"SHIPYARD_GOODPUT_FILE=/shipyard/task/{rel}"]
+        cache_dir = execution.env.get("SHIPYARD_COMPILE_CACHE_DIR")
+        if cache_dir:
+            # The node's persistent compile cache lives OUTSIDE the
+            # task dir (it is shared by every task on the node): give
+            # it its own mount and point the env at the mount, so the
+            # containerized workload's warm entries land where the
+            # agent's seed/export hooks find them.
+            argv += ["-v",
+                     f"{os.path.abspath(cache_dir)}:"
+                     f"/shipyard/compilecache",
+                     "-e", "SHIPYARD_COMPILE_CACHE_DIR="
+                           "/shipyard/compilecache"]
         argv += list(execution.additional_docker_run_options)
         argv += [execution.image or "",
                  "/bin/bash", "-c", execution.command]

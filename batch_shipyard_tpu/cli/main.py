@@ -17,6 +17,7 @@ mirror the reference so a Batch Shipyard user finds the same verbs:
 
 from __future__ import annotations
 
+import os
 import sys
 
 import click
@@ -233,6 +234,45 @@ def pool_suspend(click_ctx):
 def pool_start(click_ctx):
     """Restart a suspended pool."""
     fleet.action_pool_start(_ctx(click_ctx))
+
+
+@pool.group("cache")
+def pool_cache():
+    """Warm-start compile-cache seeding (docs/29-compile-cache.md)."""
+
+
+@pool_cache.command("stats")
+@click.pass_context
+def pool_cache_stats(click_ctx):
+    """Seed-artifact state: identity, entries, bytes, uploader."""
+    fleet.action_pool_cache_stats(_ctx(click_ctx),
+                                  raw=click_ctx.obj["raw"])
+
+
+@pool_cache.command("seed")
+@click.option("--cache-dir",
+              default=os.environ.get("SHIPYARD_COMPILE_CACHE_DIR")
+              or "./compilecache", show_default=True,
+              help="local cache dir to seed from the pool artifact")
+@click.pass_context
+def pool_cache_seed(click_ctx, cache_dir):
+    """Seed a local cache dir from the pool's artifact (refuses a
+    mismatched cache identity)."""
+    fleet.action_pool_cache_seed(_ctx(click_ctx), cache_dir,
+                                 raw=click_ctx.obj["raw"])
+
+
+@pool_cache.command("prune")
+@click.option("-y", "--yes", is_flag=True)
+@click.pass_context
+def pool_cache_prune(click_ctx, yes):
+    """Delete the pool's compile-cache artifacts (stale-cache escape
+    hatch after jax/model upgrades)."""
+    if not yes:
+        click.confirm("prune the pool's compile-cache artifacts?",
+                      abort=True)
+    fleet.action_pool_cache_prune(_ctx(click_ctx),
+                                  raw=click_ctx.obj["raw"])
 
 
 @pool.group()

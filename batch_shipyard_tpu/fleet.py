@@ -556,6 +556,41 @@ def action_jobs_wait(ctx: Context, job_id: str,
     return tasks
 
 
+# ---------------------------- compile cache ----------------------------
+
+def action_pool_cache_stats(ctx: Context, raw: bool = False) -> dict:
+    """Seed-artifact state of the pool's warm-start compile cache
+    (compilecache/seeding.py): latest identity/entries/bytes plus the
+    stored artifact list."""
+    from batch_shipyard_tpu.compilecache import seeding
+    report = seeding.stats(ctx.store, ctx.pool.id)
+    _emit(report, raw)
+    return report
+
+
+def action_pool_cache_seed(ctx: Context, cache_dir: str,
+                           raw: bool = False) -> str:
+    """Seed a LOCAL cache dir from the pool artifact (the node
+    agents seed themselves before each task; this verb serves dev
+    boxes and pre-bake pipelines). Refuses a mismatched identity."""
+    from batch_shipyard_tpu.compilecache import seeding
+    status = seeding.seed_cache(ctx.store, ctx.pool.id, cache_dir)
+    _emit({"pool_id": ctx.pool.id, "cache_dir": cache_dir,
+           "status": status,
+           "seeded": status == seeding.SEEDED}, raw)
+    return status
+
+
+def action_pool_cache_prune(ctx: Context, raw: bool = False) -> int:
+    """Drop the pool's cache artifacts (the stale-cache escape hatch:
+    after a jax/jaxlib upgrade or model change the old seed can only
+    miss — see docs/17-troubleshooting.md)."""
+    from batch_shipyard_tpu.compilecache import seeding
+    removed = seeding.prune(ctx.store, ctx.pool.id)
+    _emit({"pool_id": ctx.pool.id, "removed": removed}, raw)
+    return removed
+
+
 # ------------------------------- goodput -------------------------------
 
 def action_goodput(ctx: Context, scope: str,

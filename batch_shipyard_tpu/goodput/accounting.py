@@ -234,6 +234,14 @@ def decompose(event_list: list[dict],
     preemptions = 0
     steps = 0
     tokens = 0
+    # Warm-start compilation detail (compilecache/manager.py): compile
+    # and warm-up events carry cache_hit/saved_seconds attrs — the
+    # seconds a warm persistent-cache hit did NOT spend compiling.
+    # Reported NEXT TO compile badput (it is avoided time, not an
+    # interval on the timeline — the partition is untouched).
+    compile_saved = 0.0
+    compile_hits = 0
+    compile_misses = 0
     # Counter dedup: an N-wide SPMD gang ingests N identical step
     # ranges per job (one per instance) — one unit of progress, so
     # each distinct (job, step range) counts its steps/tokens once.
@@ -255,6 +263,18 @@ def decompose(event_list: list[dict],
                 steps += max(0, step_end - step_start)
                 tokens += _as_int(attrs.get("tokens")) or 0
             continue
+        if kind in (ev.PROGRAM_COMPILE, ev.PROGRAM_WARMUP):
+            attrs = event.get("attrs") or {}
+            hit = attrs.get("cache_hit")
+            if hit is True:
+                compile_hits += 1
+            elif hit is False:
+                compile_misses += 1
+            try:
+                compile_saved += max(
+                    0.0, float(attrs.get("saved_seconds") or 0.0))
+            except (TypeError, ValueError):
+                pass
         category = _KIND_CATEGORY.get(kind)
         if category is None:
             continue
@@ -297,6 +317,9 @@ def decompose(event_list: list[dict],
         "program_goodput": program_g,
         "badput_seconds": badput,
         "overlapped_seconds": overlapped,
+        "compile_saved_seconds": compile_saved,
+        "compile_cache_hits": compile_hits,
+        "compile_cache_misses": compile_misses,
         "steps": steps,
         "tokens": tokens,
         "retries": retries,
@@ -313,6 +336,8 @@ def _empty_report() -> dict[str, Any]:
         "resource_goodput": 0.0, "program_goodput": 0.0,
         "badput_seconds": {c: 0.0 for c in BADPUT_CATEGORIES},
         "overlapped_seconds": {c: 0.0 for c in OVERLAPPED_CATEGORIES},
+        "compile_saved_seconds": 0.0,
+        "compile_cache_hits": 0, "compile_cache_misses": 0,
         "steps": 0, "tokens": 0, "retries": 0, "preemptions": 0,
         "events": 0, "window": None,
     }
@@ -351,7 +376,8 @@ def decompose_by_node(event_list: list[dict],
         for category, value in sub["overlapped_seconds"].items():
             total["overlapped_seconds"][category] += value
         for key in ("steps", "tokens", "retries", "preemptions",
-                    "events"):
+                    "events", "compile_saved_seconds",
+                    "compile_cache_hits", "compile_cache_misses"):
             total[key] += sub[key]
     wall = total["wall_seconds"]
     sched = sum(total["badput_seconds"][c]
@@ -430,6 +456,9 @@ def fleet_report(store: StateStore,
     total_productive = 0.0
     badput = {c: 0.0 for c in BADPUT_CATEGORIES}
     overlapped = {c: 0.0 for c in OVERLAPPED_CATEGORIES}
+    compile_saved = 0.0
+    compile_hits = 0
+    compile_misses = 0
     for row in store.query_entities(names.TABLE_POOLS,
                                     partition_key="pools"):
         pool_id = row["_rk"]
@@ -444,6 +473,9 @@ def fleet_report(store: StateStore,
         for category, value in report.get(
                 "overlapped_seconds", {}).items():
             overlapped[category] += value
+        compile_saved += report.get("compile_saved_seconds", 0.0)
+        compile_hits += report.get("compile_cache_hits", 0)
+        compile_misses += report.get("compile_cache_misses", 0)
     sched = sum(badput[c] for c in _SCHEDULING_BADPUT)
     resource = sum(badput[c] for c in _RESOURCE_BADPUT)
     avail = max(0.0, total_wall - sched)
@@ -461,6 +493,9 @@ def fleet_report(store: StateStore,
                             if run else 0.0),
         "badput_seconds": badput,
         "overlapped_seconds": overlapped,
+        "compile_saved_seconds": compile_saved,
+        "compile_cache_hits": compile_hits,
+        "compile_cache_misses": compile_misses,
     }
 
 
@@ -496,6 +531,17 @@ def waterfall_table(report: dict[str, Any]) -> str:
     if shown:
         lines.append("(~ overlapped persist: not badput; covered "
                      "portions already count as productive)")
+    # Warm vs cold compile: charged compile badput is what was PAID;
+    # compile_saved_seconds is what the warm persistent cache avoided
+    # paying (not an interval — the partition above is untouched).
+    saved = report.get("compile_saved_seconds", 0.0)
+    if saved > 0.0:
+        hits = report.get("compile_cache_hits", 0)
+        misses = report.get("compile_cache_misses", 0)
+        lines.append(f"{'~compile_saved':<22}{saved:>12.2f}  "
+                     f"(warm cache: {hits} hit / {misses} cold)")
+        lines.append("(~ compile_saved: wall time AVOIDED by the "
+                     "warm compile cache, not badput)")
     lines.append("-" * 42)
     lines.append(f"{'wall':<22}{wall:>12.2f}  {pct(wall)}")
     lines.append(
@@ -534,4 +580,7 @@ def prometheus_lines(report: dict[str, Any],
         lines.append(
             f"goodput_overlapped_seconds{{{label_str}{sep}"
             f'category="{category}"}} {value:.3f}')
+    lines.append(
+        f"goodput_compile_saved_seconds{{{label_str}}} "
+        f"{report.get('compile_saved_seconds', 0.0):.3f}")
     return lines

@@ -496,23 +496,132 @@ class ContinuousBatcher:
 
     # ------------------------------ public -----------------------------
 
-    def warmup(self, prompt_len: int = 4,
-               max_new_tokens: int = 2) -> None:
-        """Drive one throwaway request through prefill + decode so
-        the jit compiles happen before real traffic, recorded as an
+    def warmup_buckets(self) -> list[int]:
+        """Every prefill compile bucket this engine can serve,
+        DERIVED from _bucket_length (the one source of the bucket
+        rule): walk each bucket's successor until the cap."""
+        buckets = [self._bucket_length(1)]
+        while buckets[-1] < self.max_decode_len:
+            buckets.append(self._bucket_length(buckets[-1] + 1))
+        return buckets
+
+    def warmup(self, prompt_len: Optional[int] = None,
+               max_new_tokens: int = 2) -> list[int]:
+        """Drive throwaway requests through prefill + decode so the
+        jit compiles happen before real traffic, recorded as an
         engine warm-up goodput phase (compile-leg badput; see
-        goodput/accounting.py). Serving front ends call this before
-        accepting load so warm-up never pollutes TTFT."""
+        goodput/accounting.py) with the persistent compile cache's
+        hit/saved detail when one is enabled. By default EVERY prefill
+        length bucket up to max_decode_len is warmed — one request per
+        bucket, drained sequentially — so the first long-prompt
+        request never pays a mid-traffic compile; the decode step and,
+        when a draft model is configured, the speculative draft/verify
+        paths compile on the first request. ``prompt_len`` pins a
+        single warm-up request instead. Serving front ends call this
+        before accepting load so warm-up never pollutes TTFT. Returns
+        the buckets warmed."""
+        from batch_shipyard_tpu.compilecache import (
+            manager as cc_manager)
         from batch_shipyard_tpu.goodput import events as goodput_events
+        if prompt_len is not None:
+            lengths = [prompt_len]
+        else:
+            lengths = [min(bucket,
+                           self.max_decode_len - max_new_tokens)
+                       for bucket in self.warmup_buckets()]
+            if self.paged:
+                # A deliberately tight page pool (overcommit sizing)
+                # cannot admit the longest buckets' worst case: skip
+                # them rather than fail startup — they compile on
+                # first real (admittable) use, as before.
+                lengths = [
+                    length for length in lengths
+                    if -(-(length + max_new_tokens)
+                         // self.page_size) <= self._total_pages]
+        warmed: list[int] = []
         with goodput_events.phase(goodput_events.PROGRAM_WARMUP,
                                   what="serving_engine",
-                                  prompt_len=prompt_len):
-            self.submit(Request(
-                request_id=f"__warmup__{uuid.uuid4().hex[:8]}",
-                prompt=list(range(1, prompt_len + 1)),
-                max_new_tokens=max_new_tokens))
-            while self.pending():
-                self.step()
+                                  buckets=len(lengths)) as attrs, \
+                cc_manager.tracked(attrs, "serving_warmup"):
+            for length in lengths:
+                self.submit(Request(
+                    request_id=f"__warmup__{uuid.uuid4().hex[:8]}",
+                    prompt=[(i % 7) + 1 for i in range(length)],
+                    max_new_tokens=max_new_tokens))
+                while self.pending():
+                    self.step()
+                warmed.append(self._bucket_length(length))
+        return warmed
+
+    def precompile(self) -> int:
+        """AOT warm start from shapes — no throwaway requests: lower +
+        compile the decode step (or the speculative draft/verify step)
+        and every prefill bucket against ShapeDtypeStruct abstract
+        inputs. The executables are discarded; the value is the
+        PERSISTENT compilation cache (compilecache/manager.py) they
+        populate, which turns the first real request's jit compiles
+        into fast deserializes — so enable the cache first, or this
+        compiles twice for nothing. Returns the number of functions
+        compiled."""
+        import jax as jax_mod
+
+        from batch_shipyard_tpu.compilecache import aot
+        from batch_shipyard_tpu.compilecache import (
+            manager as cc_manager)
+        from batch_shipyard_tpu.goodput import events as goodput_events
+        count = 0
+        with goodput_events.phase(goodput_events.PROGRAM_WARMUP,
+                                  what="serving_aot") as attrs, \
+                cc_manager.tracked(attrs, "serving_precompile"):
+            params_abs = aot.abstractify(self.params)
+            cache_abs = aot.abstractify(self.cache)
+            tokens_abs = jax_mod.ShapeDtypeStruct(
+                (self.num_slots, 1), jnp.int32)
+            pos_abs = jax_mod.ShapeDtypeStruct((self.num_slots,),
+                                               jnp.int32)
+            active_abs = jax_mod.ShapeDtypeStruct((self.num_slots,),
+                                                  jnp.bool_)
+            if self.speculative is not None:
+                _speculative_step.lower(
+                    self.model, self._spec_step.args[1], self.gamma,
+                    params_abs, aot.abstractify(self._draft_params),
+                    cache_abs, aot.abstractify(self._draft_cache),
+                    tokens_abs, pos_abs, active_abs).compile()
+            else:
+                key_abs = aot.abstractify(self._key)
+                _decode_step.lower(
+                    self.model, self.sampling, params_abs, cache_abs,
+                    tokens_abs, pos_abs, active_abs,
+                    key_abs).compile()
+            count += 1
+            dense_model = self._prefill.args[0]
+            for bucket in self.warmup_buckets():
+                prompt_abs = jax_mod.ShapeDtypeStruct((1, bucket),
+                                                      jnp.int32)
+                if self.paged:
+                    row_abs = jax_mod.ShapeDtypeStruct(
+                        (self.max_blocks,), jnp.int32)
+                    _prefill_paged.lower(
+                        dense_model, self.prefill_chunk,
+                        self.page_size, params_abs, cache_abs, 0,
+                        prompt_abs, row_abs, bucket).compile()
+                else:
+                    _prefill_dense.lower(
+                        dense_model, self.prefill_chunk, params_abs,
+                        cache_abs, 0, prompt_abs, bucket).compile()
+                count += 1
+                if self.speculative is not None:
+                    # _admit prefills the DRAFT cache too (the
+                    # spec-step invariant) — a distinct compile per
+                    # bucket that would otherwise hit mid-traffic.
+                    _prefill_dense.lower(
+                        self._draft_prefill.args[0],
+                        self.prefill_chunk,
+                        aot.abstractify(self._draft_params),
+                        aot.abstractify(self._draft_cache), 0,
+                        prompt_abs, bucket).compile()
+                    count += 1
+        return count
 
     def submit(self, request: Request) -> None:
         if request.max_new_tokens < 1:

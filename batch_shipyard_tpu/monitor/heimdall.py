@@ -146,6 +146,10 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "checkpoint persist) not covered by productive windows; "
         "shown, not charged as badput.",
         "# TYPE goodput_overlapped_seconds gauge",
+        "# HELP goodput_compile_saved_seconds Wall-clock seconds the "
+        "warm persistent compilation cache avoided spending on "
+        "compiles (compilecache/; not badput).",
+        "# TYPE goodput_compile_saved_seconds gauge",
     ]
     for pool in store.query_entities(names.TABLE_POOLS,
                                      partition_key="pools"):

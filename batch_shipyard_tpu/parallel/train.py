@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from batch_shipyard_tpu.compilecache import manager as cc_manager
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.models import resnet as resnet_mod
 from batch_shipyard_tpu.models import transformer as tfm
@@ -36,6 +37,27 @@ class TrainHarness:
     opt_state: Any
     step: Callable
     batch_sharding: Any
+    # AOT warm start (compilecache/aot.py): lower+compile the step
+    # against abstract batch shapes and swap the executable into the
+    # step hot path, so the first real step runs the same compiled
+    # program as the steady state — no cold-compile spike. None for
+    # builders without an AOT path (the pipeline schedules).
+    precompile: Optional[Callable[[], None]] = None
+
+
+def _aot_step(compiled: dict, step: Callable, *args):
+    """Dispatch through the AOT executable when one is installed.
+    Signature/layout mismatches (an abstract-shape guess that doesn't
+    match the real batch) raise at call validation, BEFORE any donated
+    buffer is consumed — drop the executable and fall back to the jit
+    path, which compiles for the true signature."""
+    fn = compiled.get("step")
+    if fn is not None:
+        try:
+            return fn(*args)
+        except (TypeError, ValueError):
+            compiled.pop("step", None)
+    return step(*args)
 
 
 def make_transformer_config(mesh: Optional[Mesh] = None,
@@ -72,9 +94,11 @@ def build_transformer_train(
     param_specs = shard_rules.transformer_param_specs(abstract)
     param_shardings = shard_rules.to_shardings(mesh, param_specs)
     # Param/opt-state init is jit-compile time: charge it to the
-    # compile badput category (no-op outside a pool task).
+    # compile badput category (no-op outside a pool task), stamped
+    # with the persistent cache's hit/saved detail when enabled.
     with goodput_events.phase(goodput_events.PROGRAM_COMPILE,
-                              what="init"):
+                              what="init") as init_attrs, \
+            cc_manager.tracked(init_attrs, "transformer_init"):
         params = jax.jit(init_fn, out_shardings=param_shardings)(rng)
         opt_state = jax.jit(
             optimizer.init,
@@ -108,14 +132,24 @@ def build_transformer_train(
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": loss}
 
+    compiled: dict = {}
+
     def step_wrapper(params, opt_state, batch):
-        params, opt_state, metrics = step(
-            params, opt_state, batch["tokens"], batch["targets"])
+        params, opt_state, metrics = _aot_step(
+            compiled, step, params, opt_state, batch["tokens"],
+            batch["targets"])
         return params, opt_state, metrics
+
+    def precompile():
+        tokens_abs = jax.ShapeDtypeStruct(tokens_shape, jnp.int32,
+                                          sharding=batch_sharding)
+        compiled["step"] = step.lower(
+            params, opt_state, tokens_abs, tokens_abs).compile()
 
     return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
                         step=step_wrapper,
-                        batch_sharding=batch_sharding)
+                        batch_sharding=batch_sharding,
+                        precompile=precompile)
 
 
 def build_transformer_train_pp(
@@ -406,16 +440,30 @@ def build_resnet_train(mesh: Mesh,
         return params, updates["batch_stats"], opt_state, {"loss": loss}
 
     state = {"batch_stats": batch_stats}
+    compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
-        params, state["batch_stats"], opt_state, metrics = step(
-            params, state["batch_stats"], opt_state, batch["images"],
-            batch["labels"])
+        params, state["batch_stats"], opt_state, metrics = _aot_step(
+            compiled, step, params, state["batch_stats"], opt_state,
+            batch["images"], batch["labels"])
         return params, opt_state, metrics
+
+    def precompile():
+        # bf16 images are what both the bench and the train_resnet
+        # loader feed; a different real dtype falls back to jit.
+        images_abs = jax.ShapeDtypeStruct(
+            (batch_size, image_size, image_size, 3), jnp.bfloat16,
+            sharding=batch_sharding)
+        labels_abs = jax.ShapeDtypeStruct((batch_size,), jnp.int32,
+                                          sharding=batch_sharding)
+        compiled["step"] = step.lower(
+            params, state["batch_stats"], opt_state, images_abs,
+            labels_abs).compile()
 
     return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
                         step=step_wrapper,
-                        batch_sharding=batch_sharding)
+                        batch_sharding=batch_sharding,
+                        precompile=precompile)
 
 
 def build_vit_train(mesh: Mesh, config=None, batch_size: int = 256,
@@ -460,13 +508,25 @@ def build_vit_train(mesh: Mesh, config=None, batch_size: int = 256,
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": loss}
 
+    compiled: dict = {}
+
     def step_wrapper(params, opt_state, batch):
-        return step(params, opt_state, batch["images"],
-                    batch["labels"])
+        return _aot_step(compiled, step, params, opt_state,
+                         batch["images"], batch["labels"])
+
+    def precompile():
+        images_abs = jax.ShapeDtypeStruct(
+            (batch_size, config.image_size, config.image_size, 3),
+            jnp.float32, sharding=batch_sharding)
+        labels_abs = jax.ShapeDtypeStruct((batch_size,), jnp.int32,
+                                          sharding=batch_sharding)
+        compiled["step"] = step.lower(
+            params, opt_state, images_abs, labels_abs).compile()
 
     return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
                         step=step_wrapper,
-                        batch_sharding=batch_sharding)
+                        batch_sharding=batch_sharding,
+                        precompile=precompile)
 
 
 def build_diffusion_train(mesh: Mesh, config=None,
@@ -521,14 +581,28 @@ def build_diffusion_train(mesh: Mesh, config=None,
         return params, opt_state, {"loss": loss}
 
     counter = {"step": 0}
+    compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
-        params, opt_state, metrics = step(
-            params, opt_state, batch["images"], batch.get("labels"),
-            counter["step"])
+        params, opt_state, metrics = _aot_step(
+            compiled, step, params, opt_state, batch["images"],
+            batch.get("labels"), counter["step"])
         counter["step"] += 1
         return params, opt_state, metrics
 
+    def precompile():
+        images_abs = jax.ShapeDtypeStruct(
+            (batch_size, config.image_size, config.image_size,
+             config.channels), jnp.float32, sharding=batch_sharding)
+        labels_abs = (jax.ShapeDtypeStruct(
+            (batch_size,), jnp.int32, sharding=batch_sharding)
+            if labeled else None)
+        # step_idx is a weak-typed python int at every call site;
+        # lowering with a concrete 0 matches that signature.
+        compiled["step"] = step.lower(
+            params, opt_state, images_abs, labels_abs, 0).compile()
+
     return TrainHarness(mesh=mesh, params=params, opt_state=opt_state,
                         step=step_wrapper,
-                        batch_sharding=batch_sharding)
+                        batch_sharding=batch_sharding,
+                        precompile=precompile)

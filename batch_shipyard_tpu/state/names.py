@@ -133,3 +133,18 @@ def global_resource_lock_key(pool_id: str, resource_hash: str,
 def federation_job_blob_key(federation_id: str, job_id: str,
                             unique: str) -> str:
     return f"fedjobs/{federation_id}/{job_id}/{unique}"
+
+
+# Pool-wide compile-cache seeding (compilecache/seeding.py): one tar
+# artifact per cache identity, a latest.json pointer read before
+# download, and a lease so exactly one node uploads per identity.
+def compile_cache_key(pool_id: str, identity: str) -> str:
+    return f"compilecache/{pool_id}/{identity}.tar"
+
+
+def compile_cache_latest_key(pool_id: str) -> str:
+    return f"compilecache/{pool_id}/latest.json"
+
+
+def compile_cache_lease_key(pool_id: str, identity: str) -> str:
+    return f"compilecache/{pool_id}/{identity}.lock"

@@ -21,10 +21,26 @@ import sys
 import jax
 import jax.numpy as jnp
 
+from batch_shipyard_tpu import compilecache
 from batch_shipyard_tpu.models import inference as inf
 from batch_shipyard_tpu.models import serving
 from batch_shipyard_tpu.models import transformer as tfm
 from batch_shipyard_tpu.models.server import ServingFrontEnd
+
+
+def warm_engine(args, engine: serving.ContinuousBatcher) -> None:
+    """Warm one engine before its front end takes traffic: every
+    prefill bucket via throwaway requests, or — with --aot-precompile
+    and the persistent cache enabled — from abstract shapes alone, so
+    no request is burned and restarts deserialize instead of
+    compiling. AOT executables are discarded (their value IS the
+    persistent cache they populate), so without an enabled cache the
+    flag would leave the engine cold AND double-compile — fall back
+    to the request-driven warm-up instead."""
+    if args.aot_precompile and compilecache.current() is not None:
+        engine.precompile()
+    else:
+        engine.warmup()
 
 
 def build_config(args) -> tfm.TransformerConfig:
@@ -185,7 +201,14 @@ def main() -> int:
                              "queue-depth-aware fleet router "
                              "(models/router.py); the router binds "
                              "--host/--port")
+    compilecache.add_compile_cache_args(parser)
     args = parser.parse_args()
+    # Persistent compile cache before any engine construction: the
+    # engine __init__ compiles nothing, but warm-up / precompile and
+    # the first requests do, and pool restarts should hit warm.
+    compilecache.enable_from_args(
+        args, model_digest=compilecache.config_digest(
+            build_config(args)))
 
     fronts = []
     router = None
@@ -202,9 +225,11 @@ def main() -> int:
                    for _ in range(args.replicas)]
         # Warm every replica BEFORE it starts taking traffic (jit
         # compiles recorded as engine warm-up goodput; must run before
-        # the front's engine thread owns the stepping).
+        # the front's engine thread owns the stepping). Same-config
+        # replicas share the module-level jits, so replica 1 pays and
+        # the rest reuse.
         for e in engines:
-            e.warmup()
+            warm_engine(args, e)
         fronts = [ServingFrontEnd(e, port=0).start()
                   for e in engines]
         router = ServingRouter([f.url for f in fronts],
@@ -215,7 +240,7 @@ def main() -> int:
               f"replica(s)", flush=True)
     else:
         engine = build_engine(args)
-        engine.warmup()
+        warm_engine(args, engine)
         fronts = [ServingFrontEnd(engine, host=args.host,
                                   port=args.port).start()]
         url = fronts[0].url

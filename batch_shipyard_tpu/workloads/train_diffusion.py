@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batch_shipyard_tpu import compilecache
 from batch_shipyard_tpu.models import diffusion as dif_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -38,6 +39,7 @@ def main() -> int:
                         help="generate N DDIM samples at the end")
     parser.add_argument("--sample-steps", type=int, default=50)
     checkpoint.add_checkpoint_args(parser)
+    compilecache.add_compile_cache_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -49,8 +51,13 @@ def main() -> int:
         d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, d_ff=4 * args.d_model,
         num_classes=args.num_classes, dtype=jnp.bfloat16)
+    compilecache.enable_from_args(
+        args, mesh_shape=dict(mesh.shape),
+        model_digest=compilecache.config_digest(config))
     harness = train_mod.build_diffusion_train(
         mesh, config, batch_size=batch_size)
+    join_aot = (compilecache.aot.precompile_async(harness)
+                if args.aot_precompile else None)
     from batch_shipyard_tpu.data import loader
 
     rng = np.random.RandomState(jax.process_index())
@@ -67,6 +74,8 @@ def main() -> int:
     params, opt_state, start_step = ckpt.restore(params, opt_state)
     if start_step:
         distributed.log(ctx, f"resumed from step {start_step}")
+    if join_aot is not None:
+        join_aot()
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   batch)

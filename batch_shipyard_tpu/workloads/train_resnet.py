@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batch_shipyard_tpu import compilecache
 from batch_shipyard_tpu.models import resnet as resnet_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -39,6 +40,7 @@ def main() -> int:
                              "gcsfuse mount); synthetic when omitted")
     parser.add_argument("--prefetch", type=int, default=2)
     checkpoint.add_checkpoint_args(parser)
+    compilecache.add_compile_cache_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -47,9 +49,17 @@ def main() -> int:
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(n_dev))
     config = resnet_mod.ResNetConfig(num_classes=args.num_classes,
                                      dtype=jnp.bfloat16)
+    # Warm-start compilation: persistent cache before the first jit;
+    # --aot-precompile overlaps the step compile with the data
+    # pipeline construction below.
+    compilecache.enable_from_args(
+        args, mesh_shape=dict(mesh.shape),
+        model_digest=compilecache.config_digest(config))
     harness = train_mod.build_resnet_train(
         mesh, config, batch_size=batch_size,
         image_size=args.image_size)
+    join_aot = (compilecache.aot.precompile_async(harness)
+                if args.aot_precompile else None)
     from batch_shipyard_tpu.data import loader
 
     rng = np.random.RandomState(jax.process_index())
@@ -87,6 +97,8 @@ def main() -> int:
     params, opt_state, start_step = ckpt.restore(params, opt_state)
     if start_step:
         distributed.log(ctx, f"resumed from step {start_step}")
+    if join_aot is not None:
+        join_aot()
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   next(batches))

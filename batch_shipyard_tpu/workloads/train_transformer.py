@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batch_shipyard_tpu import compilecache
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -52,6 +53,7 @@ def main() -> int:
                              "(QAT straight-through backward)")
     parser.add_argument("--no-remat", action="store_true")
     checkpoint.add_checkpoint_args(parser)
+    compilecache.add_compile_cache_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -72,8 +74,19 @@ def main() -> int:
         moe=moe, moe_every=args.moe_every,
         quantize_matmuls=args.int8,
         remat=not args.no_remat)
+    # Persistent compile cache: identity-keyed to this mesh + model
+    # config so pool-wide seeding never ships entries that can only
+    # miss; must be enabled BEFORE the first jit (the harness build's
+    # init compile).
+    compilecache.enable_from_args(
+        args, mesh_shape=dict(mesh.shape),
+        model_digest=compilecache.config_digest(config))
     harness = train_mod.build_transformer_train(
         mesh, config, batch_size=args.batch, seq_len=args.seq_len)
+    # --aot-precompile: the step compiles on a background thread while
+    # the host builds the data pipeline below; joined before warm-up.
+    join_aot = (compilecache.aot.precompile_async(harness)
+                if args.aot_precompile else None)
     from batch_shipyard_tpu.data import loader
     rng = np.random.RandomState(jax.process_index())
     local_batch = args.batch // jax.process_count()
@@ -90,12 +103,17 @@ def main() -> int:
     params, opt_state, start_step = ckpt.restore(params, opt_state)
     if start_step:
         distributed.log(ctx, f"resumed from step {start_step}")
+    if join_aot is not None:
+        join_aot()
     # Goodput program phases: the warm-up loop is jit compile time
-    # (compile badput); the measured loop is the productive step
-    # window, stamped with step + token counters so the accounting
-    # engine can price preemption-recovery rework after a restore.
+    # (compile badput, stamped with the cache's hit/saved detail);
+    # the measured loop is the productive step window, stamped with
+    # step + token counters so the accounting engine can price
+    # preemption-recovery rework after a restore.
     with goodput_events.phase(goodput_events.PROGRAM_COMPILE,
-                              what="jit_warmup", steps=args.warmup):
+                              what="jit_warmup",
+                              steps=args.warmup) as warm_attrs, \
+            compilecache.tracked(warm_attrs, "transformer_warmup"):
         for _ in range(args.warmup):
             params, opt_state, metrics = harness.step(params,
                                                       opt_state, batch)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batch_shipyard_tpu import compilecache
 from batch_shipyard_tpu.models import vit as vit_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -36,6 +37,7 @@ def main() -> int:
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--warmup", type=int, default=3)
     checkpoint.add_checkpoint_args(parser)
+    compilecache.add_compile_cache_args(parser)
     args = parser.parse_args()
 
     ctx = distributed.setup()
@@ -47,8 +49,13 @@ def main() -> int:
         num_classes=args.num_classes, d_model=args.d_model,
         n_layers=args.layers, n_heads=args.heads,
         d_ff=4 * args.d_model, dtype=jnp.bfloat16)
+    compilecache.enable_from_args(
+        args, mesh_shape=dict(mesh.shape),
+        model_digest=compilecache.config_digest(config))
     harness = train_mod.build_vit_train(mesh, config,
                                         batch_size=batch_size)
+    join_aot = (compilecache.aot.precompile_async(harness)
+                if args.aot_precompile else None)
     from batch_shipyard_tpu.data import loader
 
     rng = np.random.RandomState(jax.process_index())
@@ -66,6 +73,8 @@ def main() -> int:
     params, opt_state, start_step = ckpt.restore(params, opt_state)
     if start_step:
         distributed.log(ctx, f"resumed from step {start_step}")
+    if join_aot is not None:
+        join_aot()
     for _ in range(args.warmup):
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)

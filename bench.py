@@ -388,6 +388,128 @@ def bench_checkpoint_overhead(num_saves: int = 3,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# Cold vs warm compile is only honest across PROCESSES: within one
+# process the jit dispatch cache would make every second compile
+# "warm" regardless of the persistent cache. The child builds a small
+# transformer train step directly on models/transformer (no mesh
+# machinery — single device suffices to time XLA) and reports its
+# time-to-first-step; run 1 starts from an empty cache dir, run 2
+# shares it and adds --aot-precompile's lower().compile() path.
+_COMPILE_WARM_CHILD = r"""
+import functools, json, os, sys, time
+sys.path.insert(0, os.environ["SHIPYARD_BENCH_REPO"])
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from batch_shipyard_tpu.compilecache import manager
+mgr = manager.enable(os.environ["SHIPYARD_BENCH_CACHE_DIR"])
+from batch_shipyard_tpu.models import transformer as tfm
+config = tfm.TransformerConfig(
+    vocab_size=512, d_model=128, n_layers=2, n_heads=4, d_head=32,
+    d_ff=256, max_seq_len=128, remat=False)
+model = tfm.TransformerLM(config)
+optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+def loss_fn(params, tokens, targets):
+    hidden, _ = model.apply({"params": params}, tokens,
+                            return_hidden=True, mutable=["losses"])
+    return tfm.lm_loss_chunked(hidden, params["embed"]["embedding"],
+                               targets)
+
+@jax.jit
+def step(params, opt_state, tokens, targets):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+rng = np.random.RandomState(0)
+tokens = jnp.asarray(rng.randint(0, 512, (2, 128)), jnp.int32)
+targets = jnp.asarray(rng.randint(0, 512, (2, 128)), jnp.int32)
+entries_before = len(mgr.entries())
+with mgr.track("bench_compile_warm") as tracked:
+    start = time.perf_counter()
+    params = jax.jit(
+        lambda r: model.init(r, tokens)["params"])(
+            jax.random.PRNGKey(0))
+    opt_state = optimizer.init(params)
+    fn = step
+    if os.environ.get("SHIPYARD_BENCH_AOT"):
+        abstract = jax.ShapeDtypeStruct((2, 128), jnp.int32)
+        fn = step.lower(params, opt_state, abstract,
+                        abstract).compile()
+    t_first = time.perf_counter()
+    params, opt_state, loss = fn(params, opt_state, tokens, targets)
+    float(loss)
+    first_ms = (time.perf_counter() - t_first) * 1e3
+    to_first_ms = (time.perf_counter() - start) * 1e3
+steady = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    params, opt_state, loss = fn(params, opt_state, tokens, targets)
+    float(loss)
+    steady.append((time.perf_counter() - t0) * 1e3)
+print(json.dumps({
+    "time_to_first_step_ms": round(to_first_ms, 2),
+    "first_step_ms": round(first_ms, 2),
+    "steady_step_ms": round(min(steady), 2),
+    "entries_before": entries_before,
+    "new_entries": tracked["new_entries"],
+    "cache_hit": tracked["cache_hit"],
+    "aot": bool(os.environ.get("SHIPYARD_BENCH_AOT")),
+}))
+"""
+
+
+def bench_compile_warm(timeout: float = 600.0) -> dict:
+    """Warm-start compilation phase (compilecache/): the same small
+    transformer train step in two fresh processes sharing one
+    persistent compilation cache dir. Run 1 compiles cold and
+    populates the cache; run 2 (--aot-precompile path) deserializes
+    warm — cold_ms vs warm_ms is the whole badput the pool-wide
+    seeding removes per node per restart, and run 2's first step
+    matching its steady step shows AOT leaves no cold-compile
+    spike."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="shipyard-compilecache-")
+    try:
+        runs = []
+        for aot in ("", "1"):
+            env = dict(
+                os.environ,
+                SHIPYARD_BENCH_REPO=str(REPO_ROOT),
+                SHIPYARD_BENCH_CACHE_DIR=cache_dir,
+                SHIPYARD_BENCH_AOT=aot)
+            proc = subprocess.run(
+                [sys.executable, "-c", _COMPILE_WARM_CHILD],
+                capture_output=True, text=True, timeout=timeout,
+                env=env)
+            if proc.returncode != 0:
+                return {"error": (proc.stderr or proc.stdout)[-800:]}
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        cold, warm = runs
+        cold_ms = cold["time_to_first_step_ms"]
+        warm_ms = warm["time_to_first_step_ms"]
+        return {
+            "cold_ms": cold_ms,
+            "warm_ms": warm_ms,
+            "speedup": (round(cold_ms / warm_ms, 2)
+                        if warm_ms else None),
+            # Entries the warm run reused instead of recompiling.
+            "cache_hits": max(0, warm["entries_before"]
+                              - warm["new_entries"]),
+            "cold_first_step_ms": cold["first_step_ms"],
+            "aot_first_step_ms": warm["first_step_ms"],
+            "steady_step_ms": warm["steady_step_ms"],
+            "cache_entries": cold["new_entries"],
+        }
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -540,9 +662,9 @@ def main(argv: list[str] | None = None) -> int:
         "orchestration",
         help="comma-separated subset to run (resnet, transformer, "
         "serving, serving_speculative, checkpoint_overhead, "
-        "orchestration; serving_speculative and checkpoint_overhead "
-        "are opt-in — the silicon-proof pipeline runs each as its "
-        "own phase)")
+        "compile_warm, orchestration; serving_speculative, "
+        "checkpoint_overhead and compile_warm are opt-in — the "
+        "silicon-proof pipeline runs each as its own phase)")
     parser.add_argument(
         "--quick", action="store_true",
         help="fewer timed iterations (tuning A/B mode)")
@@ -679,6 +801,14 @@ def main(argv: list[str] | None = None) -> int:
                 payload_mb=16 if args.quick else 64)
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["checkpoint_overhead"] = {"error": str(exc)}
+    if "compile_warm" in workloads:
+        # Opt-in (the silicon-proof compile_warm phase): cold vs warm
+        # persistent-cache compile wall time in fresh subprocesses —
+        # runs on CPU, no orchestration needed.
+        try:
+            details["compile_warm"] = bench_compile_warm()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["compile_warm"] = {"error": str(exc)}
     if "orchestration" in workloads:
         try:
             details["orchestration"] = bench_orchestration_latency()

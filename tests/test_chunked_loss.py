@@ -159,7 +159,7 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     assert names == ["probe", "kernel_checks", "flash_flip",
                      "tuning_ab", "final_bench",
                      "serving_speculative", "checkpoint_overhead",
-                     "goodput"]
+                     "goodput", "compile_warm"]
     assert all(p["status"] == "dry_run" for p in report["phases"])
     # The speculative serving phase's skeleton names every metric it
     # will emit, for both KV layouts.
@@ -170,6 +170,13 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
         assert set(spec["metrics"][variant]) == {
             "tokens_per_second", "ttft_ms_p50", "tpot_ms_p50",
             "acceptance_rate"}
+    # The warm-start compilation phase's skeleton names every metric
+    # benchgen binds to.
+    compile_warm = report["phases"][8]
+    assert "compile_warm" in compile_warm["command"]
+    assert set(compile_warm["metrics"]) == {
+        "cold_ms", "warm_ms", "speedup", "cache_hits",
+        "aot_first_step_ms", "steady_step_ms"}
     # The tuning plan must cover every profile with a runnable command.
     plan = report["phases"][3]["plan"]
     from batch_shipyard_tpu.parallel.tuning import PROFILES

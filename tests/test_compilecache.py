@@ -1,0 +1,475 @@
+"""Warm-start compilation: cache identity honesty, hit/saved-seconds
+tracking, pool-wide seeding round trips through the state store, the
+all-bucket serving warm-up, and the fakepod e2e where task 1 compiles
+cold + exports the seed and task 2 runs warm with
+``compile_saved_seconds > 0``."""
+
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from batch_shipyard_tpu.compilecache import manager, seeding
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.goodput import events as gp
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+_ID_ARGS = dict(jax_version="0.4.37", jaxlib_version="0.4.36",
+                backend="tpu", device_kind="TPU v5e",
+                device_count=8, process_count=2,
+                mesh_shape={"dp": 4, "tp": 2},
+                model_digest="abc123")
+
+
+# --------------------------- identity key ------------------------------
+
+def test_identity_key_stable_for_identical_inputs():
+    """Pure over explicit inputs: the same config produces the same
+    key in any process (no object reprs, no clocks, no randomness)."""
+    assert manager.identity_key(**_ID_ARGS) == \
+        manager.identity_key(**dict(_ID_ARGS))
+
+
+@pytest.mark.parametrize("field,value", [
+    ("jax_version", "0.5.0"),
+    ("jaxlib_version", "0.5.0"),
+    ("backend", "cpu"),
+    ("device_kind", "TPU v4"),
+    ("device_count", 16),
+    ("process_count", 4),
+    ("mesh_shape", {"dp": 2, "tp": 4}),
+    ("model_digest", "def456"),
+])
+def test_identity_key_changes_per_dimension(field, value):
+    changed = dict(_ID_ARGS, **{field: value})
+    assert manager.identity_key(**changed) != \
+        manager.identity_key(**_ID_ARGS)
+
+
+def _attention(q, k, v, causal):
+    return q
+
+
+def test_config_digest_stable_and_sensitive():
+    """Equal configs digest identically even across instances holding
+    callables (no memory addresses leak in); any field change changes
+    the digest."""
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Cfg:
+        d_model: int = 64
+        fn: object = _attention
+
+    assert manager.config_digest(Cfg()) == manager.config_digest(Cfg())
+    assert manager.config_digest(Cfg(d_model=128)) != \
+        manager.config_digest(Cfg())
+    # Raw-object fallback reprs get their addresses scrubbed.
+    class Opaque:
+        pass
+
+    assert manager.config_digest({"x": Opaque()}) == \
+        manager.config_digest({"x": Opaque()})
+
+
+# ------------------------ track: hit/miss/saved ------------------------
+
+def _fake_compile(mgr, label, entry, cold_sleep=0.05):
+    with mgr.track(label) as result:
+        path = os.path.join(mgr.cache_dir, entry)
+        if not os.path.exists(path):
+            time.sleep(cold_sleep)
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write("x" * 2048)
+    return result
+
+
+def test_track_records_miss_then_hit_with_saved_seconds(tmp_path):
+    mgr = manager.enable(str(tmp_path / "cache"), identity="idA",
+                         configure_jax=False)
+    cold = _fake_compile(mgr, "step", "step-cache")
+    assert cold["cache_hit"] is False and cold["new_entries"] == 1
+    assert cold["saved_seconds"] == 0.0
+    # A warm RESTART is a fresh process = a fresh manager over the
+    # same dir: the hit is priced against the remembered cold wall.
+    mgr = manager.enable(str(tmp_path / "cache"), identity="idA",
+                         configure_jax=False)
+    warm = _fake_compile(mgr, "step", "step-cache")
+    assert warm["cache_hit"] is True and warm["new_entries"] == 0
+    assert warm["saved_seconds"] > 0.0
+    stats = mgr.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 0
+    assert stats["saved_seconds"] > 0.0
+
+
+def test_track_repeat_label_is_not_a_persistent_hit(tmp_path):
+    """Replica engines 2..N reuse replica 1's in-process jits: a
+    repeat of a label within one process must be reported as reuse —
+    neither a hit (no multiplied compile_saved_seconds) nor a
+    miss."""
+    mgr = manager.enable(str(tmp_path / "cache"), identity="idA",
+                         configure_jax=False)
+    _fake_compile(mgr, "warmup", "warm-cache")
+    repeat = _fake_compile(mgr, "warmup", "warm-cache")
+    assert repeat["in_process_reuse"] is True
+    assert repeat["cache_hit"] is False
+    assert repeat["saved_seconds"] == 0.0
+    assert mgr.stats() == {**mgr.stats(), "hits": 0, "misses": 1}
+    # tracked() stamps nothing for a reuse — the goodput event must
+    # not count as a miss either.
+    attrs = {}
+    with manager.tracked(attrs, "warmup"):
+        pass
+    assert "cache_hit" not in attrs
+
+
+def test_tracked_stamps_goodput_attrs(tmp_path):
+    mgr = manager.enable(str(tmp_path / "cache"), identity="idA",
+                         configure_jax=False)
+    _fake_compile(mgr, "warmup", "warm-cache")
+    manager.enable(str(tmp_path / "cache"), identity="idA",
+                   configure_jax=False)  # fresh process analog
+    attrs = {}
+    with manager.tracked(attrs, "warmup"):
+        pass  # everything already cached
+    assert attrs["cache_hit"] is True
+    assert attrs["saved_seconds"] >= 0.0
+
+
+def test_identities_coexist_under_one_root(tmp_path):
+    """A mixed pool's node dir holds one namespaced subdir per
+    identity: enabling identity B must NOT disturb identity A's warm
+    entries (the thrash a single shared dir would cause)."""
+    root = str(tmp_path / "cache")
+    mgr_a = manager.enable(root, identity="idA", configure_jax=False)
+    _fake_compile(mgr_a, "step", "step-cache")
+    mgr_b = manager.enable(root, identity="idB", configure_jax=False)
+    assert mgr_b.entries() == {}
+    assert mgr_a.entries() != {}
+    assert mgr_a.cache_dir != mgr_b.cache_dir
+    dirs = manager.list_identity_dirs(root)
+    assert sorted(dirs) == ["idA", "idB"]
+    assert manager.read_identity(dirs["idA"]) == "idA"
+    assert manager.read_identity(dirs["idB"]) == "idB"
+
+
+def test_enable_configures_real_jax_persistent_cache(tmp_path):
+    """The real integration: enable() + one tiny jit writes entries
+    into the dir (thresholds dropped to zero so CPU-test compiles
+    land)."""
+    import jax
+    import jax.numpy as jnp
+    cache = str(tmp_path / "jaxcache")
+    mgr = manager.enable(cache)
+    try:
+        with mgr.track("tiny") as result:
+            jax.jit(lambda x: x * 2 + 1)(
+                jnp.arange(8.0)).block_until_ready()
+        assert result["new_entries"] >= 1
+        assert mgr.entries()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+# ------------------------------ seeding --------------------------------
+
+def _seeded_store(tmp_path, identity="idA"):
+    store = MemoryStateStore()
+    cache = str(tmp_path / "node-a")
+    mgr = manager.enable(cache, identity=identity,
+                         configure_jax=False)
+    _fake_compile(mgr, "step", "step-cache")
+    _fake_compile(mgr, "prefill", "prefill-cache")
+    assert seeding.export_cache(store, "pool1", cache, "node-a")
+    return store, cache
+
+
+def test_export_seed_round_trip_hits_on_fresh_node(tmp_path):
+    store, _cache = _seeded_store(tmp_path)
+    latest = seeding.latest_info(store, "pool1")
+    assert latest["identities"]["idA"]["entries"] == 2
+    fresh = str(tmp_path / "node-b")
+    assert seeding.seed_cache(store, "pool1", fresh) == \
+        seeding.SEEDED
+    # The seeded node's next "compile" is a warm hit WITH a priced
+    # saving: the cold times travel in the meta sidecar.
+    mgr = manager.enable(fresh, identity="idA", configure_jax=False)
+    warm = _fake_compile(mgr, "step", "step-cache")
+    assert warm["cache_hit"] is True
+    assert warm["saved_seconds"] > 0.0
+
+
+def test_seed_refuses_unpublished_pinned_identity(tmp_path):
+    store, _cache = _seeded_store(tmp_path)
+    fresh = str(tmp_path / "node-c")
+    assert seeding.seed_cache(
+        store, "pool1", fresh,
+        expected_identity="idOTHER") == seeding.REFUSED
+    assert manager.list_identity_dirs(fresh) == {}
+    # Unpinned, a mixed-identity node seeds ONLY into the published
+    # identity's subdir; a foreign subdir is never polluted.
+    mixed = str(tmp_path / "node-d")
+    manager.enable(mixed, identity="idOTHER", configure_jax=False)
+    assert seeding.seed_cache(store, "pool1",
+                              mixed) == seeding.SEEDED
+    assert manager.snapshot(
+        manager.identity_subdir(mixed, "idOTHER")) == {}
+    assert "step-cache" in manager.snapshot(
+        manager.identity_subdir(mixed, "idA"))
+
+
+def test_export_handles_mixed_identities(tmp_path):
+    """Two workload types on one node export under their own
+    identities; the pool map keeps BOTH pointers live."""
+    store, cache = _seeded_store(tmp_path)
+    mgr_b = manager.enable(cache, identity="idB",
+                           configure_jax=False)
+    _fake_compile(mgr_b, "other", "other-cache")
+    assert seeding.export_cache(store, "pool1", cache,
+                                "node-a") is not None
+    identities = seeding.latest_info(store, "pool1")["identities"]
+    assert identities["idA"]["entries"] == 2
+    assert identities["idB"]["entries"] == 1
+
+
+def test_export_skips_when_pool_has_equal_or_newer(tmp_path):
+    store, cache = _seeded_store(tmp_path)
+    # Same identity, same entry count: nothing newer to publish.
+    assert seeding.export_cache(store, "pool1", cache,
+                                "node-a") is None
+    # A third entry makes it newer again.
+    mgr = manager.enable(cache, identity="idA", configure_jax=False)
+    _fake_compile(mgr, "decode", "decode-cache")
+    assert seeding.export_cache(store, "pool1", cache,
+                                "node-a") is not None
+    assert seeding.latest_info(
+        store, "pool1")["identities"]["idA"]["entries"] == 3
+
+
+def test_export_respects_the_lease(tmp_path):
+    store = MemoryStateStore()
+    cache = str(tmp_path / "node-a")
+    mgr = manager.enable(cache, identity="idA", configure_jax=False)
+    _fake_compile(mgr, "step", "step-cache")
+    from batch_shipyard_tpu.state import names
+    held = store.acquire_lease(
+        names.compile_cache_lease_key("pool1", "idA"), 30.0, "other")
+    assert held is not None
+    assert seeding.export_cache(store, "pool1", cache,
+                                "node-a") is None
+    store.release_lease(held)
+    assert seeding.export_cache(store, "pool1", cache,
+                                "node-a") is not None
+
+
+def test_seed_skips_when_local_is_as_warm(tmp_path):
+    store, cache = _seeded_store(tmp_path)
+    assert seeding.seed_cache(store, "pool1", cache) == seeding.SKIP
+    assert seeding.seed_cache(MemoryStateStore(), "pool1",
+                              cache) == seeding.ABSENT
+
+
+def test_prune_and_stats(tmp_path):
+    store, _cache = _seeded_store(tmp_path)
+    report = seeding.stats(store, "pool1")
+    assert report["identities"]["idA"]["entries"] == 2
+    assert len(report["artifacts"]) == 1
+    removed = seeding.prune(store, "pool1")
+    assert removed == 2  # tar + latest.json
+    assert seeding.latest_info(store, "pool1") is None
+    assert seeding.stats(store, "pool1")["artifacts"] == []
+
+
+def test_seed_rejects_traversal_members(tmp_path):
+    """A hostile artifact cannot write outside the cache dir."""
+    import io
+    import tarfile
+    store = MemoryStateStore()
+    buffer = io.BytesIO()
+    with tarfile.open(fileobj=buffer, mode="w") as tar:
+        data = b"evil"
+        for name in ("../escape-cache", "sub/dir-cache",
+                     "ok-cache"):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    from batch_shipyard_tpu.state import names
+    store.put_object(names.compile_cache_key("pool1", "idA"),
+                     buffer.getvalue())
+    store.put_object(
+        names.compile_cache_latest_key("pool1"),
+        json.dumps({"identities": {"idA": {
+            "entries": 3,
+            "key": names.compile_cache_key("pool1", "idA"),
+        }}}).encode())
+    target = str(tmp_path / "seedme")
+    assert seeding.seed_cache(store, "pool1",
+                              target) == seeding.SEEDED
+    subdir = manager.identity_subdir(target, "idA")
+    assert sorted(manager.snapshot(subdir)) == ["ok-cache"]
+    assert not (tmp_path / "escape-cache").exists()
+    assert not (tmp_path / "seedme" / "escape-cache").exists()
+
+
+# ---------------------- serving warm-up buckets ------------------------
+
+def test_serving_warmup_warms_every_bucket(tmp_path, monkeypatch):
+    """Satellite: warm-up no longer compiles only the 16-token bucket
+    — every configured bucket up to max_decode_len is driven, so the
+    first long-prompt request never pays a mid-traffic compile; the
+    goodput warm-up event carries the cache detail."""
+    import jax
+    import jax.numpy as jnp
+
+    from batch_shipyard_tpu.models import serving
+    from batch_shipyard_tpu.models import transformer as tfm
+    events_file = tmp_path / "goodput.jsonl"
+    monkeypatch.setenv(gp.GOODPUT_FILE_ENV, str(events_file))
+    # The standard serving-test config: the engine's jits are
+    # module-level static-model compiles, so this test PRE-PAYS the
+    # decode/bucket-16 compiles that tests/test_serving.py (later in
+    # the alphabet) reuses — only the longer buckets are net-new
+    # suite cost.
+    config = tfm.TransformerConfig(
+        vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    params = tfm.TransformerLM(config).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = serving.ContinuousBatcher(config, params, num_slots=2,
+                                       max_decode_len=64)
+    assert engine.warmup_buckets() == [16, 32, 64]
+    assert engine.warmup() == [16, 32, 64]
+    assert engine.pending() == 0
+    recorded = [json.loads(line) for line in
+                events_file.read_text().splitlines()]
+    warm = [e for e in recorded if e["kind"] == gp.PROGRAM_WARMUP]
+    assert warm and warm[-1]["attrs"]["buckets"] == 3
+    # Legacy single-length warm-up still available.
+    assert engine.warmup(prompt_len=4) == [16]
+
+
+# --------------------------- e2e on fakepod ----------------------------
+
+_E2E_PAYLOAD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from batch_shipyard_tpu.compilecache import manager
+from batch_shipyard_tpu.goodput import events
+mgr = manager.enable(os.environ["SHIPYARD_COMPILE_CACHE_DIR"],
+                     identity="e2e-fixed", configure_jax=False)
+with events.phase(events.PROGRAM_COMPILE, what="probe") as attrs:
+    with manager.tracked(attrs, "probe"):
+        entry = os.path.join(mgr.cache_dir, "probe-entry-cache")
+        if os.path.exists(entry):
+            time.sleep(0.02)   # warm: cache deserialization cost
+        else:
+            time.sleep(0.35)   # cold: the full "XLA compile"
+            with open(entry, "w", encoding="utf-8") as fh:
+                fh.write("x" * 4096)
+start = time.time()
+time.sleep(0.08)
+events.record(events.PROGRAM_STEP_WINDOW, start, time.time(),
+              step_start=0, step_end=4, tokens=32)
+"""
+
+
+@pytest.fixture()
+def fakepod_env():
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    conf = {"pool_specification": {
+        "id": "cachepool", "substrate": "fake",
+        "tpu": {"accelerator_type": "v5litepod-16", "num_slices": 1},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 30,
+    }}
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store)
+    pool = settings_mod.pool_settings(conf)
+    pool_mgr.create_pool(store, substrate, pool,
+                         settings_mod.global_settings({}), conf)
+    yield store, substrate, pool, jobs_mgr
+    substrate.stop_all()
+
+
+def _partition_is_exact(report):
+    total = report["productive_seconds"] + \
+        sum(report["badput_seconds"].values()) + \
+        sum(report["overlapped_seconds"].values())
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+
+
+def test_e2e_second_task_runs_warm_and_reports_savings(
+        fakepod_env, tmp_path):
+    """Satellite acceptance: two sequential tasks on one pool. Task 1
+    cold-compiles and the agent exports the pool seed; task 2 runs
+    warm (locally or via seeding) and reports
+    ``compile_saved_seconds > 0`` with compile badput strictly lower,
+    while the wall-clock partition stays exact for both jobs."""
+    store, substrate, pool, jobs_mgr = fakepod_env
+    script = tmp_path / "payload.py"
+    script.write_text(_E2E_PAYLOAD.format(repo=str(REPO_ROOT)),
+                      encoding="utf-8")
+    for job_id in ("jcold", "jwarm"):
+        jobs_mgr.add_jobs(store, pool, settings_mod.job_settings_list(
+            {"job_specifications": [{
+                "id": job_id,
+                "tasks": [{"command": f"python3 {script}"}]}]}))
+        tasks = jobs_mgr.wait_for_tasks(store, "cachepool", job_id,
+                                        timeout=30)
+        assert tasks[0]["state"] == "completed", tasks[0]
+        # The agent's export runs on a background thread after the
+        # task; wait for the artifact so job 2 is guaranteed a seed
+        # whichever node it lands on.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                seeding.latest_info(store, "cachepool") is None:
+            time.sleep(0.05)
+    latest = seeding.latest_info(store, "cachepool")
+    assert latest is not None
+    assert latest["identities"]["e2e-fixed"]["entries"] >= 1
+
+    def _wait_report(job_id):
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            events = gp.query(store, "cachepool", job_id=job_id)
+            if any(e["kind"] == gp.PROGRAM_COMPILE for e in events):
+                break
+            time.sleep(0.1)
+        return accounting.job_report(store, "cachepool", job_id)
+
+    cold = _wait_report("jcold")
+    warm = _wait_report("jwarm")
+    assert cold["compile_cache_misses"] >= 1
+    assert cold["compile_saved_seconds"] == 0.0
+    assert warm["compile_cache_hits"] >= 1
+    assert warm["compile_saved_seconds"] > 0.0
+    assert warm["badput_seconds"]["compile"] < \
+        cold["badput_seconds"]["compile"]
+    _partition_is_exact(cold)
+    _partition_is_exact(warm)
+    # Pool rollup and prometheus surface the saving.
+    pool_rep = accounting.pool_report(store, "cachepool")
+    assert pool_rep["compile_saved_seconds"] > 0.0
+    lines = accounting.prometheus_lines(pool_rep,
+                                        {"pool": "cachepool"})
+    assert any(line.startswith("goodput_compile_saved_seconds")
+               for line in lines)
+    # A genuinely fresh node (empty dir) seeds from the exported
+    # artifact and holds the warm entry; a mismatched node refuses.
+    fresh = str(tmp_path / "fresh-node")
+    assert seeding.seed_cache(
+        store, "cachepool", fresh,
+        expected_identity="e2e-fixed") == seeding.SEEDED
+    assert "probe-entry-cache" in manager.snapshot(
+        manager.identity_subdir(fresh, "e2e-fixed"))
+    assert seeding.seed_cache(
+        store, "cachepool", str(tmp_path / "mismatched"),
+        expected_identity="other") == seeding.REFUSED

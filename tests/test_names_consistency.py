@@ -94,6 +94,41 @@ def test_goodput_program_constants_are_declared():
     assert not problems, "\n".join(problems)
 
 
+def test_train_workloads_enable_the_compile_cache():
+    """Every workload that builds a parallel.train harness must go
+    through the compilecache enable hook (compilecache.
+    enable_from_args) AND register its flag surface
+    (add_compile_cache_args) — a workload that silently opts out of
+    the persistent cache pays a cold XLA compile on every node and
+    every restart, exactly the badput the warm-start pipeline exists
+    to remove (mirrors the no-blocking-checkpoint-save check)."""
+    problems = []
+    for path in sorted((PACKAGE / "workloads").glob("train_*.py")):
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+        rel = path.relative_to(PACKAGE.parent)
+        uses_train = any(
+            isinstance(node, ast.ImportFrom) and
+            node.module == "batch_shipyard_tpu.parallel" and
+            any(alias.name == "train" for alias in node.names)
+            for node in ast.walk(tree))
+        if not uses_train:
+            continue
+        calls = {
+            node.func.attr
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute)}
+        for required in ("enable_from_args",
+                         "add_compile_cache_args"):
+            if required not in calls:
+                problems.append(
+                    f"{rel}: parallel.train workload never calls "
+                    f"compilecache.{required} — it silently opts "
+                    f"out of the persistent compile cache")
+    assert not problems, "\n".join(problems)
+
+
 def test_train_loops_never_call_blocking_checkpoint_save():
     """The train workloads must drive checkpoints through
     checkpoint.TrainCheckpointer (which routes to the async manager

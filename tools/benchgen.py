@@ -184,6 +184,48 @@ def _checkpoint_overhead(out: list[str], data: dict) -> None:
     out.append("")
 
 
+_COMPILE_WARM_KEYS = (
+    ("cold_ms", "cold compile (empty cache, time to first step)"),
+    ("warm_ms", "warm compile (seeded cache + AOT)"),
+    ("speedup", "warm-start speedup"),
+    ("cache_hits", "persistent-cache entries reused"),
+    ("aot_first_step_ms", "first step after AOT precompile"),
+    ("steady_step_ms", "steady-state step"))
+
+
+def _compile_warm(out: list[str], data: dict) -> None:
+    """Warm-start compilation section: cold vs warm compile wall time
+    (docs/29-compile-cache.md). Falls back to the silicon-proof
+    phase's skeleton metrics so the dry run still renders the full
+    shape."""
+    if not isinstance(data, dict) or not data:
+        proof = _load(ARTIFACTS / "SILICON_PROOF.json") or {}
+        phase = next((p for p in proof.get("phases", [])
+                      if p.get("phase") == "compile_warm"), None)
+        if phase is None:
+            return
+        data = phase.get("metrics") or {}
+    out.append("### Warm-start compilation (cold vs warm cache)\n")
+    if "error" in data:
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    out.append("Time to first train step in a fresh process: cold "
+               "XLA compile vs a seeded persistent compilation cache "
+               "plus `--aot-precompile` "
+               "([29-compile-cache.md](29-compile-cache.md)). This "
+               "is the per-node, per-restart compile badput that "
+               "pool-wide cache seeding removes.\n")
+    out.append("| metric | value |")
+    out.append("|---|---|")
+    for key, label in _COMPILE_WARM_KEYS:
+        value = data.get(key)
+        unit = (" ms" if key.endswith("_ms") and value is not None
+                else "x" if key == "speedup" and value is not None
+                else "")
+        out.append(f"| {label} | {_fmt(value, 2)}{unit} |")
+    out.append("")
+
+
 _ORCH_KEYS = ("pool_add_to_ready_seconds", "nodeprep_seconds",
               "image_prefetch_seconds",
               "submit_to_task_complete_seconds")
@@ -306,6 +348,10 @@ def render() -> str:
             "checkpoint_overhead" in ckpt_details:
         details["checkpoint_overhead"] = (
             ckpt_details["checkpoint_overhead"])
+    # And the warm-start compilation phase's.
+    cw_details = _load(ARTIFACTS / "COMPILE_WARM_DETAILS.json") or {}
+    if "compile_warm" not in details and "compile_warm" in cw_details:
+        details["compile_warm"] = cw_details["compile_warm"]
     out.append("## Latest detailed run\n")
     if details.get("error"):
         out.append(f"**Status**: `{details['error']}`\n")
@@ -338,6 +384,7 @@ def render() -> str:
     _serving(out, "Serving, speculative decoding (paged KV)",
              details.get("serving_speculative_paged", {}))
     _checkpoint_overhead(out, details.get("checkpoint_overhead", {}))
+    _compile_warm(out, details.get("compile_warm", {}))
     _orchestration(out, details.get("orchestration", {}))
     _goodput(out)
     _silicon_proof(out)

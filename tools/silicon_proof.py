@@ -37,6 +37,11 @@ invokes this script on the first successful probe; it:
                       bench pool's event log (goodput/accounting.py):
                       goodput_ratio plus badput seconds per category,
                       persisted as GOODPUT_REPORT.json.
+  9. compile_warm   — warm-start compilation proof: cold vs warm
+                      persistent-compile-cache wall time for the
+                      transformer train step in fresh subprocesses,
+                      plus the AOT-precompile first-step spike check
+                      (batch_shipyard_tpu/compilecache/).
 
 Every phase's outcome is recorded in SILICON_PROOF.json; --dry-run
 writes the complete report skeleton on CPU (each phase records the
@@ -349,6 +354,41 @@ class Pipeline:
                     "ok" if ok else "failed", rc=rc,
                     metrics=summary, output_tail=out[-800:])
 
+    def compile_warm(self) -> None:
+        """Cold vs warm compile wall time through the persistent
+        compilation cache (bench.py's compile_warm workload): run 1
+        compiles the transformer train step cold into a fresh cache
+        dir, run 2 deserializes warm with AOT precompile — the per
+        node, per-restart badput that pool-wide cache seeding
+        removes. The dry-run skeleton names every metric."""
+        details_path = self.out / "COMPILE_WARM_DETAILS.json"
+        cmd = [sys.executable, "bench.py", "--workloads",
+               "compile_warm", "--details-out", str(details_path)]
+        metric_keys = ("cold_ms", "warm_ms", "speedup", "cache_hits",
+                       "aot_first_step_ms", "steady_step_ms")
+        if self.dry:
+            self.record("compile_warm", "dry_run",
+                        command=" ".join(cmd),
+                        metrics={k: None for k in metric_keys})
+            return
+        rc, out = _run(cmd, BENCH_QUICK_TIMEOUT, env=self.child_env)
+        try:
+            with open(details_path, encoding="utf-8") as fh:
+                det = json.load(fh)
+        except (OSError, ValueError):
+            det = {}
+        rep = det.get("compile_warm") or {}
+        if "error" in rep:
+            summary = {"error": rep["error"]}
+        else:
+            summary = {k: rep.get(k) for k in metric_keys}
+        ok = (rc == 0 and "error" not in summary
+              and summary.get("cold_ms") is not None
+              and summary.get("warm_ms") is not None
+              and summary["warm_ms"] < summary["cold_ms"])
+        self.record("compile_warm", "ok" if ok else "failed", rc=rc,
+                    metrics=summary, output_tail=out[-800:])
+
     def goodput(self) -> None:
         """Decompose whatever goodput events the bench run's state
         store accumulated into the paper's availability x resource x
@@ -409,6 +449,7 @@ class Pipeline:
             self.serving_speculative()
             self.checkpoint_overhead()
             self.goodput()
+            self.compile_warm()
         report = {
             "started_at": started,
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
