@@ -31,6 +31,7 @@ import time
 import uuid
 from typing import Callable, Optional
 
+from batch_shipyard_tpu.agent import progress as progress_mod
 from batch_shipyard_tpu.agent import task_runner
 from batch_shipyard_tpu.compilecache import manager as cc_manager
 from batch_shipyard_tpu.compilecache import seeding as cc_seeding
@@ -100,6 +101,12 @@ class NodeAgent:
                      Callable[[str], int]] = None,
                  force_remote_scratch: bool = False,
                  scratch_finalize_timeout: float = 120.0,
+                 retry_backoff_base: float = 2.0,
+                 retry_backoff_cap: float = 300.0,
+                 health_quarantine_threshold: float = 0.25,
+                 health_probation_seconds: float = 300.0,
+                 claim_visibility_seconds: float = 60.0,
+                 gang_sweep_interval: float = 60.0,
                  ) -> None:
         self.store = store
         self.identity = identity
@@ -145,6 +152,17 @@ class NodeAgent:
         # (job_id, task_id) -> last gang-health probe (rate limiting
         # the claim-failure bounce path).
         self._gang_probe_at: dict[tuple[str, str], float] = {}
+        # (gang_pk, instance) claims held LIVE by a worker slot of
+        # this process. A claim whose slot crashed (store fault after
+        # _gang_claim) leaves joined rows owned by a live node that
+        # nothing is running — no observer ever judges them stale, so
+        # the gang would wedge forever. Redelivery resumes such a
+        # claim, but only when no slot here still holds it (a
+        # duplicate message copy must not double-run the instance).
+        self._active_gang_claims: set[tuple[str, int]] = set()
+        # Orphaned-gang-row janitor cadence (heartbeat loop).
+        self.gang_sweep_interval = gang_sweep_interval
+        self._last_gang_sweep = time.monotonic()
         # (job_id, secret_id) -> resolved env block: one provider
         # round trip per job per node, not per task launch.
         self._env_block_cache: dict[tuple[str, str], dict] = {}
@@ -172,6 +190,37 @@ class NodeAgent:
         self._compile_cache_seen_gen: Optional[int] = None
         self._compile_cache_export_thread: Optional[
             threading.Thread] = None
+        # Retry supervisor: exponential backoff parameters for
+        # requeued failures (delay = base * 2^retries, capped, with
+        # deterministic per-(task, attempt) jitter).
+        self.retry_backoff_base = retry_backoff_base
+        self.retry_backoff_cap = retry_backoff_cap
+        # Claimed-message invisibility window: also the recovery-
+        # latency floor after a node crash (the dead node's claim
+        # redelivers only when this lapses). Chaos drills shrink it.
+        self.claim_visibility_seconds = claim_visibility_seconds
+        # Node health score in [0, 1]: decayed by task failures
+        # (harder by wedges), recovered by successes. Below the
+        # threshold the node quarantines itself — auto-drain: running
+        # work finishes, no new claims — and publishes the
+        # health/quarantined columns on its node entity so observers
+        # (gang recovery, heimdall) exclude it too.
+        self._health = 1.0
+        self._health_quarantine_threshold = health_quarantine_threshold
+        self._node_quarantined = False
+        # Quarantine is probational, never permanent: a quarantined
+        # node claims nothing, so it can never earn the successes that
+        # restore its score — without a timer, a poison job of
+        # ordinary buggy tasks (exit 1) would drain EVERY node in the
+        # pool forever. After this window the score resets to the
+        # threshold: one more failure re-quarantines immediately, one
+        # success starts real recovery.
+        self._health_probation_seconds = health_probation_seconds
+        self._quarantined_at = 0.0
+        self._health_lock = threading.Lock()
+        # Chaos injection seam: heartbeats are suppressed while
+        # wall-clock < this (simulated network partition).
+        self.heartbeat_blackout_until = 0.0
         # Retention sweeps: (monotonic deadline, task dir) for
         # completed tasks whose spec sets retention_time_seconds —
         # the Azure Batch task-constraint retention_time analog
@@ -197,17 +246,33 @@ class NodeAgent:
             "worker_index": self.identity.worker_index,
             "heartbeat_at": time.time(),
             "task_slots": self.pool.task_slots_per_node,
+            names.NODE_COL_HEALTH: self._health,
+            names.NODE_COL_QUARANTINED: self._node_quarantined,
         }
         entity.update(extra)
         self.store.upsert_entity(names.TABLE_NODES, pool_id, node_id, entity)
 
     def _heartbeat(self, **extra) -> None:
+        # Chaos seam (chaos/injectors.py heartbeat_blackout): a
+        # suppressed heartbeat simulates a partitioned-but-running
+        # node without touching the network stack.
+        if time.time() < self.heartbeat_blackout_until:
+            return
         pool_id, node_id = self._nid
+        # Health/quarantine ride on every heartbeat so a one-shot
+        # publish lost to a blackout window or store hiccup
+        # self-repairs on the next periodic write.
+        with self._health_lock:
+            health_cols = {
+                names.NODE_COL_HEALTH: self._health,
+                names.NODE_COL_QUARANTINED: self._node_quarantined,
+            }
         try:
             self.store.merge_entity(
                 names.TABLE_NODES, pool_id, node_id,
                 {"heartbeat_at": time.time(),
-                 "running_tasks": self._running_tasks, **extra})
+                 "running_tasks": self._running_tasks,
+                 **health_cols, **extra})
         except NotFoundError:
             pass
 
@@ -315,12 +380,25 @@ class NodeAgent:
 
     def _heartbeat_loop(self) -> None:
         while not self.stop_event.wait(self.heartbeat_interval):
-            self._heartbeat()
-            self._sweep_retention()
+            # A transient store error must not kill the heartbeat
+            # thread forever — that would turn one hiccup into a
+            # permanently "dead" node (orphan reclaim would then
+            # steal its running tasks).
+            try:
+                self._heartbeat()
+                self._sweep_retention()
+                self._sweep_orphaned_gangs()
+            except Exception:
+                logger.exception("heartbeat iteration failed; "
+                                 "continuing")
         # Final state write must NOT resurrect a node entity the
         # substrate already deleted (teardown race) — _heartbeat
-        # merges and tolerates a missing row.
-        self._heartbeat(state="offline")
+        # merges and tolerates a missing row. Best-effort: a store
+        # failure here just leaves the row to go heartbeat-stale.
+        try:
+            self._heartbeat(state="offline")
+        except Exception:
+            logger.exception("final offline heartbeat failed")
 
     # --------------------------- work loop -----------------------------
 
@@ -328,8 +406,16 @@ class NodeAgent:
         pool_id, node_id = self._nid
         ctrlq = names.control_queue(pool_id, node_id)
         while not self.stop_event.is_set():
-            msgs = self.store.get_messages(
-                ctrlq, max_messages=4, visibility_timeout=60.0)
+            try:
+                msgs = self.store.get_messages(
+                    ctrlq, max_messages=4, visibility_timeout=60.0)
+            except Exception:
+                # Same survival rule as the heartbeat loop: a store
+                # hiccup must not permanently deafen the node to
+                # control verbs (term_task, shutdown).
+                logger.exception("control poll failed; retrying")
+                time.sleep(self.poll_interval)
+                continue
             for msg in msgs:
                 try:
                     self._handle_control(json.loads(msg.payload))
@@ -359,6 +445,15 @@ class NodeAgent:
         skip = {0: 0, 2: 0}  # band index -> cycles left to skip
         streak = {0: 0, 2: 0}
         while not self.stop_event.is_set():
+            # Quarantined node: auto-drain means claim NOTHING — do
+            # not even pop messages. Each pop would hide a message
+            # from healthy nodes for a visibility window and churn
+            # the store for the whole probation period. (The
+            # per-message guard in _process_task_message stays as a
+            # backstop for races across this check.)
+            if self.node_quarantined():
+                time.sleep(self.poll_interval)
+                continue
             msg = None
             for b, band_queues in enumerate(bands):
                 if b in skip and skip[b] > 0:
@@ -368,8 +463,17 @@ class NodeAgent:
                 found = False
                 for k in range(n):
                     taskq = band_queues[(stagger + k) % n]
-                    msgs = self.store.get_messages(
-                        taskq, max_messages=1, visibility_timeout=60.0)
+                    try:
+                        msgs = self.store.get_messages(
+                            taskq, max_messages=1,
+                            visibility_timeout=(
+                                self.claim_visibility_seconds))
+                    except Exception:  # noqa: BLE001 - slot survives
+                        # A transient store error on the poll path
+                        # must not kill the worker slot forever.
+                        logger.exception("queue poll failed; "
+                                         "retrying")
+                        msgs = []
                     if msgs:
                         msg = msgs[0]
                         found = True
@@ -405,7 +509,10 @@ class NodeAgent:
                 self._goodput_work_done(slot)
                 try:
                     self.store.update_message(msg, visibility_timeout=5.0)
-                except NotFoundError:
+                except Exception:  # noqa: BLE001 - slot must survive
+                    # A store error in the error handler must not
+                    # kill the worker slot; visibility timeout will
+                    # redeliver the message anyway.
                     pass
 
     def _handle_control(self, control: dict) -> None:
@@ -584,7 +691,8 @@ class NodeAgent:
             state = ent.get("state")
             if state == "completed":
                 continue
-            if state in ("failed", "blocked"):
+            if state in ("failed", "blocked",
+                         names.TASK_STATE_QUARANTINED):
                 dep_action = (ent.get("spec", {}).get("exit_options", {})
                               .get("dependency_action", "block"))
                 if dep_action == "satisfy":
@@ -603,7 +711,7 @@ class NodeAgent:
         except NotFoundError:
             self.store.delete_message(msg)
             return
-        if entity.get("state") in ("completed", "failed", "blocked"):
+        if entity.get("state") in names.TERMINAL_TASK_STATES:
             self.store.delete_message(msg)
             return
         # Disabled jobs keep their tasks queued but unscheduled
@@ -629,6 +737,23 @@ class NodeAgent:
             # queue-head pinned message instead of the work behind it.
             self.store.update_message(
                 msg, visibility_timeout=self.poll_interval)
+            return
+        # Retry-supervisor backoff: a requeued task is not claimable
+        # before its not_before. The requeue message already carries
+        # the delay; this guards redelivered older copies of the
+        # message from defeating the backoff.
+        not_before = entity.get("not_before")
+        if not_before and time.time() < float(not_before):
+            self.store.update_message(
+                msg, visibility_timeout=min(
+                    5.0, max(0.1, float(not_before) - time.time())))
+            return
+        # Quarantined node: auto-drain. Make the message promptly
+        # visible for healthy nodes and claim nothing new.
+        if self.node_quarantined():
+            self.store.update_message(
+                msg, visibility_timeout=self.poll_interval)
+            time.sleep(self.poll_interval)
             return
         deps = self._deps_status(job_id, spec)
         if deps == "blocked":
@@ -702,13 +827,21 @@ class NodeAgent:
             return None
         return self._task_entity(job_id, task_id)
 
-    def _message_keepalive(self, msg, interval: float = 20.0,
-                           visibility: float = 60.0):
+    def _message_keepalive(self, msg, interval: Optional[float] = None,
+                           visibility: Optional[float] = None):
         """Keep a claimed queue message invisible while work runs.
 
         Without this, a task running past the visibility timeout gets
         redelivered and double-executed (on this node if it has spare
-        slots, or on another via the orphan-reclaim path)."""
+        slots, or on another via the orphan-reclaim path). The window
+        follows claim_visibility_seconds: it is also the FLOOR on
+        crashed-node recovery latency (a dead node's claimed message
+        only redelivers when its window lapses), which is why chaos
+        drills and tests shrink it."""
+        if visibility is None:
+            visibility = self.claim_visibility_seconds
+        if interval is None:
+            interval = max(0.5, visibility / 3.0)
         stop = threading.Event()
 
         def _renew() -> None:
@@ -767,6 +900,25 @@ class NodeAgent:
                 task_id=task_id, node_id=self.identity.node_id,
                 start=submitted, end=now,
                 attrs={"retries": entity.get("retries", 0)})
+        # Retry supervisor's deliberate backoff wait: priced on claim
+        # (never at requeue — that would future-date the interval).
+        # The window [requeue, not_before] sits inside the queue span
+        # above; backoff outranks queueing in the overlap sweep, so
+        # the deliberate wait lands in its own category without
+        # double counting. A task terminated mid-backoff simply never
+        # re-claims, and no unelapsed second is ever charged.
+        not_before = entity.get("not_before")
+        if (submitted is not None and not_before
+                and entity.get("requeued_at")):
+            end = min(float(not_before), now)
+            if end > submitted:
+                goodput_events.emit(
+                    self.store, self.identity.pool_id,
+                    goodput_events.TASK_BACKOFF, job_id=job_id,
+                    task_id=task_id, node_id=self.identity.node_id,
+                    start=submitted, end=end,
+                    attrs={"retries": entity.get("retries", 0),
+                           "delay_seconds": end - submitted})
 
     def _ensure_images_timed(self, job_id: str, task_id: str,
                              spec: dict) -> None:
@@ -887,11 +1039,259 @@ class NodeAgent:
         self._compile_cache_export_thread = thread
         thread.start()
 
+    # ------------------ retry supervisor + node health -----------------
+
+    def _backoff_seconds(self, task_id: str, retries: int) -> float:
+        """Exponential backoff with deterministic jitter for attempt
+        ``retries`` (1-based): base * 2^(n-1), capped, +-25% jitter
+        keyed on (task, attempt) so a burst of simultaneous failures
+        doesn't re-thunder onto the store in lockstep — and so chaos
+        drills with a fixed seed replay the exact same schedule."""
+        import zlib
+        n = max(1, retries)
+        delay = min(self.retry_backoff_cap,
+                    self.retry_backoff_base * (2.0 ** (n - 1)))
+        jitter = (zlib.crc32(f"{task_id}#{n}".encode()) % 1000) / 1000.0
+        return delay * (0.75 + 0.5 * jitter)
+
+    @staticmethod
+    def _retry_decision(retries: int, max_retries: int) -> str:
+        """THE supervisor policy, shared by the regular-task,
+        gang-recovery, and gang-finalize paths: 'requeue' while the
+        budget lasts (max_retries < 0 = unlimited), 'quarantine' once
+        a configured budget is burned, 'fail' when no budget was ever
+        configured (max_task_retries=0 keeps the legacy fail-fast
+        contract)."""
+        if max_retries < 0 or retries < max_retries:
+            return "requeue"
+        if max_retries > 0:
+            return "quarantine"
+        return "fail"
+
+    def _append_attempt(self, entity: dict, exit_code: int,
+                        reason: str) -> list[dict]:
+        """Attempt-history entry for the quarantine diagnostics
+        bundle, trimmed to the last 16 attempts."""
+        history = list(entity.get("attempt_history") or [])
+        history.append({"node_id": self.identity.node_id,
+                        "exit_code": exit_code, "reason": reason,
+                        "at": util.datetime_utcnow_iso()})
+        return history[-16:]
+
+    def _requeue_with_backoff(self, job_id: str, task_id: str,
+                              spec: dict, retries: int,
+                              exit_code: int, reason: str,
+                              instances: Optional[int] = None,
+                              if_match: Optional[str] = None) -> bool:
+        """Retry supervisor requeue: bump the retry counter, stamp
+        not_before (honored by the claim path; the queue message also
+        carries the delay) and append the attempt to the diagnostics
+        history. The backoff wait itself is priced by the claim side
+        once it has elapsed (see _goodput_work_started). Returns
+        False when the optimistic merge lost (someone else already
+        transitioned the task)."""
+        delay = self._backoff_seconds(task_id, retries)
+        now = time.time()
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            return False
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": "pending", "retries": retries,
+                "last_exit_code": exit_code,
+                "last_error": reason,
+                "not_before": now + delay,
+                "requeued_at": util.datetime_utcnow_iso(),
+                "attempt_history": self._append_attempt(
+                    entity, exit_code, reason),
+                "node_id": None,
+            }, if_match=if_match)
+        except (EtagMismatchError, NotFoundError):
+            return False
+        goodput_events.emit(
+            self.store, self.identity.pool_id,
+            goodput_events.TASK_RETRY, job_id=job_id,
+            task_id=task_id, node_id=self.identity.node_id,
+            attrs={"retries": retries, "exit_code": exit_code,
+                   "reason": reason})
+        # The TASK_BACKOFF interval is emitted by the CLAIM side
+        # (_goodput_work_started) once the wait has actually elapsed:
+        # emitting [now, now+delay] here would future-date the event,
+        # and any report or heimdall scrape taken during the window
+        # would extend wall past the present and charge seconds that
+        # never elapsed yet.
+        queue = names.task_queue_for(
+            self.identity.pool_id, task_id,
+            self.pool.task_queue_shards,
+            priority=int(spec.get("priority", 0) or 0))
+        if instances:
+            self.store.put_messages(
+                queue,
+                [json.dumps({"job_id": job_id, "task_id": task_id,
+                             "instance": k}).encode()
+                 for k in range(instances)],
+                delay_seconds=delay)
+        else:
+            self.store.put_message(
+                queue,
+                json.dumps({"job_id": job_id,
+                            "task_id": task_id}).encode(),
+                delay_seconds=delay)
+        logger.warning(
+            "task %s/%s requeued (attempt %d, %s); backoff %.1fs",
+            job_id, task_id, retries, reason, delay)
+        return True
+
+    def _quarantine_task(self, job_id: str, task_id: str,
+                         exit_code: int, reason: str,
+                         stderr_path: Optional[str] = None,
+                         if_match: Optional[str] = None) -> bool:
+        """Poison quarantine: the task exhausted its retry budget.
+        Park it terminally with a diagnostics bundle (last stderr
+        tail, per-attempt node/exit history) so the operator reads
+        the post-mortem off `jobs tasks list` instead of grepping
+        node logs. Returns False when the merge lost."""
+        tail = ""
+        if stderr_path:
+            try:
+                with open(stderr_path, "rb") as fh:
+                    fh.seek(max(0, os.path.getsize(stderr_path) - 2048))
+                    tail = fh.read().decode(errors="replace")
+            except OSError:
+                pass
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            return False
+        history = self._append_attempt(entity, exit_code, reason)
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": names.TASK_STATE_QUARANTINED,
+                "exit_code": exit_code,
+                "error": f"retry budget exhausted: {reason}",
+                "completed_at": util.datetime_utcnow_iso(),
+                "node_id": None,
+                # node/exit-code histories are projections of
+                # attempt_history — derived at display time
+                # (fleet.action_jobs_tasks_list), not stored thrice.
+                "diagnostics": {
+                    "stderr_tail": tail,
+                    "attempt_history": history,
+                },
+            }, if_match=if_match)
+        except (EtagMismatchError, NotFoundError):
+            return False
+        logger.error("task %s/%s quarantined after retry budget: %s",
+                     job_id, task_id, reason)
+        return True
+
+    def _drop_live_proc(self, key: tuple[str, str],
+                        mine: list) -> None:
+        """Remove this run's proc from the live-proc registry — and
+        ONLY this run's. A superseded gang zombie (its attempt was
+        recovered while it ran) must not unregister the recovered
+        attempt's proc on the same node, or term_task / chaos
+        task_kill would silently miss the live rerun."""
+        if mine and self._live_procs.get(key) is mine[-1]:
+            self._live_procs.pop(key, None)
+
+    def _run_task_registered(self, key: tuple[str, str],
+                             execution: task_runner.TaskExecution
+                             ) -> task_runner.TaskResult:
+        """run_task with live-proc registration (term_task control
+        verbs and chaos task_kill/task_wedge target the proc through
+        _live_procs), unregistering only its own entry on exit (see
+        _drop_live_proc). Shared by the regular and gang paths."""
+        mine: list = []
+
+        def _register(proc):
+            mine.append(proc)
+            self._live_procs[key] = proc
+
+        try:
+            return task_runner.run_task(execution,
+                                        on_start=_register)
+        finally:
+            self._drop_live_proc(key, mine)
+
+    def _note_task_outcome(self, ok: bool,
+                           wedged: bool = False) -> None:
+        """Node health scoring: failures decay the score (wedges
+        harder — a wedge usually implicates the node's accelerator
+        state, not the task), successes recover it. Crossing the
+        threshold quarantines the node: auto-drain via
+        claim-exclusion (this agent stops claiming; observers read
+        the column). Recovery back above the threshold un-drains."""
+        with self._health_lock:
+            if ok:
+                self._health = min(1.0, self._health + 0.1)
+            elif wedged:
+                self._health *= 0.5
+            else:
+                self._health *= 0.7
+            was = self._node_quarantined
+            self._node_quarantined = (
+                self._health < self._health_quarantine_threshold)
+            if self._node_quarantined and not was:
+                self._quarantined_at = time.monotonic()
+            health = self._health
+            quarantined = self._node_quarantined
+        if quarantined and not was:
+            logger.error(
+                "node %s health %.3f below threshold %.2f; "
+                "quarantining (draining: no further claims)",
+                self.identity.node_id, health,
+                self._health_quarantine_threshold)
+        elif was and not quarantined:
+            logger.warning("node %s recovered (health %.3f); "
+                           "resuming claims",
+                           self.identity.node_id, health)
+        # Advisory publish on the task-completion critical path: a
+        # store hiccup here must not discard a finished task's result
+        # (the periodic heartbeat now carries these columns, so a
+        # lost publish self-repairs).
+        try:
+            self._heartbeat()
+        except Exception:
+            logger.exception("health publish failed; will ride the "
+                             "next periodic heartbeat")
+
+    def node_quarantined(self) -> bool:
+        released = False
+        with self._health_lock:
+            if self._node_quarantined and (
+                    time.monotonic() - self._quarantined_at
+                    >= self._health_probation_seconds):
+                # Probation lapsed: resume claims at exactly the
+                # threshold score (see __init__ — quarantine must not
+                # be a terminal state for the node).
+                self._health = self._health_quarantine_threshold
+                self._node_quarantined = False
+                released = True
+            health = self._health
+            quarantined = self._node_quarantined
+        if released:
+            logger.warning(
+                "node %s quarantine probation lapsed after %.0fs; "
+                "resuming claims at health %.3f",
+                self.identity.node_id,
+                self._health_probation_seconds, health)
+            try:
+                self._heartbeat()
+            except Exception:
+                logger.exception("probation-release publish failed; "
+                                 "will ride the next periodic "
+                                 "heartbeat")
+        return quarantined
+
     # ----------------------- regular task path -------------------------
 
     def _claim_regular(self, job_id: str, task_id: str,
                        entity: dict) -> Optional[str]:
         if entity.get("state") != "pending":
+            return None
+        if self.node_quarantined():
             return None
         try:
             return self._merge_task(
@@ -950,14 +1350,10 @@ class NodeAgent:
             self._heartbeat(state="running")
             with self._running_lock:
                 self._running_tasks += 1
-            key = (job_id, task_id)
             try:
-                result = task_runner.run_task(
-                    execution,
-                    on_start=lambda proc: self._live_procs.__setitem__(
-                        key, proc))
+                result = self._run_task_registered(
+                    (job_id, task_id), execution)
             finally:
-                self._live_procs.pop(key, None)
                 with self._running_lock:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
@@ -971,31 +1367,39 @@ class NodeAgent:
                              job_id, task_id)
             self._merge_task(job_id, task_id,
                              {"output_error": str(exc)})
+        ok = result.exit_code == 0
+        self._note_task_outcome(ok, wedged=result.wedged)
         retries = entity.get("retries", 0)
         max_retries = spec.get("max_task_retries", 0)
-        if result.exit_code != 0 and (
-                max_retries < 0 or retries < max_retries):
-            goodput_events.emit(
-                self.store, self.identity.pool_id,
-                goodput_events.TASK_RETRY, job_id=job_id,
-                task_id=task_id, node_id=self.identity.node_id,
-                attrs={"retries": retries + 1,
-                       "exit_code": result.exit_code})
-            self._merge_task(job_id, task_id, {
-                "state": "pending", "retries": retries + 1,
-                "last_exit_code": result.exit_code,
-                "requeued_at": util.datetime_utcnow_iso(),
-                "node_id": None})
+        reason = ("wedged: no progress beat within "
+                  f"{spec.get('progress_deadline_seconds')}s"
+                  if result.wedged else
+                  f"exit code {result.exit_code}")
+        decision = ("complete" if ok
+                    else self._retry_decision(retries, max_retries))
+        if decision == "requeue":
+            # Retry supervisor: exponential backoff + jitter, the
+            # not_before stamp honored by every claimer.
+            self._requeue_with_backoff(
+                job_id, task_id, spec, retries + 1,
+                result.exit_code, reason)
+            self._heartbeat(state="idle")
             self.store.delete_message(msg)
-            self.store.put_message(
-                names.task_queue_for(
-                    self.identity.pool_id, task_id,
-                    self.pool.task_queue_shards,
-                    priority=int(spec.get("priority", 0) or 0)),
-                json.dumps({"job_id": job_id, "task_id": task_id}).encode())
             return
+        if decision == "quarantine":
+            # Poison quarantine: the budget is burned — park the task
+            # with its post-mortem instead of plain "failed".
+            if self._quarantine_task(job_id, task_id,
+                                     result.exit_code, reason,
+                                     stderr_path=result.stderr_path):
+                self._schedule_retention(spec, job_id, task_id)
+                self._heartbeat(state="idle")
+                self.store.delete_message(msg)
+                self._maybe_autocomplete_job(job_id)
+                return
         self._schedule_retention(spec, job_id, task_id)
-        self._finish_task(job_id, task_id, result)
+        self._finish_task(job_id, task_id, result,
+                          error=None if ok else reason)
         self.store.delete_message(msg)
         self._maybe_autocomplete_job(job_id)
 
@@ -1071,29 +1475,52 @@ class NodeAgent:
                 logger.info("retention expired; removed %s", task_dir)
 
     def _finish_task(self, job_id: str, task_id: str,
-                     result: task_runner.TaskResult) -> None:
-        self._merge_task(job_id, task_id, {
+                     result: task_runner.TaskResult,
+                     error: Optional[str] = None) -> None:
+        patch = {
             "state": "completed" if result.exit_code == 0 else "failed",
             "exit_code": result.exit_code,
             "timed_out": result.timed_out,
+            "wedged": result.wedged,
             "completed_at": result.completed_at,
             "wall_seconds": result.wall_seconds,
-        })
+        }
+        if error:
+            patch["error"] = error
+        self._merge_task(job_id, task_id, patch)
         self._heartbeat(state="idle")
 
     # ------------------------ gang (MI) task path ----------------------
 
-    def _gang_claim(self, job_id: str, task_id: str,
-                    instance: int) -> bool:
+    def _gang_pk(self, job_id: str, task_id: str,
+                 entity: dict) -> str:
+        """Attempt-namespaced gang partition: each recovery attempt
+        rendezvouses in a fresh partition (keyed on the task's retry
+        count), so a zombie member of a recovered gang can never
+        corrupt the rerun's rows (see names.gang_pk)."""
+        return names.gang_pk(self.identity.pool_id, job_id, task_id,
+                             attempt=int(entity.get("retries", 0)))
+
+    def _gang_claim(self, gang_pk: str, instance: int) -> bool:
         """Claim gang instance k for this node. One instance per node:
-        a second claim by the same node is released and requeued."""
-        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
+        a second claim by the same node is released and requeued.
+        Quarantined nodes never join a gang — one sick participant
+        wedges the whole ICI collective.
+
+        A True return registers the claim in _active_gang_claims;
+        the caller must release it on exit (_run_gang_instance's
+        finally)."""
+        if self.node_quarantined():
+            return False
         try:
             self.store.insert_entity(
                 names.TABLE_GANGS, gang_pk, f"node${self.identity.node_id}",
                 {"instance": instance})
         except EntityExistsError:
-            return False
+            # Our marker already exists: either another slot of this
+            # node is live in this gang (bounce), or a crashed slot
+            # abandoned its claim (resume).
+            return self._resume_own_gang_claim(gang_pk, instance)
         try:
             self.store.insert_entity(
                 names.TABLE_GANGS, gang_pk, f"i{instance}", {
@@ -1104,30 +1531,88 @@ class NodeAgent:
                     "worker_index": self.identity.worker_index,
                     "state": "joined",
                 })
+            with self._running_lock:
+                self._active_gang_claims.add((gang_pk, instance))
             return True
         except EntityExistsError:
+            # Our own instance row with the marker missing (a partial
+            # crash undid the marker but leaked the row): resume it,
+            # keeping the marker just re-inserted.
+            if self._resume_own_gang_claim(gang_pk, instance):
+                return True
             # Instance already claimed elsewhere; undo node marker.
             self.store.delete_entity(
                 names.TABLE_GANGS, gang_pk,
                 f"node${self.identity.node_id}")
             return False
+        except Exception:
+            # Store fault between the two inserts: without the undo
+            # the marker leaks (orphaned gang row) and this node is
+            # locked out of the attempt partition forever.
+            try:
+                self.store.delete_entity(
+                    names.TABLE_GANGS, gang_pk,
+                    f"node${self.identity.node_id}")
+            except Exception:
+                logger.exception("gang claim undo failed for %s "
+                                 "(terminal sweep will retire it)",
+                                 gang_pk)
+            raise
 
-    def _gang_members(self, job_id: str, task_id: str) -> list[dict]:
-        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
+    def _resume_own_gang_claim(self, gang_pk: str,
+                               instance: int) -> bool:
+        """Take back this node's own ABANDONED claim: the instance
+        row is ours and still 'joined', but no worker slot here holds
+        it live — a store fault after _gang_claim crashed the slot
+        out of the rendezvous. The node stays alive, so no gang
+        observer will ever judge the row stale, and no other node can
+        insert over it: without resume the gang wedges forever.
+        Registers the claim atomically with the liveness check so a
+        duplicate message copy in another slot cannot double-run."""
+        try:
+            row = self.store.get_entity(
+                names.TABLE_GANGS, gang_pk, f"i{instance}")
+        except NotFoundError:
+            return False
+        if (row.get("node_id") != self.identity.node_id
+                or row.get("state") != "joined"):
+            return False
+        with self._running_lock:
+            if (gang_pk, instance) in self._active_gang_claims:
+                return False
+            self._active_gang_claims.add((gang_pk, instance))
+        logger.warning(
+            "resuming abandoned gang claim %s i%d (a prior worker "
+            "slot crashed out of the rendezvous)", gang_pk, instance)
+        return True
+
+    def _gang_members(self, gang_pk: str) -> list[dict]:
         return [e for e in self.store.query_entities(
             names.TABLE_GANGS, partition_key=gang_pk, row_key_prefix="i")]
 
     def _node_alive(self, node_id: str) -> bool:
         """THE liveness predicate (shared by orphan reclaim and gang
-        health): node entity present, not offline, heartbeat fresh."""
+        health): node entity present, not offline, heartbeat fresh.
+
+        Registration grace: a node entity exists from the moment the
+        substrate registers it, but its FIRST heartbeat only lands
+        once the agent boots — judging heartbeat_at=0 as "dead" let a
+        gang observer fail a healthy just-booted member (the startup
+        race). A node that has never heartbeated is alive while its
+        registration is younger than the staleness window."""
         try:
             node = self.store.get_entity(
                 names.TABLE_NODES, self.identity.pool_id, node_id)
         except NotFoundError:
             return False
-        return (node.get("state") not in ("offline",) and
-                time.time() - float(node.get("heartbeat_at", 0)) <
-                self.node_stale_seconds)
+        if node.get("state") in ("offline",):
+            return False
+        heartbeat = float(node.get("heartbeat_at", 0) or 0)
+        if heartbeat <= 0:
+            registered = float(node.get("registered_at", 0) or 0)
+            return (registered > 0 and
+                    time.time() - registered < self.node_stale_seconds)
+        return time.time() - heartbeat < self.node_stale_seconds
 
     def _stale_gang_members(self, members: list[dict]) -> list[dict]:
         """Joined (not yet done) members whose node died — a
@@ -1146,25 +1631,190 @@ class NodeAgent:
                 stale.append(member)
         return stale
 
-    def _fail_broken_gang(self, job_id: str, task_id: str,
-                          stale: list[dict], msg) -> None:
-        dead = sorted(m.get("node_id", "?") for m in stale)
-        logger.warning("gang %s/%s lost member(s) %s; failing task",
-                       job_id, task_id, dead)
+    def _clear_gang_rows(self, gang_pk: str) -> None:
+        for row in list(self.store.query_entities(
+                names.TABLE_GANGS, partition_key=gang_pk)):
+            try:
+                self.store.delete_entity(names.TABLE_GANGS, gang_pk,
+                                         row["_rk"])
+            except NotFoundError:
+                pass
+
+    def _is_gang_sweep_leader(self) -> bool:
+        """Deterministic sweeper election without a lease: the
+        lowest-indexed node with a fresh heartbeat (or fresh
+        registration — the _node_alive grace rule) leads. One
+        partition-scoped nodes query per sweep interval."""
+        now = time.time()
+        best: Optional[int] = None
+        for node in self.store.query_entities(
+                names.TABLE_NODES,
+                partition_key=self.identity.pool_id):
+            if node.get("state") in ("offline",):
+                continue
+            heartbeat = float(node.get("heartbeat_at", 0) or 0)
+            if heartbeat > 0:
+                fresh = now - heartbeat < self.node_stale_seconds
+            else:
+                registered = float(node.get("registered_at", 0) or 0)
+                fresh = (registered > 0 and
+                         now - registered < self.node_stale_seconds)
+            if not fresh:
+                continue
+            index = int(node.get("node_index", 1 << 30))
+            if best is None or index < best:
+                best = index
+        return best is not None and best == self.identity.node_index
+
+    def _sweep_orphaned_gangs(self) -> None:
+        """Janitor for leaked rendezvous rows: a gang cleanup
+        interrupted mid-flight (store fault between a task's state
+        transition and its row clear, or a claim whose second insert
+        failed) is never retried by the member that owed it — the
+        rows would outlive their task forever. Any partition whose
+        task is terminal, gone, or already past that attempt
+        (entity retries advanced) is garbage. Clearing is
+        idempotent, so concurrent sweepers on other nodes are
+        harmless."""
+        if (time.monotonic() - self._last_gang_sweep
+                < self.gang_sweep_interval):
+            return
+        self._last_gang_sweep = time.monotonic()
+        # One sweeper per pool: the table scan below is unpartitioned
+        # (no prefix query in the store interface), so N nodes each
+        # scanning every interval would multiply fleet-wide read
+        # traffic for zero extra safety. Lowest-indexed LIVE node
+        # sweeps; a brief double-leader window during failover is
+        # harmless because clearing is idempotent.
+        if not self._is_gang_sweep_leader():
+            return
+        prefix = f"{self.identity.pool_id}$"
+        seen: set[str] = set()
+        for row in list(self.store.query_entities(names.TABLE_GANGS)):
+            pk = row["_pk"]
+            if pk in seen or not pk.startswith(prefix):
+                continue
+            seen.add(pk)
+            base, _, suffix = pk.partition("#g")
+            try:
+                attempt = int(suffix) if suffix else 0
+            except ValueError:
+                continue
+            parts = base.split("$")
+            if len(parts) != 3:
+                continue
+            _, job_id, task_id = parts
+            try:
+                entity = self._task_entity(job_id, task_id)
+            except NotFoundError:
+                entity = None
+            if (entity is not None
+                    and entity.get("state")
+                    not in names.TERMINAL_TASK_STATES
+                    and int(entity.get("retries", 0)) <= attempt):
+                # Live (or future) rendezvous attempt — not garbage.
+                continue
+            logger.warning("sweeping orphaned gang rows in %s", pk)
+            self._clear_gang_rows(pk)
+
+    def _clear_gang_history(self, job_id: str, task_id: str,
+                            retries: int) -> None:
+        """Retire EVERY attempt's rendezvous partition once the task
+        is terminal. An earlier attempt can leak rows when its
+        cleanup was cut short mid-flight (a store fault between the
+        requeue transition and its clear, or a claim whose second
+        insert failed): nothing retries those clears, so the
+        terminal transition sweeps attempts 0..retries to
+        self-repair. Best-effort per partition — a fault here leaves
+        at most what was already leaked."""
+        for attempt in range(retries + 1):
+            pk = names.gang_pk(self.identity.pool_id, job_id,
+                               task_id, attempt=attempt)
+            try:
+                self._clear_gang_rows(pk)
+            except Exception:
+                logger.exception("gang row sweep failed for %s", pk)
+
+    def _recover_broken_gang(self, job_id: str, task_id: str,
+                             gang_pk: str, stale: list[dict],
+                             msg, attempt: int = 0) -> None:
+        """Checkpoint-aware gang requeue: a gang that lost a member
+        (preemption, crash, wedge-killed node) is RE-RUN from its
+        latest COMMITTED checkpoint instead of failed terminally —
+        within the retry budget the whole gang requeues with backoff
+        (the rerun's restore pulls the committed step, so only the
+        steps since that checkpoint are rework: exactly the
+        preemption_recovery badput the goodput engine prices).
+        Exhausting the budget quarantines the task with diagnostics.
+
+        Every surviving member observes the same breakage; the
+        etag-guarded requeue/quarantine merge elects one recoverer —
+        losers only drop their message."""
+        dead = sorted({m.get("node_id", "?") for m in stale})
         try:
-            self._merge_task(job_id, task_id, {
-                "state": "failed", "exit_code": -4,
-                "error": f"gang member(s) lost: {dead}"})
+            entity = self._task_entity(job_id, task_id)
         except NotFoundError:
-            pass
+            self.store.delete_message(msg)
+            return
+        retries = int(entity.get("retries", 0))
+        if entity.get("state") in names.TERMINAL_TASK_STATES or \
+                retries != attempt:
+            # Terminally resolved, or a peer already recovered this
+            # attempt (every recovery bumps the retry counter — state
+            # alone can't discriminate: a gang broken during
+            # FORMATION is still legitimately "pending").
+            self.store.delete_message(msg)
+            return
+        spec = entity["spec"]
+        max_retries = spec.get("max_task_retries", 0)
+        num_instances = spec["multi_instance"]["num_instances"]
+        reason = f"gang member(s) lost: {dead}"
+        decision = self._retry_decision(retries, max_retries)
+        logger.warning("gang %s/%s lost member(s) %s; %s",
+                       job_id, task_id, dead,
+                       "requeuing from committed checkpoint"
+                       if decision == "requeue"
+                       else "retry budget exhausted")
+        if decision == "requeue":
+            if self._requeue_with_backoff(
+                    job_id, task_id, spec, retries + 1, -4, reason,
+                    instances=num_instances,
+                    if_match=entity["_etag"]):
+                goodput_events.emit(
+                    self.store, self.identity.pool_id,
+                    goodput_events.NODE_PREEMPTED, job_id=job_id,
+                    task_id=task_id,
+                    attrs={"dead_nodes": dead, "gang": True})
+                self._clear_gang_rows(gang_pk)
+        elif decision == "quarantine":
+            # A configured budget got burned: poison quarantine with
+            # the diagnostics bundle.
+            if self._quarantine_task(job_id, task_id, -4, reason,
+                                     if_match=entity["_etag"]):
+                self._clear_gang_history(job_id, task_id, retries)
+                self._maybe_autocomplete_job(job_id)
+        else:
+            # No retry budget configured (max_task_retries=0): the
+            # legacy fail-fast contract — terminal "failed", exit -4.
+            try:
+                self._merge_task(job_id, task_id, {
+                    "state": "failed", "exit_code": -4,
+                    "error": reason,
+                    "completed_at": util.datetime_utcnow_iso()},
+                    if_match=entity["_etag"])
+            except (EtagMismatchError, NotFoundError):
+                self.store.delete_message(msg)
+                return
+            self._clear_gang_history(job_id, task_id, retries)
+            self._maybe_autocomplete_job(job_id)
         self.store.delete_message(msg)
-        self._maybe_autocomplete_job(job_id)
 
     def _run_gang_instance(self, slot: int, job_id: str, task_id: str,
                            entity: dict, instance: int, msg) -> None:
         spec = entity["spec"]
         num_instances = spec["multi_instance"]["num_instances"]
-        if not self._gang_claim(job_id, task_id, instance):
+        gang_pk = self._gang_pk(job_id, task_id, entity)
+        if not self._gang_claim(gang_pk, instance):
             # This node can't take this instance. Probe gang health at
             # most once per heartbeat interval per gang — the bounce
             # path spins during normal formation on large pools.
@@ -1173,25 +1823,47 @@ class NodeAgent:
             if now - self._gang_probe_at.get(probe_key, 0.0) > max(
                     1.0, self.heartbeat_interval):
                 self._gang_probe_at[probe_key] = now
-                members = self._gang_members(job_id, task_id)
+                members = self._gang_members(gang_pk)
                 if (len(members) >= num_instances and all(
                         m.get("state") == "done" for m in members)):
                     # Whole gang finished but the last member crashed
                     # between marking done and finalizing: finish the
                     # aggregation on its behalf.
-                    self._gang_finalize(job_id, task_id, num_instances)
+                    self._gang_finalize(job_id, task_id, gang_pk,
+                                        num_instances)
                     self.store.delete_message(msg)
                     self._maybe_autocomplete_job(job_id)
                     return
                 stale = self._stale_gang_members(members)
                 if stale:
-                    self._fail_broken_gang(job_id, task_id, stale, msg)
+                    self._recover_broken_gang(
+                        job_id, task_id, gang_pk, stale, msg,
+                        attempt=int(entity.get("retries", 0)))
                     return
             # Otherwise make the message promptly available for other
             # nodes.
             self.store.update_message(msg, visibility_timeout=0.0)
             time.sleep(self.poll_interval)
             return
+        try:
+            self._run_gang_claimed(slot, job_id, task_id, entity,
+                                   instance, msg, gang_pk,
+                                   num_instances, spec)
+        finally:
+            # Release the slot-local claim registration taken by
+            # _gang_claim however we exit; a crash here leaves the
+            # rows joined+ours, and the redelivered message resumes
+            # them through _resume_own_gang_claim.
+            with self._running_lock:
+                self._active_gang_claims.discard((gang_pk, instance))
+
+    def _run_gang_claimed(self, slot: int, job_id: str, task_id: str,
+                          entity: dict, instance: int, msg,
+                          gang_pk: str, num_instances: int,
+                          spec: dict) -> None:
+        """Post-claim gang path: rendezvous, run, aggregate. The
+        caller holds this node's active-claim registration for
+        (gang_pk, instance) and releases it when this returns."""
         self._goodput_work_started(slot, job_id, task_id, entity,
                                    emit_queued=(instance == 0))
         # Rendezvous: wait for all instances to join, watching for
@@ -1200,29 +1872,61 @@ class NodeAgent:
         keepalive = time.monotonic()
         last_stale_check = 0.0
         while True:
-            members = self._gang_members(job_id, task_id)
+            members = self._gang_members(gang_pk)
             if len(members) >= num_instances:
                 break
             if time.monotonic() - last_stale_check > max(
                     1.0, self.heartbeat_interval):
                 stale = self._stale_gang_members(members)
                 if stale:
-                    self._fail_broken_gang(job_id, task_id, stale, msg)
+                    self._recover_broken_gang(
+                        job_id, task_id, gang_pk, stale, msg,
+                        attempt=int(entity.get("retries", 0)))
                     self._goodput_work_done(slot)
                     return
                 last_stale_check = time.monotonic()
             if time.monotonic() > deadline:
-                self._merge_task(job_id, task_id, {
-                    "state": "failed", "exit_code": -1,
-                    "error": "gang rendezvous timeout"})
+                retries = int(entity.get("retries", 0))
+                try:
+                    fresh = self._task_entity(job_id, task_id)
+                except NotFoundError:
+                    fresh = None
+                if (fresh is not None
+                        and fresh.get("state")
+                        not in names.TERMINAL_TASK_STATES
+                        and int(fresh.get("retries", 0)) == retries):
+                    try:
+                        self._merge_task(job_id, task_id, {
+                            "state": "failed", "exit_code": -1,
+                            "error": "gang rendezvous timeout",
+                            "completed_at":
+                                util.datetime_utcnow_iso()},
+                            if_match=fresh["_etag"])
+                    except (EtagMismatchError, NotFoundError):
+                        # A peer recovered/terminated the task
+                        # concurrently — its transition wins.
+                        self.store.delete_message(msg)
+                        self._goodput_work_done(slot)
+                        return
+                    # Terminal: retire the rendezvous rows now, not
+                    # at the janitor's next leader pass.
+                    self._clear_gang_history(job_id, task_id, retries)
                 self.store.delete_message(msg)
                 self._goodput_work_done(slot)
                 return
             if self.stop_event.is_set():
                 self._goodput_work_done(slot)
                 return
-            if time.monotonic() - keepalive > 30.0:
-                self.store.update_message(msg, visibility_timeout=60.0)
+            # Renew the claim on the same cadence as
+            # _message_keepalive: the visibility window is
+            # configurable (drills shrink it below the old hardcoded
+            # 30s renew), and a lapsed window mid-rendezvous means
+            # duplicate redeliveries churning the bounce path.
+            if time.monotonic() - keepalive > max(
+                    0.5, self.claim_visibility_seconds / 3.0):
+                self.store.update_message(
+                    msg,
+                    visibility_timeout=self.claim_visibility_seconds)
                 keepalive = time.monotonic()
             time.sleep(self.poll_interval)
         if instance == 0:
@@ -1238,7 +1942,7 @@ class NodeAgent:
                 hostname=m["hostname"], internal_ip=m["internal_ip"],
                 slice_index=m.get("slice_index", 0),
                 worker_index=m.get("worker_index", 0))
-            for m in sorted(self._gang_members(job_id, task_id),
+            for m in sorted(self._gang_members(gang_pk),
                             key=lambda e: int(e["_rk"][1:]))]
         me = next(m for m in gang_members if m.instance == instance)
         mi = _mi_settings_from_spec(spec["multi_instance"])
@@ -1300,14 +2004,32 @@ class NodeAgent:
                             task_dir=os.path.join(
                                 execution.task_dir, "coord"))
                         task_runner.run_task(coordination)
-                    result = task_runner.run_task(execution)
+                    # Register the live proc like the regular path:
+                    # term_task control verbs and chaos task_kill/
+                    # task_wedge injections target gang instances too.
+                    result = self._run_task_registered(
+                        (job_id, task_id), execution)
             finally:
                 with self._running_lock:
                     self._running_tasks -= 1
-        gang_pk = names.gang_pk(self.identity.pool_id, job_id, task_id)
-        self.store.merge_entity(
-            names.TABLE_GANGS, gang_pk, f"i{instance}",
-            {"state": "done", "exit_code": result.exit_code})
+        self._note_task_outcome(result.exit_code == 0,
+                                wedged=result.wedged)
+        try:
+            self.store.merge_entity(
+                names.TABLE_GANGS, gang_pk, f"i{instance}",
+                {"state": "done", "exit_code": result.exit_code})
+        except NotFoundError:
+            # The gang was recovered (requeued under a new attempt
+            # partition) while this instance was running: its result
+            # belongs to a superseded attempt. Clean up and bow out —
+            # the rerun owns the task entity now.
+            logger.warning(
+                "gang %s/%s i%d finished after the gang was "
+                "recovered; discarding superseded result",
+                job_id, task_id, instance)
+            self._goodput_task_finished(slot, job_id, task_id, result)
+            self.store.delete_message(msg)
+            return
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
         self._ingest_goodput(job_id, task_id, execution)
@@ -1322,13 +2044,17 @@ class NodeAgent:
                              {"output_error": str(exc)})
         self._schedule_retention(spec, job_id, task_id)
         self.store.delete_message(msg)
-        self._gang_finalize(job_id, task_id, num_instances)
+        self._gang_finalize(job_id, task_id, gang_pk, num_instances)
         self._maybe_autocomplete_job(job_id)
 
-    def _gang_finalize(self, job_id: str, task_id: str,
+    def _gang_finalize(self, job_id: str, task_id: str, gang_pk: str,
                        num_instances: int) -> None:
-        """Last instance to finish aggregates the gang exit code."""
-        members = self._gang_members(job_id, task_id)
+        """Last instance to finish aggregates the gang exit code. A
+        failing gang (any nonzero member) retries WHOLE — same
+        supervisor as regular tasks: backoff requeue within the
+        budget (the rerun restores from the committed checkpoint),
+        quarantine past it."""
+        members = self._gang_members(gang_pk)
         done = [m for m in members if m.get("state") == "done"]
         if len(done) < num_instances:
             return
@@ -1341,7 +2067,28 @@ class NodeAgent:
             entity = self._task_entity(job_id, task_id)
         except NotFoundError:
             return
-        if entity.get("state") in ("completed", "failed"):
+        if entity.get("state") in names.TERMINAL_TASK_STATES or \
+                entity.get("state") == "pending":
+            return
+        spec = entity["spec"]
+        retries = int(entity.get("retries", 0))
+        max_retries = spec.get("max_task_retries", 0)
+        decision = ("complete" if exit_code == 0
+                    else self._retry_decision(retries, max_retries))
+        if decision == "requeue":
+            if self._requeue_with_backoff(
+                    job_id, task_id, spec, retries + 1, exit_code,
+                    f"gang exit code {exit_code}",
+                    instances=num_instances,
+                    if_match=entity["_etag"]):
+                self._clear_gang_rows(gang_pk)
+            return
+        if decision == "quarantine":
+            if self._quarantine_task(
+                    job_id, task_id, exit_code,
+                    f"gang exit code {exit_code}",
+                    if_match=entity["_etag"]):
+                self._clear_gang_history(job_id, task_id, retries)
             return
         try:
             self._merge_task(job_id, task_id, {
@@ -1350,7 +2097,12 @@ class NodeAgent:
                 "completed_at": util.datetime_utcnow_iso(),
             }, if_match=entity["_etag"])
         except (EtagMismatchError, NotFoundError):
-            pass
+            return
+        # Terminal: retire the rendezvous partitions (every attempt)
+        # so no gang rows outlive their task (the drill's
+        # no-orphaned-state invariant). Late zombie members of this
+        # attempt get NotFoundError on their done-merge and bow out.
+        self._clear_gang_history(job_id, task_id, retries)
 
     # --------------------------- helpers -------------------------------
 
@@ -1470,6 +2222,20 @@ class NodeAgent:
             goodput_events.GOODPUT_FILE_ENV,
             os.path.join(task_dir.rstrip("/"),
                          "goodput_events.jsonl"))
+        # Wedge-watchdog liveness file: instrumented workloads beat it
+        # every step (agent/progress.py); the task runner kills tasks
+        # whose spec declares progress_deadline_seconds when it goes
+        # stale.
+        env.setdefault(
+            progress_mod.PROGRESS_FILE_ENV,
+            os.path.join(task_dir.rstrip("/"), "progress_beat"))
+        if spec.get("progress_deadline_seconds") is not None:
+            # Export the deadline too: beat() scales its write
+            # throttle to it, so a tight deadline can't be starved by
+            # the throttle itself.
+            env.setdefault(
+                progress_mod.PROGRESS_DEADLINE_ENV,
+                str(spec["progress_deadline_seconds"]))
         # Warm-start compilation: every task sees the node's
         # persistent compile cache dir, seeded from the pool artifact
         # just before launch so restarts and late pool joiners
@@ -1488,6 +2254,8 @@ class NodeAgent:
             env=env, task_dir=task_dir.rstrip("/"), slot=slot,
             instances=instances, instance=instance, host_list=host_list,
             max_wall_time_seconds=spec.get("max_wall_time_seconds"),
+            progress_deadline_seconds=spec.get(
+                "progress_deadline_seconds"),
             remove_container_after_exit=spec.get(
                 "remove_container_after_exit", True),
             shm_size=spec.get("shm_size"),
@@ -2054,7 +2822,7 @@ class NodeAgent:
         tasks = list(self.store.query_entities(
             names.TABLE_TASKS, partition_key=pk))
         if not tasks or any(
-                t.get("state") not in ("completed", "failed", "blocked")
+                t.get("state") not in names.TERMINAL_TASK_STATES
                 for t in tasks):
             return
         try:

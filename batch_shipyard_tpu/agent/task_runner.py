@@ -20,6 +20,11 @@ Env contract exposed to every task (the $AZ_BATCH_* analog):
   SHIPYARD_GOODPUT_FILE    JSONL sink for program-phase goodput events
                            (goodput/events.py record/phase); the agent
                            ingests it into TABLE_GOODPUT post-task
+  SHIPYARD_PROGRESS_FILE   liveness file for the wedge watchdog
+                           (agent/progress.py): instrumented workloads
+                           beat it every step; tasks declaring
+                           progress_deadline_seconds are killed when
+                           it goes stale (hang -> bounded retry)
 plus, for gang tasks with jax_distributed enabled, the launcher env from
 jobs/launcher.py (JAX_COORDINATOR_ADDRESS etc.).
 """
@@ -34,6 +39,7 @@ import subprocess
 import time
 from typing import Optional
 
+from batch_shipyard_tpu.agent import progress
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -63,6 +69,9 @@ class TaskExecution:
     instance: int = 0
     host_list: tuple[str, ...] = ()
     max_wall_time_seconds: Optional[float] = None
+    # Wedge watchdog: kill the task when its progress file goes stale
+    # past this deadline (None = watchdog disabled for this task).
+    progress_deadline_seconds: Optional[float] = None
     remove_container_after_exit: bool = True
     shm_size: Optional[str] = None
     additional_docker_run_options: tuple[str, ...] = ()
@@ -80,6 +89,9 @@ class TaskResult:
     completed_at: str
     wall_seconds: float
     timed_out: bool = False
+    # True when the wedge watchdog killed the task for missing its
+    # progress deadline (alive but stalled — the TPU-wedge shape).
+    wedged: bool = False
 
 
 def build_task_env(execution: TaskExecution,
@@ -103,6 +115,16 @@ def build_task_env(execution: TaskExecution,
     return env
 
 
+def container_name(execution: "TaskExecution") -> Optional[str]:
+    """The fixed docker ``--name`` for this execution, or None for
+    non-docker runtimes and exec-in tasks (which attach to a
+    container somebody else owns)."""
+    if execution.runtime == "docker" and not execution.docker_exec_in:
+        return (f"shipyard-{execution.job_id}-{execution.task_id}"
+                f"-i{execution.instance}")
+    return None
+
+
 def synthesize_command(execution: TaskExecution) -> list[str]:
     """Build the argv for the task's runtime.
 
@@ -122,9 +144,7 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
             argv += ["--runtime", "kata-runtime"]
         if execution.remove_container_after_exit:
             argv.append("--rm")
-        argv += ["--name",
-                 f"shipyard-{execution.job_id}-{execution.task_id}"
-                 f"-i{execution.instance}"]
+        argv += ["--name", container_name(execution)]
         if execution.interactive:
             argv.append("-it")
         # TPU device passthrough (the nvidia-runtime analog).
@@ -158,6 +178,18 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
                 rel = os.path.relpath(host_file, host_dir)
                 argv += ["-e",
                          f"SHIPYARD_GOODPUT_FILE=/shipyard/task/{rel}"]
+        progress_file = execution.env.get(progress.PROGRESS_FILE_ENV)
+        if progress_file:
+            # Same mount remap as the goodput sink: beats written
+            # inside the container must land where the host-side
+            # watchdog stats them.
+            host_dir = os.path.abspath(execution.task_dir)
+            host_file = os.path.abspath(progress_file)
+            if host_file.startswith(host_dir + os.sep):
+                rel = os.path.relpath(host_file, host_dir)
+                argv += ["-e",
+                         f"{progress.PROGRESS_FILE_ENV}="
+                         f"/shipyard/task/{rel}"]
         cache_dir = execution.env.get("SHIPYARD_COMPILE_CACHE_DIR")
         if cache_dir:
             # The node's persistent compile cache lives OUTSIDE the
@@ -203,38 +235,120 @@ def run_task(execution: TaskExecution,
     started_at = util.datetime_utcnow_iso()
     start = time.monotonic()
     timed_out = False
+    wedged = False
+    progress_file = execution.env.get(progress.PROGRESS_FILE_ENV)
+    watchdog = execution.progress_deadline_seconds
+    if progress_file:
+        # Spawn counts as the first beat: the watchdog clock starts
+        # now, and un-instrumented-but-opted-in tasks get the full
+        # deadline before their first (never-coming) beat is due.
+        progress.seed(progress_file)
     with open(stdout_path, "wb") as out, open(stderr_path, "wb") as err:
         proc = subprocess.Popen(
             argv, stdout=out, stderr=err, env=env, cwd=execution.task_dir,
             start_new_session=True)
         if on_start is not None:
             on_start(proc)
-        try:
-            exit_code = proc.wait(timeout=execution.max_wall_time_seconds)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            logger.warning(
-                "task %s/%s/%s exceeded wall time %.1fs; killing",
-                execution.pool_id, execution.job_id, execution.task_id,
-                execution.max_wall_time_seconds)
+        policing = watchdog is not None and progress_file
+        while True:
+            if policing:
+                timeout = _WATCHDOG_POLL_SECONDS
+            elif execution.max_wall_time_seconds is not None:
+                # Wall limit only: sleep straight to the deadline —
+                # no 5 Hz wakeups over a multi-hour task lifetime.
+                timeout = max(0.1, execution.max_wall_time_seconds
+                              - (time.monotonic() - start))
+            else:
+                # Nothing to police: one blocking wait.
+                timeout = None
             try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
-            except ProcessLookupError:
-                pass
-            try:
-                exit_code = proc.wait(timeout=10)
+                exit_code = proc.wait(timeout=timeout)
+                break
             except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-                exit_code = proc.wait()
+                pass
+            elapsed = time.monotonic() - start
+            if execution.max_wall_time_seconds is not None and \
+                    elapsed > execution.max_wall_time_seconds:
+                timed_out = True
+                logger.warning(
+                    "task %s/%s/%s exceeded wall time %.1fs; killing",
+                    execution.pool_id, execution.job_id,
+                    execution.task_id,
+                    execution.max_wall_time_seconds)
+                exit_code = _kill_task(
+                    proc, grace_seconds=10.0,
+                    container=container_name(execution))
+                break
+            if watchdog is not None and progress_file:
+                beat = progress.last_beat(progress_file)
+                stale = (elapsed if beat is None
+                         else time.time() - beat)
+                if stale > watchdog:
+                    # Wedged: alive but no progress. SIGKILL straight
+                    # away — the motivating hangs (TPU_WEDGE_REPORT.md)
+                    # sit inside the runtime and never honor SIGTERM.
+                    wedged = True
+                    logger.warning(
+                        "task %s/%s/%s made no progress for %.1fs "
+                        "(deadline %.1fs); killing as wedged",
+                        execution.pool_id, execution.job_id,
+                        execution.task_id, stale, watchdog)
+                    exit_code = _kill_task(
+                        proc, grace_seconds=0.0,
+                        container=container_name(execution))
+                    break
     wall = time.monotonic() - start
     return TaskResult(
         exit_code=exit_code, stdout_path=stdout_path,
         stderr_path=stderr_path, started_at=started_at,
         completed_at=util.datetime_utcnow_iso(), wall_seconds=wall,
-        timed_out=timed_out)
+        timed_out=timed_out, wedged=wedged)
+
+
+# Watchdog poll granularity: how often a running task's wall-time and
+# progress deadlines are re-checked. Small enough that tests with
+# ~second deadlines stay sharp; large enough to cost nothing.
+_WATCHDOG_POLL_SECONDS = 0.2
+
+
+def _kill_task(proc, grace_seconds: float = 10.0,
+               container: Optional[str] = None) -> int:
+    """Kill a task's whole process group: SIGTERM with a grace window,
+    then SIGKILL (grace_seconds=0 goes straight to SIGKILL — the
+    wedge path, where SIGTERM provably never lands).
+
+    For docker tasks the process-group escalation only reaches the
+    docker CLIENT: SIGKILL is never proxied, so the container (and
+    the accelerator it holds) would live on, and its fixed --name
+    would break every retry landing on this node. Before the hard
+    kill, force-remove the container so the workload actually dies
+    and the name is freed. (SIGTERM in the grace window IS proxied
+    by the client, so graceful shutdown still works.)"""
+    if grace_seconds > 0:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            return proc.wait(timeout=grace_seconds)
+        except subprocess.TimeoutExpired:
+            pass
+    if container is not None:
+        _force_remove_container(container)
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    return proc.wait()
+
+
+def _force_remove_container(name: str) -> None:
+    try:
+        subprocess.run(["docker", "rm", "-f", name],
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL, timeout=30)
+    except Exception:  # noqa: BLE001 - kill escalation proceeds anyway
+        logger.warning("docker rm -f %s failed", name, exc_info=True)
 
 
 def format_command_line(argv: list[str]) -> str:

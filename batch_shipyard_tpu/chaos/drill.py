@@ -1,0 +1,223 @@
+"""Chaos drill: run a seeded fault schedule against a real fakepod
+pool and assert the self-healing invariants.
+
+The drill is the proof the recovery layer demands: it builds a pool of
+REAL NodeAgents (threads over a shared state store), submits a batch
+of watchdog-protected tasks, replays a ChaosPlan's injections at their
+scheduled offsets — wedges, mid-run kills, node preemptions, heartbeat
+blackouts, store faults — then verifies that the system healed:
+
+  * every task reached ``completed`` (bounded retries beat every
+    injected fault),
+  * exactly-once effects (each task's output holds exactly its line),
+  * no orphaned coordination state (gang rows, queue messages),
+  * the goodput partition stayed exact (productive + badput +
+    overlapped == wall) — chaos may move seconds between categories
+    but can never lose any.
+
+Used by `shipyard chaos drill`, tools/chaos_drill.py, and the test
+suite (tests/test_chaos_recovery.py drives small, fast drills).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.chaos import injectors as injectors_mod
+from batch_shipyard_tpu.chaos.plan import ChaosPlan
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+POOL_ID = "chaos-drill"
+JOB_ID = "drill"
+# Every drill workload carries one real gang task alongside the
+# regular tasks: without it TABLE_GANGS is empty by construction and
+# the "no orphaned gang rows" invariant would be vacuously true — a
+# leak in _clear_gang_rows/_recover_broken_gang under chaos would
+# pass every drill.
+GANG_TASK_ID = "g000"
+GANG_INSTANCES = 2
+
+
+def run_drill(seed: int = 0, tasks: int = 16,
+              accelerator: str = "v5litepod-16",
+              duration: float = 4.0,
+              kinds: Optional[tuple[str, ...]] = None,
+              injections_per_kind: int = 1,
+              task_sleep: float = 1.2,
+              wait_timeout: float = 120.0,
+              plan: Optional[ChaosPlan] = None) -> dict:
+    """Run one drill; returns the report dict (invariants + plan
+    fingerprint + goodput decomposition). Raises AssertionError when
+    an invariant does not hold.
+
+    Defaults are tuned so the submitted work SPANS the injection
+    window (tasks * task_sleep ≈ 2-3x duration / slots): a kill
+    scheduled at t=3 must find a victim actually running, or the
+    drill proves nothing about the kill paths. ``tasks`` counts the
+    regular tasks; one gang task (``GANG_TASK_ID``) always rides
+    along so the gang-row cleanup invariant is actually exercised."""
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    raw_store = MemoryStateStore()
+    chaos_store = injectors_mod.ChaosStore(raw_store)
+    # Agents live on the chaos-wrapped store (they must survive the
+    # faults); the drill driver itself orchestrates through the raw
+    # store so an injected error never masquerades as a driver bug.
+    substrate = FakePodSubstrate(chaos_store, node_stale_seconds=3.0)
+    substrate.agent_kwargs = {
+        "retry_backoff_base": 0.2, "retry_backoff_cap": 2.0,
+        # The claimed-message window floors crashed-node recovery
+        # latency; production's 60s would dominate a seconds-scale
+        # drill.
+        "claim_visibility_seconds": 5.0,
+        # Fast janitor cadence: a cleanup lost to an injected store
+        # fault must be swept inside the invariant-check window.
+        "gang_sweep_interval": 1.0}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "tpu": {"accelerator_type": accelerator},
+        "task_slots_per_node": 2,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    if plan is None:
+        plan = ChaosPlan.generate(
+            seed, duration=duration,
+            num_nodes=pool.tpu.total_workers if pool.tpu else 4,
+            kinds=kinds, injections_per_kind=injections_per_kind)
+    report: dict = {"seed": plan.seed,
+                    "fingerprint": plan.fingerprint(),
+                    "plan": plan.to_dict(),
+                    "applied": [], "invariants": {}}
+    try:
+        pool_mgr.create_pool(raw_store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": JOB_ID,
+            "tasks": [{"id": f"t{i:03d}",
+                       "command": (f"sleep {task_sleep} && "
+                                   f"echo drill-{i}"),
+                       "max_task_retries": 8,
+                       "progress_deadline_seconds": 2}
+                      for i in range(tasks)]
+                     + [{"id": GANG_TASK_ID,
+                         "command": (f"sleep {task_sleep} && "
+                                     "echo drill-gang"),
+                         "max_task_retries": 8,
+                         "progress_deadline_seconds": 2,
+                         "multi_instance": {
+                             "num_instances": GANG_INSTANCES}}],
+        }]})
+        started = time.monotonic()
+        jobs_mgr.add_jobs(raw_store, pool, jobs)
+        driver = threading.Thread(
+            target=_inject_schedule,
+            args=(plan, started, substrate, chaos_store, report),
+            daemon=True, name="chaos-driver")
+        driver.start()
+        task_rows = jobs_mgr.wait_for_tasks(
+            raw_store, POOL_ID, JOB_ID, timeout=wait_timeout,
+            poll_interval=0.25)
+        driver.join(timeout=max(0.0, duration -
+                                (time.monotonic() - started)) + 5.0)
+        _check_invariants(raw_store, task_rows, tasks, report)
+    finally:
+        substrate.stop_all()
+    return report
+
+
+def _inject_schedule(plan: ChaosPlan, started: float, substrate,
+                     chaos_store, report: dict) -> None:
+    for injection in plan.injections:
+        delay = injection.at - (time.monotonic() - started)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            record = injectors_mod.apply_injection(
+                injection, substrate, POOL_ID, store=chaos_store)
+        except Exception as exc:  # noqa: BLE001 - record, keep going
+            record = {"kind": injection.kind, "error": str(exc)}
+        logger.info("chaos injection %s", record)
+        report["applied"].append(record)
+
+
+def _check_invariants(store, task_rows: list, expected: int,
+                      report: dict) -> None:
+    invariants = report["invariants"]
+    # 1. Every task completed (exactly the expected set, each once —
+    # entities are unique by id, so completion is single-valued).
+    states: dict = {}
+    for task in task_rows:
+        states[task.get("state")] = states.get(task.get("state"), 0) + 1
+    invariants["tasks"] = states
+    assert states == {"completed": expected + 1}, (
+        f"drill tasks not all completed: {states}")
+    # 2. Exactly-once effects: the final output of each task is its
+    # single line (a double-completed task would have been re-run
+    # after success and is a claim-protocol bug).
+    for task in task_rows:
+        task_id = task["_rk"]
+        if task_id == GANG_TASK_ID:
+            # Gang instance 0's final output holds its single line
+            # (a recovered attempt overwrites the same key, so this
+            # checks the LAST attempt ran cleanly).
+            out = jobs_mgr.get_task_output(
+                store, POOL_ID, JOB_ID, task_id, instance=0)
+            assert out.strip() == b"drill-gang", (
+                f"{task_id}: unexpected gang output {out!r}")
+            continue
+        index = int(task_id[1:])
+        out = jobs_mgr.get_task_output(store, POOL_ID, JOB_ID, task_id)
+        assert out.strip() == f"drill-{index}".encode(), (
+            f"{task_id}: unexpected output {out!r}")
+    # 3. No orphaned coordination state: gang rows are gone and the
+    # task queues drain, each within a bounded window (terminal-task
+    # messages get deleted on next delivery; a gang cleanup lost to
+    # an injected store fault is repaired by the agents' orphan
+    # janitor sweep). The workload's gang task guarantees gang rows
+    # EXISTED during the drill, so an empty table here proves
+    # cleanup, not absence of gangs.
+    deadline = time.monotonic() + 30.0
+    queues = names.task_queues(POOL_ID, 1)
+    while True:
+        leftover_gangs = list(store.query_entities(names.TABLE_GANGS))
+        depth = sum(store.queue_length(q) for q in queues)
+        if (not leftover_gangs and depth == 0) or \
+                time.monotonic() >= deadline:
+            break
+        time.sleep(0.25)
+    invariants["orphaned_gang_rows"] = len(leftover_gangs)
+    assert not leftover_gangs, leftover_gangs
+    invariants["queue_depth"] = depth
+    assert depth == 0, f"undrained task queues: {depth} messages"
+    # 4. Goodput partition exactness: chaos moves time between
+    # categories; it must never create or lose a second.
+    pool_report = accounting.pool_report(store, POOL_ID,
+                                         include_jobs=False)
+    total = (pool_report["productive_seconds"]
+             + sum(pool_report["badput_seconds"].values())
+             + sum(pool_report["overlapped_seconds"].values()))
+    invariants["goodput_wall_seconds"] = pool_report["wall_seconds"]
+    invariants["goodput_partition_total"] = total
+    assert abs(total - pool_report["wall_seconds"]) <= max(
+        1e-6 * max(1.0, pool_report["wall_seconds"]), 1e-6), (
+        f"goodput partition broke: {total} != "
+        f"{pool_report['wall_seconds']}")
+    invariants["retries"] = pool_report.get("retries", 0)
+    invariants["backoff_seconds"] = (
+        pool_report["badput_seconds"].get("backoff", 0.0))
+    report["goodput"] = {
+        "goodput_ratio": pool_report["goodput_ratio"],
+        "badput_seconds": pool_report["badput_seconds"],
+        "overlapped_seconds": pool_report["overlapped_seconds"],
+    }
+    invariants["ok"] = True

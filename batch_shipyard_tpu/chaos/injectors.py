@@ -1,0 +1,191 @@
+"""Chaos injectors: apply one Injection through a framework seam.
+
+Each injector exercises a failure mode the recovery layer claims to
+survive, through surfaces the framework ALREADY exposes (no
+monkey-patching):
+
+  * ChaosStore wraps a StateStore and adds windowed latency or a
+    bounded burst of op errors — the agent's worker/heartbeat/control
+    loops must absorb them (requeue, retry next tick).
+  * heartbeat blackout flips the agent's blackout attribute — node
+    keeps running, looks partitioned.
+  * task kill / task wedge signal a live task's process group —
+    SIGKILL exercises the retry supervisor, SIGSTOP the progress
+    watchdog (alive, zero progress: the TPU-wedge shape).
+  * node preempt crash-kills the fakepod agent and revives it later —
+    orphan reclaim + gang recovery territory.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.chaos.plan import Injection
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+# Store methods subject to delay/error injection: the coordination hot
+# path. Mutators and readers both — a flaky store is flaky everywhere.
+_FAULTED_OPS = frozenset({
+    "put_object", "get_object", "get_object_meta", "delete_object",
+    "insert_entity", "upsert_entity", "merge_entity", "get_entity",
+    "query_entities", "delete_entity", "insert_entities",
+    "put_message", "put_messages", "get_messages", "delete_message",
+    "update_message",
+})
+
+
+class ChaosError(RuntimeError):
+    """An injected state-store failure."""
+
+
+class ChaosStore:
+    """StateStore wrapper with windowed fault injection.
+
+    Delegates everything to the wrapped store; ops named in
+    _FAULTED_OPS first pass the fault gate: an active delay window
+    sleeps them, an armed error budget raises ChaosError and
+    decrements. Thread-safe — agents hit this from many threads."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        self._lock = threading.Lock()
+        self._delay_until = 0.0
+        self._delay_seconds = 0.0
+        self._error_budget = 0
+
+    # -- fault control (called by the drill driver) --------------------
+
+    def inject_delay(self, delay_seconds: float,
+                     window_seconds: float) -> None:
+        with self._lock:
+            self._delay_seconds = delay_seconds
+            self._delay_until = time.monotonic() + window_seconds
+
+    def inject_errors(self, ops: int) -> None:
+        with self._lock:
+            self._error_budget += max(0, int(ops))
+
+    # -- delegation ----------------------------------------------------
+
+    def _gate(self) -> None:
+        with self._lock:
+            delay = (self._delay_seconds
+                     if time.monotonic() < self._delay_until else 0.0)
+            err = self._error_budget > 0
+            if err:
+                self._error_budget -= 1
+        if err:
+            raise ChaosError("chaos: injected store error")
+        if delay:
+            time.sleep(delay)
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in _FAULTED_OPS and callable(attr):
+            def faulted(*args, **kwargs):
+                self._gate()
+                return attr(*args, **kwargs)
+            return faulted
+        return attr
+
+
+def apply_injection(injection: Injection, substrate,
+                    pool_id: str,
+                    store: Optional[ChaosStore] = None) -> dict:
+    """Apply one scheduled injection against a live fakepod pool.
+    Returns an application record (what was actually hit) for the
+    drill report. Node targets resolve by index modulo the live
+    agent set, so a plan generated for N nodes applies to any pool."""
+    record = {"kind": injection.kind, "at": injection.at,
+              "node_index": injection.node_index, "applied": False}
+    if injection.kind == "store_delay":
+        if store is not None:
+            store.inject_delay(injection.param("delay", 0.02),
+                               injection.param("window", 1.0))
+            record["applied"] = True
+        return record
+    if injection.kind == "store_error":
+        if store is not None:
+            store.inject_errors(injection.param("ops", 3))
+            record["applied"] = True
+        return record
+
+    agents = _live_agents(substrate, pool_id)
+    if not agents:
+        return record
+    agent = agents[injection.node_index % len(agents)]
+    record["node_id"] = agent.identity.node_id
+
+    if injection.kind == "heartbeat_blackout":
+        agent.heartbeat_blackout_until = (
+            time.time() + injection.param("window", 2.0))
+        record["applied"] = True
+    elif injection.kind in ("task_kill", "task_wedge"):
+        # Prefer the target node's live task; fall back to any node
+        # actually running one (the schedule is deterministic, the
+        # scheduler's placement is not). A scheduled kill landing in
+        # a claim gap waits briefly for a victim — the drill's point
+        # is to exercise the kill paths, not to miss by 100ms.
+        victim = _pick_live_proc(agents, preferred=agent)
+        deadline = time.monotonic() + 2.0
+        while victim is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            victim = _pick_live_proc(
+                _live_agents(substrate, pool_id), preferred=None)
+        if victim is None:
+            return record
+        node, proc = victim
+        record["node_id"] = node.identity.node_id
+        sig = (signal.SIGKILL if injection.kind == "task_kill"
+               else signal.SIGSTOP)
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+            record["applied"] = True
+            record["pid"] = proc.pid
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    elif injection.kind == "node_preempt":
+        context = substrate.crash_node(pool_id,
+                                       agent.identity.node_id)
+        if context is not None:
+            record["applied"] = True
+            revive_after = injection.param("revive_after", 0.5)
+
+            def _revive():
+                time.sleep(revive_after)
+                substrate.revive_node(pool_id, context)
+
+            threading.Thread(target=_revive, daemon=True,
+                             name="chaos-revive").start()
+    return record
+
+
+def _live_agents(substrate, pool_id: str) -> list:
+    with substrate._lock:
+        agents = list(substrate._agents.get(pool_id, {}).values())
+    return sorted(agents, key=lambda a: a.identity.node_index)
+
+
+def _pick_live_proc(agents: list, preferred=None):
+    ordered = ([preferred] if preferred is not None else []) + [
+        a for a in agents if a is not preferred]
+    for agent in ordered:
+        # The agent's worker threads mutate _live_procs without a
+        # lock; retry the snapshot instead of letting a concurrent
+        # pop turn a scheduled injection into a silent skip.
+        procs = []
+        for _ in range(3):
+            try:
+                procs = list(agent._live_procs.items())
+                break
+            except RuntimeError:
+                continue
+        if procs:
+            return agent, procs[0][1]
+    return None

@@ -1,0 +1,126 @@
+"""ChaosPlan: a deterministic, seeded fault schedule.
+
+Reproducibility is the whole point: a drill that only fails one run in
+twenty is useless for regression-testing recovery code. A plan is a
+pure function of (seed, shape parameters) — same seed, same injection
+sequence, byte for byte — so a failing drill replays exactly, and two
+operators comparing notes can name a fault scenario by its seed.
+
+The schedule is substrate-agnostic: targets are logical node INDICES
+(resolved against the live pool at apply time by chaos/drill.py) and
+times are offsets from drill start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from typing import Optional
+
+# Injection vocabulary. Each kind maps onto one existing framework
+# seam (see chaos/injectors.py):
+#   store_delay        — latency on every state-store op for a window
+#   store_error        — a burst of injected store-op failures
+#   heartbeat_blackout — node keeps working, heartbeats suppressed
+#   task_kill          — SIGKILL a running task's process group
+#   task_wedge         — SIGSTOP a running task: alive, zero progress
+#                        (the TPU-wedge shape; only the progress
+#                        watchdog can catch it)
+#   node_preempt       — hard-kill a node agent (no offline write),
+#                        revive after a delay
+INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
+                   "task_kill", "task_wedge", "node_preempt")
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    at: float           # seconds from drill start
+    kind: str           # one of INJECTION_KINDS
+    node_index: int     # logical target node (resolved at apply time)
+    params: tuple       # sorted (key, value) pairs — hashable/frozen
+
+    def param(self, key: str, default=None):
+        return dict(self.params).get(key, default)
+
+    def to_dict(self) -> dict:
+        return {"at": self.at, "kind": self.kind,
+                "node_index": self.node_index,
+                "params": dict(self.params)}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    seed: int
+    duration: float
+    num_nodes: int
+    injections: tuple[Injection, ...]
+
+    @classmethod
+    def generate(cls, seed: int, duration: float = 4.0,
+                 num_nodes: int = 4,
+                 kinds: Optional[tuple[str, ...]] = None,
+                 injections_per_kind: int = 1) -> "ChaosPlan":
+        """Deterministic schedule: for each requested kind, draw
+        ``injections_per_kind`` (time, target, params) tuples from a
+        seed-keyed RNG. Faults land in the middle 70% of the drill
+        window so the pool has formed before the first one and has
+        runway to recover after the last."""
+        kinds = tuple(kinds or INJECTION_KINDS)
+        unknown = [k for k in kinds if k not in INJECTION_KINDS]
+        if unknown:
+            raise ValueError(f"unknown injection kinds {unknown}")
+        rng = random.Random(seed)
+        out: list[Injection] = []
+        lo, hi = 0.1 * duration, 0.8 * duration
+        for kind in kinds:
+            for _ in range(max(1, injections_per_kind)):
+                at = round(rng.uniform(lo, hi), 3)
+                node_index = rng.randrange(max(1, num_nodes))
+                params: dict = {}
+                if kind == "store_delay":
+                    params = {"delay": round(rng.uniform(0.01, 0.05),
+                                             3),
+                              "window": round(rng.uniform(0.5, 1.5),
+                                              3)}
+                elif kind == "store_error":
+                    params = {"ops": rng.randrange(2, 6)}
+                elif kind == "heartbeat_blackout":
+                    params = {"window": round(rng.uniform(1.0, 2.5),
+                                              3)}
+                elif kind == "node_preempt":
+                    params = {"revive_after":
+                              round(rng.uniform(0.3, 1.0), 3)}
+                out.append(Injection(
+                    at=at, kind=kind, node_index=node_index,
+                    params=tuple(sorted(params.items()))))
+        out.sort(key=lambda i: (i.at, i.kind, i.node_index))
+        return cls(seed=seed, duration=duration, num_nodes=num_nodes,
+                   injections=tuple(out))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "duration": self.duration,
+                "num_nodes": self.num_nodes,
+                "fingerprint": self.fingerprint(),
+                "injections": [i.to_dict() for i in self.injections]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ChaosPlan":
+        return cls(
+            seed=int(data["seed"]), duration=float(data["duration"]),
+            num_nodes=int(data["num_nodes"]),
+            injections=tuple(
+                Injection(at=float(i["at"]), kind=i["kind"],
+                          node_index=int(i["node_index"]),
+                          params=tuple(sorted(
+                              (i.get("params") or {}).items())))
+                for i in data["injections"]))
+
+    def fingerprint(self) -> str:
+        """Stable digest of the injection sequence — two plans with
+        the same fingerprint inject identically (the determinism
+        acceptance check)."""
+        payload = json.dumps(
+            [i.to_dict() for i in self.injections], sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]

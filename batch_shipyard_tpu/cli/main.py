@@ -9,6 +9,7 @@ mirror the reference so a Batch Shipyard user finds the same verbs:
   shipyard-tpu jobs   add | list | term | del | stats | wait |
                       tasks list
   shipyard-tpu goodput job | pool | fleet
+  shipyard-tpu chaos  plan | drill
   shipyard-tpu data   stream | ingress
   shipyard-tpu diag   perf
   shipyard-tpu storage clear
@@ -23,6 +24,7 @@ import sys
 import click
 
 from batch_shipyard_tpu import fleet
+from batch_shipyard_tpu.chaos import plan as chaos_plan_mod
 from batch_shipyard_tpu.version import __version__
 
 
@@ -613,6 +615,69 @@ def goodput_prune(click_ctx, older_than_hours):
     removed = goodput_events.prune(ctx.store, ctx.pool.id,
                                    older_than_hours * 3600.0)
     click.echo(f"pruned {removed} events from pool {ctx.pool.id}")
+
+
+# ------------------------------- chaos ---------------------------------
+
+@cli.group()
+def chaos():
+    """Deterministic chaos engineering (chaos/): seeded fault
+    schedules replayed against a self-contained fakepod pool, with
+    the self-healing invariants asserted (every task completes
+    exactly once, no orphaned coordination state, goodput partition
+    exact)."""
+
+
+def _parse_kinds(kinds: str):
+    return tuple(k.strip() for k in kinds.split(",") if k.strip()) \
+        or None
+
+
+@chaos.command("plan")
+@click.option("--seed", type=int, default=0,
+              help="Schedule seed (same seed, same injections)")
+@click.option("--duration", type=float, default=4.0,
+              help="Drill window in seconds (must match the drill's "
+                   "for fingerprint parity)")
+@click.option("--num-nodes", type=int, default=4,
+              help="Logical node count targets are drawn from")
+@click.option("--kinds", default="",
+              help="Comma-separated injection kinds, default all: "
+                   + ",".join(chaos_plan_mod.INJECTION_KINDS))
+@click.option("--injections-per-kind", type=int, default=1)
+@click.pass_context
+def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
+               injections_per_kind):
+    """Render the deterministic fault schedule for a seed (no pool,
+    no execution — review what a drill would inject)."""
+    fleet.action_chaos_plan(
+        None, seed, duration=duration, num_nodes=num_nodes,
+        kinds=_parse_kinds(kinds),
+        injections_per_kind=injections_per_kind,
+        raw=click_ctx.obj["raw"])
+
+
+@chaos.command("drill")
+@click.option("--seed", type=int, default=0,
+              help="Schedule seed (same seed, same injections)")
+@click.option("--tasks", type=int, default=16,
+              help="Tasks submitted to the drill pool")
+@click.option("--duration", type=float, default=4.0,
+              help="Injection window in seconds")
+@click.option("--kinds", default="",
+              help="Comma-separated injection kinds, default all: "
+                   + ",".join(chaos_plan_mod.INJECTION_KINDS))
+@click.option("--injections-per-kind", type=int, default=1)
+@click.pass_context
+def chaos_drill(click_ctx, seed, tasks, duration, kinds,
+                injections_per_kind):
+    """Run the seeded drill on a local fakepod pool and assert the
+    recovery invariants (nonzero exit = a self-healing regression)."""
+    fleet.action_chaos_drill(
+        None, seed, tasks=tasks, duration=duration,
+        kinds=_parse_kinds(kinds),
+        injections_per_kind=injections_per_kind,
+        raw=click_ctx.obj["raw"])
 
 
 # ------------------------------- data ----------------------------------

@@ -484,6 +484,9 @@ class TaskSettings:
     depends_on_range: Optional[tuple[int, int]]
     max_task_retries: int
     max_wall_time_seconds: Optional[int]
+    # Wedge watchdog opt-in: kill + requeue the task when it emits no
+    # progress beat ($SHIPYARD_PROGRESS_FILE) for this long.
+    progress_deadline_seconds: Optional[int]
     retention_time_seconds: Optional[int]
     multi_instance: Optional[MultiInstanceSettings]
     input_data: tuple[dict, ...]
@@ -659,6 +662,8 @@ def task_settings(task: dict, job: JobSettings,
             task, "max_task_retries", default=job.max_task_retries),
         max_wall_time_seconds=_get(
             task, "max_wall_time_seconds", default=job.max_wall_time_seconds),
+        progress_deadline_seconds=_get(task,
+                                       "progress_deadline_seconds"),
         retention_time_seconds=_get(task, "retention_time_seconds"),
         multi_instance=mi,
         input_data=tuple(_get(task, "input_data", default=[])),

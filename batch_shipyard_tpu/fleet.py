@@ -516,10 +516,31 @@ def action_jobs_list(ctx: Context, raw: bool = False) -> None:
 
 def action_jobs_tasks_list(ctx: Context, job_id: str,
                            raw: bool = False) -> None:
-    tasks = [{"id": t["_rk"], "state": t.get("state"),
-              "exit_code": t.get("exit_code"),
-              "node_id": t.get("node_id")}
-             for t in jobs_mgr.list_tasks(ctx.store, ctx.pool.id, job_id)]
+    tasks = []
+    for t in jobs_mgr.list_tasks(ctx.store, ctx.pool.id, job_id):
+        row = {"id": t["_rk"], "state": t.get("state"),
+               "exit_code": t.get("exit_code"),
+               "node_id": t.get("node_id")}
+        if t.get("retries"):
+            row["retries"] = t.get("retries")
+        if t.get("wedged"):
+            row["wedged"] = True
+        # Poison quarantine surfaces its post-mortem right here: the
+        # retry supervisor's diagnostics bundle (stderr tail, node /
+        # exit-code history) so the operator never greps node logs.
+        if t.get("state") == names.TASK_STATE_QUARANTINED:
+            row["error"] = t.get("error")
+            diag = dict(t.get("diagnostics") or {})
+            history = diag.get("attempt_history") or []
+            if history:
+                # Operator-friendly projections of attempt_history
+                # (the entity stores only the one source of truth).
+                diag["node_history"] = [a.get("node_id")
+                                        for a in history]
+                diag["exit_codes"] = [a.get("exit_code")
+                                      for a in history]
+            row["diagnostics"] = diag
+        tasks.append(row)
     _emit({"tasks": tasks}, raw)
 
 
@@ -625,6 +646,48 @@ def action_goodput(ctx: Context, scope: str,
                     f"\n== job {jid} ==\n"
                     + accounting.waterfall_table(
                         report["jobs"][jid]) + "\n")
+    return report
+
+
+# -------------------------------- chaos --------------------------------
+
+def action_chaos_plan(ctx_or_none, seed: int, duration: float = 4.0,
+                      num_nodes: int = 4,
+                      kinds: Optional[tuple[str, ...]] = None,
+                      injections_per_kind: int = 1,
+                      raw: bool = False) -> dict:
+    """Render a deterministic fault schedule (chaos/plan.py) without
+    running it — same seed, same injection sequence, so operators can
+    review exactly what a drill will do (and name a scenario by its
+    seed + fingerprint). Needs no live pool or config context."""
+    from batch_shipyard_tpu.chaos.plan import ChaosPlan
+    plan = ChaosPlan.generate(
+        seed, duration=duration, num_nodes=num_nodes, kinds=kinds,
+        injections_per_kind=injections_per_kind)
+    payload = plan.to_dict()
+    _emit(payload, raw)
+    return payload
+
+
+def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
+                       duration: float = 4.0,
+                       kinds: Optional[tuple[str, ...]] = None,
+                       injections_per_kind: int = 1,
+                       raw: bool = False) -> dict:
+    """Run a seeded chaos drill against a self-contained fakepod pool
+    (chaos/drill.py) and report the recovery invariants: every task
+    completed exactly once, no orphaned gang rows or queue messages,
+    goodput partition exact. Raises on any violated invariant, so a
+    nonzero exit IS the regression signal."""
+    from batch_shipyard_tpu.chaos import drill
+    report = drill.run_drill(
+        seed=seed, tasks=tasks, duration=duration, kinds=kinds,
+        injections_per_kind=injections_per_kind)
+    _emit({"seed": report["seed"],
+           "fingerprint": report["fingerprint"],
+           "invariants": report["invariants"],
+           "applied": report["applied"],
+           "goodput": report.get("goodput", {})}, raw)
     return report
 
 

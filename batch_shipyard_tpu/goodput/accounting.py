@@ -38,7 +38,7 @@ from batch_shipyard_tpu.state.base import StateStore
 # no event covers — surfaced explicitly instead of silently inflating
 # a real category.
 BADPUT_CATEGORIES = (
-    "provisioning", "queueing", "image_pull", "compile",
+    "provisioning", "queueing", "backoff", "image_pull", "compile",
     "checkpoint", "preemption_recovery", "idle", "unaccounted",
 )
 
@@ -60,6 +60,7 @@ _KIND_CATEGORY = {
     ev.NODE_PREP: "provisioning",
     ev.NODE_PREEMPTED: "provisioning",   # reclaim -> re-provision time
     ev.TASK_QUEUED: "queueing",
+    ev.TASK_BACKOFF: "backoff",
     ev.TASK_IMAGE_PULL: "image_pull",
     ev.TASK_CONTAINER_START: "image_pull",
     ev.PROGRAM_COMPILE: "compile",
@@ -78,7 +79,7 @@ _KIND_CATEGORY = {
 # overlapped persist) needs no tuple — it is whatever remains of run
 # time after productive, so program goodput is computed directly as
 # productive / run time.
-_SCHEDULING_BADPUT = ("provisioning", "queueing")
+_SCHEDULING_BADPUT = ("provisioning", "queueing", "backoff")
 _RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
 
 # Sweep priority, highest first. SAME-PROGRAM overheads (rework,
@@ -91,10 +92,15 @@ _RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
 # ranking those above PRODUCTIVE would let one waiting task erase a
 # whole pool's productive seconds); waits beat idle beats the bare
 # running container beats nothing (unaccounted).
+# "backoff" outranks "queueing": the retry supervisor's deliberate
+# delay window sits INSIDE the retried task's queued span (requeue ->
+# re-claim), and the sweep must charge those seconds to the more
+# specific cause exactly once.
 _PRIORITY = (
     "preemption_recovery", "checkpoint", "compile", PRODUCTIVE,
     "checkpoint_async",
-    "image_pull", "provisioning", "queueing", "idle", "_running",
+    "image_pull", "provisioning", "backoff", "queueing", "idle",
+    "_running",
 )
 _PRIORITY_RANK = {c: i for i, c in enumerate(_PRIORITY)}
 

@@ -57,6 +57,11 @@ TASK_IMAGE_PULL = "image_pull"         # image provisioning on node
 TASK_CONTAINER_START = "container_start"
 TASK_RUNNING = "running"               # task process start -> exit
 TASK_RETRY = "retry"                   # instantaneous requeue marker
+TASK_BACKOFF = "backoff"               # retry supervisor's deliberate
+                                       # requeue delay (requeue ->
+                                       # not_before); its own badput
+                                       # category so retry waits never
+                                       # land in "unaccounted"
 
 # Program phases (emitted from inside the workload process)
 PROGRAM_COMPILE = "compile"            # jit compile / warm-up steps
@@ -75,7 +80,7 @@ PROGRAM_EVAL = "eval"
 EVENT_KINDS = frozenset({
     NODE_PROVISIONING, NODE_PREP, NODE_IDLE, NODE_PREEMPTED,
     TASK_QUEUED, TASK_IMAGE_PULL, TASK_CONTAINER_START, TASK_RUNNING,
-    TASK_RETRY,
+    TASK_RETRY, TASK_BACKOFF,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,

@@ -52,6 +52,7 @@ def _task_spec(task: TaskSettings, job: JobSettings,
                              if task.depends_on_range else None),
         "max_task_retries": task.max_task_retries,
         "max_wall_time_seconds": task.max_wall_time_seconds,
+        "progress_deadline_seconds": task.progress_deadline_seconds,
         "retention_time_seconds": task.retention_time_seconds,
         "remove_container_after_exit": task.remove_container_after_exit,
         "shm_size": task.shm_size,
@@ -331,7 +332,7 @@ def wait_for_tasks(store: StateStore, pool_id: str, job_id: str,
     while True:
         tasks = list_tasks(store, pool_id, job_id)
         if tasks and all(t.get("state") in
-                         ("completed", "failed", "blocked")
+                         names.TERMINAL_TASK_STATES
                          for t in tasks):
             return tasks
         if time.monotonic() > deadline:
@@ -368,7 +369,7 @@ def stream_task_output(store: StateStore, pool_id: str, job_id: str,
                 offset = len(data)
         except NotFoundError:
             pass
-        if task.get("state") in ("completed", "failed", "blocked"):
+        if task.get("state") in names.TERMINAL_TASK_STATES:
             return
         if time.monotonic() > deadline:
             raise TimeoutError(f"stream of {task_id} timed out")
@@ -386,7 +387,7 @@ def terminate_job(store: StateStore, pool_id: str, job_id: str,
                         "completed_at": util.datetime_utcnow_iso()})
     pk = names.task_pk(pool_id, job_id)
     for task in list_tasks(store, pool_id, job_id):
-        if task.get("state") not in ("completed", "failed", "blocked"):
+        if task.get("state") not in names.TERMINAL_TASK_STATES:
             try:
                 store.merge_entity(
                     names.TABLE_TASKS, pk, task["_rk"],
@@ -519,7 +520,7 @@ def terminate_task(store: StateStore, pool_id: str, job_id: str,
     node's agent."""
     task = get_task(store, pool_id, job_id, task_id)
     state = task.get("state")
-    if state in ("completed", "failed", "blocked"):
+    if state in names.TERMINAL_TASK_STATES:
         return
     if state == "pending":
         try:
@@ -541,7 +542,7 @@ def terminate_task(store: StateStore, pool_id: str, job_id: str,
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             task = get_task(store, pool_id, job_id, task_id)
-            if task.get("state") in ("completed", "failed", "blocked"):
+            if task.get("state") in names.TERMINAL_TASK_STATES:
                 return
             time.sleep(0.2)
         raise TimeoutError(f"task {task_id} did not terminate")
@@ -559,8 +560,8 @@ def delete_task(store: StateStore, pool_id: str, job_id: str,
     """Delete a task's entity and its uploaded objects (tasks del
     analog). Non-terminal tasks must be terminated first."""
     task = get_task(store, pool_id, job_id, task_id)
-    if require_terminal and task.get("state") not in (
-            "completed", "failed", "blocked"):
+    if require_terminal and task.get("state") not in \
+            names.TERMINAL_TASK_STATES:
         raise ValueError(
             f"task {task_id} is {task.get('state')}; terminate first")
     prefix = names.task_output_key(pool_id, job_id, task_id, "")

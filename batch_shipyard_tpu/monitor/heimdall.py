@@ -124,6 +124,12 @@ def write_file_sd(store: StateStore, output_dir: str) -> str:
 # (operators prune history with `goodput prune` / events.prune).
 GOODPUT_EXPORT_WINDOW_SECONDS = 24 * 3600.0
 
+# Node health/quarantine gauges only cover rows seen within this
+# window (heartbeat, or registration for a still-booting node) —
+# generous against any sane heartbeat interval, small enough that a
+# permanently crashed node stops gauging within minutes.
+NODE_GAUGE_STALE_SECONDS = 300.0
+
 
 def build_goodput_metrics(store: StateStore) -> list[str]:
     """Prometheus gauge lines for every registered-or-known pool's
@@ -150,6 +156,13 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "warm persistent compilation cache avoided spending on "
         "compiles (compilecache/; not badput).",
         "# TYPE goodput_compile_saved_seconds gauge",
+        "# HELP node_health_score Per-node health score in [0,1] "
+        "(task failures/wedges decay it; below threshold the node "
+        "quarantines itself and stops claiming).",
+        "# TYPE node_health_score gauge",
+        "# HELP nodes_quarantined Count of self-quarantined "
+        "(auto-drained) nodes per pool.",
+        "# TYPE nodes_quarantined gauge",
     ]
     for pool in store.query_entities(names.TABLE_POOLS,
                                      partition_key="pools"):
@@ -159,6 +172,29 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
             include_jobs=False)
         lines.extend(accounting.prometheus_lines(
             report, {"pool": pool["_rk"]}))
+        quarantined = 0
+        now = time.time()
+        for node in store.query_entities(names.TABLE_NODES,
+                                         partition_key=pool["_rk"]):
+            # Dead or cleanly-stopped rows must not gauge (and alert)
+            # forever: a crashed quarantined node would otherwise
+            # inflate nodes_quarantined for the life of its row.
+            if node.get("state") == "offline":
+                continue
+            last_seen = float(node.get("heartbeat_at", 0) or 0)
+            if last_seen <= 0:
+                last_seen = float(node.get("registered_at", 0) or 0)
+            if now - last_seen > NODE_GAUGE_STALE_SECONDS:
+                continue
+            health = node.get(names.NODE_COL_HEALTH)
+            if health is not None:
+                lines.append(
+                    f'node_health_score{{pool="{pool["_rk"]}",'
+                    f'node="{node["_rk"]}"}} {float(health):.3f}')
+            if node.get(names.NODE_COL_QUARANTINED):
+                quarantined += 1
+        lines.append(f'nodes_quarantined{{pool="{pool["_rk"]}"}} '
+                     f'{quarantined}')
     return lines
 
 

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from batch_shipyard_tpu.agent import progress as progress_mod
 from batch_shipyard_tpu.compilecache import manager as cc_manager
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.models import resnet as resnet_mod
@@ -135,6 +136,9 @@ def build_transformer_train(
     compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         params, opt_state, metrics = _aot_step(
             compiled, step, params, opt_state, batch["tokens"],
             batch["targets"])
@@ -241,6 +245,9 @@ def build_transformer_train_pp(
         return params, opt_state, {"loss": loss}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         params, opt_state, metrics = step(
             params, opt_state, batch["tokens"], batch["targets"])
         return params, opt_state, metrics
@@ -383,6 +390,9 @@ def build_transformer_train_1f1b(
         return params, opt_state, {"loss": loss}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         params, opt_state, metrics = step(
             params, opt_state, batch["tokens"], batch["targets"])
         return params, opt_state, metrics
@@ -443,6 +453,9 @@ def build_resnet_train(mesh: Mesh,
     compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         params, state["batch_stats"], opt_state, metrics = _aot_step(
             compiled, step, params, state["batch_stats"], opt_state,
             batch["images"], batch["labels"])
@@ -511,6 +524,9 @@ def build_vit_train(mesh: Mesh, config=None, batch_size: int = 256,
     compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         return _aot_step(compiled, step, params, opt_state,
                          batch["images"], batch["labels"])
 
@@ -584,6 +600,9 @@ def build_diffusion_train(mesh: Mesh, config=None,
     compiled: dict = {}
 
     def step_wrapper(params, opt_state, batch):
+        # Wedge-watchdog liveness: every step call is one unit of
+        # progress (throttled no-op outside pool tasks).
+        progress_mod.beat()
         params, opt_state, metrics = _aot_step(
             compiled, step, params, opt_state, batch["images"],
             batch.get("labels"), counter["step"])

@@ -71,7 +71,10 @@ def list_nodes(store: StateStore, pool_id: str) -> list[NodeInfo]:
             internal_ip=row.get("internal_ip", ""),
             node_index=int(row.get("node_index", 0)),
             slice_index=int(row.get("slice_index", 0)),
-            worker_index=int(row.get("worker_index", 0))))
+            worker_index=int(row.get("worker_index", 0)),
+            health=float(row.get(names.NODE_COL_HEALTH, 1.0) or 0.0),
+            quarantined=bool(row.get(names.NODE_COL_QUARANTINED,
+                                     False))))
     return sorted(out, key=lambda n: n.node_index)
 
 
@@ -234,7 +237,8 @@ def pool_stats(store: StateStore, pool_id: str) -> dict:
     jobs = list(store.query_entities(names.TABLE_JOBS,
                                      partition_key=pool_id))
     task_counts = {"pending": 0, "running": 0, "completed": 0,
-                   "failed": 0, "blocked": 0, "assigned": 0}
+                   "failed": 0, "blocked": 0, "assigned": 0,
+                   names.TASK_STATE_QUARANTINED: 0}
     for job in jobs:
         pk = names.task_pk(pool_id, job["_rk"])
         for task in store.query_entities(names.TABLE_TASKS,

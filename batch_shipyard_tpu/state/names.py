@@ -27,12 +27,49 @@ TABLE_REMOTEFS = "remotefs"    # pk="remotefs",        rk=cluster_id
 TABLE_REMOTEFS_NODES = "remotefs_nodes"  # pk=cluster_id, rk=node name
 
 
+# Entity state vocabularies. Every "state" literal written to a task
+# or node entity must come from these tuples (enforced by an AST scan
+# in tests/test_names_consistency.py) — a typo'd state string would
+# otherwise silently dodge every terminal-state check in the fleet.
+#
+# "quarantined" is the poison-task terminal state: the retry
+# supervisor parks a task there after its retry budget is exhausted,
+# with a diagnostics bundle on the entity (agent/node_agent.py).
+TASK_STATE_QUARANTINED = "quarantined"
+TASK_STATES = ("pending", "assigned", "running", "completed",
+               "failed", "blocked", TASK_STATE_QUARANTINED)
+TERMINAL_TASK_STATES = ("completed", "failed", "blocked",
+                        TASK_STATE_QUARANTINED)
+NODE_STATES = ("creating", "starting", "idle", "running", "offline",
+               "unusable", "start_task_failed", "suspended",
+               "preempted")
+# Auxiliary coordination states (jobprep fan-out rows, gang member
+# rows, job lifecycle, remotefs/slurm cluster lifecycle) — same
+# registry, same AST enforcement.
+AUX_STATES = ("joined", "done", "active", "disabled", "terminated",
+              "completed", "resizing", "ready", "allocation_failed",
+              "deleted", "defined", "provisioned")
+
+# Node-entity health columns (written by the node agent's health
+# scorer, read by claim exclusion + heimdall gauges).
+NODE_COL_HEALTH = "health"
+NODE_COL_QUARANTINED = "quarantined"
+
+
 def task_pk(pool_id: str, job_id: str) -> str:
     return f"{pool_id}${job_id}"
 
 
-def gang_pk(pool_id: str, job_id: str, task_id: str) -> str:
-    return f"{pool_id}${job_id}${task_id}"
+def gang_pk(pool_id: str, job_id: str, task_id: str,
+            attempt: int = 0) -> str:
+    """Gang rendezvous partition. ``attempt`` (the task's retry count)
+    namespaces each recovery attempt: a zombie member of a recovered
+    gang finishing late merges into the OLD attempt's (deleted)
+    partition and gets NotFoundError, instead of corrupting the fresh
+    rendezvous that reuses its instance index. Attempt 0 keeps the
+    historical name so existing pools are unchanged on disk."""
+    base = f"{pool_id}${job_id}${task_id}"
+    return base if attempt <= 0 else f"{base}#g{attempt}"
 
 
 # Queues

@@ -34,6 +34,11 @@ class NodeInfo:
     node_index: int
     slice_index: int
     worker_index: int
+    # Self-healing surface: the agent's health score in [0, 1] and
+    # whether the node quarantined itself (auto-drain; excluded from
+    # claims and gang formation).
+    health: float = 1.0
+    quarantined: bool = False
 
 
 class ComputeSubstrate(abc.ABC):

@@ -96,12 +96,17 @@ class FakePodSubstrate(base.ComputeSubstrate):
             node_stale_seconds=self.node_stale_seconds,
             nodeprep=self._nodeprep, substrate=self,
             **self.agent_kwargs)
+        import time as time_mod
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {
                 "state": "creating", "hostname": identity.hostname,
                 "internal_ip": identity.internal_ip,
                 "node_index": node_index, "slice_index": slice_index,
-                "worker_index": worker_index})
+                "worker_index": worker_index,
+                # Registration grace anchor: _node_alive treats a
+                # never-heartbeated node as alive while this is fresh
+                # (the gang-observer startup race fix).
+                "registered_at": time_mod.time()})
         thread = threading.Thread(
             target=self._boot_agent, args=(agent,),
             name=f"fakepod-boot-{node_id}", daemon=True)
@@ -297,6 +302,40 @@ class FakePodSubstrate(base.ComputeSubstrate):
         with self._lock:
             return self._agents.get(pool_id, {}).get(node_id)
 
+    def crash_node(self, pool_id: str, node_id: str) -> Optional[dict]:
+        """Hard-kill one node's agent (stop without cleanup — a real
+        crash writes no 'offline' state). Returns the revival context
+        for revive_node, or None when the node has no live agent."""
+        with self._lock:
+            agent = self._agents.get(pool_id, {}).get(node_id)
+        if agent is None:
+            return None
+        context = {"identity": agent.identity, "pool": agent.pool,
+                   "work_dir": agent.work_dir}
+        agent.stop_event.set()
+        agent.join(timeout=5.0)
+        with self._lock:
+            self._agents.get(pool_id, {}).pop(node_id, None)
+            self._boot_threads.pop(node_id, None)
+        return context
+
+    def revive_node(self, pool_id: str, context: dict) -> None:
+        """Reboot a crashed node with the same identity."""
+        revived = NodeAgent(
+            self.store, context["identity"], context["pool"],
+            work_dir=context["work_dir"],
+            heartbeat_interval=self.heartbeat_interval,
+            poll_interval=0.05, gang_timeout=60.0,
+            job_state_ttl=0.2, node_stale_seconds=3.0,
+            nodeprep=None, substrate=self, **self.agent_kwargs)
+        thread = threading.Thread(
+            target=self._boot_agent, args=(revived,), daemon=True)
+        with self._lock:
+            self._agents.setdefault(pool_id, {})[
+                context["identity"].node_id] = revived
+            self._boot_threads[context["identity"].node_id] = thread
+        thread.start()
+
     def start_chaos(self, pool_id: str, kill_interval: float = 1.0,
                     revive_after: float = 0.5,
                     seed: int = 0) -> threading.Event:
@@ -305,7 +344,9 @@ class FakePodSubstrate(base.ComputeSubstrate):
         it shortly after. Returns a stop event. Exercises orphan
         reclaim, message redelivery, and heartbeat staleness under
         continuous failure (the fault-injection capability SURVEY.md
-        5.3 notes the reference lacks entirely)."""
+        5.3 notes the reference lacks entirely). For a DETERMINISTIC
+        schedule of these (plus wedges, mid-run kills, store faults,
+        heartbeat blackouts) use chaos.ChaosPlan + chaos.drill."""
         import random
         stop = threading.Event()
         rng = random.Random(seed)
@@ -313,37 +354,15 @@ class FakePodSubstrate(base.ComputeSubstrate):
         def _chaos_loop():
             while not stop.wait(kill_interval):
                 with self._lock:
-                    agents = list(self._agents.get(pool_id, {}).items())
+                    agents = list(self._agents.get(pool_id, {}))
                 if not agents:
                     continue
-                node_id, agent = rng.choice(agents)
-                identity = agent.identity
-                pool = agent.pool
-                work_dir = agent.work_dir
-                # Crash: stop threads abruptly; do NOT write offline
-                # state (a real crash wouldn't).
-                agent.stop_event.set()
-                agent.join(timeout=5.0)
-                with self._lock:
-                    self._agents.get(pool_id, {}).pop(node_id, None)
-                    self._boot_threads.pop(node_id, None)
+                context = self.crash_node(pool_id, rng.choice(agents))
+                if context is None:
+                    continue
                 if stop.wait(revive_after):
                     return
-                # Revive with the same identity (reboot).
-                revived = NodeAgent(
-                    self.store, identity, pool, work_dir=work_dir,
-                    heartbeat_interval=self.heartbeat_interval,
-                    poll_interval=0.05, gang_timeout=60.0,
-                    job_state_ttl=0.2, node_stale_seconds=3.0,
-                    nodeprep=None, substrate=self)
-                thread = threading.Thread(
-                    target=self._boot_agent, args=(revived,),
-                    daemon=True)
-                with self._lock:
-                    self._agents.setdefault(pool_id, {})[
-                        node_id] = revived
-                    self._boot_threads[node_id] = thread
-                thread.start()
+                self.revive_node(pool_id, context)
 
         thread = threading.Thread(target=_chaos_loop, daemon=True,
                                   name=f"chaos-{pool_id}")

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import shutil
 import subprocess
+import time as _time
 from typing import Optional
 
 from batch_shipyard_tpu.config.settings import (
@@ -157,7 +158,8 @@ class GcpTpuSubstrate(base.ComputeSubstrate):
                         "accessConfig", {}).get("externalIp", ""),
                     "node_index": slice_index * workers + w,
                     "slice_index": slice_index, "worker_index": w,
-                    "tpu_name": name, "zone": pool.zone or self.zone})
+                    "tpu_name": name, "zone": pool.zone or self.zone,
+                    "registered_at": _time.time()})
 
     def _bootstrap_agents(self, pool: PoolSettings,
                           slice_index: int) -> None:

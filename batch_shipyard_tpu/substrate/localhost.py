@@ -9,6 +9,7 @@ integration substrate for the localfs state store.
 
 from __future__ import annotations
 
+
 import json
 import os
 import socket
@@ -83,7 +84,8 @@ class LocalhostSubstrate(base.ComputeSubstrate):
                 "state": "creating", "hostname": boot["identity"][
                     "hostname"],
                 "internal_ip": "127.0.0.1", "node_index": node_index,
-                "slice_index": slice_index, "worker_index": worker_index})
+                "slice_index": slice_index, "worker_index": worker_index,
+                "registered_at": time.time()})
         log = open(os.path.join(work_dir, "agent.log"), "ab")
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))

@@ -159,7 +159,7 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     assert names == ["probe", "kernel_checks", "flash_flip",
                      "tuning_ab", "final_bench",
                      "serving_speculative", "checkpoint_overhead",
-                     "goodput", "compile_warm"]
+                     "goodput", "compile_warm", "chaos_drill"]
     assert all(p["status"] == "dry_run" for p in report["phases"])
     # The speculative serving phase's skeleton names every metric it
     # will emit, for both KV layouts.
@@ -177,6 +177,16 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     assert set(compile_warm["metrics"]) == {
         "cold_ms", "warm_ms", "speedup", "cache_hits",
         "aot_first_step_ms", "steady_step_ms"}
+    # The chaos-drill phase's skeleton names the recovery invariants
+    # benchgen binds to (docs/30-fault-tolerance.md).
+    chaos = report["phases"][9]
+    assert "chaos_drill.py" in chaos["command"]
+    assert set(chaos["metrics"]) == {"determinism",
+                                     "injections_applied",
+                                     "invariants"}
+    assert set(chaos["metrics"]["invariants"]) == {
+        "tasks", "orphaned_gang_rows", "queue_depth", "retries",
+        "backoff_seconds"}
     # The tuning plan must cover every profile with a runnable command.
     plan = report["phases"][3]["plan"]
     from batch_shipyard_tpu.parallel.tuning import PROFILES

@@ -197,6 +197,69 @@ def test_decompose_categories_partition_wall():
     assert report["goodput_ratio"] == pytest.approx(0.6)
 
 
+def test_backoff_attributed_not_unaccounted_partition_exact():
+    """Satellite (PR 5): the retry supervisor's deliberate requeue
+    delay is its own badput category. The backoff span sits INSIDE
+    the retried task's queued span (requeue -> re-claim); the sweep
+    charges those seconds to `backoff` exactly once — never to
+    `queueing` twice, never leaking into `unaccounted` — and the
+    partition stays exact."""
+    events = [
+        _ev(gp.TASK_RUNNING, 0.0, 10.0),          # attempt 1 (fails)
+        _ev(gp.TASK_QUEUED, 10.0, 30.0),          # requeue -> claim
+        _ev(gp.TASK_BACKOFF, 10.0, 18.0, retries=1,
+            delay_seconds=8.0),                   # supervisor delay
+        _ev(gp.TASK_RUNNING, 30.0, 90.0),         # attempt 2
+        _ev(gp.PROGRAM_STEP_WINDOW, 30.0, 90.0, step_start=0,
+            step_end=60),
+    ]
+    report = accounting.decompose(events)
+    assert report["badput_seconds"]["backoff"] == pytest.approx(8.0)
+    # Only the un-backed-off remainder of the wait is queueing.
+    assert report["badput_seconds"]["queueing"] == pytest.approx(12.0)
+    assert report["badput_seconds"]["unaccounted"] == pytest.approx(
+        10.0)  # attempt 1's doomed run, nothing program-attributed
+    assert report["productive_seconds"] == pytest.approx(60.0)
+    total = (report["productive_seconds"]
+             + sum(report["badput_seconds"].values())
+             + sum(report["overlapped_seconds"].values()))
+    assert total == pytest.approx(report["wall_seconds"])
+
+
+def test_backoff_emitted_on_requeue_e2e(fakepod_env, tmp_path):
+    """A requeued task's backoff wait is priced as TASK_BACKOFF —
+    emitted by the CLAIM side once the wait elapsed (never
+    future-dated: a report scraped mid-backoff must not extend wall
+    past the present), and the pool report prices it."""
+    store, substrate, pool = fakepod_env
+    marker = tmp_path / "bo_marker"
+    jobs = settings_mod.job_settings_list({"job_specifications": [{
+        "id": "jboff",
+        "tasks": [{"id": "t0",
+                   "command": f"test -f {marker} || "
+                              f"{{ touch {marker}; exit 1; }}",
+                   "max_task_retries": 2}],
+    }]})
+    jobs_mgr.add_jobs(store, pool, jobs)
+    tasks = jobs_mgr.wait_for_tasks(store, pool.id, "jboff",
+                                    timeout=30, poll_interval=0.2)
+    assert tasks[0]["state"] == "completed"
+    backoffs = [e for e in gp.query(store, pool.id)
+                if e["kind"] == gp.TASK_BACKOFF]
+    assert len(backoffs) == 1
+    assert backoffs[0]["end"] > backoffs[0]["start"]
+    # Never future-dated: the interval was fully elapsed at emit.
+    assert backoffs[0]["end"] <= time.time()
+    assert backoffs[0]["attrs"]["retries"] == 1
+    report = accounting.pool_report(store, pool.id,
+                                    include_jobs=False)
+    assert report["badput_seconds"]["backoff"] > 0.0
+    total = (report["productive_seconds"]
+             + sum(report["badput_seconds"].values())
+             + sum(report["overlapped_seconds"].values()))
+    assert total == pytest.approx(report["wall_seconds"], rel=0.01)
+
+
 def test_cross_task_queue_wait_does_not_mask_productive_time():
     """T1 trains 0..100 while T2 waits in queue the whole time on a
     busy node: the node's time is productive; T2's wait is

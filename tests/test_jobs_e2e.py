@@ -69,16 +69,23 @@ def test_task_env_contract(env):
     assert out.strip() == b"42 pool1 jenv"
 
 
-def test_failing_task_retries_then_fails(env):
+def test_failing_task_retries_then_quarantines(env):
+    """Retry budget exhausted: the retry supervisor (PR 5) parks the
+    task in the terminal `quarantined` state with its post-mortem
+    instead of plain `failed` — tests/test_chaos_recovery.py covers
+    the bundle contents and the zero-budget legacy path."""
+    from batch_shipyard_tpu.state import names
     store, substrate, pool = env
     submit(store, pool, {"job_specifications": [{
         "id": "jfail",
         "tasks": [{"command": "exit 3", "max_task_retries": 2}],
     }]})
     tasks = jobs_mgr.wait_for_tasks(store, "pool1", "jfail", timeout=30)
-    assert tasks[0]["state"] == "failed"
+    assert tasks[0]["state"] == names.TASK_STATE_QUARANTINED
     assert tasks[0]["exit_code"] == 3
     assert tasks[0]["retries"] == 2
+    assert [a["exit_code"] for a in
+            tasks[0]["diagnostics"]["attempt_history"]] == [3, 3, 3]
 
 
 def test_task_dependencies_order(env):

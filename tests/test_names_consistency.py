@@ -94,6 +94,104 @@ def test_goodput_program_constants_are_declared():
     assert not problems, "\n".join(problems)
 
 
+def test_task_state_literals_come_from_the_registry():
+    """Every task-state string literal compared against or written to
+    a task entity's "state" must be a member of names.TASK_STATES (or
+    the auxiliary vocabularies) — a typo'd state ("quarantined" vs
+    "quarantine") would silently dodge every terminal-state check in
+    the fleet. Scans comparisons (==, in) whose other side mentions
+    "state" and dict literals with a "state" key."""
+    allowed = (set(names.TASK_STATES) | set(names.NODE_STATES)
+               | set(names.AUX_STATES))
+    problems = []
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            # {"state": "<literal>"} entity patches.
+            if isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if isinstance(key, ast.Constant) and \
+                            key.value == "state" and \
+                            isinstance(value, ast.Constant) and \
+                            isinstance(value.value, str):
+                        if value.value not in allowed:
+                            problems.append(
+                                f"{rel}:{node.lineno}: state "
+                                f"literal {value.value!r} not in "
+                                f"state/names.py vocabularies")
+            # state == "<literal>" / state in ("<literal>", ...)
+            if isinstance(node, ast.Compare):
+                mentions_state = "state" in ast.dump(node.left).lower()
+                if not mentions_state:
+                    continue
+                for comparator in node.comparators:
+                    literals = []
+                    if isinstance(comparator, ast.Constant) and \
+                            isinstance(comparator.value, str):
+                        literals = [comparator.value]
+                    elif isinstance(comparator, (ast.Tuple, ast.List,
+                                                 ast.Set)):
+                        literals = [
+                            e.value for e in comparator.elts
+                            if isinstance(e, ast.Constant) and
+                            isinstance(e.value, str)]
+                    for literal in literals:
+                        # Upper-case literals are cloud-API enums
+                        # (GCE VM states), not our vocabulary.
+                        if literal and literal not in allowed and \
+                                literal.isidentifier() and \
+                                literal == literal.lower():
+                            problems.append(
+                                f"{rel}:{node.lineno}: state "
+                                f"literal {literal!r} not in "
+                                f"state/names.py vocabularies")
+    assert not problems, "\n".join(problems)
+
+
+def test_quarantine_and_health_names_declared():
+    """PR 5's new vocabulary rides the registry: the quarantined task
+    state is terminal (and a TASK_STATE), and the node health columns
+    are single-sourced."""
+    assert names.TASK_STATE_QUARANTINED == "quarantined"
+    assert names.TASK_STATE_QUARANTINED in names.TASK_STATES
+    assert names.TASK_STATE_QUARANTINED in names.TERMINAL_TASK_STATES
+    assert set(names.TERMINAL_TASK_STATES) <= set(names.TASK_STATES)
+    assert names.NODE_COL_HEALTH == "health"
+    assert names.NODE_COL_QUARANTINED == "quarantined"
+
+
+def test_task_and_backoff_event_constants_are_declared():
+    """Every TASK_* event constant referenced at an emit site (the
+    retry supervisor's TASK_RETRY/TASK_BACKOFF among them) resolves
+    to a declared goodput/events.py constant registered in
+    EVENT_KINDS, and the backoff category is priced by the
+    accounting sweep (not silently dropped into 'unaccounted')."""
+    from batch_shipyard_tpu.goodput import accounting
+    from batch_shipyard_tpu.goodput import events as gp_events
+    problems = []
+    event_attrs = {"TASK_QUEUED", "TASK_IMAGE_PULL",
+                   "TASK_CONTAINER_START", "TASK_RUNNING",
+                   "TASK_RETRY", "TASK_BACKOFF"}
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in event_attrs:
+                value = getattr(gp_events, node.attr, None)
+                if value is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} not "
+                        f"declared in goodput/events.py")
+                elif value not in gp_events.EVENT_KINDS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} value "
+                        f"{value!r} missing from EVENT_KINDS")
+    assert not problems, "\n".join(problems)
+    assert accounting._KIND_CATEGORY[
+        gp_events.TASK_BACKOFF] == "backoff"
+    assert "backoff" in accounting.BADPUT_CATEGORIES
+
+
 def test_train_workloads_enable_the_compile_cache():
     """Every workload that builds a parallel.train harness must go
     through the compilecache enable hook (compilecache.

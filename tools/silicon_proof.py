@@ -42,6 +42,13 @@ invokes this script on the first successful probe; it:
                       transformer train step in fresh subprocesses,
                       plus the AOT-precompile first-step spike check
                       (batch_shipyard_tpu/compilecache/).
+ 10. chaos_drill    — self-healing proof: a seeded fault schedule
+                      (wedge, mid-run kill, node preemption,
+                      heartbeat blackout, store faults) replayed
+                      against a fakepod pool via tools/chaos_drill.py
+                      with every recovery invariant asserted (all
+                      tasks complete exactly once, no orphaned
+                      coordination state, goodput partition exact).
 
 Every phase's outcome is recorded in SILICON_PROOF.json; --dry-run
 writes the complete report skeleton on CPU (each phase records the
@@ -436,6 +443,48 @@ class Pipeline:
         except Exception as exc:  # noqa: BLE001 - report, don't die
             self.record("goodput", "failed", error=str(exc))
 
+    def chaos_drill(self) -> None:
+        """Self-healing proof (chaos/): replay a seeded fault
+        schedule over a fakepod pool and assert the recovery
+        invariants. Pure CPU — real NodeAgent threads, no
+        accelerator — so the same drill that gates CI also runs on
+        the pod to prove recovery under real substrate timing. The
+        dry-run skeleton names every invariant benchgen binds to."""
+        details_path = self.out / "CHAOS_DRILL_DETAILS.json"
+        cmd = [sys.executable, "tools/chaos_drill.py",
+               "--seeds", "7",
+               "--report-out", str(details_path)]
+        invariant_keys = ("tasks", "orphaned_gang_rows",
+                          "queue_depth", "retries",
+                          "backoff_seconds")
+        if self.dry:
+            self.record("chaos_drill", "dry_run",
+                        command=" ".join(cmd),
+                        metrics={"determinism": None,
+                                 "injections_applied": None,
+                                 "invariants": {k: None for k in
+                                                invariant_keys}})
+            return
+        rc, out = _run(cmd, BENCH_QUICK_TIMEOUT, env=self.child_env)
+        try:
+            with open(details_path, encoding="utf-8") as fh:
+                det = json.load(fh)
+        except (OSError, ValueError):
+            det = {}
+        scenarios = det.get("scenarios") or [{}]
+        first = scenarios[0]
+        summary = {
+            "determinism": first.get("determinism"),
+            "injections_applied": first.get("injections_applied"),
+            "invariants": {k: first.get("invariants", {}).get(k)
+                           for k in invariant_keys},
+        }
+        if first.get("error"):
+            summary["error"] = first["error"]
+        ok = rc == 0 and det.get("ok") is True
+        self.record("chaos_drill", "ok" if ok else "failed", rc=rc,
+                    metrics=summary, output_tail=out[-800:])
+
     # -- driver ----------------------------------------------------
     def run(self) -> int:
         started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -450,6 +499,7 @@ class Pipeline:
             self.checkpoint_overhead()
             self.goodput()
             self.compile_warm()
+            self.chaos_drill()
         report = {
             "started_at": started,
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
