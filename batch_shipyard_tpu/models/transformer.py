@@ -95,6 +95,13 @@ class TransformerConfig:
     # (gather over the full table width), or None = kernel on TPU and
     # xla elsewhere (ops/paged_attention.py dispatch).
     paged_attention_impl: Optional[str] = None
+    # DENSE int8 decode attention implementation: 'kernel' (Pallas,
+    # int8 cache + per-(position, head) scales dequantized in VMEM
+    # per tile — HBM holds int8 + scales only), 'xla' (dequant
+    # multiply outside the kernel, fused — or not — by XLA), or
+    # None = auto, gated on the dense_decode_int8 silicon-validation
+    # marker (ops/decode_attention.resolve_dense_decode_impl).
+    decode_attention_impl: Optional[str] = None
     # Megatron-style tensor parallelism INSIDE a shard_map body (the
     # pipeline path): q/k/v/gate/up are column-sharded and
     # o_proj/down_proj row-sharded over this mesh axis, with explicit
@@ -323,9 +330,25 @@ class Attention(nn.Module):
             # causal prefix of this one.
             mask = (key_pos[None, None, :] <=
                     cols[:, :, None])[:, None, :, :]  # [B, 1, S, T]
+        if int8_kv and seq == 1:
+            # Single-token decode dispatches through
+            # ops/decode_attention: impl='kernel' dequantizes the
+            # int8 rows + scales in VMEM tile by tile (no dequantized
+            # cache ever exists in HBM — the dense_decode_hlo check
+            # pins that on the compiled step); 'xla'/auto-fallback is
+            # the dequant+einsum reference formulation. lengths =
+            # keys visible to the query = idx + 1 (the key_pos <= idx
+            # mask below, as a count).
+            from batch_shipyard_tpu.ops import decode_attention as dd
+            return dd.dense_decode_attention(
+                q, cache_k.value, cache_v.value, scale_k.value,
+                scale_v.value, idx + 1,
+                impl=cfg.decode_attention_impl).astype(cfg.dtype)
         if int8_kv:
-            # Dequant is elementwise on the matmul operands — XLA
-            # fuses it into the dots; HBM holds int8 + scales only
+            # Multi-token prefill/insert path: dequant is elementwise
+            # on the matmul operands — XLA fuses it into the dots (a
+            # bet the int8_kv_dequant_fusion check measures); HBM
+            # holds int8 + scales only
             # (ops/quantization.dequantize_int8 is the shared
             # contract partner of the quantize above).
             from batch_shipyard_tpu.ops import quantization as qz

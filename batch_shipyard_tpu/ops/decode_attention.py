@@ -1,0 +1,191 @@
+"""Pallas dense decode-attention kernel with in-kernel int8 dequant.
+
+The dense int8 KV decode path (models/transformer._decode_attend)
+previously dequantized the ENTIRE [B, L, H, D] cache with an
+elementwise multiply outside any kernel and bet peak HBM on XLA fusing
+it into the attention dots — the paged path (ops/paged_attention.py)
+already dequantizes per tile inside its kernel. This kernel closes the
+gap for the dense cache: the int8 K/V rows and their per-(position,
+head) fp32 scales stream through VMEM tile by tile, the dequant
+multiply happens on the tile right before the dots, and HBM holds
+int8 + scales only — the entire 2x-HBM claim of kv_cache_dtype='int8'
+(arxiv 2605.25645 makes that headroom the serving-throughput lever).
+tools/tpu_checks.py asserts the claim on the COMPILED step: no
+full-cache-sized f32/bf16 buffer in the HLO, kernel custom-call
+present (check names dense_decode_int8 / dense_decode_hlo).
+
+Shares the online-softmax block recurrence with the paged kernel
+(_accumulate_page / _init_and_emit) — a fix there lands here too. The
+grid is (batch, heads, length-blocks): blocks wholly past a slot's
+live length are skipped (@pl.when) and their DMAs clamped to the last
+live block, exactly the paged kernel's dead-step discipline.
+
+impl='auto' (None) resolution is gated by silicon validation: the
+kernel turns on only when KERNEL_VALIDATION.json records an on-chip
+pass for 'dense_decode_int8' (ops/kernel_select), the XLA
+dequant+einsum formulation remaining the reference/fallback path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from batch_shipyard_tpu.ops import kernel_select
+from batch_shipyard_tpu.ops.paged_attention import (_accumulate_page,
+                                                    _init_and_emit)
+
+_NEG_INF = -1e30
+
+
+def _dense_decode_kernel_int8(len_ref, q_ref, k_ref, ks_ref, v_ref,
+                              vs_ref, o_ref, o_acc, m_acc, l_acc, *,
+                              block: int, scale: float):
+    """One (slot, head, length-block) program: dequantize the int8
+    K/V tile in VMEM ([block, D] int8 * [block, 1] fp32 scales) right
+    before the dots, then run the shared online-softmax recurrence."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_blocks = pl.num_programs(2)
+    length = len_ref[b]
+    emit = _init_and_emit(j, num_blocks, o_ref, o_acc, m_acc, l_acc)
+
+    @pl.when(j * block < length)
+    def _accumulate():
+        k_tile = k_ref[...].astype(jnp.float32) * ks_ref[...]
+        v_tile = v_ref[...].astype(jnp.float32) * vs_ref[...]
+        _accumulate_page(q_ref[...].astype(jnp.float32), k_tile,
+                         v_tile, j, length, o_acc, m_acc, l_acc,
+                         page=block, scale=scale)
+
+    pl.when(j == num_blocks - 1)(emit)
+
+
+def _largest_block(length: int, preferred: int = 128) -> int:
+    """Largest divisor of the cache length <= preferred."""
+    block = min(preferred, length)
+    while length % block:
+        block -= 1
+    return block
+
+
+def dense_decode_attention_kernel(q, cache_k, cache_v, k_scales,
+                                  v_scales, lengths,
+                                  block: Optional[int] = None,
+                                  interpret: bool = False):
+    """Pallas path. q: [B, 1, H, D]; cache_k/cache_v: [B, L, H, D]
+    int8; k_scales/v_scales: [B, L, H] fp32 per-(position, head)
+    absmax scales; lengths: [B] int32 valid-key counts (INCLUDING the
+    token written this step — the decode contract never attends an
+    unwritten slot). Returns [B, 1, H, D] in q.dtype."""
+    batch, seq, heads, depth = q.shape
+    assert seq == 1, "dense decode kernel consumes one token per call"
+    t_len = cache_k.shape[1]
+    block = block or _largest_block(t_len)
+    if t_len % block:
+        raise ValueError(
+            f"cache length {t_len} not divisible by block {block}")
+    num_blocks = t_len // block
+    scale = 1.0 / (depth ** 0.5)
+    q_r = q.reshape(batch, heads, 1, depth)
+
+    def tile_index(b, h, j, ln):
+        # Clamp dead steps to the slot's LAST live block: blocks past
+        # the length are skipped by @pl.when, so don't spend HBM
+        # bandwidth DMA-ing rows nobody reads (the paged kernel's
+        # discipline; here every row exists, so this is thrift, not
+        # correctness).
+        live = jnp.maximum((ln[b] + block - 1) // block - 1, 0)
+        return (b, jnp.minimum(j, live), h, 0)
+
+    tile_spec = pl.BlockSpec((None, block, None, depth), tile_index)
+    scale_spec = pl.BlockSpec((None, block, None, 1), tile_index)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, heads, num_blocks),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, depth),
+                         lambda b, h, j, ln: (b, h, 0, 0)),
+            tile_spec,
+            scale_spec,
+            tile_spec,
+            scale_spec,
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, depth),
+                               lambda b, h, j, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, depth), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_dense_decode_kernel_int8, block=block,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, heads, 1, depth),
+                                       q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q_r, cache_k,
+      k_scales.reshape(*k_scales.shape, 1), cache_v,
+      v_scales.reshape(*v_scales.shape, 1))
+    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
+
+
+def dense_decode_attention_xla(q, cache_k, cache_v, k_scales,
+                               v_scales, lengths):
+    """The reference formulation: dequantize the gathered cache with
+    an elementwise multiply and rely on XLA fusing it into the dots —
+    the fallback path and the numerics oracle for the kernel. Same
+    math as the einsum path in models/transformer._decode_attend."""
+    batch, seq, heads, depth = q.shape
+    assert seq == 1
+    k_all = cache_k.astype(jnp.float32) * k_scales[..., None]
+    v_all = cache_v.astype(jnp.float32) * v_scales[..., None]
+    k_all = k_all.astype(q.dtype)
+    v_all = v_all.astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(depth))
+    key_pos = jax.lax.broadcasted_iota(
+        jnp.int32, (cache_k.shape[1], 1), 0)[:, 0]
+    mask = key_pos[None, :] < lengths[:, None]
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def resolve_dense_decode_impl(impl: Optional[str] = None) -> str:
+    """'kernel' | 'xla' | None (auto). Auto stays on the XLA path
+    until tools/tpu_checks.py records an on-chip pass for
+    dense_decode_int8 in KERNEL_VALIDATION.json AND the current
+    backend is tpu (ops/kernel_select)."""
+    if impl is not None:
+        if impl not in ("kernel", "xla"):
+            raise ValueError(
+                f"unknown dense decode attention impl {impl!r}")
+        return impl
+    return kernel_select.resolve_auto("dense_decode_int8",
+                                      pallas_impl="kernel",
+                                      fallback="xla")
+
+
+def dense_decode_attention(q, cache_k, cache_v, k_scales, v_scales,
+                           lengths, impl: Optional[str] = None,
+                           interpret: bool = False):
+    """Dispatch: the in-kernel int8 dequant path or the XLA
+    dequant+einsum reference (see resolve_dense_decode_impl)."""
+    impl = resolve_dense_decode_impl(impl)
+    if impl == "kernel":
+        return dense_decode_attention_kernel(
+            q, cache_k, cache_v, k_scales, v_scales, lengths,
+            interpret=interpret)
+    return dense_decode_attention_xla(
+        q, cache_k, cache_v, k_scales, v_scales, lengths)

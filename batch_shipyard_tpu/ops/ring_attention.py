@@ -27,32 +27,46 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from batch_shipyard_tpu.utils.compat import shard_map
 
 from batch_shipyard_tpu.ops import attention as attn_ops
 from batch_shipyard_tpu.ops import kernel_select
+
+
+RING_IMPLS = ("pallas_dma", "flash", "xla")
 
 
 def resolve_ring_impl(impl: str = "auto") -> str:
     """Resolve 'auto' to a concrete ring implementation.
 
     Priority: explicit impl > SHIPYARD_RING_IMPL env > the
-    KERNEL_VALIDATION.json marker via ops/kernel_select ('flash' only
-    when the flash_ring check passed on a TPU backend AND the current
-    backend is tpu) > 'xla'. CPU always resolves to 'xla' — pallas
-    interpret mode aborts inside shard_map there.
+    KERNEL_VALIDATION.json marker via ops/kernel_select
+    ('pallas_dma' — flash kernels + async-DMA ring permute — only
+    when BOTH the ring_collectives and flash_ring checks passed on a
+    TPU backend; 'flash' when flash_ring alone passed; both require
+    the current backend to be tpu) > 'xla'. CPU always resolves to
+    'xla' — pallas interpret mode aborts inside shard_map there.
     """
     if impl != "auto":
         return impl
     env = os.environ.get("SHIPYARD_RING_IMPL")
     if env:
-        if env not in ("flash", "xla"):
+        if env not in RING_IMPLS:
             raise ValueError(
-                f"SHIPYARD_RING_IMPL={env!r}: must be flash or xla")
+                f"SHIPYARD_RING_IMPL={env!r}: must be one of "
+                f"{', '.join(RING_IMPLS)}")
         return env
-    return kernel_select.resolve_auto("flash_ring",
-                                      pallas_impl="flash")
+    resolved = kernel_select.resolve_auto("flash_ring",
+                                          pallas_impl="flash")
+    if resolved == "flash":
+        # The DMA-permute tier needs its own silicon proof on top of
+        # the flash one (tools/tpu_checks.py check 'ring_collectives').
+        return kernel_select.resolve_auto("ring_collectives",
+                                          pallas_impl="pallas_dma",
+                                          fallback="flash")
+    return resolved
 
 
 def _flash_ring_rotation(q, k_cur, v_cur, my_idx, src, causal: bool):
@@ -83,12 +97,29 @@ def _flash_ring_rotation(q, k_cur, v_cur, my_idx, src, causal: bool):
                           q, k_cur, v_cur)
 
 
-def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
+                                kv_permute: str = "ppermute",
+                                mesh_axis_names=None):
     """Per-shard ring body using the Pallas flash kernels (see
-    _flash_ring_rotation for the 3-case selection)."""
+    _flash_ring_rotation for the 3-case selection).
+
+    kv_permute: 'ppermute' rotates KV shards with lax.ppermute (XLA
+    schedules the transfer); 'dma' uses the async-remote-DMA Pallas
+    permute kernel (ops/ring_collectives.ring_permute_pair) — the
+    impl='pallas_dma' tier, TPU silicon only.
+    """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def rotate(k_cur, v_cur):
+        if kv_permute == "dma":
+            from batch_shipyard_tpu.ops import ring_collectives
+            return ring_collectives.ring_permute_pair(
+                k_cur, v_cur, axis_name, tuple(mesh_axis_names),
+                int(axis_size))
+        return (jax.lax.ppermute(k_cur, axis_name, perm),
+                jax.lax.ppermute(v_cur, axis_name, perm))
 
     @jax.checkpoint
     def step(carry, t):
@@ -98,8 +129,7 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
                                           src, causal)
         o_acc, lse_acc = attn_ops.merge_attention_blocks(
             o_acc, lse_acc, o_s, lse_s)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt, v_nxt = rotate(k_cur, v_cur)
         return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     o0, lse0 = attn_ops.masked_attention_block(q)
@@ -183,20 +213,28 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """Global-view entry: q/k/v are [B, T, H, D] global arrays; returns
     the exact attention output with T sharded over axis_name.
 
-    impl: 'flash' (Pallas kernels per rotation — the TPU fast path),
-    'xla' (pure-XLA online softmax — runs anywhere), or 'auto'
-    (resolved by resolve_ring_impl: flash on a TPU backend once the
-    KERNEL_VALIDATION.json marker records an on-chip pass, else xla).
+    impl: 'pallas_dma' (flash kernels per rotation + async-remote-DMA
+    KV permute — the deepest on-chip tier), 'flash' (Pallas kernels
+    per rotation, lax.ppermute rotation), 'xla' (pure-XLA online
+    softmax — runs anywhere), or 'auto' (resolved by
+    resolve_ring_impl: the validated Pallas tiers on a TPU backend
+    once the KERNEL_VALIDATION.json marker records their on-chip
+    passes, else xla).
     """
     impl = resolve_ring_impl(impl)
-    if impl == "flash":
+    if impl in ("flash", "pallas_dma"):
         t_local = q.shape[1] // mesh.shape[axis_name]
         if not attn_ops.flash_shapes_ok(t_local, t_local):
             raise ValueError(
                 f"local shard length {t_local} does not tile the "
                 f"flash blocks; use impl='xla'")
-    body = (_ring_attention_local_flash if impl == "flash"
-            else _ring_attention_local)
+    if impl == "pallas_dma":
+        body = functools.partial(
+            _ring_attention_local_flash, kv_permute="dma",
+            mesh_axis_names=mesh.axis_names)
+    else:
+        body = (_ring_attention_local_flash if impl == "flash"
+                else _ring_attention_local)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(body, axis_name=axis_name, causal=causal),

@@ -100,7 +100,13 @@ def build_transformer_train(
     with goodput_events.phase(goodput_events.PROGRAM_COMPILE,
                               what="init") as init_attrs, \
             cc_manager.tracked(init_attrs, "transformer_init"):
-        params = jax.jit(init_fn, out_shardings=param_shardings)(rng)
+        # Sharding-invariant init draws (utils/compat): the same seed
+        # must produce the same parameters on a dp-only and a tp/sp
+        # mesh, or the parallelism configs can never agree.
+        from batch_shipyard_tpu.utils import compat
+        with compat.threefry_partitionable():
+            params = jax.jit(init_fn,
+                             out_shardings=param_shardings)(rng)
         opt_state = jax.jit(
             optimizer.init,
             out_shardings=None)(params)
@@ -121,11 +127,27 @@ def build_transformer_train(
                 jnp.mean(a) for a in aux_leaves)
         return loss
 
+    # Pin the opt-state shardings SYMMETRICALLY (in == out == the
+    # initialized buffers' actual shardings): opt_state is donated,
+    # and leaving out_shardings to XLA lets the compiler pick a
+    # different layout than the donated input buffer under tp — a
+    # runtime aliasing size mismatch, not a resharding. Leaves that
+    # initialized off-mesh (optax scalar counts land on one device)
+    # are normalized to mesh-replicated and re-placed.
+    def _opt_sharding(x):
+        if isinstance(x.sharding, NamedSharding) and \
+                x.sharding.mesh == mesh:
+            return x.sharding
+        return NamedSharding(mesh, P())
+
+    opt_shardings = jax.tree_util.tree_map(_opt_sharding, opt_state)
+    opt_state = jax.device_put(opt_state, opt_shardings)
+
     @functools.partial(
         jax.jit, donate_argnums=(0, 1),
-        in_shardings=(param_shardings, None, batch_sharding,
+        in_shardings=(param_shardings, opt_shardings, batch_sharding,
                       batch_sharding),
-        out_shardings=(param_shardings, None, None))
+        out_shardings=(param_shardings, opt_shardings, None))
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
                                                   targets)

@@ -15,8 +15,25 @@ import os
 import subprocess
 from typing import Optional, Sequence
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+# The cryptography wheel is absent from some accelerator containers;
+# gate it so importing this module (and everything that transitively
+# pulls utils) stays possible — the key/credential helpers raise a
+# clear error at CALL time instead.
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - environment-dependent
+    hashes = serialization = padding = rsa = None
+    HAVE_CRYPTOGRAPHY = False
+
+
+def _require_cryptography() -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "the 'cryptography' package is not installed in this "
+            "environment; ssh keypair / credential encryption "
+            "helpers are unavailable")
 
 from batch_shipyard_tpu.utils import util
 
@@ -28,6 +45,7 @@ def generate_ssh_keypair(output_dir: str,
                          bits: int = 3072) -> tuple[str, str]:
     """Generate an RSA ssh keypair; returns (private_path,
     public_path). (reference crypto.py:127)"""
+    _require_cryptography()
     key = rsa.generate_private_key(public_exponent=65537, key_size=bits)
     private_pem = key.private_bytes(
         serialization.Encoding.PEM,
@@ -49,6 +67,7 @@ def generate_ssh_keypair(output_dir: str,
 
 def generate_rsa_keypair_pem(bits: int = 3072) -> tuple[bytes, bytes]:
     """(private_pem, public_pem) for credential encryption."""
+    _require_cryptography()
     key = rsa.generate_private_key(public_exponent=65537, key_size=bits)
     private_pem = key.private_bytes(
         serialization.Encoding.PEM,
@@ -63,6 +82,7 @@ def generate_rsa_keypair_pem(bits: int = 3072) -> tuple[bytes, bytes]:
 def encrypt_credential(public_pem: bytes, plaintext: str) -> str:
     """RSA-OAEP encrypt a short credential for on-node decryption
     (reference crypto.py:535 encrypt via cert)."""
+    _require_cryptography()
     public = serialization.load_pem_public_key(public_pem)
     ciphertext = public.encrypt(
         plaintext.encode("utf-8"),
@@ -72,6 +92,7 @@ def encrypt_credential(public_pem: bytes, plaintext: str) -> str:
 
 
 def decrypt_credential(private_pem: bytes, encrypted_b64: str) -> str:
+    _require_cryptography()
     private = serialization.load_pem_private_key(private_pem, None)
     plaintext = private.decrypt(
         base64.b64decode(encrypted_b64),

@@ -510,6 +510,123 @@ def bench_compile_warm(timeout: float = 600.0) -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_ring_collectives(
+        sizes_bytes=(1 << 18, 1 << 20, 1 << 22),
+        virtual_ring: int = 4) -> dict:
+    """Ring-collective kernel phase (ops/ring_collectives.py):
+    numeric parity of the async-DMA Pallas ring
+    all-gather/reduce-scatter against the lax collectives, plus
+    per-size bandwidth rows. With >1 TPU device the real shard_map
+    remote-DMA ring runs over the sp axis AND the equivalent lax
+    collective is timed as the baseline; on a single TPU chip the
+    virtual-ring kernels are compiled and timed (same Mosaic
+    DMA/semaphore lowering, no ICI — labeled, not a bandwidth claim);
+    on a non-TPU backend the kernels run in interpret mode for the
+    parity check only (timings omitted — interpreting is not
+    measuring)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from batch_shipyard_tpu.ops import ring_collectives as rc
+    from batch_shipyard_tpu.ops.collectives import (_collective_fn,
+                                                    _timeit)
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    multi = n_dev > 1 and on_tpu
+    feat = 128
+    itemsize = 4  # fp32
+    rows = []
+    numeric_ok = True
+    rng = np.random.RandomState(0)
+
+    def add_row(op, impl, nbytes, fn, arg, timed):
+        rows.append({
+            "op": op, "impl": impl, "bytes": nbytes,
+            "seconds": _timeit(fn, arg) if timed else None,
+        })
+
+    if multi:
+        mode = "remote_dma"
+        ring = n_dev
+        mesh = mesh_mod.make_mesh(
+            mesh_mod.auto_axis_sizes(n_dev, sp=n_dev))
+        lax_ag = _collective_fn(mesh, "sp", "all_gather")
+        lax_rs = _collective_fn(mesh, "sp", "reduce_scatter")
+        for size in sizes_bytes:
+            chunk = max(8, size // itemsize // (ring * feat))
+            chunk -= chunk % 8
+            x = jnp.asarray(
+                rng.randn(ring * chunk, feat), jnp.float32)
+            ag = jax.jit(lambda x: rc.ring_all_gather(x, mesh, "sp"))
+            numeric_ok &= bool(np.allclose(np.asarray(ag(x)),
+                                           np.asarray(x), atol=1e-5))
+            nbytes = x.nbytes
+            add_row("ring_all_gather", "pallas_dma", nbytes, ag, x,
+                    True)
+            add_row("ring_all_gather", "lax", nbytes, lax_ag,
+                    x.reshape(-1), True)
+            y = jnp.asarray(
+                rng.randn(ring, ring * chunk, feat), jnp.float32)
+            rs = jax.jit(
+                lambda y: rc.ring_reduce_scatter(y, mesh, "sp"))
+            numeric_ok &= bool(np.allclose(
+                np.asarray(rs(y)), np.asarray(jnp.sum(y, axis=0)),
+                atol=1e-4))
+            add_row("ring_reduce_scatter", "pallas_dma", nbytes, rs,
+                    y, True)
+            add_row("ring_reduce_scatter", "lax", nbytes, lax_rs,
+                    y.reshape(-1), True)
+    else:
+        # Compiled on a single TPU chip (lowering + schedule proof);
+        # interpret mode anywhere else (parity only, never timed).
+        mode = "virtual" if on_tpu else "virtual_interpret"
+        ring = virtual_ring
+        ag_fn = functools.partial(rc.ring_all_gather_virtual,
+                                  interpret=not on_tpu)
+        rs_fn = functools.partial(rc.ring_reduce_scatter_virtual,
+                                  interpret=not on_tpu)
+        if on_tpu:
+            ag_fn, rs_fn = jax.jit(ag_fn), jax.jit(rs_fn)
+        for size in sizes_bytes:
+            chunk = max(8, size // itemsize // (ring * feat))
+            chunk -= chunk % 8
+            x = jnp.asarray(rng.randn(ring, chunk, feat), jnp.float32)
+            got = np.asarray(ag_fn(x))
+            ref = np.asarray(x).reshape(ring * chunk, feat)
+            numeric_ok &= all(
+                np.allclose(got[i], ref, atol=1e-5)
+                for i in range(ring))
+            add_row("ring_all_gather", f"pallas_{mode}",
+                    ring * chunk * feat * itemsize, ag_fn, x, on_tpu)
+            y = jnp.asarray(rng.randn(ring, ring * chunk, feat),
+                            jnp.float32)
+            numeric_ok &= bool(np.allclose(
+                np.asarray(rs_fn(y)),
+                np.asarray(jnp.sum(y, axis=0)).reshape(
+                    ring, chunk, feat), atol=1e-4))
+            add_row("ring_reduce_scatter", f"pallas_{mode}",
+                    ring * chunk * feat * itemsize, rs_fn, y, on_tpu)
+    for row in rows:
+        row["algo_bw_gbps"] = (
+            row["bytes"] / row["seconds"] / 1e9
+            if row["seconds"] else None)
+    best = {}
+    for op in ("ring_all_gather", "ring_reduce_scatter"):
+        vals = [r["algo_bw_gbps"] for r in rows
+                if r["op"] == op and r["impl"].startswith("pallas")
+                and r["algo_bw_gbps"] is not None]
+        best[f"best_{op.removeprefix('ring_')}_gbps"] = (
+            round(max(vals), 3) if vals else None)
+    return {
+        "mode": mode, "ring": ring, "chips": n_dev,
+        "numeric_ok": bool(numeric_ok), "rows": rows, **best,
+    }
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -662,9 +779,10 @@ def main(argv: list[str] | None = None) -> int:
         "orchestration",
         help="comma-separated subset to run (resnet, transformer, "
         "serving, serving_speculative, checkpoint_overhead, "
-        "compile_warm, orchestration; serving_speculative, "
-        "checkpoint_overhead and compile_warm are opt-in — the "
-        "silicon-proof pipeline runs each as its own phase)")
+        "compile_warm, ring_collectives, orchestration; "
+        "serving_speculative, checkpoint_overhead, compile_warm and "
+        "ring_collectives are opt-in — the silicon-proof pipeline "
+        "runs each as its own phase)")
     parser.add_argument(
         "--quick", action="store_true",
         help="fewer timed iterations (tuning A/B mode)")
@@ -809,6 +927,13 @@ def main(argv: list[str] | None = None) -> int:
             details["compile_warm"] = bench_compile_warm()
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["compile_warm"] = {"error": str(exc)}
+    if "ring_collectives" in workloads:
+        # Opt-in (the silicon-proof ring_collectives phase): async-DMA
+        # ring kernel bandwidth + parity vs the lax collectives.
+        try:
+            details["ring_collectives"] = bench_ring_collectives()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["ring_collectives"] = {"error": str(exc)}
     if "orchestration" in workloads:
         try:
             details["orchestration"] = bench_orchestration_latency()

@@ -37,6 +37,10 @@ _xb._backend_factories.pop("axon", None)
 
 import pytest  # noqa: E402
 
+# Installs the pltpu.force_tpu_interpret_mode polyfill on JAX versions
+# that lack it (the interpret-mode tests use it as a context manager).
+import batch_shipyard_tpu.utils.compat  # noqa: E402,F401
+
 
 @pytest.fixture()
 def tmp_statestore(tmp_path):

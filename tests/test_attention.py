@@ -66,6 +66,7 @@ def test_ring_attention_matches_reference(causal, sp):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_differentiable():
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8, sp=4))
     q, k, v = make_qkv(batch=2, seq=128, heads=2, depth=32)
@@ -130,6 +131,7 @@ def test_flash_backward_matches_reference_interpret():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_flash_ring_merge_algorithm_matches_reference(causal):
     """The flash-ring building blocks — flash_attention_with_lse,
     masked_attention_block, merge_attention_blocks, and the 3-case
@@ -167,7 +169,119 @@ def test_flash_ring_merge_algorithm_matches_reference(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+# ---------------- in-kernel int8 dense decode -------------------------
+
+def _int8_cache(batch=6, t_len=64, heads=4, depth=64, seed=11):
+    from batch_shipyard_tpu.ops.quantization import quantize_int8_rows
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(batch, 1, heads, depth), jnp.float32)
+    k_f = jnp.asarray(rng.randn(batch, t_len, heads, depth),
+                      jnp.float32)
+    v_f = jnp.asarray(rng.randn(batch, t_len, heads, depth),
+                      jnp.float32)
+    ck, ks = quantize_int8_rows(k_f)
+    cv, vs = quantize_int8_rows(v_f)
+    return q, k_f, v_f, ck, ks, cv, vs
+
+
+def test_dense_decode_int8_kernel_matches_dequant_einsum():
+    """The in-kernel int8 dequant dense decode kernel
+    (ops/decode_attention.py, interpret mode) vs the existing
+    dequantize + einsum path, over ragged lengths INCLUDING the
+    short-prefix masked region (length 1 and lengths straddling the
+    kernel's block boundary)."""
+    from batch_shipyard_tpu.ops import decode_attention as dd
+    q, _, _, ck, ks, cv, vs = _int8_cache()
+    lengths = jnp.asarray([1, 3, 16, 17, 63, 64], jnp.int32)
+    got = dd.dense_decode_attention_kernel(q, ck, cv, ks, vs,
+                                           lengths, interpret=True)
+    want = dd.dense_decode_attention_xla(q, ck, cv, ks, vs, lengths)
+    rel = (np.linalg.norm(np.asarray(got - want)) /
+           np.linalg.norm(np.asarray(want)))
+    assert rel < 1e-5, rel
+    # And both within quantization noise of the fp cache.
+    q2, k_f, v_f, *_ = _int8_cache()
+    ones = jnp.ones(k_f.shape[:3], jnp.float32)
+    ref = dd.dense_decode_attention_xla(q2, k_f, v_f, ones, ones,
+                                        lengths)
+    rel_fp = (np.linalg.norm(np.asarray(want - ref)) /
+              np.linalg.norm(np.asarray(ref)))
+    assert rel_fp < 0.02, rel_fp
+
+
+def test_dense_decode_impl_resolution():
+    """auto stays on the XLA path until the dense_decode_int8 check
+    passes on a TPU backend; explicit impls pass through; unknown
+    impls fail fast."""
+    import json
+    from batch_shipyard_tpu.ops import decode_attention as dd
+    from batch_shipyard_tpu.ops import kernel_select
+    assert dd.resolve_dense_decode_impl("kernel") == "kernel"
+    assert dd.resolve_dense_decode_impl("xla") == "xla"
+    with pytest.raises(ValueError):
+        dd.resolve_dense_decode_impl("bogus")
+    # CPU backend: even a tpu-backed marker leaves auto on xla.
+    import os
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        marker = os.path.join(tmp, "KERNEL_VALIDATION.json")
+        with open(marker, "w", encoding="utf-8") as fh:
+            json.dump({"dense_decode_int8":
+                       {"ok": True, "backend": "tpu"}}, fh)
+        old = os.environ.get(kernel_select.MARKER_ENV)
+        os.environ[kernel_select.MARKER_ENV] = marker
+        try:
+            assert dd.resolve_dense_decode_impl(None) == "xla"
+        finally:
+            if old is None:
+                os.environ.pop(kernel_select.MARKER_ENV, None)
+            else:
+                os.environ[kernel_select.MARKER_ENV] = old
+
+
+def test_dense_decode_kernel_through_transformer():
+    """The flax dense int8 decode path with decode_attention_impl=
+    'kernel' (interpret mode) matches the einsum path end to end —
+    prefill via the multi-token insert, then one kernel decode
+    step."""
+    import dataclasses
+    from jax.experimental.pallas import tpu as pltpu
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_head=16,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    params = tfm.TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jnp.asarray([[5, 17, 31, 2, 9, 40]], jnp.int32)
+
+    def decode_logits(impl):
+        dcfg = dataclasses.replace(
+            inf.decode_config(cfg, 64), kv_cache_dtype="int8",
+            decode_attention_impl=impl)
+        model = tfm.TransformerLM(dcfg)
+        cache = inf.init_cache(model, params, 1)
+        _, mutated = model.apply(
+            {"params": params, "cache": cache}, prompt,
+            return_hidden=True, mutable=["cache"])
+        logits, _ = model.apply(
+            {"params": params, "cache": mutated["cache"]},
+            jnp.asarray([[7]], jnp.int32),
+            positions=jnp.asarray([[6]], jnp.int32),
+            mutable=["cache"])
+        return logits
+
+    ref = decode_logits("xla")
+    with pltpu.force_tpu_interpret_mode():
+        got = decode_logits("kernel")
+    rel = (np.linalg.norm(np.asarray(got - ref)) /
+           np.linalg.norm(np.asarray(ref)))
+    assert rel < 1e-5, rel
+
+
 @pytest.mark.parametrize("scale", [0.1, 1.0])
+@pytest.mark.slow
 def test_flash_ring_merge_gradients(scale):
     """Gradients flow correctly through the merge + flash building
     blocks (2-shard simulated ring vs oracle). The merge weights

@@ -177,6 +177,8 @@ def test_gcsfuse_mount_args():
 
 # ------------------------------- crypto --------------------------------
 
+@pytest.mark.skipif(not crypto.HAVE_CRYPTOGRAPHY,
+                    reason="cryptography wheel absent from container")
 def test_ssh_keypair_and_credential_roundtrip(tmp_path):
     private_path, public_path = crypto.generate_ssh_keypair(
         str(tmp_path))

@@ -157,13 +157,23 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
     assert report["dry_run"] is True
     names = [p["phase"] for p in report["phases"]]
     assert names == ["probe", "kernel_checks", "flash_flip",
-                     "tuning_ab", "final_bench",
+                     "ring_collectives", "tuning_ab", "final_bench",
                      "serving_speculative", "checkpoint_overhead",
                      "goodput", "compile_warm", "chaos_drill"]
     assert all(p["status"] == "dry_run" for p in report["phases"])
+    # The ring-collectives kernel phase's skeleton names every metric
+    # and carries the explicit unreachable marker benchgen renders
+    # (claims are labeled, not implied).
+    ring = report["phases"][3]
+    assert "bench.py" in ring["command"]
+    assert "ring_collectives" in ring["command"]
+    assert "dry-run skeleton" in ring["note"]
+    assert set(ring["metrics"]) == {
+        "mode", "ring", "chips", "numeric_ok",
+        "best_all_gather_gbps", "best_reduce_scatter_gbps"}
     # The speculative serving phase's skeleton names every metric it
     # will emit, for both KV layouts.
-    spec = report["phases"][5]
+    spec = report["phases"][6]
     assert "bench.py" in spec["command"]
     assert "serving_speculative" in spec["command"]
     for variant in ("dense", "paged"):
@@ -172,14 +182,14 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
             "acceptance_rate"}
     # The warm-start compilation phase's skeleton names every metric
     # benchgen binds to.
-    compile_warm = report["phases"][8]
+    compile_warm = report["phases"][9]
     assert "compile_warm" in compile_warm["command"]
     assert set(compile_warm["metrics"]) == {
         "cold_ms", "warm_ms", "speedup", "cache_hits",
         "aot_first_step_ms", "steady_step_ms"}
     # The chaos-drill phase's skeleton names the recovery invariants
     # benchgen binds to (docs/30-fault-tolerance.md).
-    chaos = report["phases"][9]
+    chaos = report["phases"][10]
     assert "chaos_drill.py" in chaos["command"]
     assert set(chaos["metrics"]) == {"determinism",
                                      "injections_applied",
@@ -188,7 +198,7 @@ def test_silicon_proof_dry_run_writes_full_skeleton(tmp_path):
         "tasks", "orphaned_gang_rows", "queue_depth", "retries",
         "backoff_seconds"}
     # The tuning plan must cover every profile with a runnable command.
-    plan = report["phases"][3]["plan"]
+    plan = report["phases"][4]["plan"]
     from batch_shipyard_tpu.parallel.tuning import PROFILES
     assert set(plan) == set(PROFILES)
     assert all("bench.py --quick" in cmd for cmd in plan.values())

@@ -67,6 +67,7 @@ def test_param_sharding_rules():
     {"dp": 2, "sp": 2, "tp": 2},
     {"fsdp": 4, "tp": 2},
 ])
+@pytest.mark.slow
 def test_transformer_train_step_parallelisms(axes):
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(
         8, tp=axes.get("tp", 1), sp=axes.get("sp", 1),
@@ -119,6 +120,7 @@ def test_parallelism_configs_agree():
                                rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_resnet_forward_and_train_step():
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
     config = resnet_mod.ResNetConfig(num_classes=10,
@@ -165,6 +167,7 @@ def test_moe_capacity_drops_overflow():
     assert float(jnp.sum(dispatch)) == 4.0
 
 
+@pytest.mark.slow
 def test_moe_transformer_trains_with_ep():
     from batch_shipyard_tpu.models.moe import MoEConfig
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8, ep=4))
@@ -226,6 +229,7 @@ def test_moe_top2_capacity_priority():
     assert per_expert[2:].sum() == 0
 
 
+@pytest.mark.slow
 def test_moe_top2_transformer_trains():
     from batch_shipyard_tpu.models.moe import MoEConfig
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8, ep=2))

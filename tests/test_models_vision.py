@@ -4,6 +4,7 @@ train harnesses on the virtual 8-device mesh, sampler determinism."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from batch_shipyard_tpu.models import diffusion as dif_mod
 from batch_shipyard_tpu.models import vit as vit_mod
@@ -19,6 +20,7 @@ TINY_DIT = dif_mod.DiTConfig(
     d_ff=128, timesteps=100, dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_vit_forward_shape_and_grad():
     model = vit_mod.ViT(TINY_VIT)
     images = jnp.ones((2, 32, 32, 3), jnp.float32)
@@ -38,6 +40,7 @@ def test_vit_forward_shape_and_grad():
     assert all(np.all(np.isfinite(leaf)) for leaf in leaves)
 
 
+@pytest.mark.slow
 def test_vit_train_loss_decreases():
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
     harness = train_mod.build_vit_train(
@@ -86,6 +89,7 @@ def test_dit_class_conditional_requires_labels():
         pass
 
 
+@pytest.mark.slow
 def test_diffusion_train_loss_decreases():
     mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(8))
     harness = train_mod.build_diffusion_train(

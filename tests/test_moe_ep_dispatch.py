@@ -7,8 +7,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax import linen as nn
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from batch_shipyard_tpu.utils.compat import shard_map
 
 from batch_shipyard_tpu.models import moe
 
@@ -153,6 +154,7 @@ def test_single_axis_ep_dispatch_matches_dense():
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_stage_inside_1f1b_pipeline():
     """dp x pp x ep composition (ROADMAP 'wire it into the training
     path'): a 2-stage 1F1B pipeline whose stages each run an

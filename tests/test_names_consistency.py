@@ -227,6 +227,136 @@ def test_train_workloads_enable_the_compile_cache():
     assert not problems, "\n".join(problems)
 
 
+def _tpu_checks_names():
+    """CHECKS keys from tools/tpu_checks.py, by AST (dict literal
+    keys plus CHECKS["..."] = ... assignments) — no import of the
+    TPU harness."""
+    path = PACKAGE.parent / "tools" / "tpu_checks.py"
+    tree = ast.parse(path.read_text(encoding="utf-8"),
+                     filename=str(path))
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        target.id == "CHECKS" and \
+                        isinstance(node.value, ast.Dict):
+                    names |= {k.value for k in node.value.keys
+                              if isinstance(k, ast.Constant)}
+                if isinstance(target, ast.Subscript) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "CHECKS" and \
+                        isinstance(target.slice, ast.Constant):
+                    names.add(target.slice.value)
+    return names
+
+
+def test_kernel_select_names_are_backed_by_tpu_checks():
+    """Every validation name the package consults for impl='auto'
+    dispatch (kernel_select.resolve_auto / kernel_validated) must be
+    a tools/tpu_checks.py CHECKS entry — a typo'd gate name would
+    keep a Pallas fast path off forever with no failing check to say
+    why (the ring_collectives / dense_decode_int8 gates among
+    them)."""
+    check_names = _tpu_checks_names()
+    assert check_names, "could not parse tpu_checks.CHECKS"
+    problems = []
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name)
+                    else None)
+            if name not in ("resolve_auto", "kernel_validated"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                check = node.args[0].value
+                if check not in check_names:
+                    problems.append(
+                        f"{rel}:{node.lineno}: kernel_select gate "
+                        f"{check!r} has no tools/tpu_checks.py "
+                        f"CHECKS entry")
+    assert not problems, "\n".join(problems)
+
+
+def test_benchgen_phase_and_workload_names_exist():
+    """Every silicon-proof phase name tools/benchgen.py binds to
+    (p.get("phase") == "X") must be record()-ed by
+    tools/silicon_proof.py, and every bench workload a silicon-proof
+    phase command invokes (--workloads X) must be dispatched by
+    bench.py ("X" in workloads) — a renamed phase cannot silently
+    turn a docs section or a pipeline phase into a no-op."""
+    tools = PACKAGE.parent / "tools"
+    benchgen_tree = ast.parse(
+        (tools / "benchgen.py").read_text(encoding="utf-8"))
+    proof_src = (tools / "silicon_proof.py").read_text(
+        encoding="utf-8")
+    proof_tree = ast.parse(proof_src)
+    bench_tree = ast.parse(
+        (PACKAGE.parent / "bench.py").read_text(encoding="utf-8"))
+
+    recorded = set()
+    workloads_invoked = set()
+    for node in ast.walk(proof_tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "record" and node.args and \
+                isinstance(node.args[0], ast.Constant):
+            recorded.add(node.args[0].value)
+        # ["...", "--workloads", "X", ...] command lists.
+        if isinstance(node, ast.List):
+            values = [e.value for e in node.elts
+                      if isinstance(e, ast.Constant) and
+                      isinstance(e.value, str)]
+            for i, value in enumerate(values[:-1]):
+                if value == "--workloads":
+                    workloads_invoked |= {
+                        w.strip() for w in values[i + 1].split(",")}
+
+    referenced = set()
+    for node in ast.walk(benchgen_tree):
+        # p.get("phase") == "X" comparisons.
+        if isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Call) and \
+                isinstance(node.left.func, ast.Attribute) and \
+                node.left.func.attr == "get" and node.left.args and \
+                isinstance(node.left.args[0], ast.Constant) and \
+                node.left.args[0].value == "phase":
+            for comparator in node.comparators:
+                if isinstance(comparator, ast.Constant) and \
+                        isinstance(comparator.value, str):
+                    referenced.add(comparator.value)
+    assert referenced, "no phase references found in benchgen.py"
+    missing = referenced - recorded
+    assert not missing, (
+        f"benchgen.py binds to silicon-proof phases {sorted(missing)} "
+        f"that tools/silicon_proof.py never records")
+
+    dispatched = set()
+    for node in ast.walk(bench_tree):
+        # "X" in workloads dispatch checks.
+        if isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], ast.In) and \
+                isinstance(node.comparators[0], ast.Name) and \
+                node.comparators[0].id == "workloads":
+            dispatched.add(node.left.value)
+    assert dispatched, "no workload dispatch found in bench.py"
+    missing = workloads_invoked - dispatched
+    assert not missing, (
+        f"silicon_proof.py invokes bench workloads {sorted(missing)} "
+        f"that bench.py never dispatches")
+    # The new kernel phase is wired end to end.
+    assert "ring_collectives" in recorded
+    assert "ring_collectives" in dispatched
+
+
 def test_train_loops_never_call_blocking_checkpoint_save():
     """The train workloads must drive checkpoints through
     checkpoint.TrainCheckpointer (which routes to the async manager

@@ -46,6 +46,7 @@ def test_pipeline_matches_sequential(pp, microbatches):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_sequential():
     mesh = make_mesh_pp(4)
     params = make_stage_params(4, width=16)
@@ -81,6 +82,7 @@ def test_pipeline_composes_with_dp():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_blocks():
     """Pipeline real transformer blocks: 4 stages x 1 block each."""
     from batch_shipyard_tpu.models import transformer as tfm
@@ -121,6 +123,7 @@ def test_pipeline_rejects_bad_microbatch():
                                 batch_axes=("dp",))
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_training():
     """Full pipeline-parallel training: pp=4 x dp=2 mesh, loss
     decreases, and the pipelined forward equals a sequential pass
@@ -151,6 +154,7 @@ def test_pipeline_transformer_training():
     assert float(metrics["loss"]) < first
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_matches_nonpipelined():
     """The pp=4 pipelined forward loss equals running the same blocks
     sequentially (no pipeline) with identical parameters."""
@@ -210,6 +214,7 @@ def _mb_mean_loss(last_params, h, targets, last_fn, num_microbatches):
 
 
 @pytest.mark.parametrize("pp,microbatches", [(4, 4), (4, 8), (2, 8)])
+@pytest.mark.slow
 def test_1f1b_matches_autodiff(pp, microbatches):
     """The manual 1F1B fwd+bwd schedule reproduces autodiff's loss AND
     gradients (stage params, last-stage params, input cotangent) for
@@ -251,6 +256,7 @@ def test_1f1b_matches_autodiff(pp, microbatches):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_1f1b_transformer_step_matches_sequential_loss():
     """build_transformer_train_1f1b: one step on the dp x pp mesh
     reports the same pre-update loss as the non-pipelined model."""
@@ -342,6 +348,7 @@ def test_1f1b_peak_memory_below_gpipe():
     assert m_1f1b < m_gpipe, (m_1f1b, m_gpipe)
 
 
+@pytest.mark.slow
 def test_1f1b_with_tensor_parallel_stages_matches():
     """1F1B over a dp x pp x tp mesh (Megatron tp INSIDE each stage:
     column/row-sharded projections with explicit f/g operators)
@@ -411,6 +418,7 @@ def test_interleaved_schedule_requires_divisibility():
 
 @pytest.mark.parametrize("pp,chunks,microbatches",
                          [(2, 2, 4), (4, 2, 8)])
+@pytest.mark.slow
 def test_interleaved_1f1b_matches_autodiff(pp, chunks, microbatches):
     """The interleaved schedule reproduces autodiff's loss and
     gradients (chunk params, head params, input cotangent)."""
@@ -456,6 +464,7 @@ def test_interleaved_1f1b_matches_autodiff(pp, chunks, microbatches):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_interleaved_composes_with_dp():
     """dp x pp mesh: data-parallel shards see different microbatches;
     grads pmean across dp — loss equals the full-batch reference."""

@@ -226,6 +226,61 @@ def _compile_warm(out: list[str], data: dict) -> None:
     out.append("")
 
 
+_RING_KEYS = (("mode", "mode (remote_dma = multi-chip ICI ring; "
+               "virtual = single-chip schedule proof)"),
+              ("ring", "ring size"),
+              ("chips", "chips"),
+              ("numeric_ok", "parity vs lax collectives"),
+              ("best_all_gather_gbps", "best ring all-gather (GB/s)"),
+              ("best_reduce_scatter_gbps",
+               "best ring reduce-scatter (GB/s)"))
+
+
+def _ring_collectives(out: list[str], data: dict) -> None:
+    """Async-DMA ring collective kernels section
+    (docs/31-pallas-kernels.md). Falls back to the silicon-proof
+    phase's skeleton metrics; when nothing was measured (the relay is
+    down), the section says so explicitly — claims are labeled, not
+    implied."""
+    skeleton_note = None
+    if not isinstance(data, dict) or not data:
+        proof = _load(ARTIFACTS / "SILICON_PROOF.json") or {}
+        phase = next((p for p in proof.get("phases", [])
+                      if p.get("phase") == "ring_collectives"), None)
+        if phase is None:
+            return
+        data = phase.get("metrics") or {}
+        skeleton_note = phase.get("note")
+    out.append("### Ring collectives (async-DMA Pallas kernels)\n")
+    if "error" in data:
+        out.append(f"Not measured: `{data['error']}`\n")
+        return
+    out.append("Double-buffered `make_async_remote_copy` ring "
+               "all-gather/reduce-scatter: numeric parity against the "
+               "XLA lax collectives always; a timed lax baseline only "
+               "in `remote_dma` mode (interpret-mode runs are parity "
+               "checks, never timings) "
+               "([31-pallas-kernels.md](31-pallas-kernels.md)).\n")
+    if skeleton_note or data.get("numeric_ok") is None:
+        out.append("**accelerator unreachable — dry-run skeleton** "
+                   "(no chip has answered since round 2; the values "
+                   "below are unmeasured placeholders, not claims).\n")
+    out.append("| metric | value |")
+    out.append("|---|---|")
+    for key, label in _RING_KEYS:
+        out.append(f"| {label} | {_fmt(data.get(key), 3)} |")
+    out.append("")
+    rows = data.get("rows") or []
+    if rows:
+        out.append("| op | impl | bytes | GB/s |")
+        out.append("|---|---|---|---|")
+        for row in rows:
+            out.append(f"| {row.get('op')} | {row.get('impl')} | "
+                       f"{row.get('bytes')} | "
+                       f"{_fmt(row.get('algo_bw_gbps'), 3)} |")
+        out.append("")
+
+
 _ORCH_KEYS = ("pool_add_to_ready_seconds", "nodeprep_seconds",
               "image_prefetch_seconds",
               "submit_to_task_complete_seconds")
@@ -406,6 +461,13 @@ def render() -> str:
     cw_details = _load(ARTIFACTS / "COMPILE_WARM_DETAILS.json") or {}
     if "compile_warm" not in details and "compile_warm" in cw_details:
         details["compile_warm"] = cw_details["compile_warm"]
+    # And the ring-collectives kernel phase's.
+    ring_details = _load(
+        ARTIFACTS / "RING_COLLECTIVES_DETAILS.json") or {}
+    if "ring_collectives" not in details and \
+            "ring_collectives" in ring_details:
+        details["ring_collectives"] = (
+            ring_details["ring_collectives"])
     out.append("## Latest detailed run\n")
     if details.get("error"):
         out.append(f"**Status**: `{details['error']}`\n")
@@ -439,6 +501,7 @@ def render() -> str:
              details.get("serving_speculative_paged", {}))
     _checkpoint_overhead(out, details.get("checkpoint_overhead", {}))
     _compile_warm(out, details.get("compile_warm", {}))
+    _ring_collectives(out, details.get("ring_collectives", {}))
     _orchestration(out, details.get("orchestration", {}))
     _goodput(out)
     _chaos_drill(out)

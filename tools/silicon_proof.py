@@ -17,32 +17,37 @@ invokes this script on the first successful probe; it:
                       and ops/chunked_loss impl='auto' now resolve to
                       their Pallas paths (the marker is the flip: no
                       code edit).
-  4. tuning_ab      — bench.py --quick per parallel/tuning.py profile
+  4. ring_collectives — async-DMA ring collective kernels
+                      (ops/ring_collectives.py): bandwidth per message
+                      size vs the lax collectives plus numeric parity,
+                      remote-DMA ring when >1 chip answers, the
+                      virtual-ring kernels on a single chip.
+  5. tuning_ab      — bench.py --quick per parallel/tuning.py profile
                       (fresh subprocess each: XLA_FLAGS are read at
                       backend init); winner by throughput geomean
                       persisted as TUNING_SELECTED.json, which
                       bench.py auto-applies from then on.
-  5. final_bench    — full bench.py under the winning profile; the
+  6. final_bench    — full bench.py under the winning profile; the
                       one-line JSON lands in BENCH_LATEST.json and
                       BENCH_DETAILS.json carries explicit per-workload
                       MFU%% (parallel/mfu.py).
-  6. serving_speculative — speculative continuous-batching serving
+  7. serving_speculative — speculative continuous-batching serving
                       (dense + paged KV): tokens/s, TTFT/TPOT, and
                       the measured draft acceptance rate per variant.
-  7. checkpoint_overhead — zero-stall checkpointing proof: blocking
+  8. checkpoint_overhead — zero-stall checkpointing proof: blocking
                       ms/save of the sync full-durability save vs the
                       async double-buffered pipeline on a synthetic
                       large pytree (workloads/checkpoint.py).
-  8. goodput        — ML-productivity goodput decomposition of the
+  9. goodput        — ML-productivity goodput decomposition of the
                       bench pool's event log (goodput/accounting.py):
                       goodput_ratio plus badput seconds per category,
                       persisted as GOODPUT_REPORT.json.
-  9. compile_warm   — warm-start compilation proof: cold vs warm
+ 10. compile_warm   — warm-start compilation proof: cold vs warm
                       persistent-compile-cache wall time for the
                       transformer train step in fresh subprocesses,
                       plus the AOT-precompile first-step spike check
                       (batch_shipyard_tpu/compilecache/).
- 10. chaos_drill    — self-healing proof: a seeded fault schedule
+ 11. chaos_drill    — self-healing proof: a seeded fault schedule
                       (wedge, mid-run kill, node preemption,
                       heartbeat blackout, store faults) replayed
                       against a fakepod pool via tools/chaos_drill.py
@@ -184,6 +189,46 @@ class Pipeline:
         self.record("flash_flip", "ok" if ok else "failed",
                     ring_impl=ring, chunked_xent_impl=xent,
                     rc=rc, output_tail=out[-500:])
+
+    def ring_collectives(self) -> None:
+        """Async-DMA ring collective kernels
+        (ops/ring_collectives.py) via bench.py's ring_collectives
+        workload: per-size bandwidth rows plus a numeric parity flag
+        against the lax collectives. Runs the remote-DMA shard_map
+        ring when more than one chip answers, the virtual-ring
+        kernels (same Mosaic DMA/semaphore lowering, no ICI) on a
+        single chip — `mode` records which. The dry-run skeleton
+        names every metric and carries the explicit
+        accelerator-unreachable marker tools/benchgen.py renders."""
+        details_path = self.out / "RING_COLLECTIVES_DETAILS.json"
+        cmd = [sys.executable, "bench.py", "--workloads",
+               "ring_collectives", "--details-out",
+               str(details_path)]
+        metric_keys = ("mode", "ring", "chips", "numeric_ok",
+                       "best_all_gather_gbps",
+                       "best_reduce_scatter_gbps")
+        if self.dry:
+            self.record(
+                "ring_collectives", "dry_run",
+                command=" ".join(cmd),
+                note="accelerator unreachable — dry-run skeleton",
+                metrics={k: None for k in metric_keys})
+            return
+        rc, out = _run(cmd, BENCH_QUICK_TIMEOUT, env=self.child_env)
+        try:
+            with open(details_path, encoding="utf-8") as fh:
+                det = json.load(fh)
+        except (OSError, ValueError):
+            det = {}
+        rep = det.get("ring_collectives") or {}
+        if "error" in rep:
+            summary = {"error": rep["error"]}
+        else:
+            summary = {k: rep.get(k) for k in metric_keys}
+        ok = (rc == 0 and "error" not in summary
+              and summary.get("numeric_ok") is True)
+        self.record("ring_collectives", "ok" if ok else "failed",
+                    rc=rc, metrics=summary, output_tail=out[-800:])
 
     def tuning_ab(self) -> str | None:
         from batch_shipyard_tpu.parallel.tuning import PROFILES
@@ -493,6 +538,7 @@ class Pipeline:
         if ok:
             results = self.kernel_checks()
             self.flash_flip(results)
+            self.ring_collectives()
             winner = self.tuning_ab()
             self.final_bench(winner)
             self.serving_speculative()
