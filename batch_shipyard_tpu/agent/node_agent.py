@@ -42,6 +42,9 @@ from batch_shipyard_tpu.jobs import launcher
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
+from batch_shipyard_tpu.trace import context as trace_context
+from batch_shipyard_tpu.trace import profiling as trace_profiling
+from batch_shipyard_tpu.trace import spans as trace_spans
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -142,10 +145,11 @@ class NodeAgent:
         self._running_lock = threading.Lock()
         # Resolved shared-scratch paths per job (auto_scratch: shared).
         self._shared_scratch: dict[str, str] = {}
-        # Short-TTL job-state cache: the disabled/terminated check runs
-        # on every queue poll and must not cost a store round trip each
-        # time on cloud backends.
-        self._job_state_cache: dict[str, tuple[str, float]] = {}
+        # Short-TTL job cache ((state, profile_request, at)): the
+        # disabled/terminated check runs on every queue poll and must
+        # not cost a store round trip each time on cloud backends;
+        # the profile-request forwarding rides the same read.
+        self._job_state_cache: dict[str, tuple] = {}
         self._job_state_ttl = job_state_ttl
         # (job_id, task_id) -> live Popen, for task termination relay.
         self._live_procs: dict[tuple[str, str], object] = {}
@@ -221,6 +225,12 @@ class NodeAgent:
         # Chaos injection seam: heartbeats are suppressed while
         # wall-clock < this (simulated network partition).
         self.heartbeat_blackout_until = 0.0
+        # On-demand profiling: (request-file path, requested_at)
+        # pairs this agent already delivered — keyed per TARGET FILE
+        # so every gang instance dir on a multi-slot node gets its
+        # copy, yet no file is ever re-dropped after the harness
+        # consumed it (one store flag, one capture per instance).
+        self._profile_delivered: set[tuple] = set()
         # Retention sweeps: (monotonic deadline, task dir) for
         # completed tasks whose spec sets retention_time_seconds —
         # the Azure Batch task-constraint retention_time analog
@@ -388,6 +398,8 @@ class NodeAgent:
                 self._heartbeat()
                 self._sweep_retention()
                 self._sweep_orphaned_gangs()
+                self._forward_profile_requests()
+                self._ingest_live_trace_spans()
             except Exception:
                 logger.exception("heartbeat iteration failed; "
                                  "continuing")
@@ -782,18 +794,33 @@ class NodeAgent:
                 slot, job_id, task_id, entity, instance, msg)
 
     def _cached_job_state(self, job_id: str) -> Optional[str]:
+        return self._cached_job(job_id)[0]
+
+    def _cached_job_profile_request(self,
+                                    job_id: str) -> Optional[dict]:
+        """The job's pending on-demand profile request (or None);
+        rides the same short-TTL cache as the disabled/terminated
+        check so the heartbeat forwarding loop costs no extra store
+        round trips."""
+        return self._cached_job(job_id)[1]
+
+    def _cached_job(self, job_id: str) -> tuple:
         now = time.monotonic()
         cached = self._job_state_cache.get(job_id)
-        if cached is not None and now - cached[1] < self._job_state_ttl:
-            return cached[0]
+        if cached is not None and now - cached[-1] < self._job_state_ttl:
+            return cached
         try:
             job = self.store.get_entity(
                 names.TABLE_JOBS, self.identity.pool_id, job_id)
             state = job.get("state")
+            profile = job.get(trace_profiling.COL_PROFILE_REQUEST)
+            if not isinstance(profile, dict):
+                profile = None
         except NotFoundError:
             state = None
-        self._job_state_cache[job_id] = (state, now)
-        return state
+            profile = None
+        self._job_state_cache[job_id] = (state, profile, now)
+        return self._job_state_cache[job_id]
 
     def _maybe_reclaim_orphan(self, job_id: str, task_id: str,
                               entity: dict) -> Optional[dict]:
@@ -887,6 +914,14 @@ class NodeAgent:
                 goodput_events.NODE_IDLE,
                 node_id=self.identity.node_id,
                 start=idle_since, end=now)
+        ctx = trace_context.TraceContext.from_entity(entity)
+        # Claim marker: instantaneous, but it pins WHICH node won the
+        # claim (and when) on the submission's causal chain.
+        trace_spans.emit(
+            self.store, self.identity.pool_id, trace_spans.SPAN_CLAIM,
+            ctx, job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            attrs={"retries": entity.get("retries", 0)})
         if not emit_queued:
             return
         # A retried task waited since its REQUEUE, not its original
@@ -897,6 +932,14 @@ class NodeAgent:
             goodput_events.emit(
                 self.store, self.identity.pool_id,
                 goodput_events.TASK_QUEUED, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=submitted, end=now,
+                attrs={"retries": entity.get("retries", 0)},
+                trace_id=entity.get(trace_context.COL_TRACE_ID),
+                span_id=entity.get(trace_context.COL_TRACE_SPAN))
+            trace_spans.emit(
+                self.store, self.identity.pool_id,
+                trace_spans.SPAN_QUEUE_WAIT, ctx, job_id=job_id,
                 task_id=task_id, node_id=self.identity.node_id,
                 start=submitted, end=now,
                 attrs={"retries": entity.get("retries", 0)})
@@ -918,26 +961,42 @@ class NodeAgent:
                     task_id=task_id, node_id=self.identity.node_id,
                     start=submitted, end=end,
                     attrs={"retries": entity.get("retries", 0),
-                           "delay_seconds": end - submitted})
+                           "delay_seconds": end - submitted},
+                    trace_id=entity.get(trace_context.COL_TRACE_ID),
+                    span_id=entity.get(trace_context.COL_TRACE_SPAN))
+                trace_spans.emit(
+                    self.store, self.identity.pool_id,
+                    trace_spans.SPAN_BACKOFF_WAIT, ctx,
+                    job_id=job_id, task_id=task_id,
+                    node_id=self.identity.node_id,
+                    start=submitted, end=end,
+                    attrs={"retries": entity.get("retries", 0)})
 
     def _ensure_images_timed(self, job_id: str, task_id: str,
-                             spec: dict) -> None:
+                             spec: dict,
+                             entity: Optional[dict] = None) -> None:
         """_ensure_images under an image_pull goodput span (only when
         the task actually names a container image)."""
         if spec.get("image") and spec.get("runtime") in (
                 "docker", "singularity"):
+            entity = entity or {}
             with goodput_events.span(
                     self.store, self.identity.pool_id,
                     goodput_events.TASK_IMAGE_PULL, job_id=job_id,
                     task_id=task_id, node_id=self.identity.node_id,
-                    attrs={"image": spec.get("image")}):
+                    attrs={"image": spec.get("image")},
+                    trace_id=entity.get(trace_context.COL_TRACE_ID),
+                    span_id=entity.get(trace_context.COL_TRACE_SPAN)):
                 self._ensure_images(spec)
         else:
             self._ensure_images(spec)
 
     def _goodput_task_finished(self, slot: int, job_id: str,
                                task_id: str,
-                               result: task_runner.TaskResult) -> None:
+                               result: task_runner.TaskResult,
+                               entity: Optional[dict] = None,
+                               instance: Optional[int] = None) -> None:
+        entity = entity or {}
         started = goodput_events.iso_to_epoch(result.started_at)
         if started is not None and result.wall_seconds > 0:
             goodput_events.emit(
@@ -946,7 +1005,26 @@ class NodeAgent:
                 task_id=task_id, node_id=self.identity.node_id,
                 start=started, end=started + result.wall_seconds,
                 attrs={"exit_code": result.exit_code,
-                       "timed_out": result.timed_out})
+                       "timed_out": result.timed_out},
+                trace_id=entity.get(trace_context.COL_TRACE_ID),
+                span_id=entity.get(trace_context.COL_TRACE_SPAN))
+            # The task's ROOT span (the id every program phase inside
+            # the process parented under via $SHIPYARD_TRACE_SPAN_ID)
+            # is recorded as the run span itself: launch -> exit.
+            # Gang instances share the root id; only instance 0
+            # writes it (one row), the rest annotate via attrs on
+            # their own child spans.
+            ctx = trace_context.TraceContext.from_entity(entity)
+            if ctx is not None and (instance is None or instance == 0):
+                trace_spans.emit(
+                    self.store, self.identity.pool_id,
+                    trace_spans.SPAN_TASK_RUN, ctx, job_id=job_id,
+                    task_id=task_id, node_id=self.identity.node_id,
+                    start=started, end=started + result.wall_seconds,
+                    attrs={"exit_code": result.exit_code,
+                           "wedged": result.wedged,
+                           "retries": entity.get("retries", 0)},
+                    self_span=True)
         self._goodput_work_done(slot)
 
     def _goodput_work_done(self, slot: int) -> None:
@@ -968,14 +1046,184 @@ class NodeAgent:
         $SHIPYARD_GOODPUT_FILE) into the store with the task's
         identity attached."""
         path = execution.env.get(goodput_events.GOODPUT_FILE_ENV)
-        if not path:
+        if path:
+            count = goodput_events.ingest_local_events(
+                self.store, self.identity.pool_id, path, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id)
+            if count:
+                logger.debug("ingested %d goodput events from %s/%s",
+                             count, job_id, task_id)
+        # Trace spans ride the same post-task ingest: program spans
+        # the workload recorded to $SHIPYARD_TRACE_FILE join the
+        # submission's trace in TABLE_TRACE. Rename-first (the same
+        # protocol as the heartbeat drain) so a drain racing this
+        # exit path can never ingest the same lines twice — exactly
+        # one reader wins any given inode.
+        trace_path = execution.env.get(trace_context.TRACE_FILE_ENV)
+        if trace_path:
+            count = self._drain_trace_file(trace_path, job_id,
+                                           task_id)
+            if count:
+                logger.debug("ingested %d trace spans from %s/%s",
+                             count, job_id, task_id)
+
+    def _drain_trace_file(self, path: str, job_id: str,
+                          task_id: str) -> int:
+        """Atomically claim and ingest one trace-span JSONL. The
+        os.replace is the mutual exclusion between the heartbeat
+        drain and the post-task ingest: a loser gets ENOENT and
+        ingests nothing; a writer mid-append follows the inode into
+        the renamed file (still ingested), and the recorder's next
+        append re-creates the original path."""
+        if not os.path.exists(path):
+            return 0
+        drained = f"{path}.{uuid.uuid4().hex[:6]}.ingest"
+        try:
+            os.replace(path, drained)
+        except OSError:
+            return 0
+        return trace_spans.ingest_local_spans(
+            self.store, self.identity.pool_id, drained,
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id)
+
+    def _ingest_live_trace_spans(self) -> None:
+        """Drain LIVE tasks' trace-span JSONL mid-run, so long-lived
+        serving tasks feed heimdall's latency export while running
+        instead of only at exit. The drain is an atomic rename: a
+        writer mid-append follows the inode into the renamed file
+        (still ingested), and the recorder's next append re-creates
+        the original path — no line is ever lost or read twice."""
+        for job_id, task_id in list(self._live_procs.keys()):
+            root = os.path.join(self.work_dir, "tasks", job_id,
+                                task_id)
+            candidates = [os.path.join(root, "trace_spans.jsonl")]
+            try:
+                candidates += [
+                    os.path.join(root, d, "trace_spans.jsonl")
+                    for d in os.listdir(root) if d.startswith("i")]
+            except OSError:
+                continue
+            for path in candidates:
+                self._drain_trace_file(path, job_id, task_id)
+
+    # ----------------------- profiling hooks ---------------------------
+
+    def _forward_profile_requests(self) -> None:
+        """On-demand profiling, mid-run leg: the heartbeat loop drops
+        the job's pending profile request into the task dirs of this
+        node's LIVE tasks (launch-time delivery covers tasks that
+        start after the flag was set). One delivery per (task,
+        request): the harness consumes the file when capture starts,
+        and re-dropping it would trigger a second capture."""
+        for job_id, task_id in list(self._live_procs.keys()):
+            request = self._cached_job_profile_request(job_id)
+            if request is None:
+                continue
+            self._deliver_profile_request(job_id, task_id, request)
+
+    def _deliver_profile_request(self, job_id: str, task_id: str,
+                                 request: dict) -> None:
+        root = os.path.join(self.work_dir, "tasks", job_id, task_id)
+        targets = [root]
+        try:
+            targets += [os.path.join(root, d)
+                        for d in os.listdir(root)
+                        if d.startswith("i")
+                        and os.path.isdir(os.path.join(root, d))]
+        except OSError:
+            pass
+        for task_dir in targets:
+            if not os.path.isdir(task_dir):
+                continue
+            self._deliver_profile_file(
+                os.path.join(task_dir, "profile_request.json"),
+                request)
+
+    def _deliver_profile_file(self, path: str,
+                              request: dict) -> None:
+        """Write one request file, deduped per (path, request). The
+        delivered mark is taken only AFTER a successful write, so a
+        transient OSError retries on the next heartbeat instead of
+        silently losing the request forever. A sibling ``.delivered``
+        marker persists the dedup across agent restarts — without it
+        a restarted agent would re-drop a request the harness already
+        consumed and trigger a second capture."""
+        requested_at = str(request.get("requested_at"))
+        key = (path, requested_at)
+        if key in self._profile_delivered:
             return
-        count = goodput_events.ingest_local_events(
-            self.store, self.identity.pool_id, path, job_id=job_id,
-            task_id=task_id, node_id=self.identity.node_id)
-        if count:
-            logger.debug("ingested %d goodput events from %s/%s",
-                         count, job_id, task_id)
+        marker = path + ".delivered"
+        try:
+            with open(marker, encoding="utf-8") as fh:
+                if fh.read().strip() == requested_at:
+                    self._profile_delivered.add(key)
+                    return
+        except OSError:
+            pass
+        try:
+            steps = max(1, int(request.get("steps", 1)))
+        except (TypeError, ValueError):
+            steps = 1
+        try:
+            trace_profiling.write_request(
+                path, steps,
+                requested_at=request.get("requested_at"))
+            with open(marker, "w", encoding="utf-8") as fh:
+                fh.write(requested_at)
+        except OSError:
+            logger.debug("profile request delivery failed for %s",
+                         path, exc_info=True)
+            return
+        # Bound the in-memory set (the disk markers keep the dedup):
+        # a long-lived agent across many `jobs profile` invocations
+        # must not grow it forever.
+        if len(self._profile_delivered) > 4096:
+            self._profile_delivered.clear()
+        self._profile_delivered.add(key)
+
+    def _upload_profile_artifacts(self, job_id: str, task_id: str,
+                                  execution: task_runner.TaskExecution,
+                                  suffix: str = "") -> None:
+        """Post-task: ship the jax.profiler capture (if one was
+        taken) through the store next to the task's other outputs and
+        stamp the artifact prefix on the task entity, where
+        ``jobs tasks list`` surfaces it."""
+        profile_dir = execution.env.get(
+            trace_profiling.PROFILE_DIR_ENV)
+        if not profile_dir or not os.path.isdir(profile_dir):
+            return
+        uploaded = 0
+        prefix = f"{suffix}/profile" if suffix else "profile"
+        for root, _dirs, files in os.walk(profile_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, profile_dir)
+                try:
+                    with open(path, "rb") as fh:
+                        self.store.put_object(
+                            names.task_output_key(
+                                self.identity.pool_id, job_id,
+                                task_id, f"{prefix}/{rel}"),
+                            fh.read())
+                    uploaded += 1
+                except Exception:  # noqa: BLE001 - best effort
+                    logger.exception("profile artifact upload failed "
+                                     "for %s", path)
+        if not uploaded:
+            return
+        try:
+            self._merge_task(job_id, task_id, {
+                trace_profiling.COL_PROFILE_ARTIFACT:
+                    names.task_output_key(
+                        self.identity.pool_id, job_id, task_id,
+                        prefix),
+                "profile_files": uploaded,
+            })
+        except NotFoundError:
+            pass
+        logger.info("uploaded %d profile file(s) for %s/%s",
+                    uploaded, job_id, task_id)
 
     # ----------------------- compile-cache hooks -----------------------
 
@@ -1114,7 +1362,20 @@ class NodeAgent:
             goodput_events.TASK_RETRY, job_id=job_id,
             task_id=task_id, node_id=self.identity.node_id,
             attrs={"retries": retries, "exit_code": exit_code,
-                   "reason": reason})
+                   "reason": reason},
+            trace_id=entity.get(trace_context.COL_TRACE_ID),
+            span_id=entity.get(trace_context.COL_TRACE_SPAN))
+        # Requeue marker on the trace: instantaneous, carrying the
+        # supervisor's decision so the exported waterfall shows WHY
+        # the next queue_wait span exists.
+        trace_spans.emit(
+            self.store, self.identity.pool_id,
+            trace_spans.SPAN_REQUEUE,
+            trace_context.TraceContext.from_entity(entity),
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            attrs={"retries": retries, "exit_code": exit_code,
+                   "reason": reason, "backoff_seconds": delay})
         # The TASK_BACKOFF interval is emitted by the CLAIM side
         # (_goodput_work_started) once the wait has actually elapsed:
         # emitting [now, now+delay] here would future-date the event,
@@ -1125,18 +1386,18 @@ class NodeAgent:
             self.identity.pool_id, task_id,
             self.pool.task_queue_shards,
             priority=int(spec.get("priority", 0) or 0))
+        message = {"job_id": job_id, "task_id": task_id}
+        if entity.get(trace_context.COL_TRACE_ID):
+            message["trace_id"] = entity[trace_context.COL_TRACE_ID]
         if instances:
             self.store.put_messages(
                 queue,
-                [json.dumps({"job_id": job_id, "task_id": task_id,
-                             "instance": k}).encode()
+                [json.dumps({**message, "instance": k}).encode()
                  for k in range(instances)],
                 delay_seconds=delay)
         else:
             self.store.put_message(
-                queue,
-                json.dumps({"job_id": job_id,
-                            "task_id": task_id}).encode(),
+                queue, json.dumps(message).encode(),
                 delay_seconds=delay)
         logger.warning(
             "task %s/%s requeued (attempt %d, %s); backoff %.1fs",
@@ -1321,9 +1582,11 @@ class NodeAgent:
                 self._goodput_work_done(slot)
                 return
             try:
-                self._ensure_images_timed(job_id, task_id, spec)
+                self._ensure_images_timed(job_id, task_id, spec,
+                                          entity=entity)
                 execution = self._build_execution(slot, job_id,
-                                                  task_id, spec)
+                                                  task_id, spec,
+                                                  entity=entity)
             except TaskEnvError as exc:
                 self._merge_task(job_id, task_id, {
                     "state": "failed", "exit_code": -4,
@@ -1358,8 +1621,10 @@ class NodeAgent:
                     self._running_tasks -= 1
         self._upload_outputs(job_id, task_id, execution)
         self._ingest_goodput(job_id, task_id, execution)
+        self._upload_profile_artifacts(job_id, task_id, execution)
         self._export_compile_cache()
-        self._goodput_task_finished(slot, job_id, task_id, result)
+        self._goodput_task_finished(slot, job_id, task_id, result,
+                                    entity=entity)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -1871,6 +2136,7 @@ class NodeAgent:
         deadline = time.monotonic() + self.gang_timeout
         keepalive = time.monotonic()
         last_stale_check = 0.0
+        rendezvous_started = time.time()
         while True:
             members = self._gang_members(gang_pk)
             if len(members) >= num_instances:
@@ -1929,6 +2195,19 @@ class NodeAgent:
                     visibility_timeout=self.claim_visibility_seconds)
                 keepalive = time.monotonic()
             time.sleep(self.poll_interval)
+        # Full formation: the rendezvous span is per INSTANCE (each
+        # member's own wait — the straggler analysis the gang
+        # scheduler needs is exactly the spread of these).
+        trace_spans.emit(
+            self.store, self.identity.pool_id,
+            trace_spans.SPAN_RENDEZVOUS,
+            trace_context.TraceContext.from_entity(entity),
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            start=rendezvous_started, end=time.time(),
+            attrs={"instance": instance,
+                   "gang_size": num_instances,
+                   "attempt": int(entity.get("retries", 0))})
         if instance == 0:
             try:
                 self._merge_task(job_id, task_id, {
@@ -1951,13 +2230,14 @@ class NodeAgent:
         with self._message_keepalive(msg):
             jp_ok = self._ensure_job_prep(job_id, spec)
             try:
-                self._ensure_images_timed(job_id, task_id, spec)
+                self._ensure_images_timed(job_id, task_id, spec,
+                                          entity=entity)
                 execution = self._build_execution(
                     slot, job_id, task_id, spec, instance=instance,
                     instances=num_instances,
                     host_list=tuple(m.internal_ip
                                     for m in gang_members),
-                    extra_env=gang_env)
+                    extra_env=gang_env, entity=entity)
             except TaskEnvError as exc:
                 # Record the instance failure through the normal gang
                 # aggregation (a raise here would bounce the message
@@ -1979,7 +2259,7 @@ class NodeAgent:
                     instance=instance, instances=num_instances,
                     host_list=tuple(m.internal_ip
                                     for m in gang_members),
-                    extra_env=gang_env)
+                    extra_env=gang_env, entity=entity)
             try:
                 self._stage_inputs(spec, execution)
             except Exception as exc:
@@ -2027,14 +2307,19 @@ class NodeAgent:
                 "gang %s/%s i%d finished after the gang was "
                 "recovered; discarding superseded result",
                 job_id, task_id, instance)
-            self._goodput_task_finished(slot, job_id, task_id, result)
+            self._goodput_task_finished(slot, job_id, task_id, result,
+                                        entity=entity,
+                                        instance=instance)
             self.store.delete_message(msg)
             return
         self._upload_outputs(job_id, task_id, execution,
                              suffix=f"i{instance}")
         self._ingest_goodput(job_id, task_id, execution)
+        self._upload_profile_artifacts(job_id, task_id, execution,
+                                       suffix=f"i{instance}")
         self._export_compile_cache()
-        self._goodput_task_finished(slot, job_id, task_id, result)
+        self._goodput_task_finished(slot, job_id, task_id, result,
+                                    entity=entity, instance=instance)
         try:
             self._collect_outputs(spec, execution, job_id, task_id)
         except Exception as exc:
@@ -2183,6 +2468,7 @@ class NodeAgent:
                          spec: dict, instance: int = 0, instances: int = 1,
                          host_list: tuple[str, ...] = (),
                          extra_env: Optional[dict] = None,
+                         entity: Optional[dict] = None,
                          ) -> task_runner.TaskExecution:
         from batch_shipyard_tpu.utils import secrets as secrets_mod
         try:
@@ -2236,13 +2522,53 @@ class NodeAgent:
             env.setdefault(
                 progress_mod.PROGRESS_DEADLINE_ENV,
                 str(spec["progress_deadline_seconds"]))
+        # Distributed-trace contract: the task row's context is
+        # exported so every program span/goodput event the process
+        # records parents under the task's run span; the JSONL span
+        # sink is ingested post-task like the goodput file.
+        ctx = trace_context.TraceContext.from_entity(entity or {})
+        if ctx is not None:
+            for key, value in ctx.env().items():
+                env.setdefault(key, value)
+            env.setdefault(
+                trace_context.TRACE_FILE_ENV,
+                os.path.join(task_dir.rstrip("/"),
+                             "trace_spans.jsonl"))
+        # On-demand profiling contract: the harness watches the
+        # request file (trace/profiling.StepProfiler) and writes the
+        # jax.profiler artifact into the profile dir, which the agent
+        # uploads post-task. A request already pending at launch is
+        # delivered right here; requests arriving mid-run are
+        # delivered by the heartbeat loop.
+        env.setdefault(
+            trace_profiling.PROFILE_REQUEST_FILE_ENV,
+            os.path.join(task_dir.rstrip("/"),
+                         "profile_request.json"))
+        env.setdefault(
+            trace_profiling.PROFILE_DIR_ENV,
+            os.path.join(task_dir.rstrip("/"), "profile"))
+        request = self._cached_job_profile_request(job_id)
+        if request is not None:
+            # Launch-time delivery goes straight to this instance's
+            # env path (the task dir may not exist yet —
+            # write_request creates it); the per-path dedup keeps the
+            # heartbeat loop from re-dropping the same request after
+            # the harness consumed it, without starving sibling gang
+            # instances of their own copies.
+            self._deliver_profile_file(
+                env[trace_profiling.PROFILE_REQUEST_FILE_ENV],
+                request)
         # Warm-start compilation: every task sees the node's
         # persistent compile cache dir, seeded from the pool artifact
         # just before launch so restarts and late pool joiners
         # deserialize instead of compiling.
         env.setdefault(cc_manager.CACHE_DIR_ENV,
                        self._compile_cache_dir())
-        self._seed_compile_cache()
+        with trace_spans.span(
+                self.store, self.identity.pool_id,
+                trace_spans.SPAN_CACHE_SEED, ctx, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id):
+            self._seed_compile_cache()
         return task_runner.TaskExecution(
             pool_id=self.identity.pool_id, job_id=job_id, task_id=task_id,
             node_id=self.identity.node_id,

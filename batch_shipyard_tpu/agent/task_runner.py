@@ -25,6 +25,15 @@ Env contract exposed to every task (the $AZ_BATCH_* analog):
                            beat it every step; tasks declaring
                            progress_deadline_seconds are killed when
                            it goes stale (hang -> bounded retry)
+  SHIPYARD_TRACE_ID        distributed-trace context of this task
+  SHIPYARD_TRACE_SPAN_ID   (trace/context.py): program spans recorded
+  SHIPYARD_TRACE_FILE      in-process parent under the task's run
+                           span; the JSONL span sink is ingested by
+                           the agent post-task
+  SHIPYARD_PROFILE_REQUEST_FILE  on-demand profiling (trace/
+  SHIPYARD_PROFILE_DIR     profiling.py): the train harness watches
+                           the request file and writes jax.profiler
+                           captures into the dir, uploaded post-task
 plus, for gang tasks with jax_distributed enabled, the launcher env from
 jobs/launcher.py (JAX_COORDINATOR_ADDRESS etc.).
 """
@@ -190,6 +199,21 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
                 argv += ["-e",
                          f"{progress.PROGRESS_FILE_ENV}="
                          f"/shipyard/task/{rel}"]
+        # Trace-span sink + profiling request/artifact paths: same
+        # mount remap — the agent reads all three host-side after
+        # exit (SHIPYARD_TRACE_ID/_SPAN_ID are plain values and pass
+        # through the generic -e loop above untouched).
+        for var in ("SHIPYARD_TRACE_FILE",
+                    "SHIPYARD_PROFILE_REQUEST_FILE",
+                    "SHIPYARD_PROFILE_DIR"):
+            host_path = execution.env.get(var)
+            if not host_path:
+                continue
+            host_dir = os.path.abspath(execution.task_dir)
+            host_abs = os.path.abspath(host_path)
+            if host_abs.startswith(host_dir + os.sep):
+                rel = os.path.relpath(host_abs, host_dir)
+                argv += ["-e", f"{var}=/shipyard/task/{rel}"]
         cache_dir = execution.env.get("SHIPYARD_COMPILE_CACHE_DIR")
         if cache_dir:
             # The node's persistent compile cache lives OUTSIDE the

@@ -9,6 +9,7 @@ mirror the reference so a Batch Shipyard user finds the same verbs:
   shipyard-tpu jobs   add | list | term | del | stats | wait |
                       tasks list
   shipyard-tpu goodput job | pool | fleet
+  shipyard-tpu trace  show | export | prune
   shipyard-tpu chaos  plan | drill
   shipyard-tpu data   stream | ingress
   shipyard-tpu diag   perf
@@ -492,6 +493,19 @@ def jobs_cmi(click_ctx):
     click.echo(f"cleanup fanned out to {count} nodes")
 
 
+@jobs.command("profile")
+@click.argument("job_id")
+@click.option("--steps", type=int, default=10,
+              help="Number of train steps to capture with "
+                   "jax.profiler")
+@click.pass_context
+def jobs_profile(click_ctx, job_id, steps):
+    """Request an on-demand profile of a job's tasks: the next N
+    steps run under jax.profiler.trace and the artifact uploads next
+    to the task's diagnostics (see `jobs tasks list`)."""
+    fleet.action_jobs_profile(_ctx(click_ctx), job_id, steps=steps)
+
+
 @jobs.command("schedule")
 @click.option("--once", is_flag=True, default=False,
               help="Evaluate due schedules once and exit")
@@ -578,12 +592,16 @@ def goodput():
 
 @goodput.command("job")
 @click.argument("job_id")
+@click.option("--trace", "trace_id", default=None,
+              help="Scope the waterfall to one submission's trace id "
+                   "(see `jobs tasks list` / `trace show`)")
 @click.pass_context
-def goodput_job(click_ctx, job_id):
+def goodput_job(click_ctx, job_id, trace_id):
     """One job's decomposition (queue/image-pull/compile/checkpoint/
     rework badput vs productive step time)."""
     fleet.action_goodput(_ctx(click_ctx), "job", job_id=job_id,
-                         raw=click_ctx.obj["raw"])
+                         raw=click_ctx.obj["raw"],
+                         trace_id=trace_id)
 
 
 @goodput.command("pool")
@@ -615,6 +633,54 @@ def goodput_prune(click_ctx, older_than_hours):
     removed = goodput_events.prune(ctx.store, ctx.pool.id,
                                    older_than_hours * 3600.0)
     click.echo(f"pruned {removed} events from pool {ctx.pool.id}")
+
+
+# ------------------------------- trace ---------------------------------
+
+@cli.group()
+def trace():
+    """End-to-end distributed tracing (trace/): the causal chain of
+    one `jobs add` submission — queue wait, claim, backoff,
+    rendezvous, program phases, serving requests — assembled from
+    TABLE_TRACE spans + trace-tagged goodput intervals."""
+
+
+@trace.command("show")
+@click.argument("trace_id")
+@click.pass_context
+def trace_show(click_ctx, trace_id):
+    """Terminal span waterfall for one trace id."""
+    fleet.action_trace_show(_ctx(click_ctx), trace_id,
+                            raw=click_ctx.obj["raw"])
+
+
+@trace.command("export")
+@click.argument("trace_id")
+@click.option("--output", "-o", default=None,
+              help="Write the Chrome trace JSON here (default: "
+                   "stdout); load it in chrome://tracing or "
+                   "ui.perfetto.dev")
+@click.pass_context
+def trace_export(click_ctx, trace_id, output):
+    """Export one trace as Perfetto-loadable Chrome trace-event
+    JSON."""
+    fleet.action_trace_export(_ctx(click_ctx), trace_id,
+                              output=output)
+
+
+@trace.command("prune")
+@click.option("--older-than-hours", type=float, default=7 * 24.0,
+              help="Delete spans that ended more than this many "
+                   "hours ago (default: one week)")
+@click.pass_context
+def trace_prune(click_ctx, older_than_hours):
+    """Retention sweep over the pool's span log (same rule as
+    `goodput prune`)."""
+    from batch_shipyard_tpu.trace import spans as trace_spans_mod
+    ctx = _ctx(click_ctx)
+    removed = trace_spans_mod.prune(ctx.store, ctx.pool.id,
+                                    older_than_hours * 3600.0)
+    click.echo(f"pruned {removed} spans from pool {ctx.pool.id}")
 
 
 # ------------------------------- chaos ---------------------------------

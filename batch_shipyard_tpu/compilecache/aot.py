@@ -57,12 +57,17 @@ def precompile_async(harness,
         return None
     from batch_shipyard_tpu.goodput import events as goodput_events
 
+    from batch_shipyard_tpu.trace import spans as trace_spans
+
     def _run() -> None:
         try:
             with goodput_events.phase(
                     goodput_events.PROGRAM_COMPILE,
                     what="aot_precompile") as attrs, \
-                    manager.tracked(attrs, label):
+                    manager.tracked(attrs, label), \
+                    trace_spans.phase(trace_spans.SPAN_COMPILE,
+                                      what="aot_precompile",
+                                      label=label):
                 precompile()
         except Exception:  # noqa: BLE001 - jit path still works
             logger.warning("AOT precompile failed; falling back to "

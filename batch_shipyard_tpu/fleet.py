@@ -516,11 +516,22 @@ def action_jobs_list(ctx: Context, raw: bool = False) -> None:
 
 def action_jobs_tasks_list(ctx: Context, job_id: str,
                            raw: bool = False) -> None:
+    from batch_shipyard_tpu.trace import context as trace_ctx
+    from batch_shipyard_tpu.trace import profiling as trace_prof
     tasks = []
     for t in jobs_mgr.list_tasks(ctx.store, ctx.pool.id, job_id):
         row = {"id": t["_rk"], "state": t.get("state"),
                "exit_code": t.get("exit_code"),
                "node_id": t.get("node_id")}
+        # The submission's trace id: the handle `shipyard trace
+        # show|export` takes (absent on legacy pre-trace rows).
+        if t.get(trace_ctx.COL_TRACE_ID):
+            row["trace_id"] = t.get(trace_ctx.COL_TRACE_ID)
+        # On-demand profiling artifact, next to the diagnostics
+        # column: the object-store prefix the capture uploaded to.
+        if t.get(trace_prof.COL_PROFILE_ARTIFACT):
+            row["profile_artifact"] = t.get(
+                trace_prof.COL_PROFILE_ARTIFACT)
         if t.get("retries"):
             row["retries"] = t.get("retries")
         if t.get("wedged"):
@@ -612,18 +623,76 @@ def action_pool_cache_prune(ctx: Context, raw: bool = False) -> int:
     return removed
 
 
+# ------------------------------- tracing -------------------------------
+
+def action_jobs_profile(ctx: Context, job_id: str,
+                        steps: int = 10) -> dict:
+    """`jobs profile`: stamp an on-demand profiling request on the
+    job entity. Node agents forward it to the job's tasks (at launch
+    and, via the heartbeat loop, to already-running ones); the train
+    harness wraps the next N steps in jax.profiler.trace and the
+    agent uploads the artifact next to the task's diagnostics."""
+    from batch_shipyard_tpu.trace import profiling as trace_prof
+    jobs_mgr.get_job(ctx.store, ctx.pool.id, job_id)  # must exist
+    request = {"steps": int(steps),
+               "requested_at": util.datetime_utcnow_iso()}
+    ctx.store.merge_entity(
+        names.TABLE_JOBS, ctx.pool.id, job_id,
+        {trace_prof.COL_PROFILE_REQUEST: request})
+    logger.info("profile request (%d steps) stamped on job %s",
+                steps, job_id)
+    _emit({"job_id": job_id, "profile_request": request})
+    return request
+
+
+def action_trace_show(ctx: Context, trace_id: str,
+                      raw: bool = False) -> dict:
+    """`trace show <trace_id>`: terminal waterfall of one
+    submission's spans (+ its goodput intervals)."""
+    from batch_shipyard_tpu.trace import export as trace_export
+    rows = trace_export.trace_rows(ctx.store, ctx.pool.id, trace_id)
+    if raw:
+        _emit(rows, raw=True)
+    else:
+        sys.stdout.write(trace_export.render_tree(rows) + "\n")
+    return rows
+
+
+def action_trace_export(ctx: Context, trace_id: str,
+                        output: Optional[str] = None) -> dict:
+    """`trace export <trace_id>`: Chrome trace-event JSON
+    (chrome://tracing / ui.perfetto.dev loadable), to ``output`` or
+    stdout."""
+    from batch_shipyard_tpu.trace import export as trace_export
+    chrome = trace_export.export_trace(ctx.store, ctx.pool.id,
+                                       trace_id)
+    if output:
+        trace_export.write_chrome_trace(chrome, output)
+        logger.info("trace %s exported to %s (%d events)", trace_id,
+                    output, len(chrome["traceEvents"]))
+    else:
+        sys.stdout.write(json.dumps(chrome, indent=2) + "\n")
+    return chrome
+
+
 # ------------------------------- goodput -------------------------------
 
 def action_goodput(ctx: Context, scope: str,
                    job_id: Optional[str] = None,
-                   raw: bool = False) -> dict:
+                   raw: bool = False,
+                   trace_id: Optional[str] = None) -> dict:
     """Goodput decomposition + badput waterfall for a job, the pool,
-    or the whole fleet (goodput/accounting.py over TABLE_GOODPUT)."""
+    or the whole fleet (goodput/accounting.py over TABLE_GOODPUT).
+    ``trace_id`` (job scope only) restricts the waterfall to one
+    submission's trace."""
     from batch_shipyard_tpu.goodput import accounting
+    if trace_id is not None and scope != "job":
+        raise ValueError("--trace only applies to `goodput job`")
     if scope == "job":
         if not job_id:
             raise ValueError("goodput job requires a job id")
-        report = accounting.job_report(ctx.store, ctx.pool.id, job_id)
+        report = accounting.job_report(ctx.store, ctx.pool.id, job_id,
+                                       trace_id=trace_id)
     elif scope == "pool":
         report = accounting.pool_report(ctx.store, ctx.pool.id)
     elif scope == "fleet":

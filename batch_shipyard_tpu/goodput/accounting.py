@@ -404,19 +404,26 @@ def decompose_by_node(event_list: list[dict],
 
 # ------------------------------- rollups -------------------------------
 
-def job_report(store: StateStore, pool_id: str,
-               job_id: str) -> dict[str, Any]:
+def job_report(store: StateStore, pool_id: str, job_id: str,
+               trace_id: Optional[str] = None) -> dict[str, Any]:
     """One job's decomposition (job-scoped events only: queue, task
-    lifecycle, program phases)."""
-    report = decompose(ev.query(store, pool_id, job_id=job_id))
+    lifecycle, program phases). ``trace_id`` scopes the waterfall to
+    one submission's trace (events carrying that trace id — legacy
+    rows without ids never match)."""
+    report = decompose(ev.query(store, pool_id, job_id=job_id,
+                                trace_id=trace_id))
     report["job_id"] = job_id
     report["pool_id"] = pool_id
+    if trace_id is not None:
+        report["trace_id"] = trace_id
     return report
 
 
 def pool_report(store: StateStore, pool_id: str,
                 window_seconds: Optional[float] = None,
-                include_jobs: bool = True) -> dict[str, Any]:
+                include_jobs: bool = True,
+                event_list: Optional[list[dict]] = None
+                ) -> dict[str, Any]:
     """Pool rollup: ALL events of the pool (node lifecycle included)
     folded into one timeline, plus per-job subreports.
 
@@ -425,11 +432,15 @@ def pool_report(store: StateStore, pool_id: str,
     (the heimdall gauge export) must not re-sweep history forever.
     ``include_jobs=False`` skips the per-job subreports for callers
     that only read the pool-level numbers (heimdall, fleet).
+    ``event_list`` lets a caller that already fetched the pool's
+    events (heimdall fetches once per poll for several exports)
+    skip the partition re-scan.
 
     Pool scope aggregates PER NODE (wall/badput are node-seconds, via
     decompose_by_node); job subreports are single-timeline (the job's
     own wall clock)."""
-    event_list = ev.query(store, pool_id)
+    if event_list is None:
+        event_list = ev.query(store, pool_id)
     cutoff = None
     if window_seconds is not None and event_list:
         import time as time_mod

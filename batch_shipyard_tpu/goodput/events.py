@@ -111,10 +111,15 @@ def emit(store: StateStore, pool_id: str, kind: str, *,
          job_id: Optional[str] = None, task_id: Optional[str] = None,
          node_id: Optional[str] = None,
          start: Optional[float] = None, end: Optional[float] = None,
-         attrs: Optional[dict] = None) -> None:
+         attrs: Optional[dict] = None,
+         trace_id: Optional[str] = None,
+         span_id: Optional[str] = None) -> None:
     """Append one event. Instantaneous events omit ``end`` (it
-    defaults to ``start``). Never raises: goodput accounting is an
-    observer, not a participant."""
+    defaults to ``start``). ``trace_id``/``span_id`` join the event to
+    a submission's distributed trace (trace/): schema-compatible —
+    absent ids are legacy rows, and the accounting partition ignores
+    them entirely. Never raises: goodput accounting is an observer,
+    not a participant."""
     if kind not in EVENT_KINDS:
         logger.warning("unknown goodput event kind %r dropped", kind)
         return
@@ -129,6 +134,10 @@ def emit(store: StateStore, pool_id: str, kind: str, *,
             "end": ts if end is None else float(end),
             "attrs": dict(attrs or {}),
         }
+        if trace_id:
+            entity["trace_id"] = str(trace_id)
+            if span_id:
+                entity["span_id"] = str(span_id)
         # RowKey: timestamp (sortable, the perf-table convention) + a
         # uuid suffix — unlike agent/perf.py's deterministic keys, no
         # collision-bump loop is needed.
@@ -143,7 +152,9 @@ def emit(store: StateStore, pool_id: str, kind: str, *,
 def span(store: StateStore, pool_id: str, kind: str, *,
          job_id: Optional[str] = None, task_id: Optional[str] = None,
          node_id: Optional[str] = None,
-         attrs: Optional[dict] = None) -> Iterator[dict]:
+         attrs: Optional[dict] = None,
+         trace_id: Optional[str] = None,
+         span_id: Optional[str] = None) -> Iterator[dict]:
     """Time a block as one interval event. Yields the attrs dict so
     the body can add counters before the event is emitted."""
     out_attrs = dict(attrs or {})
@@ -153,19 +164,23 @@ def span(store: StateStore, pool_id: str, kind: str, *,
     finally:
         emit(store, pool_id, kind, job_id=job_id, task_id=task_id,
              node_id=node_id, start=start, end=time.time(),
-             attrs=out_attrs)
+             attrs=out_attrs, trace_id=trace_id, span_id=span_id)
 
 
 def query(store: StateStore, pool_id: str,
           job_id: Optional[str] = None,
-          task_id: Optional[str] = None) -> list[dict]:
-    """Events of a pool (optionally one job/task), sorted by start."""
+          task_id: Optional[str] = None,
+          trace_id: Optional[str] = None) -> list[dict]:
+    """Events of a pool (optionally one job/task/trace), sorted by
+    start."""
     out = []
     for row in store.query_entities(names.TABLE_GOODPUT,
                                     partition_key=pool_id):
         if job_id is not None and row.get("job_id") != job_id:
             continue
         if task_id is not None and row.get("task_id") != task_id:
+            continue
+        if trace_id is not None and row.get("trace_id") != trace_id:
             continue
         out.append(row)
     return sorted(out, key=lambda e: (e.get("start", 0.0),
@@ -202,13 +217,21 @@ def local_events_path() -> Optional[str]:
 def record(kind: str, start: float, end: Optional[float] = None,
            **attrs: Any) -> None:
     """Process-local emit: append one JSONL event to
-    $SHIPYARD_GOODPUT_FILE. No-op when unset; never raises."""
+    $SHIPYARD_GOODPUT_FILE. The task's exported trace context
+    ($SHIPYARD_TRACE_*) is attached automatically so program-phase
+    intervals join the submission's distributed trace. No-op when
+    unset; never raises."""
     path = local_events_path()
     if path is None:
         return
     event = {"kind": kind, "start": float(start),
              "end": float(start if end is None else end),
              "attrs": attrs}
+    from batch_shipyard_tpu.trace import context as trace_ctx
+    ctx = trace_ctx.TraceContext.from_env()
+    if ctx is not None:
+        event["trace_id"] = ctx.trace_id
+        event["span_id"] = ctx.span_id
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "a", encoding="utf-8") as fh:
@@ -264,9 +287,14 @@ def ingest_local_events(store: StateStore, pool_id: str, path: str, *,
                 attrs = event.get("attrs")
                 if not isinstance(attrs, dict):
                     attrs = {}
+                trace_id = event.get("trace_id")
                 emit(store, pool_id, kind, job_id=job_id,
                      task_id=task_id, node_id=node_id,
-                     start=start, end=end, attrs=attrs)
+                     start=start, end=end, attrs=attrs,
+                     trace_id=(str(trace_id) if trace_id else None),
+                     span_id=(str(event["span_id"])
+                              if trace_id and event.get("span_id")
+                              else None))
                 count += 1
         os.remove(path)
     except OSError:

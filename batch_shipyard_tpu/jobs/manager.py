@@ -23,6 +23,8 @@ from batch_shipyard_tpu.jobs.task_factory import expand_task_factory
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
+from batch_shipyard_tpu.trace import context as trace_ctx
+from batch_shipyard_tpu.trace import spans as trace_spans
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -141,9 +143,18 @@ def add_jobs(store: StateStore, pool: PoolSettings,
     submitted: dict[str, int] = {}
     for job in jobs:
         pool_id = pool_id_override or job.pool_id or pool.id
+        # The distributed trace is born HERE: one trace per job
+        # submission, whose root is the submit span. Every task row
+        # carries the trace id + its own root span id, so the whole
+        # chain (queue wait, claim, rendezvous, program phases) is
+        # attributable to this `jobs add`.
+        trace = trace_ctx.TraceContext.new()
+        submit_started = time.time()
         try:
             store.insert_entity(names.TABLE_JOBS, pool_id, job.id, {
                 "state": "active",
+                trace_ctx.COL_TRACE_ID: trace.trace_id,
+                trace_ctx.COL_TRACE_SPAN: trace.span_id,
                 "spec": {
                     "auto_complete": job.auto_complete,
                     "priority": job.priority,
@@ -161,7 +172,16 @@ def add_jobs(store: StateStore, pool: PoolSettings,
         pending = _expand_job_tasks(store, job, pool,
                                     required_node=required_node)
         _submit_tasks_batched(store, pool_id, job.id, pending,
-                              priority=job.priority)
+                              priority=job.priority, trace=trace)
+        # The submit span covers entity+message fan-out; recorded
+        # LAST so its end time is honest. Its own span_id is the
+        # trace root (parent of every task's root span).
+        trace_spans.emit(
+            store, pool_id, trace_spans.SPAN_SUBMIT, trace,
+            job_id=job.id, start=submit_started, end=time.time(),
+            attrs={"tasks": len(pending)}, self_span=True)
+        logger.info("job %s submitted under trace %s", job.id,
+                    trace.trace_id)
         submitted[job.id] = len(pending)
     return submitted
 
@@ -184,7 +204,10 @@ def merge_tasks_into_job(store: StateStore, pool: PoolSettings,
     Explicit (non-generic) ids that collide are an error. Returns the
     number of tasks added.
     """
-    get_job(store, pool_id, job.id)  # must exist
+    job_entity = get_job(store, pool_id, job.id)  # must exist
+    # Merged tasks join the job's EXISTING trace (their root spans
+    # parent under the original submit span); None for legacy jobs.
+    trace = trace_ctx.TraceContext.from_entity(job_entity)
     existing = {t["_rk"] for t in list_tasks(store, pool_id, job.id)}
     next_number = 0
     for tid in existing:
@@ -234,7 +257,7 @@ def merge_tasks_into_job(store: StateStore, pool: PoolSettings,
         spec["depends_on"] = [remap.get(d, d)
                               for d in spec.get("depends_on", [])]
     _submit_tasks_batched(store, pool_id, job.id, out,
-                          priority=job.priority)
+                          priority=job.priority, trace=trace)
     return len(out)
 
 
@@ -255,39 +278,48 @@ def pool_queue_shards(store: StateStore, pool_id: str) -> int:
 
 def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
                           tasks: list[tuple[str, dict]],
-                          priority: int = 0) -> None:
+                          priority: int = 0,
+                          trace: Optional[
+                              trace_ctx.TraceContext] = None) -> None:
     """Chunked batch submission (the reference's 100-task
     TaskAddCollection chunks, batch.py:4313): one entity batch + one
     message batch per shard per chunk instead of 2N store round
     trips, with messages fanned out over the pool's queue shards.
-    ``priority`` selects the queue band agents drain first."""
+    ``priority`` selects the queue band agents drain first. ``trace``
+    is the submission's context: each task row is stamped with the
+    trace id plus its own root span (child of the submit span), and
+    queue messages carry the trace id."""
     pk = names.task_pk(pool_id, job_id)
     shards = pool_queue_shards(store, pool_id)
     submitted_at = util.datetime_utcnow_iso()
     for chunk_start in range(0, len(tasks), _SUBMIT_CHUNK):
         chunk = tasks[chunk_start:chunk_start + _SUBMIT_CHUNK]
-        rows = [(pk, task_id, {
-            "state": "pending", "spec": spec, "retries": 0,
-            "submitted_at": submitted_at,
-        }) for task_id, spec in chunk]
+        rows = []
+        for task_id, spec in chunk:
+            entity = {
+                "state": "pending", "spec": spec, "retries": 0,
+                "submitted_at": submitted_at,
+            }
+            if trace is not None:
+                entity.update(trace.child().entity_columns())
+            rows.append((pk, task_id, entity))
         store.insert_entities(names.TABLE_TASKS, rows)
         by_queue: dict[str, list[bytes]] = {}
         for task_id, spec in chunk:
             queue = names.task_queue_for(pool_id, task_id, shards,
                                          priority=priority)
+            message = {"job_id": job_id, "task_id": task_id}
+            if trace is not None:
+                message["trace_id"] = trace.trace_id
             num_instances = (spec.get("multi_instance") or {}).get(
                 "num_instances")
             if num_instances:
                 by_queue.setdefault(queue, []).extend(
-                    json.dumps({
-                        "job_id": job_id, "task_id": task_id,
-                        "instance": k}).encode()
+                    json.dumps({**message, "instance": k}).encode()
                     for k in range(num_instances))
             else:
                 by_queue.setdefault(queue, []).append(
-                    json.dumps({
-                        "job_id": job_id,
-                        "task_id": task_id}).encode())
+                    json.dumps(message).encode())
         for queue, payloads in by_queue.items():
             store.put_messages(queue, payloads)
 
@@ -480,20 +512,20 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
             dst_queue = names.task_queue_for(
                 dst_pool_id, task["_rk"], dst_shards,
                 priority=job_priority)
+            message = {"job_id": job_id, "task_id": task["_rk"]}
+            if entity.get(trace_ctx.COL_TRACE_ID):
+                message["trace_id"] = entity[trace_ctx.COL_TRACE_ID]
             num_instances = (entity.get("spec", {}).get(
                 "multi_instance") or {}).get("num_instances")
             if num_instances:
                 for k in range(num_instances):
                     store.put_message(
                         dst_queue,
-                        json.dumps({"job_id": job_id,
-                                    "task_id": task["_rk"],
+                        json.dumps({**message,
                                     "instance": k}).encode())
             else:
                 store.put_message(
-                    dst_queue,
-                    json.dumps({"job_id": job_id,
-                                "task_id": task["_rk"]}).encode())
+                    dst_queue, json.dumps(message).encode())
             moved += 1
     store.delete_entity(names.TABLE_JOBS, src_pool_id, job_id)
     return moved

@@ -18,25 +18,10 @@ import urllib.error
 import urllib.request
 from typing import Optional, Sequence, Union
 
-from batch_shipyard_tpu.models.server import percentile
+from batch_shipyard_tpu.trace.histogram import LatencyHistogram
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
-
-_HIST_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
-                    5000, 10000, 30000)
-
-
-def _histogram(values_ms: list[float]) -> dict[str, int]:
-    """Fixed-bucket latency histogram {"<=5ms": n, ..., ">30000ms": n}."""
-    out: dict[str, int] = {}
-    rest = list(values_ms)
-    for edge in _HIST_BUCKETS_MS:
-        hit = [v for v in rest if v <= edge]
-        rest = [v for v in rest if v > edge]
-        out[f"<={edge}ms"] = len(hit)
-    out[f">{_HIST_BUCKETS_MS[-1]}ms"] = len(rest)
-    return out
 
 
 def _post_generate(base_url: str, payload: dict,
@@ -59,8 +44,11 @@ def run_load(base_url: Union[str, Sequence[str]],
              eos_id: Optional[int] = None,
              request_timeout: float = 300.0) -> dict:
     """Fire ``num_requests`` at Poisson arrivals of ``rate_hz`` and
-    return the latency report: TTFT/TPOT/latency p50/p95/p99,
-    tokens/sec, and a fixed-bucket TTFT histogram.
+    return the latency report: TTFT/TPOT/latency p50/p90/p99 computed
+    from MERGED per-replica fixed-log-bucket histograms
+    (trace/histogram.py — the same aggregation rule the router and
+    heimdall use, so bench numbers and fleet dashboards agree),
+    tokens/sec, and the raw mergeable histograms.
 
     ``base_url`` may be a single URL or a list of replica URLs (a
     serving fleet — one server task per pool node); requests then
@@ -103,10 +91,19 @@ def run_load(base_url: Union[str, Sequence[str]],
     elapsed = time.perf_counter() - started
     done = [r for r in results if r is not None]
     failed = [e for e in errors if e is not None]
-    ttfts = [r["ttft_ms"] for r in done]
-    tpots = [r["tpot_ms"] for r in done]
-    lats = [r["latency_ms"] for r in done]
     tokens = sum(r["num_tokens"] for r in done)
+    # One histogram per (metric, replica), merged for the report:
+    # this is the exact aggregation a fleet of independent replicas
+    # supports (percentiles of pooled bucket counts), as opposed to
+    # averaging per-replica percentiles or reporting means.
+    per_replica: dict[str, dict[str, LatencyHistogram]] = {
+        metric: {url: LatencyHistogram() for url in urls}
+        for metric in ("ttft_ms", "tpot_ms", "latency_ms")}
+    for r in done:
+        for metric in ("ttft_ms", "tpot_ms", "latency_ms"):
+            per_replica[metric][r["_replica"]].observe(r[metric])
+    merged = {metric: LatencyHistogram.merged(hists.values())
+              for metric, hists in per_replica.items()}
     report = {
         "num_requests": num_requests,
         "completed": len(done),
@@ -116,13 +113,11 @@ def run_load(base_url: Union[str, Sequence[str]],
         "requests_per_second": len(done) / elapsed if elapsed else 0.0,
         "tokens_per_second": tokens / elapsed if elapsed else 0.0,
         "generated_tokens": tokens,
-        "ttft_ms": {f"p{p}": percentile(ttfts, p)
-                    for p in (50, 95, 99)},
-        "tpot_ms": {f"p{p}": percentile(tpots, p)
-                    for p in (50, 95, 99)},
-        "latency_ms": {f"p{p}": percentile(lats, p)
-                       for p in (50, 95, 99)},
-        "ttft_histogram": _histogram(ttfts),
+        "ttft_ms": merged["ttft_ms"].percentiles((50, 90, 99)),
+        "tpot_ms": merged["tpot_ms"].percentiles((50, 90, 99)),
+        "latency_ms": merged["latency_ms"].percentiles((50, 90, 99)),
+        "ttft_hist": merged["ttft_ms"].to_dict(),
+        "tpot_hist": merged["tpot_ms"].to_dict(),
     }
     if len(urls) > 1:
         by_replica: dict[str, int] = {}

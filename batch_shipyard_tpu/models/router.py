@@ -619,6 +619,21 @@ class ServingRouter:
                     "completed_total": snap["completed"],
                     "failed_total": snap["failed"],
                 }, labels={"replica": snap["url"]}))
+        # Fleet-wide latency: quantile gauges + the merged histogram
+        # in native _bucket exposition (stats() merged the replicas'
+        # fixed-bucket counts losslessly).
+        from batch_shipyard_tpu.trace.histogram import \
+            LatencyHistogram
+        for metric in ("ttft", "tpot"):
+            for pct, value in stats.get(f"{metric}_ms", {}).items():
+                lines.extend(prometheus_lines(
+                    "shipyard_router", {f"{metric}_ms": value},
+                    labels={"quantile": f"0.{pct}"}))
+            merged = LatencyHistogram.from_dict(
+                stats.get(f"{metric}_hist"))
+            if merged is not None and merged.count:
+                lines.extend(merged.prometheus_bucket_lines(
+                    f"shipyard_router_{metric}_ms"))
         return lines
 
     def stats(self) -> dict:
@@ -641,6 +656,24 @@ class ServingRouter:
                 s.get("generated_tokens", 0) for s in stats.values()),
             "per_replica": snaps,
         }
+        # Fleet-wide latency percentiles from LOSSLESSLY merged
+        # per-replica histograms (trace/histogram.py — every replica
+        # bins into the same fixed edges, so the merge is exact;
+        # averaging per-replica percentiles would be statistically
+        # meaningless). Replicas running pre-histogram code simply
+        # don't contribute.
+        from batch_shipyard_tpu.trace.histogram import \
+            LatencyHistogram
+        for metric in ("ttft", "tpot"):
+            merged = LatencyHistogram.merged(
+                h for h in (LatencyHistogram.from_dict(
+                    s.get(f"{metric}_hist")) for s in stats.values())
+                if h is not None)
+            if merged.count:
+                pcts = merged.percentiles((50, 90, 99))
+                agg[f"{metric}_ms"] = {p: pcts[f"p{p}"]
+                                       for p in (50, 90, 99)}
+                agg[f"{metric}_hist"] = merged.to_dict()
         # Fleet-wide speculative-decode acceptance (replicas running
         # a draft model report per-engine counters in their stats).
         proposed = sum(

@@ -46,6 +46,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from batch_shipyard_tpu.models.serving import ContinuousBatcher, Request
+from batch_shipyard_tpu.trace import spans as trace_spans
+from batch_shipyard_tpu.trace.histogram import LatencyHistogram
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -125,7 +127,8 @@ def prometheus_lines(prefix: str, values: dict,
 
 
 class _Pending:
-    __slots__ = ("request", "event", "submitted_at", "first_token_at",
+    __slots__ = ("request", "event", "submitted_at", "submitted_wall",
+                 "admitted_at", "first_token_at",
                  "finished_at", "tokens", "error", "token_queue",
                  "cancelled")
 
@@ -134,6 +137,12 @@ class _Pending:
         self.request = request
         self.event = threading.Event()
         self.submitted_at = time.perf_counter()
+        # Wall-clock arrival: the anchor the request's trace spans
+        # are placed at (perf_counter deltas give the durations).
+        self.submitted_wall = time.time()
+        # Slot admission (the engine's on_admit hook): the
+        # queued -> prefill boundary.
+        self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.tokens: Optional[list[int]] = None
@@ -164,6 +173,7 @@ class ServingFrontEnd:
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.engine = engine
         engine.on_token = self._on_token
+        engine.on_admit = self._on_admit
         self._submit_q: "queue.Queue[_Pending]" = queue.Queue()
         self._inflight: dict[str, _Pending] = {}
         self._inflight_lock = threading.Lock()
@@ -180,7 +190,22 @@ class ServingFrontEnd:
         self._cancel_q: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
         self._stats_lock = threading.Lock()
-        self._completed: list[dict] = []
+        # Recent-request detail only (bounded): totals and
+        # percentiles come from the running counters + histograms
+        # below, so a replica's memory/stats cost never grows with
+        # lifetime traffic.
+        import collections
+        self._completed: "collections.deque" = collections.deque(
+            maxlen=2048)
+        self._total_completed = 0
+        self._total_tokens = 0
+        # Mergeable fixed-log-bucket latency histograms
+        # (trace/histogram.py): the shape the router can aggregate
+        # fleet-wide and Prometheus can histogram_quantile() over —
+        # exact per-request lists stay only for this replica's own
+        # recent detail.
+        self._ttft_hist = LatencyHistogram()
+        self._tpot_hist = LatencyHistogram()
         self._started_at = time.perf_counter()
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="serving-engine", daemon=True)
@@ -391,7 +416,75 @@ class ServingFrontEnd:
                 "latency_ms": result["latency_ms"],
                 "num_tokens": n,
             })
+            self._total_completed += 1
+            self._total_tokens += n
+            self._ttft_hist.observe(result["ttft_ms"])
+            self._tpot_hist.observe(result["tpot_ms"])
+            seq = self._total_completed
+        self._record_request_spans(pending, result, seq)
         return result
+
+    # Span head-sampling: the first _SPAN_HEAD requests record full
+    # span chains, then 1-in-_SPAN_SAMPLE_EVERY. The HISTOGRAMS see
+    # every request (percentiles are exact); only the per-request
+    # span detail is sampled — a long-lived replica at high rate must
+    # not grow its JSONL sink and TABLE_TRACE by 4 rows per request
+    # forever (the goodput recorder this mirrors is low-rate by
+    # nature; serving traffic is not).
+    _SPAN_HEAD = 512
+    _SPAN_SAMPLE_EVERY = 16
+
+    def _record_request_spans(self, pending: _Pending,
+                              result: dict, seq: int) -> None:
+        """Per-request trace spans (admit -> queued -> prefill ->
+        decode), recorded through the process-local JSONL recorder —
+        a no-op outside pool tasks (no $SHIPYARD_TRACE_* context), so
+        standalone servers pay nothing."""
+        if trace_spans.local_spans_path() is None:
+            return
+        if seq > self._SPAN_HEAD and seq % self._SPAN_SAMPLE_EVERY:
+            return
+        request_id = pending.request.request_id
+        t0 = pending.submitted_wall
+
+        def wall(perf: Optional[float]) -> float:
+            return (t0 if perf is None
+                    else t0 + perf - pending.submitted_at)
+
+        parent = trace_spans.record(
+            trace_spans.SPAN_SERVE_REQUEST, t0,
+            wall(pending.finished_at), request_id=request_id,
+            num_tokens=result["num_tokens"],
+            ttft_ms=result["ttft_ms"], tpot_ms=result["tpot_ms"])
+        if parent is None:
+            return
+        admitted = wall(pending.admitted_at)
+        trace_spans.record(
+            trace_spans.SPAN_SERVE_QUEUED, t0, admitted,
+            parent_span_id=parent, request_id=request_id)
+        first = wall(pending.first_token_at)
+        trace_spans.record(
+            trace_spans.SPAN_SERVE_PREFILL, admitted, first,
+            parent_span_id=parent, request_id=request_id,
+            prompt_len=len(pending.request.prompt))
+        decode_attrs = {"request_id": request_id,
+                        "num_tokens": result["num_tokens"],
+                        "tpot_ms": result["tpot_ms"]}
+        # Speculative accept/rewind detail rides the decode span
+        # (engine-level counters: acceptance is not tracked per
+        # request, so this is the engine's running view at
+        # completion).
+        spec = self.engine.spec_stats()
+        if spec is not None:
+            decode_attrs["spec_gamma"] = spec["gamma"]
+            decode_attrs["spec_acceptance_rate"] = \
+                spec["acceptance_rate"]
+            decode_attrs["spec_rewinds"] = (
+                spec["proposed"] - spec["accepted"])
+        trace_spans.record(
+            trace_spans.SPAN_SERVE_DECODE, first,
+            wall(pending.finished_at), parent_span_id=parent,
+            **decode_attrs)
 
     def generate_stream(self, spec: dict, timeout: float = 300.0):
         """Streaming generate: yields {"token", "index"} per decoded
@@ -462,6 +555,14 @@ class ServingFrontEnd:
                 lines.extend(prometheus_lines(
                     "shipyard_serving", {metric: value},
                     labels={"quantile": f"0.{pct}"}))
+        # Native histogram exposition (cumulative _bucket/_sum/_count)
+        # so histogram_quantile() works on the scrape and fleet-level
+        # aggregation is sound.
+        with self._stats_lock:
+            for metric, hist in (("ttft_ms", self._ttft_hist),
+                                 ("tpot_ms", self._tpot_hist)):
+                lines.extend(hist.prometheus_bucket_lines(
+                    f"shipyard_serving_{metric}"))
         spec = stats.get("speculative")
         if spec:
             lines.extend(prometheus_lines("shipyard_serving", {
@@ -498,20 +599,28 @@ class ServingFrontEnd:
 
     def stats(self) -> dict:
         with self._stats_lock:
-            done = list(self._completed)
+            completed = self._total_completed
+            tokens = self._total_tokens
+            ttft_hist = self._ttft_hist.to_dict()
+            tpot_hist = self._tpot_hist.to_dict()
+            ttft_pcts = self._ttft_hist.percentiles((50, 90, 99))
+            tpot_pcts = self._tpot_hist.percentiles((50, 90, 99))
         elapsed = time.perf_counter() - self._started_at
-        tokens = sum(r["num_tokens"] for r in done)
-        ttfts = [r["ttft_ms"] for r in done]
-        tpots = [r["tpot_ms"] for r in done]
         with self._inflight_lock:
             inflight = len(self._inflight)
         out = {
-            "completed_requests": len(done),
+            "completed_requests": completed,
             "generated_tokens": tokens,
             "uptime_seconds": elapsed,
             "tokens_per_second": tokens / elapsed if elapsed else 0.0,
-            "ttft_ms": {p: percentile(ttfts, p) for p in (50, 95, 99)},
-            "tpot_ms": {p: percentile(tpots, p) for p in (50, 95, 99)},
+            # Percentiles come from the fixed-bucket histograms (the
+            # same numbers any fleet-level merge reproduces), keyed
+            # p50/p90/p99; the raw bucket counts ride along so the
+            # router can merge replicas losslessly.
+            "ttft_ms": {p: ttft_pcts[f"p{p}"] for p in (50, 90, 99)},
+            "tpot_ms": {p: tpot_pcts[f"p{p}"] for p in (50, 90, 99)},
+            "ttft_hist": ttft_hist,
+            "tpot_hist": tpot_hist,
             # Router observability (models/router.py polls these):
             # requests this front end has accepted but not completed,
             # and the engine's queued+active total.
@@ -528,6 +637,13 @@ class ServingFrontEnd:
         return out
 
     # --------------------------- engine thread -------------------------
+
+    def _on_admit(self, request_id: str) -> None:
+        # Engine-thread hook (inside engine.step's _admit): stamps
+        # the queued -> prefill boundary of the request's span chain.
+        pending = self._active_runs.get(request_id)
+        if pending is not None and pending.admitted_at is None:
+            pending.admitted_at = time.perf_counter()
 
     def _on_token(self, request_id: str, token: int, index: int) -> None:
         # _active_runs is engine-thread-owned and this hook runs on

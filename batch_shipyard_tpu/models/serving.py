@@ -374,6 +374,11 @@ class ContinuousBatcher:
         # token) — the TTFT/TPOT measurement point for serving front
         # ends. Runs on the engine's stepping thread.
         self.on_token = on_token
+        # Observer called as (request_id,) the moment a queued
+        # request wins a slot, just before its prefill runs — the
+        # queued->prefill boundary of the request's trace span chain
+        # (models/server.py). Runs on the engine's stepping thread.
+        self.on_admit: Optional[Callable[[str], None]] = None
         self.preemptions = 0
         self.speculative = speculative
         self.gamma = speculative.gamma if speculative else 0
@@ -932,6 +937,8 @@ class ContinuousBatcher:
                     self._avail_pages -= worst
                     self._slot_reserved[i] = worst
                 self._queue.pop(0)
+                if self.on_admit is not None:
+                    self.on_admit(req.request_id)
                 pages = [self._free_pages.pop()
                          for _ in range(blocks_needed)]
                 self._slot_pages[i] = pages
@@ -944,6 +951,8 @@ class ContinuousBatcher:
                     jnp.asarray(row), len(tokens))
             else:
                 self._queue.pop(0)
+                if self.on_admit is not None:
+                    self.on_admit(req.request_id)
                 self.cache, last_logits = self._prefill(
                     self.params, self.cache, i, prompt, len(tokens))
             if self.speculative is not None:

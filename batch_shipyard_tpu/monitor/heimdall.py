@@ -124,11 +124,28 @@ def write_file_sd(store: StateStore, output_dir: str) -> str:
 # (operators prune history with `goodput prune` / events.prune).
 GOODPUT_EXPORT_WINDOW_SECONDS = 24 * 3600.0
 
-# Node health/quarantine gauges only cover rows seen within this
-# window (heartbeat, or registration for a still-booting node) —
-# generous against any sane heartbeat interval, small enough that a
-# permanently crashed node stops gauging within minutes.
+# Node health/quarantine gauges — and every OTHER per-node gauge
+# (last-step-time) or node-attributed export (serving latency
+# buckets) — only cover rows seen within this window (heartbeat, or
+# registration for a still-booting node): generous against any sane
+# heartbeat interval, small enough that a permanently crashed node
+# stops gauging within minutes. A crashed replica must not export
+# frozen percentiles forever.
 NODE_GAUGE_STALE_SECONDS = 300.0
+
+
+def _node_fresh(node: dict, now: float) -> bool:
+    """THE staleness rule for per-node exports (shared by the
+    health/quarantine gauges, the last-step-time gauges and the
+    serving-latency attribution): row not offline and seen within
+    NODE_GAUGE_STALE_SECONDS (heartbeat, or registration for a
+    still-booting node)."""
+    if node.get("state") == "offline":
+        return False
+    last_seen = float(node.get("heartbeat_at", 0) or 0)
+    if last_seen <= 0:
+        last_seen = float(node.get("registered_at", 0) or 0)
+    return now - last_seen <= NODE_GAUGE_STALE_SECONDS
 
 
 def build_goodput_metrics(store: StateStore) -> list[str]:
@@ -163,28 +180,50 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "# HELP nodes_quarantined Count of self-quarantined "
         "(auto-drained) nodes per pool.",
         "# TYPE nodes_quarantined gauge",
+        "# HELP shipyard_serving_ttft_ms Serving time-to-first-token "
+        "histogram over the trailing 24h window, merged across the "
+        "pool's live replicas (trace serve_request spans, drained "
+        "mid-run by the agents; stale/offline nodes excluded). "
+        "WINDOWED and SAMPLED: bucket counts can shrink as spans "
+        "age out or are pruned — query the buckets directly "
+        "(histogram_quantile over the raw series), not "
+        "rate()/increase() — and replicas head-sample span detail "
+        "(first 512 requests, then 1-in-16), so counts are a sample. "
+        "For exact, cumulative histograms scrape the "
+        "replicas'/router's own /metrics.",
+        "# TYPE shipyard_serving_ttft_ms histogram",
+        "# HELP shipyard_serving_tpot_ms Serving time-per-output-"
+        "token histogram over the trailing 24h window (same "
+        "windowed semantics as shipyard_serving_ttft_ms).",
+        "# TYPE shipyard_serving_tpot_ms histogram",
+        "# HELP node_last_step_seconds Seconds per train step from "
+        "the node's most recent step window (stale/offline nodes "
+        "excluded).",
+        "# TYPE node_last_step_seconds gauge",
     ]
+    from batch_shipyard_tpu.goodput import events as goodput_events
     for pool in store.query_entities(names.TABLE_POOLS,
                                      partition_key="pools"):
+        # One fetch per table per poll: node rows and the goodput
+        # partition are each consumed by several exports below (the
+        # pool report, the health gauges, the latency/step gauges) —
+        # on a cloud store these are the two expensive scans.
+        now = time.time()
+        node_rows = list(store.query_entities(
+            names.TABLE_NODES, partition_key=pool["_rk"]))
+        events = goodput_events.query(store, pool["_rk"])
         report = accounting.pool_report(
             store, pool["_rk"],
             window_seconds=GOODPUT_EXPORT_WINDOW_SECONDS,
-            include_jobs=False)
+            include_jobs=False, event_list=events)
         lines.extend(accounting.prometheus_lines(
             report, {"pool": pool["_rk"]}))
         quarantined = 0
-        now = time.time()
-        for node in store.query_entities(names.TABLE_NODES,
-                                         partition_key=pool["_rk"]):
+        for node in node_rows:
             # Dead or cleanly-stopped rows must not gauge (and alert)
             # forever: a crashed quarantined node would otherwise
             # inflate nodes_quarantined for the life of its row.
-            if node.get("state") == "offline":
-                continue
-            last_seen = float(node.get("heartbeat_at", 0) or 0)
-            if last_seen <= 0:
-                last_seen = float(node.get("registered_at", 0) or 0)
-            if now - last_seen > NODE_GAUGE_STALE_SECONDS:
+            if not _node_fresh(node, now):
                 continue
             health = node.get(names.NODE_COL_HEALTH)
             if health is not None:
@@ -195,6 +234,79 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
                 quarantined += 1
         lines.append(f'nodes_quarantined{{pool="{pool["_rk"]}"}} '
                      f'{quarantined}')
+        lines.extend(_pool_latency_metrics(store, pool["_rk"], now,
+                                           node_rows, events))
+    return lines
+
+
+def _pool_latency_metrics(store: StateStore, pool_id: str,
+                          now: float, node_rows: list[dict],
+                          events: list[dict]) -> list[str]:
+    """Serving latency histogram buckets + per-node last-step-time
+    gauges for one pool, sourced from the trace log and the caller's
+    already-fetched node rows + goodput events, over the trailing
+    export window.
+
+    Both honor the NODE_GAUGE_STALE_SECONDS rule: a serve span or
+    step window attributed to a node whose row went stale/offline is
+    dropped, so a crashed replica cannot export frozen percentiles
+    (or a frozen step time) forever. Spans without a node id (e.g.
+    dev-box ingests) have no row to go stale and pass through."""
+    from batch_shipyard_tpu.trace import spans as trace_spans
+    from batch_shipyard_tpu.trace.histogram import LatencyHistogram
+    fresh = {node["_rk"] for node in node_rows
+             if _node_fresh(node, now)}
+    cutoff = now - GOODPUT_EXPORT_WINDOW_SECONDS
+
+    def node_ok(row: dict) -> bool:
+        node_id = row.get("node_id")
+        return node_id is None or node_id in fresh
+
+    lines: list[str] = []
+    ttft = LatencyHistogram()
+    tpot = LatencyHistogram()
+    for row in trace_spans.query(store, pool_id):
+        if row.get("kind") != trace_spans.SPAN_SERVE_REQUEST:
+            continue
+        if float(row.get("end", 0.0)) < cutoff or not node_ok(row):
+            continue
+        attrs = row.get("attrs") or {}
+        try:
+            ttft.observe(float(attrs["ttft_ms"]))
+            tpot.observe(float(attrs["tpot_ms"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+    for metric, hist in (("ttft_ms", ttft), ("tpot_ms", tpot)):
+        if hist.count:
+            lines.extend(hist.prometheus_bucket_lines(
+                f"shipyard_serving_{metric}", {"pool": pool_id}))
+    # Latest step window per node -> seconds-per-step gauge (the
+    # liveness-of-progress signal next to the health score).
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    latest: dict[str, tuple[float, float]] = {}
+    for event in events:
+        if event.get("kind") != goodput_events.PROGRAM_STEP_WINDOW:
+            continue
+        node_id = event.get("node_id")
+        if node_id is None or node_id not in fresh:
+            continue
+        end = float(event.get("end", 0.0))
+        if end < cutoff:
+            continue
+        attrs = event.get("attrs") or {}
+        try:
+            steps = int(attrs["step_end"]) - int(attrs["step_start"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if steps <= 0:
+            continue
+        seconds = max(0.0, end - float(event.get("start", end)))
+        if node_id not in latest or end > latest[node_id][0]:
+            latest[node_id] = (end, seconds / steps)
+    for node_id in sorted(latest):
+        lines.append(
+            f'node_last_step_seconds{{node="{node_id}",'
+            f'pool="{pool_id}"}} {latest[node_id][1]:.6f}')
     return lines
 
 

@@ -18,6 +18,7 @@ TABLE_GANGS = "gangs"          # pk=f"{pool}${job}${task}", rk=f"i{k}"
 TABLE_JOBPREP = "jobprep"      # pk=f"{pool}${job}",   rk=node_id
 TABLE_PERF = "perf"            # pk=f"{pool}",         rk=f"{ts}${uniq}"
 TABLE_GOODPUT = "goodput"      # pk=pool_id,           rk=f"{ts}${uniq}"
+TABLE_TRACE = "trace"          # pk=pool_id,           rk=f"{ts}${uniq}"
 TABLE_IMAGES = "images"        # pk=pool_id,           rk=image hash
 TABLE_MONITOR = "monitor"      # pk="monitor",         rk=resource id
 TABLE_FEDERATIONS = "federations"  # pk="fed",         rk=federation_id

@@ -1,0 +1,30 @@
+"""End-to-end distributed tracing + on-demand step profiling.
+
+The goodput subsystem (goodput/) answers *how much* wall clock a pool
+lost per category; this package answers *where one specific
+submission lost it*: a trace context born at ``jobs add`` is persisted
+on every task row, carried through queue messages and gang attempt
+partitions, and exported into task processes as ``$SHIPYARD_TRACE_*``
+env — so agent-side lifecycle spans (claim, backoff, rendezvous,
+launch) and in-process program spans (compile, checkpoint, train step
+windows, serving requests) all share one causal chain that
+``shipyard trace show|export`` can assemble into a Perfetto-loadable
+Chrome trace.
+
+Modules:
+  context.py    trace-context identity (trace_id/span_id/parent),
+                env contract, task-row persistence helpers
+  spans.py      declared span-kind registry + store-backed and
+                process-local (JSONL) span recorders, agent-ingested
+                post-task exactly like the goodput recorder
+  export.py     spans + goodput intervals -> Chrome trace-event JSON
+                (one track per node/slot/request)
+  histogram.py  fixed log-bucket latency histograms, mergeable across
+                replicas/router, backing TTFT/TPOT/step-time
+                percentiles and Prometheus ``_bucket`` export
+  profiling.py  on-demand ``jax.profiler`` step capture driven by the
+                ``jobs profile`` store flag the agent forwards
+"""
+
+from batch_shipyard_tpu.trace.context import (  # noqa: F401
+    TRACE_FILE_ENV, TRACE_ID_ENV, TRACE_SPAN_ENV, TraceContext)

@@ -1,0 +1,120 @@
+"""Trace-context identity and propagation.
+
+A trace context is three ids::
+
+    trace_id        one per job submission (born at action_jobs_add)
+    span_id         the current operation's own id
+    parent_span_id  the operation that caused it (None at the root)
+
+Propagation path (CLI -> fleet -> state/queue -> agent -> task):
+
+  * ``jobs add`` creates one context per job; the SUBMIT span is
+    recorded store-side and every task entity is stamped with
+    ``trace_id`` + a per-task root ``trace_span_id`` (child of the
+    submit span). Queue messages carry ``trace_id`` so a redelivered
+    message stays attributable even if the entity read races a retry.
+  * The node agent attaches the task row's ids to every goodput event
+    and trace span it emits (claim/backoff/requeue/rendezvous/run),
+    and exports the context into the task process env
+    ($SHIPYARD_TRACE_ID / $SHIPYARD_TRACE_SPAN_ID, plus the
+    $SHIPYARD_TRACE_FILE JSONL sink — docker path remap in
+    task_runner, the goodput-file pattern).
+  * Inside the task, spans.record()/phase() read the env lazily: the
+    task's exported span id becomes the parent of every program span,
+    and goodput/events.record() attaches the same ids so the goodput
+    intervals of a run join its trace for export.
+
+Ids are short hex (uuid4-derived): 16 chars for trace ids, 8 for span
+ids — long enough for fleet-lifetime uniqueness, short enough to read
+in a terminal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Optional
+
+# Env contract exported into every task process by the node agent.
+TRACE_ID_ENV = "SHIPYARD_TRACE_ID"
+TRACE_SPAN_ENV = "SHIPYARD_TRACE_SPAN_ID"
+# Process-local span sink (JSONL), agent-ingested post-task — the
+# $SHIPYARD_GOODPUT_FILE pattern.
+TRACE_FILE_ENV = "SHIPYARD_TRACE_FILE"
+
+# Task/job entity columns (written at submit, read by the agent and
+# `jobs tasks list`). A task row stores its ROOT span (child of the
+# job's submit span) plus that parent, so the agent can emit the
+# task-run span under the right id without re-reading the job entity.
+COL_TRACE_ID = "trace_id"
+COL_TRACE_SPAN = "trace_span_id"
+COL_TRACE_PARENT = "trace_parent_span_id"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """An immutable (trace, span, parent) triple."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        """A fresh root context (the submit span of a new trace)."""
+        return cls(trace_id=new_trace_id(), span_id=new_span_id())
+
+    def child(self) -> "TraceContext":
+        """A new span caused by this one."""
+        return TraceContext(trace_id=self.trace_id,
+                            span_id=new_span_id(),
+                            parent_span_id=self.span_id)
+
+    @classmethod
+    def from_entity(cls, entity: dict) -> Optional["TraceContext"]:
+        """Context stored on a task/job entity, or None for legacy
+        rows submitted before tracing existed. A row with a trace id
+        but NO span id (partial merge, foreign writer) is also None:
+        minting a fresh id per call would hand every caller a
+        different 'root' and silently shred the parent chain —
+        untraced degrades cleanly, a broken chain does not."""
+        trace_id = entity.get(COL_TRACE_ID)
+        span_id = entity.get(COL_TRACE_SPAN)
+        if not trace_id or not span_id:
+            return None
+        parent = entity.get(COL_TRACE_PARENT)
+        return cls(trace_id=str(trace_id), span_id=str(span_id),
+                   parent_span_id=str(parent) if parent else None)
+
+    def entity_columns(self) -> dict[str, str]:
+        """The columns a task/job row stores for this context."""
+        out = {COL_TRACE_ID: self.trace_id,
+               COL_TRACE_SPAN: self.span_id}
+        if self.parent_span_id:
+            out[COL_TRACE_PARENT] = self.parent_span_id
+        return out
+
+    @classmethod
+    def from_env(cls) -> Optional["TraceContext"]:
+        """The context the agent exported into THIS process, or None
+        outside pool tasks (tracing is then a no-op). Both vars must
+        be present — same degrade-to-None rule as from_entity."""
+        trace_id = os.environ.get(TRACE_ID_ENV)
+        span_id = os.environ.get(TRACE_SPAN_ENV)
+        if not trace_id or not span_id:
+            return None
+        return cls(trace_id=trace_id, span_id=span_id)
+
+    def env(self) -> dict[str, str]:
+        """The env block the agent exports into a task process."""
+        return {TRACE_ID_ENV: self.trace_id,
+                TRACE_SPAN_ENV: self.span_id}

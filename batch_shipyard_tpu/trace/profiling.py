@@ -1,0 +1,178 @@
+"""On-demand step profiling: `shipyard jobs profile <job> --steps N`.
+
+Flow (store flag -> agent -> train harness -> artifact):
+
+  1. The fleet action stamps ``profile_request: {steps, requested_at}``
+     on the job entity (one request at a time; a new request
+     supersedes).
+  2. The node agent forwards the request to its tasks: at launch it
+     exports $SHIPYARD_PROFILE_REQUEST_FILE / $SHIPYARD_PROFILE_DIR
+     (docker path remap like the progress file), and — for tasks
+     ALREADY running — its heartbeat loop drops the request file into
+     the live task dirs, so profiling is genuinely on-demand, not
+     launch-time-only.
+  3. The train harness calls ``StepProfiler.tick(step)`` once per
+     step: when the request file appears, the next N steps run inside
+     ``jax.profiler.trace`` writing into the profile dir; the request
+     file is consumed (removed) when capture starts so one request is
+     one capture.
+  4. Post-task, the agent uploads the profile dir through the store
+     next to the task's other outputs and stamps
+     ``profile_artifact`` on the task entity (shown by
+     ``jobs tasks list``).
+
+Everything is best-effort: a failed profiler start (no TensorBoard
+plugin, unsupported backend) logs and disarms instead of failing the
+training step that triggered it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.trace import spans as trace_spans
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+# Env contract (exported by the node agent; docker remap in
+# task_runner).
+PROFILE_REQUEST_FILE_ENV = "SHIPYARD_PROFILE_REQUEST_FILE"
+PROFILE_DIR_ENV = "SHIPYARD_PROFILE_DIR"
+
+# Job-entity column the fleet action writes and the agent polls.
+COL_PROFILE_REQUEST = "profile_request"
+# Task-entity column the agent stamps after uploading the artifact.
+COL_PROFILE_ARTIFACT = "profile_artifact"
+
+
+def read_request(path: Optional[str]) -> Optional[dict]:
+    """Parse a request file; None when absent/junk (task-controlled
+    surface — junk must never crash the step loop)."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            request = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return request if isinstance(request, dict) else None
+
+
+def write_request(path: str, steps: int,
+                  requested_at: Optional[str] = None) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"steps": int(steps),
+                   "requested_at": requested_at
+                   or util.datetime_utcnow_iso()}, fh)
+    os.replace(tmp, path)
+
+
+class StepProfiler:
+    """Per-process profiling driver the train loops tick every step.
+
+    ``tick(step)`` is O(one os.path.exists) while disarmed — cheap
+    enough for every step of a CPU test loop, invisible next to a
+    real TPU step. ``close()`` stops a capture cut short by loop
+    exit."""
+
+    def __init__(self,
+                 request_path: Optional[str] = None,
+                 profile_dir: Optional[str] = None) -> None:
+        self.request_path = (request_path if request_path is not None
+                             else os.environ.get(
+                                 PROFILE_REQUEST_FILE_ENV))
+        self.profile_dir = (profile_dir if profile_dir is not None
+                            else os.environ.get(PROFILE_DIR_ENV))
+        self._remaining = 0
+        self._requested = 0
+        self._active = False
+        self._started_at = 0.0
+        self._start_step: Optional[int] = None
+        self._broken = False  # profiler start failed; stay disarmed
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def tick(self, step: int) -> None:
+        """Call once per train step, BEFORE running the step: arms on
+        a pending request, counts captured steps, stops after N."""
+        if self._active:
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self._stop(step)
+            return
+        if self._broken or not self.request_path or \
+                not self.profile_dir:
+            return
+        request = read_request(self.request_path)
+        if request is None:
+            return
+        try:
+            steps = max(1, int(request.get("steps", 1)))
+        except (TypeError, ValueError):
+            steps = 1
+        # Consume the request BEFORE starting: one request, one
+        # capture, even if the start fails below.
+        try:
+            os.remove(self.request_path)
+        except OSError:
+            pass
+        self._start(step, steps)
+
+    def _start(self, step: int, steps: int) -> None:
+        try:
+            os.makedirs(self.profile_dir, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+        except Exception:  # noqa: BLE001 - never fail the step loop
+            logger.exception("jax.profiler start failed; profiling "
+                             "disarmed for this process")
+            self._broken = True
+            return
+        self._active = True
+        self._remaining = steps
+        self._requested = steps
+        self._started_at = time.time()
+        self._start_step = step
+        logger.info("profiling %d step(s) from step %d into %s",
+                    steps, step, self.profile_dir)
+
+    def _stop(self, end_step: int) -> None:
+        """``end_step`` is EXCLUSIVE: the capture covers the
+        half-open step range [start_step, end_step) — tick(N) stops
+        the trace before step N runs, so N itself is never in the
+        artifact."""
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            logger.exception("jax.profiler stop failed")
+        self._active = False
+        # The capture window joins the task's trace (span ingested
+        # post-task like every program phase). step_end is the same
+        # half-open bound, so step_end - step_start = steps captured.
+        trace_spans.record(
+            trace_spans.SPAN_PROFILE, self._started_at, time.time(),
+            step_start=self._start_step, step_end=end_step,
+            profile_dir=self.profile_dir)
+        logger.info("profile capture complete (steps [%s, %s))",
+                    self._start_step, end_step)
+
+    def close(self) -> None:
+        """Stop a capture cut short by loop exit (fewer steps ran
+        than requested): the honest exclusive bound is start +
+        steps-actually-run. The arming tick precedes its step, so by
+        the time a loop-exit close runs, one more step has completed
+        than the remaining counter saw."""
+        if self._active:
+            captured = min(self._requested,
+                           self._requested - self._remaining + 1)
+            self._remaining = 0
+            self._stop((self._start_step or 0) + captured)

@@ -53,6 +53,7 @@ import threading
 from typing import Any, Optional
 
 from batch_shipyard_tpu.goodput import events as goodput_events
+from batch_shipyard_tpu.trace import spans as trace_spans
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
@@ -145,7 +146,9 @@ def save(checkpoint_dir: str, step: int, params: Any,
     state = {"params": params, "opt_state": opt_state,
              "step": step}
     with goodput_events.phase(
-            goodput_events.PROGRAM_CHECKPOINT_SAVE, step=step):
+            goodput_events.PROGRAM_CHECKPOINT_SAVE, step=step), \
+            trace_spans.phase(trace_spans.SPAN_CKPT_PERSIST,
+                              step=step, overlapped=False):
         path = _persist_state(checkpoint_dir, step, state)
     return path
 
@@ -234,7 +237,9 @@ def restore_params(checkpoint_dir: str) -> Optional[tuple]:
         return None
     path = _step_path(checkpoint_dir, step)
     with goodput_events.phase(
-            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step):
+            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step), \
+            trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
+                              step=step):
         restored = _checkpointer().restore(path)
     logger.info("checkpoint params restored: %s", path)
     return restored["params"], restored.get("step", step)
@@ -253,7 +258,9 @@ def restore(checkpoint_dir: str, params_template: Any,
                 "opt_state": opt_state_template, "step": step}
     import orbax.checkpoint as ocp
     with goodput_events.phase(
-            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step):
+            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step), \
+            trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
+                              step=step):
         restored = _checkpointer().restore(
             path, item=template,
             restore_args=ocp.checkpoint_utils.construct_restore_args(
@@ -306,7 +313,10 @@ class AsyncCheckpointManager:
                 try:
                     with goodput_events.phase(
                             goodput_events.PROGRAM_CHECKPOINT_ASYNC,
-                            step=step):
+                            step=step), \
+                            trace_spans.phase(
+                                trace_spans.SPAN_CKPT_PERSIST,
+                                step=step, overlapped=True):
                         _persist_state(self.checkpoint_dir, step,
                                        state)
                     if self.keep_last:
@@ -377,7 +387,9 @@ class AsyncCheckpointManager:
             return path
         with goodput_events.phase(
                 goodput_events.PROGRAM_CHECKPOINT_SAVE, step=step,
-                mode="snapshot"):
+                mode="snapshot"), \
+                trace_spans.phase(trace_spans.SPAN_CKPT_SNAPSHOT,
+                                  step=step):
             # Snapshot FIRST (the second buffer), so the in-flight
             # persist keeps overlapping with the transfer; then wait
             # out the depth-1 bound.

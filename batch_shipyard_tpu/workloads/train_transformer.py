@@ -137,7 +137,14 @@ def main() -> int:
         window["step"] = end_step
         window["time"] = time.time()
 
+    # On-demand profiling (trace/profiling.py): `shipyard jobs
+    # profile` drops a request file the agent forwards; the next N
+    # steps run under jax.profiler.trace. O(one stat) per step while
+    # disarmed.
+    from batch_shipyard_tpu.trace.profiling import StepProfiler
+    profiler = StepProfiler()
     for step_num in range(start_step, start_step + args.steps):
+        profiler.tick(step_num)
         params, opt_state, metrics = harness.step(params,
                                                   opt_state, batch)
         if ckpt.due(step_num + 1):
@@ -148,6 +155,7 @@ def main() -> int:
             ckpt.step_save(step_num + 1, params, opt_state)
             window["time"] = time.time()  # save span is not steps
     loss = float(metrics["loss"])  # hard sync before the final flush
+    profiler.close()
     _flush_window(start_step + args.steps)
     elapsed = time.perf_counter() - start
     # Exit save dedups against the loop's cadenced save of the same

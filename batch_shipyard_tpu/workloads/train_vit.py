@@ -79,12 +79,17 @@ def main() -> int:
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)
         float(metrics["loss"])  # hard sync
+    # On-demand profiling: `shipyard jobs profile` (trace/profiling).
+    from batch_shipyard_tpu.trace.profiling import StepProfiler
+    profiler = StepProfiler()
     start = time.perf_counter()
     for step_num in range(start_step, start_step + args.steps):
+        profiler.tick(step_num)
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)
         ckpt.step_save(step_num + 1, params, opt_state)
     loss = float(metrics["loss"])
+    profiler.close()
     elapsed = time.perf_counter() - start
     ckpt.finalize(start_step + args.steps, params, opt_state)
     images_per_sec = batch_size * args.steps / elapsed

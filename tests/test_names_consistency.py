@@ -357,6 +357,62 @@ def test_benchgen_phase_and_workload_names_exist():
     assert "ring_collectives" in dispatched
 
 
+def test_span_kinds_are_declared_in_trace_spans():
+    """Every SPAN_* constant referenced at an emit site anywhere in
+    the package must resolve to a declared constant in trace/spans.py
+    whose value is registered in SPAN_KINDS — a typo'd span kind
+    would silently produce spans the exporter drops (the same rule
+    the goodput PROGRAM_* constants live under)."""
+    from batch_shipyard_tpu.trace import spans as trace_spans
+    problems = []
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("SPAN_"):
+                value = getattr(trace_spans, node.attr, None)
+                if value is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} not "
+                        f"declared in trace/spans.py")
+                elif value not in trace_spans.SPAN_KINDS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} value "
+                        f"{value!r} missing from SPAN_KINDS")
+    assert not problems, "\n".join(problems)
+    # The span log's table rides the names registry like every other
+    # coordination surface.
+    assert names.TABLE_TRACE == "trace"
+    assert "TABLE_TRACE" in _DECLARED_ATTRS
+
+
+def test_trace_and_profile_fleet_actions_are_wired_in_cli():
+    """Every fleet trace/profile action (action_trace_* and
+    action_jobs_profile) must have a cli/main.py call site — an
+    unwired action is dead surface nobody can reach (`shipyard trace
+    show|export`, `shipyard jobs profile`)."""
+    fleet_tree = ast.parse(
+        (PACKAGE / "fleet.py").read_text(encoding="utf-8"))
+    actions = {
+        node.name for node in ast.walk(fleet_tree)
+        if isinstance(node, ast.FunctionDef)
+        and (node.name.startswith("action_trace_")
+             or node.name == "action_jobs_profile")}
+    assert actions, "no trace/profile actions found in fleet.py"
+    cli_tree = ast.parse(
+        (PACKAGE / "cli" / "main.py").read_text(encoding="utf-8"))
+    called = {
+        node.func.attr for node in ast.walk(cli_tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "fleet"}
+    missing = actions - called
+    assert not missing, (
+        f"fleet trace/profile actions {sorted(missing)} are not "
+        f"wired in cli/main.py")
+
+
 def test_train_loops_never_call_blocking_checkpoint_save():
     """The train workloads must drive checkpoints through
     checkpoint.TrainCheckpointer (which routes to the async manager

@@ -71,8 +71,12 @@ def test_health_stats_and_errors(front):
         stats = json.loads(resp.read())
     assert stats["completed_requests"] >= 1
     assert stats["generated_tokens"] >= 3
-    assert set(stats["ttft_ms"]) == {"50", "95", "99"} or set(
-        stats["ttft_ms"]) == {50, 95, 99}
+    assert set(stats["ttft_ms"]) == {"50", "90", "99"} or set(
+        stats["ttft_ms"]) == {50, 90, 99}
+    # Mergeable fixed-bucket histograms ride along for fleet
+    # aggregation (router) — counts match the request totals.
+    assert stats["ttft_hist"]["count"] == stats["completed_requests"]
+    assert stats["tpot_hist"]["count"] == stats["completed_requests"]
     # Bad request -> 400, server keeps serving.
     bad = urllib.request.Request(
         f"{front.url}/v1/generate",
@@ -95,10 +99,12 @@ def test_poisson_load_report(front):
     assert report["generated_tokens"] >= 24
     assert report["tokens_per_second"] > 0
     for section in ("ttft_ms", "tpot_ms", "latency_ms"):
-        assert set(report[section]) == {"p50", "p95", "p99"}
-        assert report[section]["p99"] >= report[section]["p50"]
-    hist = report["ttft_histogram"]
-    assert sum(hist.values()) == 12
+        assert set(report[section]) == {"p50", "p90", "p99"}
+        assert report[section]["p50"] <= report[section]["p90"] <= \
+            report[section]["p99"]
+    hist = report["ttft_hist"]
+    assert hist["count"] == 12
+    assert sum(hist["counts"]) + hist["overflow"] == 12
     # Reproducible arrivals + prompts under the same seed.
     again = loadgen.run_load(
         front.url, num_requests=3, rate_hz=100.0, prompt_len=(2, 4),

@@ -109,14 +109,17 @@ def _serving(out: list[str], name: str, data: dict) -> None:
     if not data.get("ttft_ms"):
         return
     out.append(f"### {name}\n")
-    out.append("| metric | p50 | p95 | p99 |")
+    # Percentiles come from merged per-replica fixed-log-bucket
+    # histograms (trace/histogram.py) — the same numbers the router
+    # and Prometheus histogram_quantile() report for this fleet.
+    out.append("| metric | p50 | p90 | p99 |")
     out.append("|---|---|---|---|")
     for key, label in (("ttft_ms", "TTFT (ms)"),
                        ("tpot_ms", "TPOT (ms)"),
                        ("latency_ms", "latency (ms)")):
         pcts = data.get(key, {})
         out.append(f"| {label} | {_fmt(pcts.get('p50'))} | "
-                   f"{_fmt(pcts.get('p95'))} | "
+                   f"{_fmt(pcts.get('p90', pcts.get('p95')))} | "
                    f"{_fmt(pcts.get('p99'))} |")
     out.append("")
     out.append(f"Completed {data.get('completed')}/"
