@@ -31,6 +31,7 @@ import time
 import uuid
 from typing import Callable, Optional
 
+from batch_shipyard_tpu.agent import preemption as preempt_mod
 from batch_shipyard_tpu.agent import progress as progress_mod
 from batch_shipyard_tpu.agent import task_runner
 from batch_shipyard_tpu.compilecache import manager as cc_manager
@@ -110,6 +111,8 @@ class NodeAgent:
                  health_probation_seconds: float = 300.0,
                  claim_visibility_seconds: float = 60.0,
                  gang_sweep_interval: float = 60.0,
+                 preempt_sweep_interval: float = 30.0,
+                 preempt_grace_seconds: float = 20.0,
                  ) -> None:
         self.store = store
         self.identity = identity
@@ -167,6 +170,24 @@ class NodeAgent:
         # Orphaned-gang-row janitor cadence (heartbeat loop).
         self.gang_sweep_interval = gang_sweep_interval
         self._last_gang_sweep = time.monotonic()
+        # Cooperative-preemption sweep cadence (heartbeat loop,
+        # leader-gated like the gang janitor: one unpartitioned task
+        # scan per pool per interval). grace = how long a pending
+        # higher-priority task must have waited before lower-priority
+        # running work is evicted for it (<=0 disables the sweep).
+        self.preempt_sweep_interval = preempt_sweep_interval
+        self.preempt_grace_seconds = preempt_grace_seconds
+        self._last_preempt_sweep = time.monotonic()
+        # (path, requested_at) preempt requests already delivered —
+        # same dedup protocol as _profile_delivered (one drain per
+        # request; disk markers persist the dedup across restarts).
+        self._preempt_delivered: set[tuple] = set()
+        # Short-TTL per-task preempt_request cache ((request, at)):
+        # the heartbeat forwarding loop must not cost one store read
+        # per live task per beat on cloud backends just to learn no
+        # preemption is pending (the _job_state_cache rule). TTL
+        # shares _job_state_ttl.
+        self._task_preempt_cache: dict[tuple, tuple] = {}
         # (job_id, secret_id) -> resolved env block: one provider
         # round trip per job per node, not per task launch.
         self._env_block_cache: dict[tuple[str, str], dict] = {}
@@ -398,7 +419,9 @@ class NodeAgent:
                 self._heartbeat()
                 self._sweep_retention()
                 self._sweep_orphaned_gangs()
+                self._sweep_preemptions()
                 self._forward_profile_requests()
+                self._forward_preempt_requests()
                 self._ingest_live_trace_spans()
             except Exception:
                 logger.exception("heartbeat iteration failed; "
@@ -971,6 +994,23 @@ class NodeAgent:
                     node_id=self.identity.node_id,
                     start=submitted, end=end,
                     attrs={"retries": entity.get("retries", 0)})
+        # Preemption-recovery interval: preempted exit -> this claim.
+        # Priced once per preemption (the claim patch clears
+        # preempted_at; gang width dedup rides the emit_queued flag,
+        # so an 8-wide gang reports the leg once). This is the badput
+        # every preemption actually costs — the drill's
+        # "preemption_recovery now populated" acceptance.
+        preempted_at = entity.get(names.TASK_COL_PREEMPTED_AT)
+        if preempted_at and now > float(preempted_at):
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_PREEMPT_RECOVERY, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=float(preempted_at), end=now,
+                attrs={"preempt_count": entity.get(
+                    names.TASK_COL_PREEMPT_COUNT, 0)},
+                trace_id=entity.get(trace_context.COL_TRACE_ID),
+                span_id=entity.get(trace_context.COL_TRACE_SPAN))
 
     def _ensure_images_timed(self, job_id: str, task_id: str,
                              spec: dict,
@@ -1124,18 +1164,7 @@ class NodeAgent:
 
     def _deliver_profile_request(self, job_id: str, task_id: str,
                                  request: dict) -> None:
-        root = os.path.join(self.work_dir, "tasks", job_id, task_id)
-        targets = [root]
-        try:
-            targets += [os.path.join(root, d)
-                        for d in os.listdir(root)
-                        if d.startswith("i")
-                        and os.path.isdir(os.path.join(root, d))]
-        except OSError:
-            pass
-        for task_dir in targets:
-            if not os.path.isdir(task_dir):
-                continue
+        for task_dir in self._task_dir_targets(job_id, task_id):
             self._deliver_profile_file(
                 os.path.join(task_dir, "profile_request.json"),
                 request)
@@ -1224,6 +1253,323 @@ class NodeAgent:
             pass
         logger.info("uploaded %d profile file(s) for %s/%s",
                     uploaded, job_id, task_id)
+
+    # ---------------------- preemption scheduling ----------------------
+
+    def _sweep_preemptions(self) -> None:
+        """Numeric-priority preemption sweep (leader-gated, like the
+        gang janitor — one unpartitioned task scan per pool per
+        interval). A pending task that has waited past the grace
+        window while STRICTLY lower-priority work runs cannot place:
+        the sweep elects the lowest-priority running victim (gangs
+        included — one stamped entity preempts every instance) and
+        stamps a cooperative preempt request on it. The victim's
+        agent delivers the request over the heartbeat path, the
+        workload drains to a step boundary, commits, and exits
+        EXIT_PREEMPTED — requeued at full budget. One victim per
+        starved task per sweep: cooperative preemption converges over
+        sweeps instead of mass-evicting a pool in one pass."""
+        if self.preempt_sweep_interval <= 0:
+            return
+        if (time.monotonic() - self._last_preempt_sweep
+                < self.preempt_sweep_interval):
+            return
+        self._last_preempt_sweep = time.monotonic()
+        if not self._is_gang_sweep_leader():
+            return
+        prefix = f"{self.identity.pool_id}$"
+        now = time.time()
+        starved: list[tuple] = []   # (priority, waited_since, row)
+        victims: list[tuple] = []   # (priority, row)
+        for row in self.store.query_entities(names.TABLE_TASKS):
+            if not row["_pk"].startswith(prefix):
+                continue
+            state = row.get("state")
+            priority = int(
+                (row.get("spec") or {}).get("priority", 0) or 0)
+            if state in names.CLAIMABLE_TASK_STATES:
+                not_before = row.get("not_before")
+                if not_before and now < float(not_before):
+                    continue  # deliberate backoff, not starvation
+                since = goodput_events.iso_to_epoch(
+                    row.get("requeued_at") or row.get("submitted_at"))
+                if since is None or \
+                        now - since < self.preempt_grace_seconds:
+                    continue
+                starved.append((priority, since, row))
+            elif state in ("assigned", "running"):
+                if row.get(names.TASK_COL_PREEMPT_REQUEST):
+                    continue  # already draining; one request each
+                victims.append((priority, row))
+        if not starved or not victims:
+            return
+        starved.sort(key=lambda t: (-t[0], t[1]))
+        victims.sort(key=lambda t: t[0])
+        from batch_shipyard_tpu.jobs import manager as jobs_mgr
+        for priority, _since, row in starved:
+            if not victims or victims[0][0] >= priority:
+                break  # nothing running is strictly lower anymore
+            victim_priority, victim = victims.pop(0)
+            victim_job = victim["_pk"][len(prefix):]
+            starved_job = row["_pk"][len(prefix):]
+            jobs_mgr.request_preemption(
+                self.store, self.identity.pool_id, victim_job,
+                victim["_rk"],
+                reason=(f"priority {priority} task "
+                        f"{starved_job}/{row['_rk']} cannot place "
+                        f"(victim priority {victim_priority})"),
+                by_job_id=starved_job, by_task_id=row["_rk"])
+
+    def _forward_preempt_requests(self) -> None:
+        """Heartbeat-loop delivery of pending preempt requests into
+        this node's LIVE tasks' dirs (the profile-request channel):
+        one short-TTL-cached entity read per live task, one file drop
+        per (target, requested_at)."""
+        for job_id, task_id in list(self._live_procs.keys()):
+            request = self._cached_task_preempt_request(job_id,
+                                                        task_id)
+            if not isinstance(request, dict):
+                continue
+            self._deliver_preempt_request(job_id, task_id, request)
+
+    def _cached_task_preempt_request(self, job_id: str,
+                                     task_id: str) -> Optional[dict]:
+        """The task's pending preempt request (or None), cached for
+        _job_state_ttl so the common no-preemption case costs no
+        store round trip per live task per beat."""
+        key = (job_id, task_id)
+        now = time.monotonic()
+        cached = self._task_preempt_cache.get(key)
+        if cached is not None and now - cached[1] < self._job_state_ttl:
+            return cached[0]
+        request = None
+        try:
+            entity = self._task_entity(job_id, task_id)
+            request = entity.get(names.TASK_COL_PREEMPT_REQUEST)
+        except NotFoundError:
+            pass
+        except Exception:  # noqa: BLE001 - heartbeat survives
+            logger.debug("preempt forward probe failed",
+                         exc_info=True)
+            return None  # transient: do not cache, retry next beat
+        if len(self._task_preempt_cache) > 256:
+            self._task_preempt_cache.clear()
+        self._task_preempt_cache[key] = (request, now)
+        return request
+
+    def _task_dir_targets(self, job_id: str,
+                          task_id: str) -> list[str]:
+        """A task's dir plus its gang-instance subdirs — every
+        location a per-task request file (profile, preempt) must land
+        in on this node."""
+        root = os.path.join(self.work_dir, "tasks", job_id, task_id)
+        targets = [root]
+        try:
+            targets += [os.path.join(root, d)
+                        for d in os.listdir(root)
+                        if d.startswith("i")
+                        and os.path.isdir(os.path.join(root, d))]
+        except OSError:
+            pass
+        return [t for t in targets if os.path.isdir(t)]
+
+    def _deliver_preempt_request(self, job_id: str, task_id: str,
+                                 request: dict) -> None:
+        for task_dir in self._task_dir_targets(job_id, task_id):
+            self._deliver_preempt_file(
+                os.path.join(task_dir, "preempt_request.json"),
+                request)
+
+    def _deliver_preempt_file(self, path: str, request: dict) -> None:
+        """One request file per (path, requested_at) — the profile
+        delivery protocol: a persisted .delivered marker survives
+        agent restarts (a re-dropped request after the harness
+        consumed it would trigger a second drain of the RERUN), and
+        the mark is taken only after a successful write so transient
+        OSErrors retry next heartbeat."""
+        requested_at = str(request.get("requested_at"))
+        key = (path, requested_at)
+        if key in self._preempt_delivered:
+            return
+        marker = path + ".delivered"
+        try:
+            with open(marker, encoding="utf-8") as fh:
+                if fh.read().strip() == requested_at:
+                    self._preempt_delivered.add(key)
+                    return
+        except OSError:
+            pass
+        try:
+            preempt_mod.write_request(
+                path, reason=str(request.get("reason") or ""),
+                requested_at=request.get("requested_at"),
+                by_job_id=request.get("by_job_id"),
+                by_task_id=request.get("by_task_id"))
+            with open(marker, "w", encoding="utf-8") as fh:
+                fh.write(requested_at)
+        except OSError:
+            logger.debug("preempt request delivery failed for %s",
+                         path, exc_info=True)
+            return
+        if len(self._preempt_delivered) > 4096:
+            self._preempt_delivered.clear()
+        self._preempt_delivered.add(key)
+        logger.warning("preempt request delivered to %s", path)
+
+    def _requeue_preempted(self, job_id: str, task_id: str,
+                           spec: dict,
+                           instances: Optional[int] = None) -> bool:
+        """Preempted requeue: the task drained cooperatively, so this
+        is a scheduling transition, not a failure — the retry counter
+        is NOT bumped (full budget preserved), no backoff is stamped
+        (the wait was deliberate on the scheduler's side, not the
+        task's), and any stale not_before from an earlier failure is
+        cleared. The entity passes through the distinct ``preempted``
+        state, which the claim path treats like pending; the rerun's
+        restore pulls the forced COMMITTED checkpoint. Returns False
+        when a concurrent transition won the merge."""
+        now = time.time()
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            return False
+        if entity.get("state") in names.TERMINAL_TASK_STATES:
+            return False
+        request = entity.get(names.TASK_COL_PREEMPT_REQUEST)
+        if not isinstance(request, dict):
+            # EXIT_PREEMPTED with NO pending preempt request is not a
+            # preemption: a buggy task exiting 75 unprompted would
+            # otherwise requeue at full budget forever. The caller
+            # falls back to the retry supervisor (budgeted).
+            logger.warning(
+                "task %s/%s exited with the preempted status but no "
+                "preempt request is pending; treating as a failure",
+                job_id, task_id)
+            return False
+        count = int(
+            entity.get(names.TASK_COL_PREEMPT_COUNT, 0) or 0) + 1
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": names.TASK_STATE_PREEMPTED,
+                "node_id": None,
+                names.TASK_COL_PREEMPTED_AT: now,
+                names.TASK_COL_PREEMPT_COUNT: count,
+                names.TASK_COL_PREEMPT_REQUEST: None,
+                "not_before": None,
+                "requeued_at": util.datetime_utcnow_iso(),
+            }, if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return False
+        goodput_events.emit(
+            self.store, self.identity.pool_id,
+            goodput_events.TASK_PREEMPT_EXIT, job_id=job_id,
+            task_id=task_id, node_id=self.identity.node_id,
+            attrs={"preempt_count": count,
+                   "reason": request.get("reason")},
+            trace_id=entity.get(trace_context.COL_TRACE_ID),
+            span_id=entity.get(trace_context.COL_TRACE_SPAN))
+        # The cooperative window (notice -> drained exit) on the
+        # trace: how long the drain + forced commit actually took.
+        requested = goodput_events.iso_to_epoch(
+            request.get("requested_at"))
+        trace_spans.emit(
+            self.store, self.identity.pool_id,
+            trace_spans.SPAN_PREEMPT,
+            trace_context.TraceContext.from_entity(entity),
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            start=(requested if requested and requested < now
+                   else now),
+            end=now,
+            attrs={"preempt_count": count,
+                   "reason": request.get("reason")})
+        queue = names.task_queue_for(
+            self.identity.pool_id, task_id,
+            self.pool.task_queue_shards,
+            priority=int(spec.get("priority", 0) or 0))
+        message = {"job_id": job_id, "task_id": task_id}
+        if entity.get(trace_context.COL_TRACE_ID):
+            message["trace_id"] = entity[trace_context.COL_TRACE_ID]
+        if instances:
+            self.store.put_messages(
+                queue,
+                [json.dumps({**message, "instance": k}).encode()
+                 for k in range(instances)])
+        else:
+            self.store.put_message(queue,
+                                   json.dumps(message).encode())
+        logger.warning(
+            "task %s/%s preempted (count %d); requeued at full "
+            "retry budget", job_id, task_id, count)
+        return True
+
+    def _elastic_size(self, spec: dict,
+                      entity: dict) -> tuple[int, int]:
+        """(current effective gang size, next attempt's size).
+
+        Rigid gangs (no min_instances floor) never change size. An
+        elastic gang's next attempt re-forms at whatever the pool can
+        actually supply: max(min_instances, min(spec size, live
+        nodes)) — shrinking when nodes were lost, growing back toward
+        the spec size when capacity returned."""
+        num_instances = spec["multi_instance"]["num_instances"]
+        eff = int(entity.get(names.TASK_COL_GANG_SIZE)
+                  or num_instances)
+        min_inst = spec["multi_instance"].get("min_instances")
+        if not min_inst or int(min_inst) >= num_instances:
+            return eff, eff
+        live = self._count_live_nodes()
+        return eff, max(int(min_inst), min(num_instances, live))
+
+    def _emit_gang_resize(self, job_id: str, task_id: str,
+                          entity: dict, old_size: int,
+                          new_size: int, attempt: int) -> None:
+        goodput_events.emit(
+            self.store, self.identity.pool_id,
+            goodput_events.GANG_RESIZE, job_id=job_id,
+            task_id=task_id,
+            attrs={"old_size": old_size, "new_size": new_size,
+                   "spec_size":
+                       entity["spec"]["multi_instance"][
+                           "num_instances"],
+                   "attempt": attempt},
+            trace_id=entity.get(trace_context.COL_TRACE_ID),
+            span_id=entity.get(trace_context.COL_TRACE_SPAN))
+        trace_spans.emit(
+            self.store, self.identity.pool_id,
+            trace_spans.SPAN_GANG_RESIZE,
+            trace_context.TraceContext.from_entity(entity),
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            attrs={"old_size": old_size, "new_size": new_size,
+                   "attempt": attempt})
+        logger.warning(
+            "gang %s/%s re-forming at size %d (was %d) for attempt "
+            "%d", job_id, task_id, new_size, old_size, attempt)
+
+    def _count_live_nodes(self) -> int:
+        """Fresh, non-quarantined nodes of this pool — the capacity
+        an elastic gang can actually re-form on (the _node_alive
+        freshness rule, registration grace included)."""
+        now = time.time()
+        live = 0
+        for node in self.store.query_entities(
+                names.TABLE_NODES,
+                partition_key=self.identity.pool_id):
+            if node.get("state") in ("offline",):
+                continue
+            if node.get(names.NODE_COL_QUARANTINED):
+                continue
+            heartbeat = float(node.get("heartbeat_at", 0) or 0)
+            if heartbeat > 0:
+                fresh = now - heartbeat < self.node_stale_seconds
+            else:
+                registered = float(node.get("registered_at", 0) or 0)
+                fresh = (registered > 0 and
+                         now - registered < self.node_stale_seconds)
+            if fresh:
+                live += 1
+        return live
 
     # ----------------------- compile-cache hooks -----------------------
 
@@ -1330,7 +1676,8 @@ class NodeAgent:
                               spec: dict, retries: int,
                               exit_code: int, reason: str,
                               instances: Optional[int] = None,
-                              if_match: Optional[str] = None) -> bool:
+                              if_match: Optional[str] = None,
+                              extra: Optional[dict] = None) -> bool:
         """Retry supervisor requeue: bump the retry counter, stamp
         not_before (honored by the claim path; the queue message also
         carries the delay) and append the attempt to the diagnostics
@@ -1354,6 +1701,11 @@ class NodeAgent:
                 "attempt_history": self._append_attempt(
                     entity, exit_code, reason),
                 "node_id": None,
+                # A pending preempt request dies with the attempt it
+                # targeted: the failure requeue supersedes the drain
+                # (the next sweep re-elects victims from live state).
+                names.TASK_COL_PREEMPT_REQUEST: None,
+                **(extra or {}),
             }, if_match=if_match)
         except (EtagMismatchError, NotFoundError):
             return False
@@ -1477,13 +1829,22 @@ class NodeAgent:
             self._drop_live_proc(key, mine)
 
     def _note_task_outcome(self, ok: bool,
-                           wedged: bool = False) -> None:
+                           wedged: bool = False,
+                           neutral: bool = False) -> None:
         """Node health scoring: failures decay the score (wedges
         harder — a wedge usually implicates the node's accelerator
         state, not the task), successes recover it. Crossing the
         threshold quarantines the node: auto-drain via
         claim-exclusion (this agent stops claiming; observers read
-        the column). Recovery back above the threshold un-drains."""
+        the column). Recovery back above the threshold un-drains.
+
+        ``neutral=True`` skips scoring entirely: an EXTERNALLY-caused
+        exit (cooperative preemption, chaos preempt notice) says
+        nothing about this node's health — debiting it would let a
+        burst of scheduler preemptions quarantine perfectly healthy
+        nodes."""
+        if neutral:
+            return
         with self._health_lock:
             if ok:
                 self._health = min(1.0, self._health + 0.1)
@@ -1550,14 +1911,21 @@ class NodeAgent:
 
     def _claim_regular(self, job_id: str, task_id: str,
                        entity: dict) -> Optional[str]:
-        if entity.get("state") != "pending":
+        if entity.get("state") not in names.CLAIMABLE_TASK_STATES:
             return None
         if self.node_quarantined():
             return None
         try:
+            # preempted_at is consumed here: the claim closes the
+            # preemption-recovery interval (_goodput_work_started
+            # emits it from the pre-claim entity snapshot), and a
+            # LATER failure-requeue of this attempt must not re-open
+            # the old window.
             return self._merge_task(
                 job_id, task_id,
-                {"state": "assigned", "node_id": self.identity.node_id},
+                {"state": "assigned",
+                 "node_id": self.identity.node_id,
+                 names.TASK_COL_PREEMPTED_AT: None},
                 if_match=entity["_etag"])
         except (EtagMismatchError, NotFoundError):
             return None
@@ -1633,7 +2001,12 @@ class NodeAgent:
             self._merge_task(job_id, task_id,
                              {"output_error": str(exc)})
         ok = result.exit_code == 0
-        self._note_task_outcome(ok, wedged=result.wedged)
+        # The distinct preempted status: a cooperative drain is a
+        # scheduling transition, never a failure — full retry budget,
+        # no node-health debit, no backoff.
+        preempted = result.exit_code == preempt_mod.EXIT_PREEMPTED
+        self._note_task_outcome(ok, wedged=result.wedged,
+                                neutral=preempted)
         retries = entity.get("retries", 0)
         max_retries = spec.get("max_task_retries", 0)
         reason = ("wedged: no progress beat within "
@@ -1641,7 +2014,14 @@ class NodeAgent:
                   if result.wedged else
                   f"exit code {result.exit_code}")
         decision = ("complete" if ok
+                    else "preempted" if preempted
                     else self._retry_decision(retries, max_retries))
+        if decision == "preempted":
+            if self._requeue_preempted(job_id, task_id, spec):
+                self._heartbeat(state="idle")
+                self.store.delete_message(msg)
+                return
+            decision = self._retry_decision(retries, max_retries)
         if decision == "requeue":
             # Retry supervisor: exponential backoff + jitter, the
             # not_before stamp honored by every claimer.
@@ -1757,14 +2137,26 @@ class NodeAgent:
 
     # ------------------------ gang (MI) task path ----------------------
 
+    @staticmethod
+    def _gang_attempt(entity: dict) -> int:
+        """Rendezvous attempt index: retries + preempt_count. A
+        preempted requeue keeps the retry budget untouched but must
+        STILL re-form in a fresh partition — reusing the drained
+        attempt's partition would race its row cleanup against the
+        rerun's claims (a fast claimer could insert rows the
+        finalizer's clear then deletes, wedging the rendezvous)."""
+        return (int(entity.get("retries", 0) or 0)
+                + int(entity.get(names.TASK_COL_PREEMPT_COUNT, 0)
+                      or 0))
+
     def _gang_pk(self, job_id: str, task_id: str,
                  entity: dict) -> str:
-        """Attempt-namespaced gang partition: each recovery attempt
-        rendezvouses in a fresh partition (keyed on the task's retry
-        count), so a zombie member of a recovered gang can never
-        corrupt the rerun's rows (see names.gang_pk)."""
+        """Attempt-namespaced gang partition: each recovery attempt —
+        retry OR preemption — rendezvouses in a fresh partition, so a
+        zombie member of a recovered gang can never corrupt the
+        rerun's rows (see names.gang_pk)."""
         return names.gang_pk(self.identity.pool_id, job_id, task_id,
-                             attempt=int(entity.get("retries", 0)))
+                             attempt=self._gang_attempt(entity))
 
     def _gang_claim(self, gang_pk: str, instance: int) -> bool:
         """Claim gang instance k for this node. One instance per node:
@@ -1976,23 +2368,24 @@ class NodeAgent:
             if (entity is not None
                     and entity.get("state")
                     not in names.TERMINAL_TASK_STATES
-                    and int(entity.get("retries", 0)) <= attempt):
+                    and self._gang_attempt(entity) <= attempt):
                 # Live (or future) rendezvous attempt — not garbage.
                 continue
             logger.warning("sweeping orphaned gang rows in %s", pk)
             self._clear_gang_rows(pk)
 
     def _clear_gang_history(self, job_id: str, task_id: str,
-                            retries: int) -> None:
+                            attempts: int) -> None:
         """Retire EVERY attempt's rendezvous partition once the task
         is terminal. An earlier attempt can leak rows when its
         cleanup was cut short mid-flight (a store fault between the
         requeue transition and its clear, or a claim whose second
         insert failed): nothing retries those clears, so the
-        terminal transition sweeps attempts 0..retries to
-        self-repair. Best-effort per partition — a fault here leaves
-        at most what was already leaked."""
-        for attempt in range(retries + 1):
+        terminal transition sweeps attempts 0..attempts (the combined
+        retries+preempt_count index, _gang_attempt) to self-repair.
+        Best-effort per partition — a fault here leaves at most what
+        was already leaked."""
+        for attempt in range(attempts + 1):
             pk = names.gang_pk(self.identity.pool_id, job_id,
                                task_id, attempt=attempt)
             try:
@@ -2023,40 +2416,55 @@ class NodeAgent:
             return
         retries = int(entity.get("retries", 0))
         if entity.get("state") in names.TERMINAL_TASK_STATES or \
-                retries != attempt:
+                self._gang_attempt(entity) != attempt:
             # Terminally resolved, or a peer already recovered this
-            # attempt (every recovery bumps the retry counter — state
-            # alone can't discriminate: a gang broken during
-            # FORMATION is still legitimately "pending").
+            # attempt (every recovery bumps the combined attempt
+            # index — state alone can't discriminate: a gang broken
+            # during FORMATION is still legitimately "pending").
             self.store.delete_message(msg)
             return
         spec = entity["spec"]
         max_retries = spec.get("max_task_retries", 0)
         num_instances = spec["multi_instance"]["num_instances"]
+        # Elastic resize: the rerun re-forms at whatever the pool can
+        # actually supply — shrinking when nodes were lost, growing
+        # back toward the spec size when capacity returned. The
+        # rerun's restore re-shards the committed checkpoint onto the
+        # new mesh (parallel/sharding.reshard_on_restore).
+        eff_size, new_size = self._elastic_size(spec, entity)
         reason = f"gang member(s) lost: {dead}"
         decision = self._retry_decision(retries, max_retries)
         logger.warning("gang %s/%s lost member(s) %s; %s",
                        job_id, task_id, dead,
-                       "requeuing from committed checkpoint"
+                       (f"requeuing at size {new_size} from "
+                        f"committed checkpoint")
                        if decision == "requeue"
                        else "retry budget exhausted")
         if decision == "requeue":
             if self._requeue_with_backoff(
                     job_id, task_id, spec, retries + 1, -4, reason,
-                    instances=num_instances,
-                    if_match=entity["_etag"]):
+                    instances=new_size,
+                    if_match=entity["_etag"],
+                    extra={names.TASK_COL_GANG_SIZE:
+                           new_size if new_size != num_instances
+                           else None}):
                 goodput_events.emit(
                     self.store, self.identity.pool_id,
                     goodput_events.NODE_PREEMPTED, job_id=job_id,
                     task_id=task_id,
                     attrs={"dead_nodes": dead, "gang": True})
+                if new_size != eff_size:
+                    self._emit_gang_resize(job_id, task_id, entity,
+                                           eff_size, new_size,
+                                           retries + 1)
                 self._clear_gang_rows(gang_pk)
         elif decision == "quarantine":
             # A configured budget got burned: poison quarantine with
             # the diagnostics bundle.
             if self._quarantine_task(job_id, task_id, -4, reason,
                                      if_match=entity["_etag"]):
-                self._clear_gang_history(job_id, task_id, retries)
+                self._clear_gang_history(job_id, task_id,
+                                         self._gang_attempt(entity))
                 self._maybe_autocomplete_job(job_id)
         else:
             # No retry budget configured (max_task_retries=0): the
@@ -2070,14 +2478,26 @@ class NodeAgent:
             except (EtagMismatchError, NotFoundError):
                 self.store.delete_message(msg)
                 return
-            self._clear_gang_history(job_id, task_id, retries)
+            self._clear_gang_history(job_id, task_id,
+                                     self._gang_attempt(entity))
             self._maybe_autocomplete_job(job_id)
         self.store.delete_message(msg)
 
     def _run_gang_instance(self, slot: int, job_id: str, task_id: str,
                            entity: dict, instance: int, msg) -> None:
         spec = entity["spec"]
-        num_instances = spec["multi_instance"]["num_instances"]
+        # Elastic resize: the CURRENT attempt's effective size may be
+        # below the spec's num_instances (gang_size stamped by
+        # _recover_broken_gang when nodes were lost).
+        num_instances = int(
+            entity.get(names.TASK_COL_GANG_SIZE)
+            or spec["multi_instance"]["num_instances"])
+        if instance >= num_instances:
+            # Stale message from a larger pre-resize attempt: this
+            # instance index no longer exists at the current size —
+            # joining would corrupt the smaller rendezvous.
+            self.store.delete_message(msg)
+            return
         gang_pk = self._gang_pk(job_id, task_id, entity)
         if not self._gang_claim(gang_pk, instance):
             # This node can't take this instance. Probe gang health at
@@ -2103,7 +2523,7 @@ class NodeAgent:
                 if stale:
                     self._recover_broken_gang(
                         job_id, task_id, gang_pk, stale, msg,
-                        attempt=int(entity.get("retries", 0)))
+                        attempt=self._gang_attempt(entity))
                     return
             # Otherwise make the message promptly available for other
             # nodes.
@@ -2147,12 +2567,13 @@ class NodeAgent:
                 if stale:
                     self._recover_broken_gang(
                         job_id, task_id, gang_pk, stale, msg,
-                        attempt=int(entity.get("retries", 0)))
+                        attempt=self._gang_attempt(entity))
                     self._goodput_work_done(slot)
                     return
                 last_stale_check = time.monotonic()
             if time.monotonic() > deadline:
                 retries = int(entity.get("retries", 0))
+                attempt = self._gang_attempt(entity)
                 try:
                     fresh = self._task_entity(job_id, task_id)
                 except NotFoundError:
@@ -2160,7 +2581,38 @@ class NodeAgent:
                 if (fresh is not None
                         and fresh.get("state")
                         not in names.TERMINAL_TASK_STATES
-                        and int(fresh.get("retries", 0)) == retries):
+                        and self._gang_attempt(fresh) == attempt):
+                    # Elastic gang stuck in FORMATION because the
+                    # pool shrank below its size (members that never
+                    # joined have no stale row to observe): re-form
+                    # at what the pool can supply instead of failing
+                    # — the resize analog of _recover_broken_gang.
+                    eff_size, new_size = self._elastic_size(
+                        spec, fresh)
+                    if (new_size != eff_size
+                            and self._retry_decision(
+                                retries,
+                                spec.get("max_task_retries", 0))
+                            == "requeue"):
+                        if self._requeue_with_backoff(
+                                job_id, task_id, spec, retries + 1,
+                                -1, "gang rendezvous timeout "
+                                    "(resizing)",
+                                instances=new_size,
+                                if_match=fresh["_etag"],
+                                extra={names.TASK_COL_GANG_SIZE:
+                                       new_size
+                                       if new_size != spec[
+                                           "multi_instance"][
+                                           "num_instances"]
+                                       else None}):
+                            self._emit_gang_resize(
+                                job_id, task_id, fresh, eff_size,
+                                new_size, retries + 1)
+                            self._clear_gang_rows(gang_pk)
+                        self.store.delete_message(msg)
+                        self._goodput_work_done(slot)
+                        return
                     try:
                         self._merge_task(job_id, task_id, {
                             "state": "failed", "exit_code": -1,
@@ -2176,7 +2628,7 @@ class NodeAgent:
                         return
                     # Terminal: retire the rendezvous rows now, not
                     # at the janitor's next leader pass.
-                    self._clear_gang_history(job_id, task_id, retries)
+                    self._clear_gang_history(job_id, task_id, attempt)
                 self.store.delete_message(msg)
                 self._goodput_work_done(slot)
                 return
@@ -2207,12 +2659,15 @@ class NodeAgent:
             start=rendezvous_started, end=time.time(),
             attrs={"instance": instance,
                    "gang_size": num_instances,
-                   "attempt": int(entity.get("retries", 0))})
+                   "attempt": self._gang_attempt(entity)})
         if instance == 0:
             try:
                 self._merge_task(job_id, task_id, {
                     "state": "running",
-                    "started_at": util.datetime_utcnow_iso()})
+                    "started_at": util.datetime_utcnow_iso(),
+                    # Recovery interval closed by this attempt (the
+                    # gang analog of _claim_regular's clear).
+                    names.TASK_COL_PREEMPTED_AT: None})
             except NotFoundError:
                 pass
         gang_members = [
@@ -2224,7 +2679,8 @@ class NodeAgent:
             for m in sorted(self._gang_members(gang_pk),
                             key=lambda e: int(e["_rk"][1:]))]
         me = next(m for m in gang_members if m.instance == instance)
-        mi = _mi_settings_from_spec(spec["multi_instance"])
+        mi = _mi_settings_from_spec(spec["multi_instance"],
+                                    num_instances=num_instances)
         gang_env = launcher.synthesize_gang_env(
             gang_members, me, mi, self.pool)
         with self._message_keepalive(msg):
@@ -2292,8 +2748,9 @@ class NodeAgent:
             finally:
                 with self._running_lock:
                     self._running_tasks -= 1
-        self._note_task_outcome(result.exit_code == 0,
-                                wedged=result.wedged)
+        self._note_task_outcome(
+            result.exit_code == 0, wedged=result.wedged,
+            neutral=result.exit_code == preempt_mod.EXIT_PREEMPTED)
         try:
             self.store.merge_entity(
                 names.TABLE_GANGS, gang_pk, f"i{instance}",
@@ -2345,27 +2802,68 @@ class NodeAgent:
             return
         # First nonzero wins (max() would mask negative signal-kill
         # codes behind a zero).
-        exit_code = next(
-            (m.get("exit_code", 0) for m in done
-             if m.get("exit_code", 0) != 0), 0)
+        nonzero = [m.get("exit_code", 0) for m in done
+                   if m.get("exit_code", 0) != 0]
+        exit_code = nonzero[0] if nonzero else 0
+        # A gang is preempted only when EVERY nonzero member drained
+        # cooperatively: one real failure among the 75s is a failure
+        # (the retry supervisor's budget applies), not a preemption.
+        if nonzero and all(c == preempt_mod.EXIT_PREEMPTED
+                           for c in nonzero):
+            exit_code = preempt_mod.EXIT_PREEMPTED
+        elif exit_code == preempt_mod.EXIT_PREEMPTED:
+            exit_code = next(c for c in nonzero
+                             if c != preempt_mod.EXIT_PREEMPTED)
         try:
             entity = self._task_entity(job_id, task_id)
         except NotFoundError:
             return
         if entity.get("state") in names.TERMINAL_TASK_STATES or \
-                entity.get("state") == "pending":
+                entity.get("state") in names.CLAIMABLE_TASK_STATES:
+            # Terminal, or already requeued (pending/preempted) by a
+            # concurrent recoverer — nothing left to aggregate.
             return
         spec = entity["spec"]
         retries = int(entity.get("retries", 0))
         max_retries = spec.get("max_task_retries", 0)
         decision = ("complete" if exit_code == 0
+                    else "preempted"
+                    if exit_code == preempt_mod.EXIT_PREEMPTED
                     else self._retry_decision(retries, max_retries))
+        if decision == "preempted":
+            # The whole gang drained cooperatively (every member ran
+            # the same preempt-aware program): requeue all instances
+            # at full budget. The effective size is preserved — a
+            # resized gang stays at its size until a recovery path
+            # recomputes it from live capacity.
+            if self._requeue_preempted(job_id, task_id, spec,
+                                       instances=num_instances):
+                self._clear_gang_rows(gang_pk)
+                return
+            # No pending request (spurious 75) or a lost merge: the
+            # retry supervisor prices it like any failure.
+            decision = self._retry_decision(retries, max_retries)
         if decision == "requeue":
+            # The rerun's size follows live capacity too: a gang
+            # whose members were killed by dying nodes finalizes with
+            # their exit codes (the nodes' threads flushed them
+            # before dying), and requeuing at the spec size onto a
+            # shrunken pool would wedge the rendezvous.
+            eff_size, new_size = self._elastic_size(spec, entity)
             if self._requeue_with_backoff(
                     job_id, task_id, spec, retries + 1, exit_code,
                     f"gang exit code {exit_code}",
-                    instances=num_instances,
-                    if_match=entity["_etag"]):
+                    instances=new_size,
+                    if_match=entity["_etag"],
+                    extra={names.TASK_COL_GANG_SIZE:
+                           new_size
+                           if new_size != spec["multi_instance"][
+                               "num_instances"]
+                           else None}):
+                if new_size != eff_size:
+                    self._emit_gang_resize(job_id, task_id, entity,
+                                           eff_size, new_size,
+                                           retries + 1)
                 self._clear_gang_rows(gang_pk)
             return
         if decision == "quarantine":
@@ -2373,7 +2871,8 @@ class NodeAgent:
                     job_id, task_id, exit_code,
                     f"gang exit code {exit_code}",
                     if_match=entity["_etag"]):
-                self._clear_gang_history(job_id, task_id, retries)
+                self._clear_gang_history(job_id, task_id,
+                                         self._gang_attempt(entity))
             return
         try:
             self._merge_task(job_id, task_id, {
@@ -2387,7 +2886,8 @@ class NodeAgent:
         # so no gang rows outlive their task (the drill's
         # no-orphaned-state invariant). Late zombie members of this
         # attempt get NotFoundError on their done-merge and bow out.
-        self._clear_gang_history(job_id, task_id, retries)
+        self._clear_gang_history(job_id, task_id,
+                                 self._gang_attempt(entity))
 
     # --------------------------- helpers -------------------------------
 
@@ -2522,6 +3022,23 @@ class NodeAgent:
             env.setdefault(
                 progress_mod.PROGRESS_DEADLINE_ENV,
                 str(spec["progress_deadline_seconds"]))
+        # Cooperative-preemption contract: the heartbeat loop drops a
+        # preempt request here; instrumented workloads poll it each
+        # step (PreemptWatcher), drain, force-commit, and exit
+        # EXIT_PREEMPTED.
+        env.setdefault(
+            preempt_mod.PREEMPT_REQUEST_FILE_ENV,
+            os.path.join(task_dir.rstrip("/"),
+                         "preempt_request.json"))
+        # A request file left by a PREVIOUS attempt must not drain
+        # the new one on its first step: the request was consumed by
+        # the attempt it preempted (the .delivered marker keeps the
+        # heartbeat loop from re-dropping that requested_at), so the
+        # rerun starts clean.
+        try:
+            os.remove(env[preempt_mod.PREEMPT_REQUEST_FILE_ENV])
+        except OSError:
+            pass
         # Distributed-trace contract: the task row's context is
         # exported so every program span/goodput event the process
         # records parents under the task's run span; the JSONL span
@@ -3171,10 +3688,18 @@ class NodeAgent:
                     "type": "job_release", "job_id": job_id}).encode())
 
 
-def _mi_settings_from_spec(mi_spec: dict) -> MultiInstanceSettings:
+def _mi_settings_from_spec(mi_spec: dict,
+                           num_instances: Optional[int] = None
+                           ) -> MultiInstanceSettings:
+    """``num_instances`` overrides the spec's size — the elastic
+    resize path runs the gang at the attempt's EFFECTIVE size, and
+    the synthesized jax-distributed env must agree with the actual
+    rendezvous width."""
     jd = mi_spec.get("jax_distributed", {})
     return MultiInstanceSettings(
-        num_instances=mi_spec["num_instances"],
+        num_instances=(num_instances if num_instances is not None
+                       else mi_spec["num_instances"]),
+        min_instances=mi_spec.get("min_instances"),
         coordination_command=mi_spec.get("coordination_command"),
         resource_files=tuple(mi_spec.get("resource_files", [])),
         jax_distributed=JaxDistributedSettings(

@@ -1,0 +1,135 @@
+"""Cooperative preemption: the process-side contract.
+
+Priority within the queue bands is numeric (jobs/manager stamps
+``spec["priority"]``), but a queue can only order WAITING work — when a
+higher-priority task cannot place because lower-priority work holds
+every slot, the scheduler must take slots back. Hard kills would work
+(PR 5's chaos engine proves recovery survives them) but every hard
+kill pays the full preemption-recovery badput leg: lost steps since
+the last checkpoint plus a cold restart. Cooperative preemption
+bounds that cost: the victim is asked to stop, drains to its next
+step boundary, forces a COMMITTED checkpoint, and exits with a
+distinct status — so the rerun resumes with ZERO lost steps beyond
+the last barrier and the only badput is the requeue wait.
+
+The delivery channel is the profile-request channel from the tracing
+layer: the preempt sweep (agent/node_agent.py, leader-gated) stamps
+``preempt_request`` on the victim task's entity; every agent's
+heartbeat loop drops the request as a JSON file into its live tasks'
+dirs (launch-path env: $SHIPYARD_PREEMPT_REQUEST_FILE); instrumented
+workloads poll the file once per step (one os.stat while disarmed)
+via PreemptWatcher — typically through
+``checkpoint.TrainCheckpointer.maybe_preempt``.
+
+Exit contract: a preempted task exits EXIT_PREEMPTED (75, EX_TEMPFAIL
+— "temporary failure, retry"). The agent recognizes the code and
+requeues at FULL retry budget with node health untouched: preemption
+is a scheduling decision, never a task failure or a node's fault.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
+# Env var the agent exports into every task: where a preempt request
+# lands. With no sink configured the watcher is a no-op, so workloads
+# run unchanged outside pools (the progress/goodput recorder rule).
+PREEMPT_REQUEST_FILE_ENV = "SHIPYARD_PREEMPT_REQUEST_FILE"
+
+# The distinct preempted exit status (EX_TEMPFAIL): the agent treats
+# this code as "drained cooperatively — requeue at full budget", never
+# as a failure. Chosen from sysexits so an uninstrumented shell task
+# can participate with a plain `exit 75`.
+EXIT_PREEMPTED = 75
+
+
+def request_path() -> Optional[str]:
+    """The preempt-request file for THIS process, or None."""
+    return os.environ.get(PREEMPT_REQUEST_FILE_ENV) or None
+
+
+def write_request(path: str, reason: str = "",
+                  requested_at: Optional[str] = None,
+                  **extra) -> None:
+    """Drop one preempt request file (atomic: tmp + rename, so a
+    watcher can never read a torn JSON). Used by the agent's delivery
+    loop and the chaos node_preempt_notice injector."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"requested_at": requested_at
+               or util.datetime_utcnow_iso(),
+               "reason": reason, **extra}
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(payload))
+    os.replace(tmp, path)
+
+
+def read_request(path: str) -> Optional[dict]:
+    """Parse a request file; None when absent or (transiently) torn."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else {}
+
+
+class PreemptWatcher:
+    """Per-step preempt poll for workload loops.
+
+    ``poll()`` costs one os.path.exists while disarmed; the first call
+    that sees the request file parses, LATCHES, and returns it — later
+    calls return None so a loop that keeps polling mid-drain cannot
+    trigger a second drain. The file is left in place: the agent's
+    per-(path, requested_at) dedup marker already prevents re-delivery
+    after the harness consumed it, and keeping it makes the consumed
+    request inspectable post-mortem."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._path = path if path is not None else request_path()
+        self._consumed = False
+
+    @property
+    def armed(self) -> bool:
+        return self._path is not None and not self._consumed
+
+    def poll(self) -> Optional[dict]:
+        """The pending preempt request, exactly once, else None."""
+        if self._path is None or self._consumed:
+            return None
+        if not os.path.exists(self._path):
+            return None
+        request = read_request(self._path)
+        if request is None:
+            # Torn write in flight (the writer is atomic, but a
+            # foreign/manual drop may not be): retry next poll.
+            return None
+        self._consumed = True
+        logger.warning("preempt request received (%s); draining to "
+                       "the next step boundary",
+                       request.get("reason") or "no reason given")
+        return request
+
+
+def preempt_requested() -> bool:
+    """One-shot convenience for simple loops (no latch semantics)."""
+    path = request_path()
+    return bool(path and os.path.exists(path))
+
+
+def wait_for_request(path: str, timeout: float,
+                     poll_interval: float = 0.05) -> Optional[dict]:
+    """Block until a request file appears (test/drill helper)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return read_request(path)
+        time.sleep(poll_interval)
+    return None

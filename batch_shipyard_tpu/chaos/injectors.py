@@ -163,6 +163,61 @@ def apply_injection(injection: Injection, substrate,
 
             threading.Thread(target=_revive, daemon=True,
                              name="chaos-revive").start()
+    elif injection.kind == "node_preempt_notice":
+        # Advance-notice preemption (the cloud spot shape): stamp a
+        # cooperative preempt request on a RUNNING task, give the
+        # workload the notice window to drain + commit + exit
+        # EXIT_PREEMPTED, then follow through with the hard node
+        # crash only if the task is still live — exactly what a
+        # provider does when the notice lapses.
+        victim = _pick_live_proc(agents, preferred=agent)
+        deadline = time.monotonic() + 2.0
+        while victim is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            victim = _pick_live_proc(
+                _live_agents(substrate, pool_id), preferred=None)
+        if victim is None:
+            return record
+        node, _proc = victim
+        # Resolve the (job, task) of the victim's live proc.
+        live = list(node._live_procs.items())
+        if not live:
+            return record
+        (job_id, task_id), proc = live[0]
+        record["node_id"] = node.identity.node_id
+        record["job_id"] = job_id
+        record["task_id"] = task_id
+        from batch_shipyard_tpu.jobs import manager as jobs_mgr
+        stamped = jobs_mgr.request_preemption(
+            node.store, pool_id, job_id, task_id,
+            reason="chaos node_preempt_notice")
+        record["applied"] = bool(stamped)
+        if not stamped:
+            return record
+        notice = injection.param("notice", 0.6)
+        revive_after = injection.param("revive_after", 0.5)
+
+        def _follow_through():
+            # The notice is about THIS attempt's process vacating:
+            # once the stamped proc exits (cooperative drain), the
+            # kill is withheld — even if a requeued rerun has already
+            # reclaimed the same (job, task) key on this node.
+            deadline = time.monotonic() + notice
+            while time.monotonic() < deadline:
+                if node._live_procs.get((job_id, task_id)) is not \
+                        proc:
+                    return  # drained cooperatively: no hard kill
+                time.sleep(0.05)
+            if node._live_procs.get((job_id, task_id)) is not proc:
+                return
+            context = substrate.crash_node(pool_id,
+                                           node.identity.node_id)
+            if context is not None:
+                time.sleep(revive_after)
+                substrate.revive_node(pool_id, context)
+
+        threading.Thread(target=_follow_through, daemon=True,
+                         name="chaos-preempt-notice").start()
     return record
 
 

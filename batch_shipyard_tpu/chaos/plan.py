@@ -30,8 +30,16 @@ from typing import Optional
 #                        watchdog can catch it)
 #   node_preempt       — hard-kill a node agent (no offline write),
 #                        revive after a delay
+#   node_preempt_notice — ADVANCE-NOTICE preemption (the cloud
+#                        spot/preemptible shape): stamp a cooperative
+#                        preempt request on the node's running task,
+#                        then crash the node after the notice window
+#                        if the task is still running — a
+#                        preempt-aware workload drains and exits
+#                        first; an oblivious one eats the hard kill
 INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
-                   "task_kill", "task_wedge", "node_preempt")
+                   "task_kill", "task_wedge", "node_preempt",
+                   "node_preempt_notice")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +99,11 @@ class ChaosPlan:
                                               3)}
                 elif kind == "node_preempt":
                     params = {"revive_after":
+                              round(rng.uniform(0.3, 1.0), 3)}
+                elif kind == "node_preempt_notice":
+                    params = {"notice":
+                              round(rng.uniform(0.4, 1.2), 3),
+                              "revive_after":
                               round(rng.uniform(0.3, 1.0), 3)}
                 out.append(Injection(
                     at=at, kind=kind, node_index=node_index,

@@ -506,6 +506,21 @@ def jobs_profile(click_ctx, job_id, steps):
     fleet.action_jobs_profile(_ctx(click_ctx), job_id, steps=steps)
 
 
+@jobs.command("preempt")
+@click.argument("job_id")
+@click.argument("task_id")
+@click.option("--reason", default="",
+              help="Recorded on the preempt notice (diagnostics)")
+@click.pass_context
+def jobs_preempt(click_ctx, job_id, task_id, reason):
+    """Cooperatively preempt a running task: it drains to its next
+    step boundary, forces a COMMITTED checkpoint, and exits with the
+    distinct preempted status — requeued at FULL retry budget with
+    node health untouched (the preempt sweep's manual override)."""
+    fleet.action_jobs_preempt(_ctx(click_ctx), job_id, task_id,
+                              reason=reason)
+
+
 @jobs.command("schedule")
 @click.option("--once", is_flag=True, default=False,
               help="Evaluate due schedules once and exit")
@@ -734,15 +749,22 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
               help="Comma-separated injection kinds, default all: "
                    + ",".join(chaos_plan_mod.INJECTION_KINDS))
 @click.option("--injections-per-kind", type=int, default=1)
+@click.option("--preempt", is_flag=True, default=False,
+              help="Run the preemption drill instead: a seeded "
+                   "node_preempt_notice schedule against a running "
+                   "4-node gang — cooperative drain, forced "
+                   "COMMITTED checkpoint, zero lost steps, retry "
+                   "budget and node health untouched")
 @click.pass_context
 def chaos_drill(click_ctx, seed, tasks, duration, kinds,
-                injections_per_kind):
+                injections_per_kind, preempt):
     """Run the seeded drill on a local fakepod pool and assert the
     recovery invariants (nonzero exit = a self-healing regression)."""
     fleet.action_chaos_drill(
         None, seed, tasks=tasks, duration=duration,
         kinds=_parse_kinds(kinds),
         injections_per_kind=injections_per_kind,
+        preempt=preempt,
         raw=click_ctx.obj["raw"])
 
 

@@ -458,6 +458,11 @@ class MultiInstanceSettings:
     resource_files: tuple[dict, ...]
     jax_distributed: JaxDistributedSettings
     pytorch_xla: bool
+    # Elastic gang floor: a gang that loses nodes may re-form at any
+    # surviving size >= min_instances (resumed state is re-sharded
+    # onto the smaller mesh by parallel/sharding.reshard_on_restore).
+    # None = rigid gang (the historical contract): all-or-nothing.
+    min_instances: Optional[int] = None
 
     def resolve_num_instances(self, pool: PoolSettings) -> int:
         if isinstance(self.num_instances, int):
@@ -484,6 +489,10 @@ class TaskSettings:
     depends_on_range: Optional[tuple[int, int]]
     max_task_retries: int
     max_wall_time_seconds: Optional[int]
+    # Numeric scheduling priority WITHIN the job's queue band: the
+    # preempt sweep compares these to elect victims (higher pending
+    # beats lower running). Defaults to the job's priority.
+    priority: int
     # Wedge watchdog opt-in: kill + requeue the task when it emits no
     # progress beat ($SHIPYARD_PROGRESS_FILE) for this long.
     progress_deadline_seconds: Optional[int]
@@ -625,6 +634,7 @@ def task_settings(task: dict, job: JobSettings,
         raw_mi = _get(task, "multi_instance")
         mi = MultiInstanceSettings(
             num_instances=_get(raw_mi, "num_instances", default=1),
+            min_instances=_get(raw_mi, "min_instances"),
             coordination_command=_get(raw_mi, "coordination_command"),
             resource_files=tuple(
                 _get(raw_mi, "resource_files", default=[])),
@@ -662,6 +672,7 @@ def task_settings(task: dict, job: JobSettings,
             task, "max_task_retries", default=job.max_task_retries),
         max_wall_time_seconds=_get(
             task, "max_wall_time_seconds", default=job.max_wall_time_seconds),
+        priority=_get(task, "priority", default=job.priority),
         progress_deadline_seconds=_get(task,
                                        "progress_deadline_seconds"),
         retention_time_seconds=_get(task, "retention_time_seconds"),

@@ -645,6 +645,24 @@ def action_jobs_profile(ctx: Context, job_id: str,
     return request
 
 
+def action_jobs_preempt(ctx: Context, job_id: str, task_id: str,
+                        reason: str = "") -> bool:
+    """`jobs preempt`: stamp a cooperative preempt request on a
+    running task (the preempt sweep's manual override). The owning
+    node delivers it over the heartbeat path; an instrumented
+    workload drains to its next step boundary, forces a COMMITTED
+    checkpoint, and exits with the distinct preempted status —
+    requeued at FULL retry budget, node health untouched."""
+    ok = jobs_mgr.request_preemption(
+        ctx.store, ctx.pool.id, job_id, task_id,
+        reason=reason or "operator request (jobs preempt)")
+    _emit({"job_id": job_id, "task_id": task_id, "requested": ok})
+    if not ok:
+        logger.warning("task %s/%s is not in a preemptible state",
+                       job_id, task_id)
+    return ok
+
+
 def action_trace_show(ctx: Context, trace_id: str,
                       raw: bool = False) -> dict:
     """`trace show <trace_id>`: terminal waterfall of one
@@ -742,16 +760,27 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                        duration: float = 4.0,
                        kinds: Optional[tuple[str, ...]] = None,
                        injections_per_kind: int = 1,
+                       preempt: bool = False,
                        raw: bool = False) -> dict:
     """Run a seeded chaos drill against a self-contained fakepod pool
     (chaos/drill.py) and report the recovery invariants: every task
     completed exactly once, no orphaned gang rows or queue messages,
     goodput partition exact. Raises on any violated invariant, so a
-    nonzero exit IS the regression signal."""
+    nonzero exit IS the regression signal.
+
+    ``preempt=True`` runs the PREEMPTION drill instead: a seeded
+    node_preempt_notice schedule against a running 4-node gang —
+    cooperative drain, forced COMMITTED checkpoint, zero lost steps,
+    retry budget + node health untouched, preemption_recovery
+    populated."""
     from batch_shipyard_tpu.chaos import drill
-    report = drill.run_drill(
-        seed=seed, tasks=tasks, duration=duration, kinds=kinds,
-        injections_per_kind=injections_per_kind)
+    if preempt:
+        report = drill.run_preemption_drill(seed=seed,
+                                            duration=duration)
+    else:
+        report = drill.run_drill(
+            seed=seed, tasks=tasks, duration=duration, kinds=kinds,
+            injections_per_kind=injections_per_kind)
     _emit({"seed": report["seed"],
            "fingerprint": report["fingerprint"],
            "invariants": report["invariants"],

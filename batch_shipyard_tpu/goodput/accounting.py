@@ -61,6 +61,10 @@ _KIND_CATEGORY = {
     ev.NODE_PREEMPTED: "provisioning",   # reclaim -> re-provision time
     ev.TASK_QUEUED: "queueing",
     ev.TASK_BACKOFF: "backoff",
+    # Preempted exit -> re-claim: the recovery leg every preemption
+    # pays (arxiv 2502.06982) — outranks queueing in the sweep, like
+    # backoff, so the wait is charged to its more specific cause.
+    ev.TASK_PREEMPT_RECOVERY: "preemption_recovery",
     ev.TASK_IMAGE_PULL: "image_pull",
     ev.TASK_CONTAINER_START: "image_pull",
     ev.PROGRAM_COMPILE: "compile",

@@ -62,6 +62,23 @@ TASK_BACKOFF = "backoff"               # retry supervisor's deliberate
                                        # not_before); its own badput
                                        # category so retry waits never
                                        # land in "unaccounted"
+# Cooperative preemption (scheduler-driven; agent/preemption.py):
+TASK_PREEMPT_NOTICE = "preempt_notice"   # instantaneous: the sweep
+                                         # stamped a preempt request
+                                         # on a running victim
+TASK_PREEMPT_EXIT = "preempt_exit"       # instantaneous: the victim
+                                         # drained, committed, and
+                                         # exited EXIT_PREEMPTED
+TASK_PREEMPT_RECOVERY = "preempt_recovery"  # interval: preempted exit
+                                         # -> re-claim; priced as the
+                                         # preemption_recovery badput
+                                         # leg (arxiv 2502.06982) —
+                                         # emitted by the CLAIM side
+                                         # once the wait has elapsed,
+                                         # like TASK_BACKOFF
+# Elastic gang resize (instantaneous marker: a broken gang re-formed
+# at a new size; attrs carry old_size/new_size/live_nodes).
+GANG_RESIZE = "gang_resize"
 
 # Program phases (emitted from inside the workload process)
 PROGRAM_COMPILE = "compile"            # jit compile / warm-up steps
@@ -81,6 +98,8 @@ EVENT_KINDS = frozenset({
     NODE_PROVISIONING, NODE_PREP, NODE_IDLE, NODE_PREEMPTED,
     TASK_QUEUED, TASK_IMAGE_PULL, TASK_CONTAINER_START, TASK_RUNNING,
     TASK_RETRY, TASK_BACKOFF,
+    TASK_PREEMPT_NOTICE, TASK_PREEMPT_EXIT, TASK_PREEMPT_RECOVERY,
+    GANG_RESIZE,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,

@@ -74,14 +74,18 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "job_input_data": list(job.input_data),
         "auto_scratch": job.auto_scratch,
         "exit_options": dict(task.default_exit_options),
-        # Queue band for retry requeues (agents must put a retried
-        # high-priority task back on the high-priority band).
-        "priority": job.priority,
+        # Numeric priority: selects the queue band by sign (hi/lo
+        # drain order, and retry requeues must land back on the same
+        # band) and orders tasks WITHIN the band for the preempt
+        # sweep — a pending task with a strictly higher number can
+        # evict lower-priority running work.
+        "priority": task.priority,
     }
     if task.multi_instance is not None:
         mi = task.multi_instance
         spec["multi_instance"] = {
             "num_instances": mi.resolve_num_instances(pool),
+            "min_instances": mi.min_instances,
             "coordination_command": mi.coordination_command,
             "resource_files": list(mi.resource_files),
             "jax_distributed": {
@@ -306,8 +310,12 @@ def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
         store.insert_entities(names.TABLE_TASKS, rows)
         by_queue: dict[str, list[bytes]] = {}
         for task_id, spec in chunk:
-            queue = names.task_queue_for(pool_id, task_id, shards,
-                                         priority=priority)
+            # Per-task numeric priority routes the band (a task may
+            # override its job's priority); the job-level param is
+            # the legacy fallback for specs without one.
+            queue = names.task_queue_for(
+                pool_id, task_id, shards,
+                priority=int(spec.get("priority", priority) or 0))
             message = {"job_id": job_id, "task_id": task_id}
             if trace is not None:
                 message["trace_id"] = trace.trace_id
@@ -508,10 +516,14 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
         store.insert_entity(names.TABLE_TASKS, dst_pk, task["_rk"],
                             entity)
         store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
-        if entity.get("state") == "pending":
+        if entity.get("state") in names.CLAIMABLE_TASK_STATES:
+            # Per-task priority routes the band, same rule as
+            # submission — a hi-band task must not lose its drain
+            # precedence by migrating.
             dst_queue = names.task_queue_for(
                 dst_pool_id, task["_rk"], dst_shards,
-                priority=job_priority)
+                priority=int((entity.get("spec") or {}).get(
+                    "priority", job_priority) or 0))
             message = {"job_id": job_id, "task_id": task["_rk"]}
             if entity.get(trace_ctx.COL_TRACE_ID):
                 message["trace_id"] = entity[trace_ctx.COL_TRACE_ID]
@@ -554,7 +566,9 @@ def terminate_task(store: StateStore, pool_id: str, job_id: str,
     state = task.get("state")
     if state in names.TERMINAL_TASK_STATES:
         return
-    if state == "pending":
+    if state in names.CLAIMABLE_TASK_STATES:
+        # pending OR preempted-awaiting-reclaim: nothing is running,
+        # mark terminal directly.
         try:
             store.merge_entity(
                 names.TABLE_TASKS, names.task_pk(pool_id, job_id),
@@ -578,6 +592,48 @@ def terminate_task(store: StateStore, pool_id: str, job_id: str,
                 return
             time.sleep(0.2)
         raise TimeoutError(f"task {task_id} did not terminate")
+
+
+def request_preemption(store: StateStore, pool_id: str, job_id: str,
+                       task_id: str, reason: str = "",
+                       by_job_id: Optional[str] = None,
+                       by_task_id: Optional[str] = None) -> bool:
+    """Stamp a cooperative preempt request on a RUNNING task. The
+    owning node's agent heartbeat loop delivers it into the live task
+    dirs (every gang instance gets its copy); an instrumented workload
+    drains to its next step boundary, forces a COMMITTED checkpoint,
+    and exits EXIT_PREEMPTED — requeued at full retry budget. Returns
+    False when the task is not in a preemptible state (or a concurrent
+    transition won the merge). Idempotent: re-stamping an already
+    pending request is a no-op (one drain per request)."""
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    task = get_task(store, pool_id, job_id, task_id)
+    if task.get("state") not in ("assigned", "running"):
+        return False
+    if task.get(names.TASK_COL_PREEMPT_REQUEST):
+        return True  # already pending; one request, one drain
+    request = {
+        "requested_at": util.datetime_utcnow_iso(),
+        "reason": reason or "preempted by scheduler",
+        "by_job_id": by_job_id, "by_task_id": by_task_id,
+    }
+    try:
+        store.merge_entity(
+            names.TABLE_TASKS, names.task_pk(pool_id, job_id),
+            task_id, {names.TASK_COL_PREEMPT_REQUEST: request},
+            if_match=task["_etag"])
+    except (EtagMismatchError, NotFoundError):
+        return False
+    goodput_events.emit(
+        store, pool_id, goodput_events.TASK_PREEMPT_NOTICE,
+        job_id=job_id, task_id=task_id,
+        attrs={"reason": request["reason"],
+               "by_job_id": by_job_id, "by_task_id": by_task_id},
+        trace_id=task.get(trace_ctx.COL_TRACE_ID),
+        span_id=task.get(trace_ctx.COL_TRACE_SPAN))
+    logger.warning("preempt requested for %s/%s: %s", job_id, task_id,
+                   request["reason"])
+    return True
 
 
 def list_task_files(store: StateStore, pool_id: str, job_id: str,

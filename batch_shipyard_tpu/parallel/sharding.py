@@ -78,3 +78,80 @@ def place(mesh: Mesh, tree, spec_tree):
     """Device-put a pytree according to a spec tree."""
     shardings = to_shardings(mesh, spec_tree)
     return jax.device_put(tree, shardings)
+
+
+# ------------------------- reshard on restore ---------------------------
+
+def place_like(template, tree):
+    """Re-lay-out ``tree``'s leaves onto ``template``'s shardings and
+    dtypes (host round trip: works for ANY source layout, including
+    plain numpy and int8-quantized leaves — the dtype is preserved
+    bit-for-bit, never promoted through float)."""
+    import numpy as np
+
+    def _place(t, v):
+        if not hasattr(t, "sharding") or not hasattr(v, "shape"):
+            return v
+        arr = np.asarray(v)
+        if tuple(arr.shape) != tuple(t.shape):
+            raise ValueError(
+                f"reshard-on-restore shape mismatch: checkpoint leaf "
+                f"{arr.shape} vs template {t.shape} — global shapes "
+                f"are mesh-independent, so this checkpoint belongs "
+                f"to a different model config")
+        if arr.dtype != t.dtype:
+            arr = arr.astype(t.dtype)
+        return jax.device_put(arr, t.sharding)
+
+    return jax.tree_util.tree_map(_place, template, tree)
+
+
+def reshard_on_restore(checkpoint_dir: str, params_template,
+                       opt_state_template):
+    """Elastic resume: load the latest COMMITTED checkpoint — saved
+    at mesh size N — and re-shard params/opt-state onto the
+    templates' mesh (size M). Returns (params, opt_state, step) or
+    None when nothing is committed.
+
+    The mechanism is deliberately layout-agnostic: full arrays are
+    restored HOST-side against shape/dtype templates (no device
+    shardings handed to Orbax — the checkpoint's layout metadata may
+    describe a mesh that no longer exists), then laid out onto the
+    M-mesh shardings the templates carry. Global shapes are
+    mesh-independent, so N->M needs no tensor surgery — only a
+    re-placement. The equivalence oracle (tests/test_reshard_restore)
+    pins the contract: a resume-at-M loss trajectory matches a
+    fresh-at-M run restored from the same step."""
+    import numpy as np
+
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    from batch_shipyard_tpu.trace import spans as trace_spans
+    from batch_shipyard_tpu.workloads import checkpoint as ckpt_mod
+
+    step = ckpt_mod.latest_step(checkpoint_dir)
+    if step is None:
+        return None
+    path = ckpt_mod._step_path(checkpoint_dir, step)
+    template = {"params": params_template,
+                "opt_state": opt_state_template, "step": step}
+
+    def _host_leaf(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return np.zeros(leaf.shape, dtype=leaf.dtype)
+        return leaf
+
+    host_template = jax.tree_util.tree_map(_host_leaf, template)
+    import orbax.checkpoint as ocp
+    with goodput_events.phase(
+            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step,
+            resharded=True), \
+            trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
+                              step=step, resharded=True):
+        restored = ckpt_mod._checkpointer().restore(
+            path, item=host_template,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(
+                host_template))
+        params = place_like(params_template, restored["params"])
+        opt_state = place_like(opt_state_template,
+                               restored["opt_state"])
+    return params, opt_state, int(restored["step"])

@@ -66,7 +66,9 @@ def sample(store: StateStore, pool: PoolSettings,
             state = task.get("state")
             if state in ("running", "assigned"):
                 active += 1
-            elif state == "pending":
+            elif state in names.CLAIMABLE_TASK_STATES:
+                # pending + preempted-awaiting-reclaim: both are
+                # demand the pool has not yet placed.
                 pending += 1
     all_nodes = pool_mgr.list_nodes(store, pool.id)
     nodes = [n for n in all_nodes if n.state in pool_mgr.READY_STATES]

@@ -37,10 +37,21 @@ TABLE_REMOTEFS_NODES = "remotefs_nodes"  # pk=cluster_id, rk=node name
 # supervisor parks a task there after its retry budget is exhausted,
 # with a diagnostics bundle on the entity (agent/node_agent.py).
 TASK_STATE_QUARANTINED = "quarantined"
+# "preempted" is the cooperative-preemption waiting state: the task
+# drained to a step boundary, committed a checkpoint, and exited with
+# the distinct preempted status (agent/preemption.EXIT_PREEMPTED).
+# NON-terminal and claimable like "pending" — the requeue consumed no
+# retry budget, and the next claim restores from the forced commit.
+TASK_STATE_PREEMPTED = "preempted"
 TASK_STATES = ("pending", "assigned", "running", "completed",
-               "failed", "blocked", TASK_STATE_QUARANTINED)
+               "failed", "blocked", TASK_STATE_QUARANTINED,
+               TASK_STATE_PREEMPTED)
 TERMINAL_TASK_STATES = ("completed", "failed", "blocked",
                         TASK_STATE_QUARANTINED)
+# Task states a node may claim for execution: "preempted" is a
+# requeued-waiting state, not a failure — the claim path treats it
+# exactly like "pending".
+CLAIMABLE_TASK_STATES = ("pending", TASK_STATE_PREEMPTED)
 NODE_STATES = ("creating", "starting", "idle", "running", "offline",
                "unusable", "start_task_failed", "suspended",
                "preempted")
@@ -55,6 +66,23 @@ AUX_STATES = ("joined", "done", "active", "disabled", "terminated",
 # scorer, read by claim exclusion + heimdall gauges).
 NODE_COL_HEALTH = "health"
 NODE_COL_QUARANTINED = "quarantined"
+
+# Task-entity preemption columns (single-sourced: stamped by the
+# preempt sweep / chaos node_preempt_notice injector, delivered by the
+# agent heartbeat loop, cleared by the preempted requeue):
+#   preempt_request — {"requested_at", "reason", "by_job_id",
+#                      "by_task_id"} while a preempt is pending
+#   preempted_at    — epoch of the last preempted exit (the recovery
+#                     interval's start; cleared at next claim)
+#   preempt_count   — lifetime preemptions survived (never consumes
+#                     the retry budget)
+#   gang_size       — elastic gang override: the CURRENT attempt's
+#                     effective size when resized below the spec's
+#                     num_instances (absent = spec size)
+TASK_COL_PREEMPT_REQUEST = "preempt_request"
+TASK_COL_PREEMPTED_AT = "preempted_at"
+TASK_COL_PREEMPT_COUNT = "preempt_count"
+TASK_COL_GANG_SIZE = "gang_size"
 
 
 def task_pk(pool_id: str, job_id: str) -> str:

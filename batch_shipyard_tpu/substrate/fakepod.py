@@ -87,15 +87,20 @@ class FakePodSubstrate(base.ComputeSubstrate):
             internal_ip=f"10.{slice_index}.{worker_index // 256}."
                         f"{worker_index % 256 + 1}",
             slice_index=slice_index, worker_index=worker_index)
+        kwargs = {
+            "heartbeat_interval": self.heartbeat_interval,
+            "poll_interval": 0.05, "gang_timeout": 60.0,
+            "job_state_ttl": 0.2,
+            "node_stale_seconds": self.node_stale_seconds,
+            "nodeprep": self._nodeprep, "substrate": self,
+        }
+        # agent_kwargs may override ANY default (tests shrink
+        # gang_timeout/claim_visibility; drills tighten backoff).
+        kwargs.update(self.agent_kwargs)
         agent = NodeAgent(
             self.store, identity, pool,
             work_dir=os.path.join(self.work_root, pool.id, node_id),
-            heartbeat_interval=self.heartbeat_interval,
-            poll_interval=0.05, gang_timeout=60.0,
-            job_state_ttl=0.2,
-            node_stale_seconds=self.node_stale_seconds,
-            nodeprep=self._nodeprep, substrate=self,
-            **self.agent_kwargs)
+            **kwargs)
         import time as time_mod
         self.store.upsert_entity(
             names.TABLE_NODES, pool.id, node_id, {
@@ -321,13 +326,16 @@ class FakePodSubstrate(base.ComputeSubstrate):
 
     def revive_node(self, pool_id: str, context: dict) -> None:
         """Reboot a crashed node with the same identity."""
+        kwargs = {
+            "heartbeat_interval": self.heartbeat_interval,
+            "poll_interval": 0.05, "gang_timeout": 60.0,
+            "job_state_ttl": 0.2, "node_stale_seconds": 3.0,
+            "nodeprep": None, "substrate": self,
+        }
+        kwargs.update(self.agent_kwargs)
         revived = NodeAgent(
             self.store, context["identity"], context["pool"],
-            work_dir=context["work_dir"],
-            heartbeat_interval=self.heartbeat_interval,
-            poll_interval=0.05, gang_timeout=60.0,
-            job_state_ttl=0.2, node_stale_seconds=3.0,
-            nodeprep=None, substrate=self, **self.agent_kwargs)
+            work_dir=context["work_dir"], **kwargs)
         thread = threading.Thread(
             target=self._boot_agent, args=(revived,), daemon=True)
         with self._lock:

@@ -60,6 +60,10 @@ SPAN_RENDEZVOUS = "gang_rendezvous"      # gang join -> full formation
 SPAN_IMAGE_PULL = "image_pull"           # image provisioning on node
 SPAN_TASK_RUN = "task_run"               # task process start -> exit
 SPAN_CACHE_SEED = "compile_cache_seed"   # pre-task pool-cache seed
+SPAN_PREEMPT = "preempt"                 # preempt notice -> drained
+                                         # exit (cooperative window)
+SPAN_GANG_RESIZE = "gang_resize"         # instantaneous: broken gang
+                                         # re-formed at a new size
 
 # Program phases (process-local emitters inside the task)
 SPAN_COMPILE = "compile"                 # jit warm-up / AOT precompile
@@ -82,7 +86,7 @@ SPAN_SERVE_DECODE = "serve_decode"       # first token -> last token;
 SPAN_KINDS = frozenset({
     SPAN_SUBMIT, SPAN_QUEUE_WAIT, SPAN_CLAIM, SPAN_BACKOFF_WAIT,
     SPAN_REQUEUE, SPAN_RENDEZVOUS, SPAN_IMAGE_PULL, SPAN_TASK_RUN,
-    SPAN_CACHE_SEED,
+    SPAN_CACHE_SEED, SPAN_PREEMPT, SPAN_GANG_RESIZE,
     SPAN_COMPILE, SPAN_STEP_WINDOW, SPAN_CKPT_SNAPSHOT,
     SPAN_CKPT_PERSIST, SPAN_CKPT_RESTORE, SPAN_PROFILE,
     SPAN_SERVE_REQUEST, SPAN_SERVE_QUEUED, SPAN_SERVE_PREFILL,

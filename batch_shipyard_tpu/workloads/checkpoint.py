@@ -46,12 +46,14 @@ shows the persist without charging it as a stall.
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import shutil
 import threading
 from typing import Any, Optional
 
+from batch_shipyard_tpu.agent import preemption
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.trace import spans as trace_spans
 from batch_shipyard_tpu.utils import util
@@ -59,6 +61,13 @@ from batch_shipyard_tpu.utils import util
 logger = util.get_logger(__name__)
 
 COMMIT_MARKER = "COMMITTED"
+# Sidecar recording the mesh a checkpoint was SAVED on (axis sizes +
+# device count), written next to the COMMITTED marker. restore()
+# compares it against the restore templates' mesh: a mismatch routes
+# through the reshard-on-restore path (parallel/sharding.py) instead
+# of handing Orbax shardings the checkpoint never had. Absent on
+# legacy dirs and host-snapshot saves — those restore strictly.
+MESH_MARKER = "MESH"
 
 
 def _checkpointer():
@@ -86,6 +95,41 @@ def is_committed(checkpoint_dir: str, step: int) -> bool:
     return os.path.exists(_marker_path(checkpoint_dir, step))
 
 
+def _mesh_meta_path(checkpoint_dir: str, step: int) -> str:
+    return _step_path(checkpoint_dir, step) + "." + MESH_MARKER
+
+
+def mesh_meta_of(tree: Any) -> Optional[dict]:
+    """{"mesh_shape": {axis: size}, "mesh_devices": N} from the first
+    mesh-sharded leaf of a pytree, or None (host arrays / no mesh)."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tree):
+        mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            try:
+                return {"mesh_shape": {str(k): int(v)
+                                       for k, v in dict(shape).items()},
+                        "mesh_devices": int(
+                            max(1, len(mesh.devices.reshape(-1))))}
+            except Exception:  # noqa: BLE001 - metadata only
+                return None
+    return None
+
+
+def saved_mesh_meta(checkpoint_dir: str,
+                    step: int) -> Optional[dict]:
+    """The mesh a committed step was saved on (sidecar), or None for
+    legacy/host-snapshot saves."""
+    try:
+        with open(_mesh_meta_path(checkpoint_dir, step),
+                  encoding="utf-8") as fh:
+            meta = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return meta if isinstance(meta, dict) else None
+
+
 def _commit_barrier(step: int) -> None:
     """Multi-host commit barrier: every host's shards must be durable
     before process 0 stamps the marker — otherwise a crash between one
@@ -99,10 +143,13 @@ def _commit_barrier(step: int) -> None:
 
 
 def _persist_state(checkpoint_dir: str, step: int,
-                   state: dict) -> str:
+                   state: dict,
+                   mesh_meta: Optional[dict] = None) -> str:
     """The durable half of a save: staging dir → Orbax write →
     multi-host barrier → marker commit. Shared by the blocking
-    ``save()`` and the async writer thread."""
+    ``save()`` and the async writer thread. ``mesh_meta`` (the mesh
+    the state was sharded on at snapshot time) lands in the .MESH
+    sidecar so restore can detect a resize."""
     import jax
     path = _step_path(checkpoint_dir, step)
     staging = _staging_path(checkpoint_dir, step)
@@ -122,6 +169,17 @@ def _persist_state(checkpoint_dir: str, step: int,
         marker = _marker_path(checkpoint_dir, step)
         shutil.rmtree(path, ignore_errors=True)
         os.replace(staging, path)
+        if mesh_meta is None:
+            mesh_meta = mesh_meta_of(state.get("params"))
+        if mesh_meta:
+            # Sidecar BEFORE the marker: once committed, the mesh
+            # record is already durable (a crash between the two
+            # leaves an unmarked, ignored step).
+            meta_tmp = _mesh_meta_path(checkpoint_dir, step) + ".tmp"
+            with open(meta_tmp, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(mesh_meta))
+            os.replace(meta_tmp,
+                       _mesh_meta_path(checkpoint_dir, step))
         marker_tmp = marker + ".tmp"
         with open(marker_tmp, "w", encoding="utf-8") as fh:
             fh.write(util.datetime_utcnow_iso())
@@ -190,6 +248,10 @@ def retention_gc(checkpoint_dir: str, keep_last: int) -> list[int]:
             os.remove(_marker_path(checkpoint_dir, step))
         except OSError:
             pass
+        try:
+            os.remove(_mesh_meta_path(checkpoint_dir, step))
+        except OSError:
+            pass
         shutil.rmtree(_step_path(checkpoint_dir, step),
                       ignore_errors=True)
         logger.info("checkpoint retention: removed step %d from %s",
@@ -246,25 +308,63 @@ def restore_params(checkpoint_dir: str) -> Optional[tuple]:
 
 
 def restore(checkpoint_dir: str, params_template: Any,
-            opt_state_template: Any) -> Optional[tuple]:
+            opt_state_template: Any,
+            allow_reshard: bool = True) -> Optional[tuple]:
     """Restore the latest committed checkpoint matching the given
     pytree structure (shardings preserved from the templates); returns
-    (params, opt_state, step) or None when no checkpoint exists."""
+    (params, opt_state, step) or None when no checkpoint exists.
+
+    Elastic resume: when the checkpoint's .MESH sidecar records a
+    DIFFERENT mesh than the templates (a gang that re-formed at a new
+    size), the restore routes through the reshard-on-restore path
+    (parallel/sharding.py) — full arrays are read host-side and
+    re-laid-out onto the templates' shardings. A strict restore that
+    fails for any reason falls back the same way (legacy dirs with no
+    sidecar included), unless ``allow_reshard=False``."""
     step = latest_step(checkpoint_dir)
     if step is None:
         return None
     path = _step_path(checkpoint_dir, step)
+    if allow_reshard:
+        saved_mesh = (saved_mesh_meta(checkpoint_dir, step)
+                      or {}).get("mesh_shape")
+        current_mesh = (mesh_meta_of(params_template)
+                        or {}).get("mesh_shape")
+        if saved_mesh and current_mesh and saved_mesh != current_mesh:
+            from batch_shipyard_tpu.parallel import (
+                sharding as shard_rules)
+            logger.warning(
+                "checkpoint step %d was saved on mesh %s; "
+                "re-sharding onto %s", step, saved_mesh,
+                current_mesh)
+            return shard_rules.reshard_on_restore(
+                checkpoint_dir, params_template, opt_state_template)
     template = {"params": params_template,
                 "opt_state": opt_state_template, "step": step}
     import orbax.checkpoint as ocp
-    with goodput_events.phase(
-            goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step), \
-            trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
-                              step=step):
-        restored = _checkpointer().restore(
-            path, item=template,
-            restore_args=ocp.checkpoint_utils.construct_restore_args(
-                template))
+    try:
+        with goodput_events.phase(
+                goodput_events.PROGRAM_CHECKPOINT_RESTORE,
+                step=step), \
+                trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
+                                  step=step):
+            restored = _checkpointer().restore(
+                path, item=template,
+                restore_args=(
+                    ocp.checkpoint_utils.construct_restore_args(
+                        template)))
+    except Exception as exc:  # noqa: BLE001 - mesh-mismatch shapes
+        # vary by orbax version; the reshard path is the one recovery
+        # that works for all of them
+        if not allow_reshard:
+            raise
+        from batch_shipyard_tpu.parallel import (
+            sharding as shard_rules)
+        logger.warning(
+            "strict restore of step %d failed (%s); retrying via "
+            "the reshard-on-restore path", step, exc)
+        return shard_rules.reshard_on_restore(
+            checkpoint_dir, params_template, opt_state_template)
     logger.info("checkpoint restored: %s", path)
     return restored["params"], restored["opt_state"], restored["step"]
 
@@ -309,7 +409,7 @@ class AsyncCheckpointManager:
             try:
                 if item is None:
                     return
-                step, state = item
+                step, state, mesh_meta = item
                 try:
                     with goodput_events.phase(
                             goodput_events.PROGRAM_CHECKPOINT_ASYNC,
@@ -318,7 +418,7 @@ class AsyncCheckpointManager:
                                 trace_spans.SPAN_CKPT_PERSIST,
                                 step=step, overlapped=True):
                         _persist_state(self.checkpoint_dir, step,
-                                       state)
+                                       state, mesh_meta=mesh_meta)
                     if self.keep_last:
                         retention_gc(self.checkpoint_dir,
                                      self.keep_last)
@@ -392,7 +492,10 @@ class AsyncCheckpointManager:
                                   step=step):
             # Snapshot FIRST (the second buffer), so the in-flight
             # persist keeps overlapping with the transfer; then wait
-            # out the depth-1 bound.
+            # out the depth-1 bound. Mesh metadata is read off the
+            # live (still-sharded) params — the host snapshot has no
+            # shardings left to record.
+            mesh_meta = mesh_meta_of(params)
             state = jax.device_get(
                 {"params": params, "opt_state": opt_state})
             state["step"] = step
@@ -400,7 +503,7 @@ class AsyncCheckpointManager:
             # A persist that failed while we waited must surface
             # before this step is enqueued on top of the hole.
             self._raise_pending_error()
-            self._queue.put((step, state))
+            self._queue.put((step, state, mesh_meta))
             self._last_enqueued = step
         return _step_path(self.checkpoint_dir, step)
 
@@ -472,6 +575,11 @@ class TrainCheckpointer:
         if checkpoint_dir and use_async:
             self.manager = AsyncCheckpointManager(
                 checkpoint_dir, keep_last=self.keep_last)
+        # Cooperative preemption: the agent drops a request file
+        # ($SHIPYARD_PREEMPT_REQUEST_FILE); maybe_preempt polls it at
+        # step boundaries (one os.stat while disarmed — the
+        # StepProfiler cost model). No-op outside pools.
+        self._preempt = preemption.PreemptWatcher()
 
     @classmethod
     def from_args(cls, args) -> "TrainCheckpointer":
@@ -516,6 +624,30 @@ class TrainCheckpointer:
         if not self.due(completed_steps):
             return False
         self._save(completed_steps, params, opt_state)
+        return True
+
+    def maybe_preempt(self, completed_steps: int, params: Any,
+                      opt_state: Any) -> bool:
+        """Cooperative drain: True when a preempt request is pending
+        — a COMMITTED checkpoint of this step boundary was forced
+        (async persist drained, so the commit is durable BEFORE the
+        process exits), and the caller must flush its step window and
+        exit ``preemption.EXIT_PREEMPTED``. The rerun resumes here:
+        zero lost steps beyond this barrier."""
+        request = self._preempt.poll()
+        if request is None:
+            return False
+        if self.enabled:
+            if self.manager is not None:
+                self.manager.save(completed_steps, params, opt_state)
+                self.manager.wait_until_finished()
+            else:
+                save(self.checkpoint_dir, completed_steps, params,
+                     opt_state)
+        logger.warning(
+            "preempt drain complete at step %d%s; exiting with the "
+            "preempted status", completed_steps,
+            "" if self.enabled else " (no checkpoint dir configured)")
         return True
 
     def finalize(self, final_step: int, params: Any,

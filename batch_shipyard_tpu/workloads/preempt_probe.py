@@ -1,0 +1,128 @@
+"""Preempt-probe: a featherweight preempt-aware "trainer" for drills.
+
+The preemption drill's acceptance criterion is about the CONTROL
+plane — notice delivered, drain to a step boundary, COMMITTED
+checkpoint forced, distinct preempted exit, resume with zero lost
+steps — not about matmuls. A real train workload would spend seconds
+importing jax/orbax per gang instance per attempt; this probe speaks
+the exact same contracts with stdlib-only imports:
+
+  * progress beats ($SHIPYARD_PROGRESS_FILE, agent/progress.py)
+  * goodput step windows ($SHIPYARD_GOODPUT_FILE, goodput/events.py)
+  * preempt requests ($SHIPYARD_PREEMPT_REQUEST_FILE,
+    agent/preemption.PreemptWatcher)
+  * the COMMITTED-marker checkpoint protocol (a JSON state file +
+    sibling marker, atomic tmp+rename — workloads/checkpoint.py's
+    commit discipline without the Orbax payload)
+
+Step ledger: every attempt appends the step numbers it actually
+executed to ``<ckpt>.steps.log`` — the drill's zero-lost-steps
+assertion reads it (each step executed exactly once across attempts
+when the drain committed the barrier).
+
+Usage (drill/gang task command):
+    python -m batch_shipyard_tpu.workloads.preempt_probe \
+        --steps 40 --step-seconds 0.05 --ckpt /path/state.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from batch_shipyard_tpu.agent import preemption
+from batch_shipyard_tpu.agent import progress
+from batch_shipyard_tpu.goodput import events as goodput_events
+
+
+def _restore(ckpt: str) -> int:
+    """Committed step, honoring the marker protocol: state without a
+    sibling .COMMITTED marker is a torn save and restores as 0."""
+    if not (ckpt and os.path.exists(ckpt)
+            and os.path.exists(ckpt + ".COMMITTED")):
+        return 0
+    try:
+        with open(ckpt, encoding="utf-8") as fh:
+            return int(json.load(fh).get("step", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def _commit(ckpt: str, step: int) -> None:
+    """state -> fsync'd tmp -> rename -> marker (the checkpoint.py
+    commit order: a crash at any point leaves the previous committed
+    state or an unmarked torn file, never a torn pickup)."""
+    os.makedirs(os.path.dirname(ckpt) or ".", exist_ok=True)
+    tmp = ckpt + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"step": step}))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, ckpt)
+    marker_tmp = ckpt + ".COMMITTED.tmp"
+    with open(marker_tmp, "w", encoding="utf-8") as fh:
+        fh.write(str(step))
+    os.replace(marker_tmp, ckpt + ".COMMITTED")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--step-seconds", type=float, default=0.05)
+    parser.add_argument("--ckpt", required=True,
+                        help="shared state file (job scratch/shared "
+                             "dir); instance 0 is the single writer")
+    parser.add_argument("--checkpoint-every", type=int, default=0,
+                        help="cadenced commits every N steps (the "
+                             "preempt drain commits regardless)")
+    args = parser.parse_args()
+
+    instance = int(os.environ.get("SHIPYARD_TASK_INSTANCE", "0"))
+    writer = instance == 0
+    start_step = _restore(args.ckpt)
+    watcher = preemption.PreemptWatcher()
+    window_started = time.time()
+    executed: list[int] = []
+
+    def _flush_window(end_step: int) -> None:
+        if executed:
+            goodput_events.record(
+                goodput_events.PROGRAM_STEP_WINDOW, window_started,
+                time.time(), step_start=executed[0],
+                step_end=end_step, tokens=len(executed))
+
+    for step in range(start_step, args.steps):
+        time.sleep(args.step_seconds)
+        progress.beat()
+        executed.append(step)
+        done = step + 1
+        if watcher.poll() is not None:
+            # Drain: this boundary is the barrier — commit, ledger,
+            # distinct preempted exit. Non-writers exit on the same
+            # boundary without touching the shared state (the
+            # single-writer convention real save pipelines follow).
+            if writer:
+                _commit(args.ckpt, done)
+                with open(args.ckpt + ".steps.log", "a",
+                          encoding="utf-8") as fh:
+                    fh.write(f"i{instance} {executed[0]}..{done} "
+                             f"preempted\n")
+            _flush_window(done)
+            return preemption.EXIT_PREEMPTED
+        if writer and args.checkpoint_every and \
+                done % args.checkpoint_every == 0:
+            _commit(args.ckpt, done)
+    if writer:
+        _commit(args.ckpt, args.steps)
+        with open(args.ckpt + ".steps.log", "a",
+                  encoding="utf-8") as fh:
+            fh.write(f"i{instance} {start_step}..{args.steps} "
+                     f"completed\n")
+    _flush_window(args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from batch_shipyard_tpu import compilecache
+from batch_shipyard_tpu.agent import preemption
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -147,6 +148,14 @@ def main() -> int:
         profiler.tick(step_num)
         params, opt_state, metrics = harness.step(params,
                                                   opt_state, batch)
+        # Cooperative preemption: drain at this step boundary, force
+        # a COMMITTED checkpoint, exit with the distinct preempted
+        # status — the agent requeues at full budget and the rerun
+        # resumes exactly here (zero lost steps beyond the barrier).
+        if ckpt.maybe_preempt(step_num + 1, params, opt_state):
+            _flush_window(step_num + 1)
+            profiler.close()
+            return preemption.EXIT_PREEMPTED
         if ckpt.due(step_num + 1):
             _flush_window(step_num + 1)
             # Sync: pays the whole persist here (checkpoint badput).

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from batch_shipyard_tpu import compilecache
+from batch_shipyard_tpu.agent import preemption
 from batch_shipyard_tpu.models import vit as vit_mod
 from batch_shipyard_tpu.parallel import mesh as mesh_mod
 from batch_shipyard_tpu.parallel import train as train_mod
@@ -87,6 +88,12 @@ def main() -> int:
         profiler.tick(step_num)
         params, opt_state, metrics = harness.step(params, opt_state,
                                                   synthetic)
+        # Cooperative preemption: force-commit this boundary and exit
+        # with the distinct preempted status (requeued at full
+        # budget; the rerun resumes here).
+        if ckpt.maybe_preempt(step_num + 1, params, opt_state):
+            profiler.close()
+            return preemption.EXIT_PREEMPTED
         ckpt.step_save(step_num + 1, params, opt_state)
     loss = float(metrics["loss"])
     profiler.close()

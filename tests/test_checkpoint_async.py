@@ -228,9 +228,10 @@ def test_train_checkpointer_finalize_dedups_final_save(
     persists = []
     real_persist = checkpoint._persist_state
 
-    def counting_persist(ckpt_dir, step, state):
+    def counting_persist(ckpt_dir, step, state, mesh_meta=None):
         persists.append(step)
-        return real_persist(ckpt_dir, step, state)
+        return real_persist(ckpt_dir, step, state,
+                            mesh_meta=mesh_meta)
 
     monkeypatch.setattr(checkpoint, "_persist_state",
                         counting_persist)

@@ -192,6 +192,109 @@ def test_task_and_backoff_event_constants_are_declared():
     assert "backoff" in accounting.BADPUT_CATEGORIES
 
 
+def test_preemption_and_resize_names_declared():
+    """PR 10's vocabulary rides the registries: the preempted task
+    state is NON-terminal and claimable; every TASK_PREEMPT_* /
+    GANG_RESIZE event constant referenced at an emit site resolves to
+    a declared goodput/events.py constant registered in EVENT_KINDS;
+    the recovery interval is priced as the preemption_recovery badput
+    category (never silently 'unaccounted'); and the preempt/resize
+    trace spans ride SPAN_KINDS (enforced by the generic SPAN_ scan
+    too)."""
+    from batch_shipyard_tpu.goodput import accounting
+    from batch_shipyard_tpu.goodput import events as gp_events
+    from batch_shipyard_tpu.trace import spans as trace_spans
+    assert names.TASK_STATE_PREEMPTED == "preempted"
+    assert names.TASK_STATE_PREEMPTED in names.TASK_STATES
+    assert names.TASK_STATE_PREEMPTED not in \
+        names.TERMINAL_TASK_STATES
+    assert names.TASK_STATE_PREEMPTED in names.CLAIMABLE_TASK_STATES
+    assert set(names.CLAIMABLE_TASK_STATES) <= set(names.TASK_STATES)
+    problems = []
+    event_attrs = {"TASK_PREEMPT_NOTICE", "TASK_PREEMPT_EXIT",
+                   "TASK_PREEMPT_RECOVERY", "GANG_RESIZE"}
+    referenced = set()
+    for path, tree in _iter_package_sources():
+        rel = path.relative_to(PACKAGE.parent)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    (node.attr in event_attrs
+                     or node.attr.startswith("TASK_PREEMPT_")):
+                referenced.add(node.attr)
+                value = getattr(gp_events, node.attr, None)
+                if value is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} not "
+                        f"declared in goodput/events.py")
+                elif value not in gp_events.EVENT_KINDS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {node.attr} value "
+                        f"{value!r} missing from EVENT_KINDS")
+    assert not problems, "\n".join(problems)
+    # Every kind of the new family is actually referenced at an emit
+    # site — a declared-but-never-emitted kind is dead registry.
+    assert event_attrs <= referenced, event_attrs - referenced
+    assert accounting._KIND_CATEGORY[
+        gp_events.TASK_PREEMPT_RECOVERY] == "preemption_recovery"
+    assert "preemption_recovery" in accounting.BADPUT_CATEGORIES
+    assert trace_spans.SPAN_PREEMPT in trace_spans.SPAN_KINDS
+    assert trace_spans.SPAN_GANG_RESIZE in trace_spans.SPAN_KINDS
+
+
+def test_chaos_kinds_help_lists_node_preempt_notice():
+    """`chaos plan --kinds` (and drill) inline the valid kinds from
+    INJECTION_KINDS — the new advance-notice kind must be in the
+    registry AND the CLI help must actually derive from it (a
+    hardcoded help string would go stale silently)."""
+    from batch_shipyard_tpu.chaos.plan import INJECTION_KINDS
+    assert "node_preempt_notice" in INJECTION_KINDS
+    cli_tree = ast.parse(
+        (PACKAGE / "cli" / "main.py").read_text(encoding="utf-8"))
+    # Each --kinds option's help is built by joining INJECTION_KINDS.
+    joins = 0
+    for node in ast.walk(cli_tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and node.args and \
+                isinstance(node.args[0], ast.Attribute) and \
+                node.args[0].attr == "INJECTION_KINDS":
+            joins += 1
+    assert joins >= 2, (
+        "--kinds help no longer derives from INJECTION_KINDS")
+    # And the rendered help really names the new kind.
+    import click
+
+    from batch_shipyard_tpu.cli import main as cli_main
+    ctx = click.Context(cli_main.chaos_plan, info_name="plan")
+    # click wraps long help lines mid-token: collapse whitespace
+    # before matching.
+    rendered = "".join(cli_main.chaos_plan.get_help(ctx).split())
+    assert "node_preempt_notice" in rendered
+
+
+def test_scheduler_scale_workload_dispatched_and_rendered():
+    """The 10^5 proof is wired end to end: bench.py dispatches the
+    scheduler_scale workload, benchgen reads the committed
+    BENCH_scheduler_scale.json artifact, and the artifact itself
+    records a complete, partition-exact run of >= 10^5 tasks."""
+    import json
+    bench_src = (PACKAGE.parent / "bench.py").read_text(
+        encoding="utf-8")
+    assert '"scheduler_scale" in workloads' in bench_src
+    benchgen_src = (PACKAGE.parent / "tools" / "benchgen.py"
+                    ).read_text(encoding="utf-8")
+    assert "BENCH_scheduler_scale.json" in benchgen_src
+    artifact = PACKAGE.parent / "BENCH_scheduler_scale.json"
+    assert artifact.exists(), (
+        "BENCH_scheduler_scale.json not committed — run "
+        "`python bench.py --workloads scheduler_scale`")
+    data = json.loads(artifact.read_text(
+        encoding="utf-8"))["scheduler_scale"]
+    assert data["num_tasks"] >= 100_000
+    assert data["completed"] is True
+    assert data["goodput"]["partition_exact"] is True
+
+
 def test_train_workloads_enable_the_compile_cache():
     """Every workload that builds a parallel.train harness must go
     through the compilecache enable hook (compilecache.

@@ -1,0 +1,229 @@
+"""Reshard-on-restore: a checkpoint saved at mesh size N restores
+onto mesh size M (parallel/sharding.reshard_on_restore + the .MESH
+sidecar routing in workloads/checkpoint.restore).
+
+Covers 1->2, 2->4 and 4->2 resizes on the virtual 8-device CPU mesh,
+int8-quantized KV-bearing state (dtype preserved bit-for-bit, never
+promoted through float), legacy pre-sidecar checkpoint dirs, and the
+equivalence oracle: a resume-at-M loss trajectory matches a
+fresh-at-M run restored from the same step.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from batch_shipyard_tpu.parallel import mesh as mesh_mod
+from batch_shipyard_tpu.parallel import sharding as shard_rules
+from batch_shipyard_tpu.workloads import checkpoint as ckpt_mod
+
+
+def _mesh(n, tp=1):
+    return mesh_mod.make_mesh(
+        mesh_mod.auto_axis_sizes(n, tp=tp),
+        devices=jax.devices()[:n])
+
+
+def _state_on(mesh):
+    """A small transformer-shaped state: a dp/tp-sharded kernel, an
+    int8 KV-style cache leaf with its fp32 scales (the quantized
+    serving state shape), and an optax-style opt_state with a scalar
+    count."""
+    kernel = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+    kv_int8 = (jnp.arange(4 * 8 * 2 * 4) % 251 - 125).astype(
+        jnp.int8).reshape(4, 8, 2, 4)
+    scales = jnp.linspace(0.5, 2.0, 4 * 8 * 2).astype(
+        jnp.float32).reshape(4, 8, 2)
+    params = {
+        "proj": {"kernel": jax.device_put(
+            kernel, NamedSharding(mesh, P(None, "tp")))},
+        "kv_cache": jax.device_put(
+            kv_int8, NamedSharding(mesh, P(("dp", "fsdp")))),
+        "kv_scales": jax.device_put(
+            scales, NamedSharding(mesh, P(("dp", "fsdp")))),
+    }
+    opt_state = {
+        "mu": jax.device_put(kernel * 0.5,
+                             NamedSharding(mesh, P(None, "tp"))),
+        "count": jax.device_put(jnp.asarray(7, jnp.int32),
+                                NamedSharding(mesh, P())),
+    }
+    return params, opt_state
+
+
+def _templates_on(mesh, like_params, like_opt):
+    def retarget(leaf):
+        spec = leaf.sharding.spec
+        return jax.device_put(jnp.zeros(leaf.shape, leaf.dtype),
+                              NamedSharding(mesh, spec))
+    return (jax.tree_util.tree_map(retarget, like_params),
+            jax.tree_util.tree_map(retarget, like_opt))
+
+
+@pytest.mark.parametrize("n_from,n_to", [(1, 2), (2, 4), (4, 2)])
+def test_reshard_restore_param_equivalence(tmp_path, n_from, n_to):
+    """Values identical across the resize, dtypes preserved (int8
+    stays int8), and every restored leaf carries the TARGET mesh's
+    sharding."""
+    mesh_from = _mesh(n_from)
+    params, opt_state = _state_on(mesh_from)
+    ckpt_mod.save(str(tmp_path), 5, params, opt_state)
+    assert ckpt_mod.saved_mesh_meta(str(tmp_path), 5) is not None
+
+    mesh_to = _mesh(n_to)
+    p_tpl, o_tpl = _templates_on(mesh_to, params, opt_state)
+    restored = shard_rules.reshard_on_restore(str(tmp_path), p_tpl,
+                                              o_tpl)
+    assert restored is not None
+    r_params, r_opt, step = restored
+    assert step == 5
+    for got, want in zip(jax.tree_util.tree_leaves(r_params),
+                         jax.tree_util.tree_leaves(params)):
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+    assert r_params["kv_cache"].dtype == jnp.int8
+    for leaf, tpl in zip(jax.tree_util.tree_leaves(r_params),
+                         jax.tree_util.tree_leaves(p_tpl)):
+        assert leaf.sharding == tpl.sharding
+    np.testing.assert_array_equal(np.asarray(r_opt["count"]), 7)
+
+
+def test_restore_routes_resize_through_reshard(tmp_path):
+    """checkpoint.restore detects the mesh change via the .MESH
+    sidecar and routes through the reshard path (no exception-driven
+    fallback needed)."""
+    mesh2 = _mesh(2)
+    params, opt_state = _state_on(mesh2)
+    ckpt_mod.save(str(tmp_path), 3, params, opt_state)
+    mesh4 = _mesh(4)
+    p_tpl, o_tpl = _templates_on(mesh4, params, opt_state)
+    r_params, _r_opt, step = ckpt_mod.restore(str(tmp_path), p_tpl,
+                                              o_tpl)
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(r_params["proj"]["kernel"]),
+        np.asarray(params["proj"]["kernel"]))
+    assert r_params["proj"]["kernel"].sharding == \
+        p_tpl["proj"]["kernel"].sharding
+
+
+def test_legacy_dir_without_sidecar_still_restores(tmp_path):
+    """Pre-sidecar checkpoint dirs (the fleet's existing resume
+    points): no .MESH file -> the strict path restores at the same
+    mesh unchanged, and reshard_on_restore works on them too."""
+    mesh2 = _mesh(2)
+    params, opt_state = _state_on(mesh2)
+    ckpt_mod.save(str(tmp_path), 9, params, opt_state)
+    os.remove(ckpt_mod._mesh_meta_path(str(tmp_path), 9))
+    assert ckpt_mod.saved_mesh_meta(str(tmp_path), 9) is None
+    # Same mesh, strict path.
+    p_tpl, o_tpl = _templates_on(mesh2, params, opt_state)
+    r_params, _r_opt, step = ckpt_mod.restore(str(tmp_path), p_tpl,
+                                              o_tpl)
+    assert step == 9
+    np.testing.assert_array_equal(np.asarray(r_params["kv_cache"]),
+                                  np.asarray(params["kv_cache"]))
+    # Legacy dir onto a NEW mesh via the explicit reshard path.
+    mesh4 = _mesh(4)
+    p_tpl4, o_tpl4 = _templates_on(mesh4, params, opt_state)
+    r4 = shard_rules.reshard_on_restore(str(tmp_path), p_tpl4,
+                                        o_tpl4)
+    assert r4 is not None
+    np.testing.assert_array_equal(np.asarray(r4[0]["kv_cache"]),
+                                  np.asarray(params["kv_cache"]))
+
+
+def test_reshard_rejects_wrong_model_shape(tmp_path):
+    """Global shapes are mesh-independent: a shape mismatch means a
+    DIFFERENT model config, and reshard must refuse loudly instead of
+    silently truncating."""
+    mesh2 = _mesh(2)
+    params, opt_state = _state_on(mesh2)
+    ckpt_mod.save(str(tmp_path), 1, params, opt_state)
+    bad = {
+        **params,
+        "proj": {"kernel": jax.device_put(
+            jnp.zeros((4, 16), jnp.float32),
+            NamedSharding(mesh2, P(None, "tp")))},
+    }
+    with pytest.raises(Exception):
+        shard_rules.reshard_on_restore(str(tmp_path), bad, opt_state)
+
+
+def test_retention_gc_removes_mesh_sidecar(tmp_path):
+    mesh1 = _mesh(1)
+    params, opt_state = _state_on(mesh1)
+    ckpt_mod.save(str(tmp_path), 1, params, opt_state)
+    ckpt_mod.save(str(tmp_path), 2, params, opt_state)
+    removed = ckpt_mod.retention_gc(str(tmp_path), keep_last=1)
+    assert removed == [1]
+    assert not os.path.exists(
+        ckpt_mod._mesh_meta_path(str(tmp_path), 1))
+    assert ckpt_mod.saved_mesh_meta(str(tmp_path), 2) is not None
+
+
+@pytest.mark.slow
+def test_loss_trajectory_equivalence_oracle(tmp_path):
+    """THE acceptance oracle: train at mesh size 2, checkpoint, then
+    (a) resume-at-4 through checkpoint.restore (sidecar-routed
+    reshard) and (b) fresh-at-4 via reshard_on_restore from the same
+    step — the two loss trajectories match to fp tolerance.
+
+    Marked slow: the three extra harness compiles this late in a
+    full-suite run reproducibly segfault XLA CPU on the 1-core test
+    container (accumulated-compile state; the test passes standalone
+    and in any partial-suite combination). The array-level
+    equivalence tests above exercise the identical restore mechanism
+    in tier-1; this oracle additionally proves the post-restore STEP
+    trajectories agree."""
+    from batch_shipyard_tpu.parallel import train as train_mod
+
+    def harness_for(n, tp=1):
+        mesh = _mesh(n, tp=tp)
+        config = train_mod.make_transformer_config(
+            mesh, vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+            d_head=8, d_ff=32, max_seq_len=32)
+        return train_mod.build_transformer_train(
+            mesh, config, batch_size=4, seq_len=8)
+
+    def batch_for(harness, seed):
+        rng = np.random.RandomState(seed)
+        tokens = rng.randint(0, 64, (4, 8)).astype(np.int32)
+        return {
+            "tokens": jax.device_put(jnp.asarray(tokens),
+                                     harness.batch_sharding),
+            "targets": jax.device_put(jnp.asarray(tokens),
+                                      harness.batch_sharding)}
+
+    h2 = harness_for(2)
+    params, opt_state = h2.params, h2.opt_state
+    for i in range(2):
+        params, opt_state, _ = h2.step(params, opt_state,
+                                       batch_for(h2, i))
+    ckpt_mod.save(str(tmp_path), 2, params, opt_state)
+
+    h4 = harness_for(4, tp=2)
+    resumed = ckpt_mod.restore(str(tmp_path), h4.params,
+                               h4.opt_state)
+    assert resumed is not None and resumed[2] == 2
+    p_a, o_a = resumed[0], resumed[1]
+    losses_resumed = []
+    for i in range(2, 5):
+        p_a, o_a, metrics = h4.step(p_a, o_a, batch_for(h4, i))
+        losses_resumed.append(float(metrics["loss"]))
+
+    h4b = harness_for(4, tp=2)
+    fresh = shard_rules.reshard_on_restore(str(tmp_path), h4b.params,
+                                           h4b.opt_state)
+    p_b, o_b = fresh[0], fresh[1]
+    losses_fresh = []
+    for i in range(2, 5):
+        p_b, o_b, metrics = h4b.step(p_b, o_b, batch_for(h4b, i))
+        losses_fresh.append(float(metrics["loss"]))
+    np.testing.assert_allclose(losses_resumed, losses_fresh,
+                               rtol=1e-5, atol=1e-6)

@@ -409,6 +409,92 @@ def test_failover_window_rejects_duplicate_request_id(params):
         front.shutdown()
 
 
+def test_two_racing_posts_across_forced_failover(params):
+    """ADVICE r5 closure proof, adversarial form: TWO genuinely
+    concurrent dispatches of the SAME request_id race while the
+    router is mid-failover (dead replica tried first). Exactly one
+    may decode; the other must be rejected by the duplicate gate —
+    and the single surviving replica must have served exactly one
+    request with that id. A real second thread (not just a probe
+    inside the window) pins the whole claim/reserve/failover
+    interleaving."""
+    import socket
+
+    from batch_shipyard_tpu.models.router import DuplicateRequestError
+
+    front = _front(params)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+    router = ServingRouter([dead_url, front.url],
+                           health_interval=30.0)
+    with router._lock:
+        for r in router._replicas:
+            if r.url == front.url:
+                r.dispatched = 5  # tie-break: dead replica first
+    results: dict = {"ok": 0, "dup": 0, "other": []}
+    results_lock = threading.Lock()
+    # Deterministic interleaving: racer B fires the moment racer A
+    # enters the failover window (after finish(retrying=True), before
+    # the retry re-registers) — the historical double-decode window.
+    window_entered = threading.Event()
+    second_done = threading.Event()
+    orig_mark = router._mark_unhealthy
+
+    def mark_and_hold(replica, exc):
+        orig_mark(replica, exc)
+        window_entered.set()
+        second_done.wait(timeout=30)  # keep A inside the window
+
+    router._mark_unhealthy = mark_and_hold
+
+    def racer(wait_for_window):
+        if wait_for_window:
+            window_entered.wait(timeout=30)
+        try:
+            code, payload = router.dispatch(
+                {"request_id": "race-1", "prompt": [1, 2],
+                 "max_new_tokens": 2})
+            with results_lock:
+                if code == 200:
+                    results["ok"] += 1
+                else:
+                    results["other"].append((code, payload))
+        except DuplicateRequestError:
+            with results_lock:
+                results["dup"] += 1
+        except Exception as exc:  # noqa: BLE001 - recorded, asserted
+            with results_lock:
+                results["other"].append(repr(exc))
+        finally:
+            if wait_for_window:
+                second_done.set()
+
+    threads = [threading.Thread(target=racer, args=(False,)),
+               threading.Thread(target=racer, args=(True,))]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results["ok"] == 1, results
+        assert results["dup"] == 1, results
+        assert not results["other"], results
+        # The fleet decoded the id exactly once.
+        with urllib.request.urlopen(f"{front.url}/v1/stats",
+                                    timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats.get("completed_requests") == 1, stats
+        # The id is released after completion: a THIRD post reuses it.
+        code, _ = router.dispatch(
+            {"request_id": "race-1", "prompt": [3],
+             "max_new_tokens": 1})
+        assert code == 200
+    finally:
+        front.shutdown()
+
+
 def test_router_midstream_timeout_orphans_ownership(params):
     """ADVICE r5 (medium): a mid-stream read timeout means the run may
     still be live on the (slow) replica — ownership must survive into
