@@ -17,6 +17,12 @@ Env contract exposed to every task (the $AZ_BATCH_* analog):
                            $AZ_BATCH_HOST_LIST analog, batch.py:4378)
   SHIPYARD_TASK_INSTANCES  gang size (1 for regular tasks)
   SHIPYARD_TASK_INSTANCE   this instance's index
+  SHIPYARD_JOB_SHARED_DIR  node-local directory shared by every task
+                           of the job ($AZ_BATCH_JOB_SHARED_DIR
+                           analog; set by the node agent)
+  SHIPYARD_JOB_SCRATCH     auto_scratch mount for the job (node-local
+                           or the gang-shared NFS namespace; only set
+                           when the job opts in)
   SHIPYARD_GOODPUT_FILE    JSONL sink for program-phase goodput events
                            (goodput/events.py record/phase); the agent
                            ingests it into TABLE_GOODPUT post-task
@@ -224,8 +230,13 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
         for var in ("SHIPYARD_POOL_ID", "SHIPYARD_JOB_ID",
                     "SHIPYARD_TASK_ID", "SHIPYARD_NODE_ID",
                     "SHIPYARD_NODE_INDEX", "SHIPYARD_TASK_INSTANCES",
-                    "SHIPYARD_TASK_INSTANCE", "SHIPYARD_HOST_LIST"):
+                    "SHIPYARD_TASK_INSTANCE", "SHIPYARD_HOST_LIST",
+                    "SHIPYARD_TASK_SLOT"):
             argv += ["-e", var]
+        # SHIPYARD_TASK_DIR names the HOST path; inside the container
+        # the task dir is the /shipyard/task mount, so forward the
+        # remapped value rather than the bare passthrough.
+        argv += ["-e", "SHIPYARD_TASK_DIR=/shipyard/task"]
         goodput_file = execution.env.get("SHIPYARD_GOODPUT_FILE")
         if goodput_file:
             # The host task_dir is mounted at /shipyard/task: remap

@@ -1,0 +1,15 @@
+"""shipyard lint: the distributed-invariant static analyzer.
+
+Importing this package registers every rule module; see core.py for
+the framework and docs/34-static-analysis.md for the rule inventory,
+baseline/suppression workflow, and how to author a rule.
+"""
+
+from batch_shipyard_tpu.analysis.core import (  # noqa: F401
+    BASELINE_FILENAME, AnalysisContext, Finding, Report, RULES,
+    analyze, load_baseline, repo_root, run_rules, write_baseline)
+
+# Rule modules register themselves on import (the @rule decorator).
+from batch_shipyard_tpu.analysis import (  # noqa: F401,E402
+    rules_env, rules_jax, rules_loops, rules_registry, rules_shell,
+    rules_store, rules_wiring)

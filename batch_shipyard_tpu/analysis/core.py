@@ -1,0 +1,387 @@
+"""`shipyard lint` framework: findings, rules, suppression, baseline.
+
+A distributed-systems reproduction of this size cannot rely on hand
+review to hold its invariants: every hard bug so far (the PR 5
+gang-row claim-marker leak, the PR 10 router duplicate-request race,
+the PR 7 double-ingest inode race) was one *instance* of a bug class
+with many sites. This package turns those classes into registered,
+machine-checked rules.
+
+Same cheap-by-design philosophy as tests/test_names_consistency.py
+(which is now a thin wrapper over these rules): pure AST scans over
+``batch_shipyard_tpu/**/*.py`` plus line scans over the shell layer
+(install.sh, tools/*.sh). Rule modules import only *leaf registries*
+(state.names, goodput.events, goodput.accounting, trace.spans,
+chaos.plan) — never agent/serving/parallel modules, and never JAX —
+so the whole analyzer runs in milliseconds anywhere pytest runs.
+
+Surfaces:
+
+  * ``shipyard lint``              CLI gate (exit 1 on new findings)
+  * ``shipyard lint --baseline-update``  triage workflow
+  * tests/test_analysis.py         tier-1 pytest gate
+  * ``# shipyard-lint: disable=<rule-id>``  inline suppression, on the
+    offending line or the line directly above it
+
+Baseline semantics: findings whose fingerprint (rule, path, message —
+line numbers excluded, so unrelated edits don't churn the file) is
+recorded in ``.shipyard-lint-baseline.json`` warn instead of failing.
+The baseline is written sorted and path-relative so diffs review like
+code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from collections import Counter
+from typing import Callable, Iterable, Optional
+
+PACKAGE_NAME = "batch_shipyard_tpu"
+BASELINE_FILENAME = ".shipyard-lint-baseline.json"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shipyard-lint:\s*disable=([A-Za-z0-9_,\-]+)")
+# File-level form, honored only in a file's first 10 lines (it is a
+# prologue statement about the whole file, not a scatter mechanism).
+_FILE_SUPPRESS_RE = re.compile(
+    r"#\s*shipyard-lint:\s*disable-file=([A-Za-z0-9_,\-]+)")
+
+
+def repo_root() -> pathlib.Path:
+    """The source tree this package lives in (the scan default)."""
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+# ------------------------------ findings -------------------------------
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str       # repo-root-relative, posix separators
+    line: int
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers excluded so edits elsewhere
+        in a file don't invalidate (or churn) the baseline."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ----------------------------- source files ----------------------------
+
+class SourceFile:
+    """One scanned file: raw lines, parsed AST (python only), and the
+    per-line suppression directives."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.is_python = self.rel.endswith(".py")
+        self.tree: Optional[ast.AST] = (
+            ast.parse(source, filename=self.rel) if self.is_python
+            else None)
+        self._suppressions: Optional[dict[int, set[str]]] = None
+        self._file_suppressions: Optional[set[str]] = None
+
+    def _suppression_map(self) -> dict[int, set[str]]:
+        if self._suppressions is None:
+            out: dict[int, set[str]] = {}
+            for idx, text in enumerate(self.lines, start=1):
+                match = _SUPPRESS_RE.search(text)
+                if not match:
+                    continue
+                ids = {t.strip() for t in match.group(1).split(",")
+                       if t.strip()}
+                # A directive applies to its own line (trailing
+                # comment form); a COMMENT-ONLY directive line also
+                # covers the line directly below it. A trailing
+                # directive must not bleed onto the next line — that
+                # would silently hide an unrelated adjacent finding.
+                out.setdefault(idx, set()).update(ids)
+                if text.lstrip().startswith("#"):
+                    out.setdefault(idx + 1, set()).update(ids)
+            self._suppressions = out
+        return self._suppressions
+
+    def _file_suppression_set(self) -> set[str]:
+        if self._file_suppressions is None:
+            ids: set[str] = set()
+            for text in self.lines[:10]:
+                match = _FILE_SUPPRESS_RE.search(text)
+                if match:
+                    ids.update(t.strip()
+                               for t in match.group(1).split(",")
+                               if t.strip())
+            self._file_suppressions = ids
+        return self._file_suppressions
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self._file_suppression_set():
+            return True
+        ids = self._suppression_map().get(line, ())
+        return rule_id in ids or "all" in ids
+
+
+# ------------------------------- context -------------------------------
+
+class AnalysisContext:
+    """Everything one analyzer run sees: the parsed python files of
+    the package plus the shell layer. Rules never read the filesystem
+    themselves, so tests feed synthetic trees via from_strings()."""
+
+    def __init__(self, root: pathlib.Path,
+                 files: list[SourceFile]) -> None:
+        self.root = pathlib.Path(root)
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+
+    @classmethod
+    def from_tree(cls, root: Optional[pathlib.Path] = None,
+                  ) -> "AnalysisContext":
+        root = pathlib.Path(root) if root else repo_root()
+        files: list[SourceFile] = []
+        package = root / PACKAGE_NAME
+        for path in sorted(package.rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            files.append(SourceFile(
+                rel, path.read_text(encoding="utf-8")))
+        shell_paths = [root / "install.sh"]
+        shell_paths += sorted((root / "tools").glob("*.sh"))
+        for path in shell_paths:
+            if path.exists():
+                rel = path.relative_to(root).as_posix()
+                files.append(SourceFile(
+                    rel, path.read_text(encoding="utf-8")))
+        return cls(root, files)
+
+    @classmethod
+    def from_strings(cls, sources: dict[str, str],
+                     ) -> "AnalysisContext":
+        """Synthetic context for rule tests: {relpath: source}."""
+        return cls(pathlib.Path("."),
+                   [SourceFile(rel, src)
+                    for rel, src in sorted(sources.items())])
+
+    @property
+    def python_files(self) -> list[SourceFile]:
+        return [f for f in self.files if f.is_python]
+
+    @property
+    def shell_files(self) -> list[SourceFile]:
+        return [f for f in self.files if f.rel.endswith(".sh")]
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+
+# -------------------------------- rules --------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str
+    doc: str    # includes the real bug the rule descends from
+    fn: Callable[[AnalysisContext], list[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+# Rule families (docs/34-static-analysis.md inventories them).
+FAMILIES = ("store", "loop", "env", "registry", "jax", "wiring",
+            "shell")
+
+
+def rule(rule_id: str, family: str):
+    """Register an analyzer rule. The decorated function's docstring
+    is the rule's documentation and MUST name the real bug it descends
+    from (bug provenance is part of the contract)."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown rule family {family!r}")
+
+    def decorate(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        if not fn.__doc__:
+            raise ValueError(f"rule {rule_id!r} has no docstring")
+        RULES[rule_id] = Rule(id=rule_id, family=family,
+                              doc=fn.__doc__, fn=fn)
+        return fn
+    return decorate
+
+
+def _select(rule_ids: Optional[Iterable[str]]) -> list[Rule]:
+    if rule_ids is None:
+        return [RULES[k] for k in sorted(RULES)]
+    out = []
+    for rid in rule_ids:
+        if rid not in RULES:
+            raise KeyError(
+                f"unknown rule {rid!r}; known: {sorted(RULES)}")
+        out.append(RULES[rid])
+    return out
+
+
+# ------------------------------- running -------------------------------
+
+@dataclasses.dataclass
+class Report:
+    """One analyzer run, split by disposition."""
+
+    new: list[Finding]          # fail the gate
+    baselined: list[Finding]    # warn: pre-existing, triage pending
+    suppressed: list[Finding]   # inline shipyard-lint: disable=
+    stale_baseline: list[tuple[str, str, str]]  # fixed but still listed
+
+    @property
+    def all_active(self) -> list[Finding]:
+        return sorted(self.new + self.baselined)
+
+    def to_dict(self) -> dict:
+        return {
+            "new": [f.render() for f in sorted(self.new)],
+            "baselined": [f.render() for f in sorted(self.baselined)],
+            "suppressed": len(self.suppressed),
+            "stale_baseline": [list(fp) for fp
+                               in sorted(self.stale_baseline)],
+        }
+
+
+def run_rules(ctx: AnalysisContext,
+              rule_ids: Optional[Iterable[str]] = None,
+              ) -> tuple[list[Finding], list[Finding]]:
+    """(active, suppressed) findings of the selected rules, sorted."""
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for rule_obj in _select(rule_ids):
+        for finding in rule_obj.fn(ctx):
+            src = ctx.get(finding.path)
+            if src is not None and src.is_suppressed(
+                    finding.rule, finding.line):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    return sorted(active), sorted(suppressed)
+
+
+def analyze(root: Optional[pathlib.Path] = None,
+            ctx: Optional[AnalysisContext] = None,
+            rule_ids: Optional[Iterable[str]] = None,
+            baseline: Optional[Counter] = None) -> Report:
+    """Full run: scan, suppress, then split against the baseline."""
+    if ctx is None:
+        ctx = AnalysisContext.from_tree(root)
+    if baseline is None:
+        baseline = load_baseline(ctx.root / BASELINE_FILENAME)
+    if rule_ids is not None:
+        # Partial-rule run: judge only the selected rules' slice of
+        # the baseline — other rules' triaged entries are out of
+        # scope, not stale.
+        rule_ids = list(rule_ids)
+        selected = set(rule_ids)
+        baseline = Counter({fp: count
+                            for fp, count in baseline.items()
+                            if fp[0] in selected})
+    active, suppressed = run_rules(ctx, rule_ids)
+    remaining = Counter(baseline)
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    for finding in active:
+        fp = finding.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            baselined.append(finding)
+        else:
+            new.append(finding)
+    stale = sorted(fp for fp, count in remaining.items()
+                   if count > 0)
+    return Report(new=new, baselined=baselined,
+                  suppressed=suppressed, stale_baseline=stale)
+
+
+# ------------------------------- baseline ------------------------------
+
+def load_baseline(path: pathlib.Path) -> Counter:
+    """Fingerprint multiset from the checked-in baseline; empty when
+    the file is absent (a repo with no triage debt needs no file)."""
+    path = pathlib.Path(path)
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    out: Counter = Counter()
+    for item in data.get("findings", []):
+        out[(item["rule"], item["path"], item["message"])] += 1
+    return out
+
+
+def write_baseline(path: pathlib.Path,
+                   findings: list[Finding]) -> None:
+    """Deterministic baseline write: sorted by fingerprint, line
+    numbers omitted, trailing newline — two runs over the same tree
+    produce byte-identical files, so baseline diffs review like
+    code."""
+    items = [
+        {"rule": f.rule, "path": f.path, "message": f.message}
+        for f in sorted(findings, key=Finding.fingerprint)]
+    payload = {"version": 1, "findings": items}
+    pathlib.Path(path).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8")
+
+
+# --------------------------- shared AST helpers ------------------------
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Terminal name of a call: foo(...) -> "foo",
+    a.b.merge_entity(...) -> "merge_entity"."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def keyword_arg(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def const_str(node: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_str_consts(tree: ast.AST) -> dict[str, str]:
+    """Module-level NAME = "literal" assignments (the _SCHED_TABLE /
+    *_ENV constant idiom) — lets rules resolve Name/Attribute
+    references one hop deep without importing the module."""
+    out: dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = node.value.value
+    return out
+
+
+def functions(tree: ast.AST):
+    """Every (async) function definition in a module, nested ones
+    included."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -1,0 +1,120 @@
+"""Hot-loop rules: work that runs once per heartbeat must stay cheap.
+
+The node agent's heartbeat thread drives every sweep
+(agent/node_agent.py _heartbeat_loop): retention, orphaned-gang
+janitor, preemption sweep, request forwarding. Anything slow or
+store-heavy inside that path multiplies by pool size and by heartbeat
+rate — the PR 10 review settled the discipline: unpartitioned table
+scans are allowed only behind the lowest-live-node leader gate
+(_is_gang_sweep_leader), so a pool pays ONE scan per interval, not
+one per node; and a sweep must never sleep (a blocked sweep starves
+the heartbeat itself, and a heartbeat-stale node gets its running
+tasks reclaimed as orphans).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, call_name, keyword_arg, rule)
+
+# Functions that run on the heartbeat cadence: the sweep/heartbeat
+# naming convention is load-bearing (the existing sweeps all follow
+# it), so the rule keys on it.
+_HOT_NAME_RE = re.compile(r"(^|_)(sweep|heartbeat)(_|$)")
+
+
+def _is_hot(fn: ast.FunctionDef) -> bool:
+    return bool(_HOT_NAME_RE.search(fn.name))
+
+
+def _leader_gated(fn: ast.FunctionDef) -> bool:
+    """A call to the leader-election helper anywhere in the function
+    body (the _is_gang_sweep_leader idiom) marks the whole function
+    as one-scan-per-pool."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and "leader" in name:
+                return True
+    return False
+
+
+@rule("loop-unpartitioned-scan", family="loop")
+def check_unpartitioned_scan(ctx: AnalysisContext) -> list[Finding]:
+    """``query_entities`` with no partition key inside a
+    heartbeat/sweep function that is not leader-gated: every node in
+    the pool pays a full-table scan per heartbeat, so store load
+    scales as nodes x rows x rate.
+
+    Provenance: the PR 5 orphaned-gang janitor originally scanned
+    the gang table from EVERY node each heartbeat; the PR 10 review
+    leader-gated it (one unpartitioned scan per pool per interval)
+    and the preemption sweep was born gated. New sweeps must follow
+    the precedent or partition the scan."""
+    findings = []
+    for src in ctx.python_files:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if not _is_hot(fn) or _leader_gated(fn):
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and
+                        call_name(node) == "query_entities"):
+                    continue
+                pk = (keyword_arg(node, "partition_key")
+                      or (node.args[1] if len(node.args) > 1
+                          else None))
+                unpartitioned = pk is None or (
+                    isinstance(pk, ast.Constant) and pk.value is None)
+                if unpartitioned:
+                    findings.append(Finding(
+                        rule="loop-unpartitioned-scan", path=src.rel,
+                        line=node.lineno,
+                        message=(f"unpartitioned query_entities scan "
+                                 f"in heartbeat-cadence function "
+                                 f"{fn.name!r} without a leader "
+                                 f"gate; every node pays it every "
+                                 f"interval")))
+    return findings
+
+
+@rule("loop-sleep-in-sweep", family="loop")
+def check_sleep_in_sweep(ctx: AnalysisContext) -> list[Finding]:
+    """``time.sleep`` inside a heartbeat/sweep function: the sweep
+    runs ON the heartbeat thread, so sleeping there delays the
+    node's own liveness signal — long enough, and the orphan-reclaim
+    path judges the node dead and steals its running tasks.
+
+    Provenance: the TPU_WEDGE_REPORT.md hang class — the progress
+    watchdog exists because blocked control loops turn into
+    silently-dead nodes. Waiting belongs in the poll loops (which
+    sleep poll_interval between EMPTY polls), never in sweep
+    bodies; a sweep that needs to wait should record state and
+    finish next interval."""
+    findings = []
+    for src in ctx.python_files:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if not _is_hot(fn):
+                continue
+            # The loop driver itself (e.g. _heartbeat_loop) paces on
+            # stop_event.wait — a plain while-loop wrapper is exempt
+            # only for that idiom, so time.sleep still flags.
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        call_name(node) == "sleep" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "time":
+                    findings.append(Finding(
+                        rule="loop-sleep-in-sweep", path=src.rel,
+                        line=node.lineno,
+                        message=(f"time.sleep inside "
+                                 f"heartbeat-cadence function "
+                                 f"{fn.name!r} stalls the heartbeat "
+                                 f"thread; pace on stop_event.wait "
+                                 f"or defer to the next interval")))
+    return findings

@@ -1,0 +1,307 @@
+"""Goodput/trace-partition registry rules.
+
+The goodput partition (arxiv 2502.06982) is exact only if every
+emitted event kind is declared, registered, and priced: an undeclared
+kind is silently dropped at emit (events.emit guards on EVENT_KINDS),
+an unpriced interval kind lands in "unaccounted", and an unclosed
+span never reaches the exporter at all. Same story for tables, state
+vocabularies, and trace spans — these rules absorb and generalize
+the AST checks that lived in tests/test_names_consistency.py (that
+file is now a thin wrapper running them).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, call_name, const_str, rule)
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.goodput import events as gp_events
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.trace import spans as trace_spans
+
+_TABLE_METHODS = {
+    "insert_entity", "upsert_entity", "merge_entity", "get_entity",
+    "query_entities", "delete_entity", "insert_entities",
+}
+_DECLARED_TABLE_ATTRS = {a for a in dir(names)
+                         if a.startswith("TABLE_")}
+_DECLARED_TABLE_VALUES = {getattr(names, a)
+                          for a in _DECLARED_TABLE_ATTRS}
+
+# Instantaneous marker kinds: zero-duration by contract, so the
+# accounting sweep ignores them — every OTHER registered kind must be
+# priced by _KIND_CATEGORY or the partition silently leaks seconds
+# into "unaccounted". Extending this set is a reviewed statement that
+# a kind is a marker, not an interval.
+MARKER_EVENT_KINDS = frozenset({
+    gp_events.TASK_RETRY, gp_events.TASK_PREEMPT_NOTICE,
+    gp_events.TASK_PREEMPT_EXIT, gp_events.GANG_RESIZE,
+})
+
+_EVENTS_MODULE = "batch_shipyard_tpu.goodput.events"
+_SPANS_MODULE = "batch_shipyard_tpu.trace.spans"
+
+
+def _module_aliases(tree: ast.AST, module: str) -> set[str]:
+    """Local names bound to ``module`` in this file, via
+    ``from pkg import events [as alias]`` or ``import pkg.mod``."""
+    pkg, _, mod = module.rpartition(".")
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == pkg:
+                for alias in node.names:
+                    if alias.name == mod:
+                        aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == module and alias.asname:
+                    aliases.add(alias.asname)
+    return aliases
+
+
+def _check_registry_attrs(ctx: AnalysisContext, rule_id: str,
+                          module: str, registry_obj,
+                          kind_set: frozenset,
+                          kind_label: str) -> list[Finding]:
+    """Every UPPER_CASE attribute referenced on an alias of
+    ``module`` must exist there, and (unless it is an *_ENV constant
+    or the registry set itself) its value must be registered in
+    ``kind_set``."""
+    findings = []
+    for src in ctx.python_files:
+        aliases = _module_aliases(src.tree, module)
+        if not aliases:
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in aliases):
+                continue
+            attr = node.attr
+            if not attr.isupper() or attr.endswith("_ENV") or \
+                    attr in ("EVENT_KINDS", "SPAN_KINDS"):
+                continue
+            value = getattr(registry_obj, attr, None)
+            if value is None:
+                findings.append(Finding(
+                    rule=rule_id, path=src.rel, line=node.lineno,
+                    message=(f"{attr} is not declared in "
+                             f"{module}")))
+            elif isinstance(value, str) and value not in kind_set:
+                findings.append(Finding(
+                    rule=rule_id, path=src.rel, line=node.lineno,
+                    message=(f"{attr} value {value!r} is not "
+                             f"registered in {kind_label}")))
+    return findings
+
+
+@rule("registry-table-undeclared", family="registry")
+def check_table_undeclared(ctx: AnalysisContext) -> list[Finding]:
+    """Every state-store table the package touches must be declared
+    in state/names.py — whether referenced as names.TABLE_X, as a
+    string literal in a store call, or through a module-level
+    constant (_SCHED_TABLE = "..."). A typo-forked table name splits
+    the schema into a partition nobody reads.
+
+    Provenance: the original test_names_consistency check (PR 2),
+    extended here to resolve local constants — which immediately
+    caught jobs/schedules.py's hand-rolled "jobschedules" literal
+    (now names.TABLE_JOBSCHEDULES)."""
+    findings = []
+    for src in ctx.python_files:
+        # Module constants: NAME = "literal" assignments.
+        consts: dict[str, str] = {}
+        for node in ast.iter_child_nodes(src.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        consts[target.id] = node.value.value
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr.startswith("TABLE_") and \
+                    node.attr not in _DECLARED_TABLE_ATTRS:
+                findings.append(Finding(
+                    rule="registry-table-undeclared", path=src.rel,
+                    line=node.lineno,
+                    message=(f"{node.attr} is not declared in "
+                             f"state/names.py")))
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in _TABLE_METHODS and node.args:
+                first = node.args[0]
+                value: Optional[str] = const_str(first)
+                if value is None and isinstance(first, ast.Name):
+                    value = consts.get(first.id)
+                if value is not None and \
+                        value not in _DECLARED_TABLE_VALUES:
+                    findings.append(Finding(
+                        rule="registry-table-undeclared",
+                        path=src.rel, line=node.lineno,
+                        message=(f"table name {value!r} is not a "
+                                 f"declared state/names.py TABLE_* "
+                                 f"value")))
+    return findings
+
+
+@rule("registry-state-literal", family="registry")
+def check_state_literal(ctx: AnalysisContext) -> list[Finding]:
+    """Every task/node/aux state string literal compared against or
+    written into an entity's "state" must come from the
+    state/names.py vocabularies — a typo'd state ("quarantine" vs
+    "quarantined") silently dodges every terminal-state check in the
+    fleet.
+
+    Provenance: the PR 5 quarantined-state review (the original
+    test_names_consistency scan, migrated verbatim)."""
+    allowed = (set(names.TASK_STATES) | set(names.NODE_STATES)
+               | set(names.AUX_STATES))
+    findings = []
+    for src in ctx.python_files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if const_str(key) == "state" and \
+                            const_str(value) is not None and \
+                            value.value not in allowed:
+                        findings.append(Finding(
+                            rule="registry-state-literal",
+                            path=src.rel, line=node.lineno,
+                            message=(f"state literal "
+                                     f"{value.value!r} not in "
+                                     f"state/names.py "
+                                     f"vocabularies")))
+            if isinstance(node, ast.Compare):
+                if "state" not in ast.dump(node.left).lower():
+                    continue
+                for comparator in node.comparators:
+                    literals = []
+                    if const_str(comparator) is not None:
+                        literals = [comparator.value]
+                    elif isinstance(comparator, (ast.Tuple, ast.List,
+                                                 ast.Set)):
+                        literals = [
+                            e.value for e in comparator.elts
+                            if const_str(e) is not None]
+                    for literal in literals:
+                        # Upper-case literals are cloud-API enums
+                        # (GCE VM states), not our vocabulary.
+                        if literal and literal not in allowed and \
+                                literal.isidentifier() and \
+                                literal == literal.lower():
+                            findings.append(Finding(
+                                rule="registry-state-literal",
+                                path=src.rel, line=node.lineno,
+                                message=(f"state literal "
+                                         f"{literal!r} not in "
+                                         f"state/names.py "
+                                         f"vocabularies")))
+    return findings
+
+
+@rule("goodput-kind-undeclared", family="registry")
+def check_goodput_kind_undeclared(ctx: AnalysisContext,
+                                  ) -> list[Finding]:
+    """Every event-kind constant referenced through a goodput/events
+    alias must be declared there AND registered in EVENT_KINDS: emit
+    drops unknown kinds with only a log line, so a typo'd constant
+    produces events the accounting never sees.
+
+    Provenance: the PR 2 PROGRAM_* scan plus the PR 5/PR 10
+    TASK_BACKOFF / TASK_PREEMPT_* extensions, generalized from
+    hand-listed attribute sets to every reference."""
+    return _check_registry_attrs(
+        ctx, "goodput-kind-undeclared", _EVENTS_MODULE, gp_events,
+        gp_events.EVENT_KINDS, "goodput EVENT_KINDS")
+
+
+@rule("goodput-kind-unpriced", family="registry")
+def check_goodput_kind_unpriced(ctx: AnalysisContext) -> list[Finding]:
+    """Every registered event kind must be priced by the accounting
+    sweep (_KIND_CATEGORY) or be a declared instantaneous marker
+    (MARKER_EVENT_KINDS): an unpriced interval kind's seconds fall
+    into "unaccounted" and the goodput partition stops meaning
+    anything.
+
+    Provenance: the PR 5 TASK_BACKOFF review — the event existed
+    for a full review round before it was priced, and only the
+    partition-exactness assertion in a drill caught it. Anchored to
+    the EVENT_KINDS declaration in goodput/events.py."""
+    findings = []
+    src = ctx.get("batch_shipyard_tpu/goodput/events.py")
+    if src is None:
+        return findings
+    # Anchor findings at the EVENT_KINDS declaration.
+    line = 1
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                for t in node.targets):
+            line = node.lineno
+            break
+    priced = set(accounting._KIND_CATEGORY) | set(MARKER_EVENT_KINDS)
+    for kind in sorted(gp_events.EVENT_KINDS):
+        if kind not in priced:
+            findings.append(Finding(
+                rule="goodput-kind-unpriced", path=src.rel, line=line,
+                message=(f"event kind {kind!r} is registered but "
+                         f"neither priced by accounting."
+                         f"_KIND_CATEGORY nor declared an "
+                         f"instantaneous marker")))
+    return findings
+
+
+@rule("trace-span-undeclared", family="registry")
+def check_span_undeclared(ctx: AnalysisContext) -> list[Finding]:
+    """Every span-kind constant referenced through a trace/spans
+    alias must be declared there AND registered in SPAN_KINDS — an
+    unknown kind is dropped at emit, so the exporter's parent-link
+    tree silently loses a node.
+
+    Provenance: the PR 7 SPAN_* scan from test_names_consistency,
+    generalized to every aliased reference."""
+    return _check_registry_attrs(
+        ctx, "trace-span-undeclared", _SPANS_MODULE, trace_spans,
+        trace_spans.SPAN_KINDS, "trace SPAN_KINDS")
+
+
+@rule("trace-span-no-with", family="registry")
+def check_span_no_with(ctx: AnalysisContext) -> list[Finding]:
+    """goodput.span / trace span / phase are context managers: called
+    as a bare statement the interval is OPENED (generator created)
+    but never closed — nothing is emitted, no exception, just a
+    missing row. The open must have a reachable close, which the
+    ``with`` statement guarantees (emit lives in its finally).
+
+    Provenance: the PR 7 serve-span review, where a bare
+    spans.phase(...) call in a prototype recorded nothing for an
+    entire benchmark run before anyone noticed the missing rows."""
+    span_fns = {"span", "phase"}
+    findings = []
+    for src in ctx.python_files:
+        gp_aliases = _module_aliases(src.tree, _EVENTS_MODULE)
+        tr_aliases = _module_aliases(src.tree, _SPANS_MODULE)
+        aliases = gp_aliases | tr_aliases
+        if not aliases:
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in span_fns and \
+                    isinstance(call.func.value, ast.Name) and \
+                    call.func.value.id in aliases:
+                findings.append(Finding(
+                    rule="trace-span-no-with", path=src.rel,
+                    line=node.lineno,
+                    message=(f"{call.func.value.id}."
+                             f"{call.func.attr}(...) called as a "
+                             f"bare statement opens a span that "
+                             f"never closes; use `with`")))
+    return findings

@@ -1,0 +1,100 @@
+"""Shell-layer rules: the shellcheck-equivalent pass.
+
+The reference Batch Shipyard's CI was lint-only but it DID lint its
+shell (shellcheck over the nodeprep/task-runner scripts,
+SURVEY.md:264-268). This container has no shellcheck binary and
+nothing may be installed, so these rules implement the small,
+high-signal subset that matters for our two-file shell layer
+(install.sh, tools/*.sh), documented as the shellcheck stand-in in
+docs/34-static-analysis.md. Rules key on raw lines, not AST.
+"""
+
+from __future__ import annotations
+
+import re
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, rule)
+
+_STRICT_RE = re.compile(r"^\s*set\s+-[a-zA-Z]*e")
+_COMMENT_RE = re.compile(r"^\s*#")
+# Unquoted $VAR (or ${VAR}) as an argument to a path-consuming
+# command: word-splitting/globbing on the expansion (shellcheck
+# SC2086's highest-stakes instances).
+_UNQUOTED_RE = re.compile(
+    r"(?:^|[;&|]\s*|\s)(?:cd|rm|cp|mv|mkdir|rmdir|touch|source|\.)"
+    r"\s+(?:-[\w-]+\s+)*\$\{?[A-Za-z_]")
+_BACKTICK_RE = re.compile(r"`[^`]+`")
+
+
+@rule("shell-strict-mode", family="shell")
+def check_strict_mode(ctx: AnalysisContext) -> list[Finding]:
+    """A shell script without ``set -e`` (errexit) in its prologue
+    keeps running after a failed step — for install.sh that means a
+    half-built venv reported as success.
+
+    Provenance: the reference's install.sh ships `set -euo pipefail`
+    on line 2; ours must not regress below it. Scripts that handle
+    failure deliberately (probe loops) suppress inline with a
+    justification comment."""
+    findings = []
+    for src in ctx.shell_files:
+        head = src.lines[:15]
+        if any(_STRICT_RE.search(line) for line in head):
+            continue
+        findings.append(Finding(
+            rule="shell-strict-mode", path=src.rel, line=1,
+            message=("no `set -e` in the first 15 lines; failures "
+                     "cascade silently")))
+    return findings
+
+
+@rule("shell-unquoted-var", family="shell")
+def check_unquoted_var(ctx: AnalysisContext) -> list[Finding]:
+    """An unquoted ``$VAR`` argument to a path-consuming command
+    (cd/rm/cp/mv/mkdir/touch/source) word-splits and globs — with
+    ``rm`` the classic catastrophic form (shellcheck SC2086).
+
+    Provenance: the reference repo's shellcheck gate; our install.sh
+    quotes every expansion and stays that way."""
+    findings = []
+    for src in ctx.shell_files:
+        for idx, line in enumerate(src.lines, start=1):
+            if _COMMENT_RE.match(line):
+                continue
+            match = _UNQUOTED_RE.search(line)
+            if match is None:
+                continue
+            # Text inside an echo/printf message isn't a command —
+            # the cheap quoting-free check: anything echoed before
+            # the match is data, not code.
+            if re.search(r"\b(echo|printf)\b", line[:match.start()]):
+                continue
+            findings.append(Finding(
+                rule="shell-unquoted-var", path=src.rel,
+                line=idx,
+                message=("unquoted $VAR argument to a "
+                         "path-consuming command; quote the "
+                         "expansion")))
+    return findings
+
+
+@rule("shell-backtick-subst", family="shell")
+def check_backtick_subst(ctx: AnalysisContext) -> list[Finding]:
+    """Backtick command substitution doesn't nest and swallows
+    backslashes; use ``$(...)`` (shellcheck SC2006).
+
+    Provenance: the reference's shellcheck gate; kept so new tooling
+    scripts start from the modern form."""
+    findings = []
+    for src in ctx.shell_files:
+        for idx, line in enumerate(src.lines, start=1):
+            if _COMMENT_RE.match(line):
+                continue
+            if _BACKTICK_RE.search(line):
+                findings.append(Finding(
+                    rule="shell-backtick-subst", path=src.rel,
+                    line=idx,
+                    message="backtick command substitution; "
+                            "use $(...)"))
+    return findings

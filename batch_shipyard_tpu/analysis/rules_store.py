@@ -1,0 +1,234 @@
+"""Store-race rules: the lost-update bug class.
+
+Every coordination surface in this system is an optimistic-concurrency
+table (state/base.py): multi-writer rows are safe only through
+insert-as-claim (EntityExistsError = somebody else won) or
+etag-guarded merge (EtagMismatchError = re-fetch and re-decide).
+``upsert_entity`` replaces the WHOLE row unconditionally — on a
+shared-mutation table it silently erases a concurrent writer's
+columns, which is exactly the shape behind the PR 5 gang-row
+claim-marker leaks and the jobschedules double-launch fixed in this
+PR.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, call_name, const_str, keyword_arg,
+    module_str_consts, rule)
+from batch_shipyard_tpu.state import names
+
+# Tables with MULTI-WRITER row mutation: tasks/gangs/jobs rows are
+# written by the submitting client, every claiming/requeueing agent,
+# and the leader sweeps; pool rows by autoscale + CLI; jobschedules
+# rows by every concurrent schedule evaluator (CLI daemon and service
+# VM are both documented run modes, docs/04). Single-writer-per-row
+# tables (nodes: the owning agent; monitor: heimdall; jobprep: the
+# publishing worker) are exempt — a blind write there races nobody.
+SHARED_MUTATION_TABLE_ATTRS = frozenset({
+    "TABLE_TASKS", "TABLE_GANGS", "TABLE_JOBS", "TABLE_POOLS",
+    "TABLE_JOBSCHEDULES",
+})
+SHARED_MUTATION_TABLE_VALUES = frozenset(
+    getattr(names, attr) for attr in SHARED_MUTATION_TABLE_ATTRS)
+
+_WRITE_METHODS = {"upsert_entity", "merge_entity"}
+_FETCH_NAMES = {"get_entity", "get_task", "get_job", "get_node"}
+
+
+def _table_token(call: ast.Call,
+                 consts: dict[str, str]) -> Optional[str]:
+    """Resolve a store call's table argument to its string value:
+    handles names.TABLE_X attributes, string literals, and
+    module-level constants (_SCHED_TABLE = ... / _TABLE = names.X)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Attribute):
+        return getattr(names, arg.attr, arg.attr)
+    value = const_str(arg)
+    if value is not None:
+        return value
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    return None
+
+
+def _attr_table_map(tree: ast.AST) -> dict[str, str]:
+    """Extend the module constant map with NAME = names.TABLE_X
+    assignments resolved through the registry."""
+    out = module_str_consts(tree)
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Attribute):
+            resolved = getattr(names, node.value.attr, None)
+            if isinstance(resolved, str):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = resolved
+    return out
+
+
+@rule("store-blind-upsert", family="store")
+def check_blind_upsert(ctx: AnalysisContext) -> list[Finding]:
+    """``upsert_entity`` on a shared-mutation table (tasks, gangs,
+    jobs, pools, jobschedules) replaces the whole row with no
+    concurrency guard: a racing writer's columns are silently lost.
+
+    Provenance: the PR 5 chaos drills exposed gang claim markers
+    leaked by exactly this lost-update shape, and the jobschedules
+    read-modify-write-upsert let two concurrent schedule evaluators
+    double-launch the same recurrence (fixed in this PR —
+    jobs/schedules.py now claims the run with insert/etag-merge).
+    Fix: insert_entity as a claim, merge_entity with if_match, or
+    move the row to a single-writer table."""
+    findings = []
+    for src in ctx.python_files:
+        consts = _attr_table_map(src.tree)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "upsert_entity"):
+                continue
+            table = _table_token(node, consts)
+            if table in SHARED_MUTATION_TABLE_VALUES:
+                findings.append(Finding(
+                    rule="store-blind-upsert", path=src.rel,
+                    line=node.lineno,
+                    message=(f"blind upsert_entity on shared-mutation "
+                             f"table {table!r}; use insert_entity "
+                             f"(claim) or etag-guarded merge_entity")))
+    return findings
+
+
+def _tainted_names(body: list[ast.stmt]) -> dict[str, int]:
+    """Names bound (directly or one assignment hop) from a fetched
+    entity, mapped to the line the taint was introduced."""
+    tainted: dict[str, int] = {}
+
+    def expr_tainted(expr: ast.expr) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and \
+                    call_name(sub) in _FETCH_NAMES:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted and \
+                    isinstance(sub.ctx, ast.Load):
+                return True
+        return False
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign) and \
+                    expr_tainted(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        tainted.setdefault(target.id, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and node.value and \
+                    isinstance(node.target, ast.Name) and \
+                    expr_tainted(node.value):
+                tainted.setdefault(node.target.id, node.lineno)
+    return tainted
+
+
+@rule("store-rmw-no-etag", family="store")
+def check_rmw_no_etag(ctx: AnalysisContext) -> list[Finding]:
+    """Read-modify-write without ``if_match`` on a shared-mutation
+    table: an entity is fetched, a value derived from it is written
+    back via merge_entity/upsert_entity with no etag guard — between
+    the read and the write any concurrent writer's update is lost.
+
+    Provenance: the jobschedules double-launch (this PR): two
+    evaluators both read run_number=N and both launched instance N.
+    The blessed shape is the terminate_task idiom (jobs/manager.py):
+    merge with if_match=entity["_etag"], re-fetch on
+    EtagMismatchError."""
+    findings = []
+    for src in ctx.python_files:
+        consts = _attr_table_map(src.tree)
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            tainted = _tainted_names(fn.body)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node) in _WRITE_METHODS):
+                    continue
+                if keyword_arg(node, "if_match") is not None:
+                    continue
+                table = _table_token(node, consts)
+                if table not in SHARED_MUTATION_TABLE_VALUES:
+                    continue
+                entity_arg = (keyword_arg(node, "entity")
+                              or (node.args[3] if len(node.args) > 3
+                                  else None))
+                if entity_arg is None:
+                    continue
+                derived = any(
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in tainted
+                    and tainted[sub.id] < node.lineno
+                    for sub in ast.walk(entity_arg))
+                if derived:
+                    findings.append(Finding(
+                        rule="store-rmw-no-etag", path=src.rel,
+                        line=node.lineno,
+                        message=(f"read-modify-write on {table!r} "
+                                 f"writes fetched-entity data back "
+                                 f"without if_match; pass the read's "
+                                 f"_etag and handle "
+                                 f"EtagMismatchError")))
+    return findings
+
+
+@rule("store-etag-retry-no-refetch", family="store")
+def check_etag_retry_no_refetch(ctx: AnalysisContext) -> list[Finding]:
+    """An ``except EtagMismatchError`` handler that writes again
+    WITHOUT re-fetching retries the same stale decision: the mismatch
+    means the row changed, so every retry must re-read and re-decide
+    (it may no longer be valid — the task may have completed, the
+    gang may have resized).
+
+    Provenance: the PR 10 preemption-sweep review — a stale-etag
+    retry on the victim stamp would have re-preempted a task that had
+    already exited. The blessed shape re-fetches first
+    (jobs/manager.py terminate_task)."""
+    findings = []
+    for src in ctx.python_files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            handled = node.type
+            mentions = handled is not None and any(
+                isinstance(sub, (ast.Name, ast.Attribute)) and
+                ("EtagMismatchError" == getattr(sub, "id", None)
+                 or "EtagMismatchError" == getattr(sub, "attr", None))
+                for sub in ast.walk(handled))
+            if not mentions:
+                continue
+            fetch_lines = []
+            write_calls = []
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = call_name(sub)
+                    if name in _FETCH_NAMES:
+                        fetch_lines.append(sub.lineno)
+                    elif name in _WRITE_METHODS or \
+                            name == "insert_entity":
+                        write_calls.append(sub)
+            for write in write_calls:
+                if not any(line <= write.lineno
+                           for line in fetch_lines):
+                    findings.append(Finding(
+                        rule="store-etag-retry-no-refetch",
+                        path=src.rel, line=write.lineno,
+                        message=("store write inside an "
+                                 "EtagMismatchError handler without "
+                                 "re-fetching the entity first; the "
+                                 "row changed — re-read and "
+                                 "re-decide")))
+    return findings

@@ -1,0 +1,132 @@
+"""Wiring rules: surfaces that exist must be reachable and honest.
+
+A fleet action with no CLI call site is dead surface nobody can
+reach; a hardcoded help string listing chaos kinds goes stale the
+day a kind is added; a train workload that skips the compile-cache
+hooks silently pays a cold XLA compile on every node restart. These
+were all hand-listed checks in tests/test_names_consistency.py —
+now registered rules that cover the whole surface automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, call_name, rule)
+
+_CLI_PATH = "batch_shipyard_tpu/cli/main.py"
+_FLEET_PATH = "batch_shipyard_tpu/fleet.py"
+
+
+@rule("wiring-cli-action-unwired", family="wiring")
+def check_cli_action_unwired(ctx: AnalysisContext) -> list[Finding]:
+    """Every ``action_*`` function in fleet.py must have a call site
+    in cli/main.py — an unwired action is surface nobody can reach
+    from the shipyard CLI (the reference's fleet.py/shipyard.py
+    pairing, where every action has exactly one CLI verb).
+
+    Provenance: the PR 7 trace/profile wiring check
+    (test_names_consistency), widened from the trace actions to the
+    whole action surface."""
+    fleet_src = ctx.get(_FLEET_PATH)
+    cli_src = ctx.get(_CLI_PATH)
+    if fleet_src is None or cli_src is None:
+        return []
+    actions = {
+        (node.name, node.lineno)
+        for node in ast.walk(fleet_src.tree)
+        if isinstance(node, ast.FunctionDef)
+        and node.name.startswith("action_")}
+    called = {
+        node.func.attr for node in ast.walk(cli_src.tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "fleet"}
+    findings = []
+    for name, line in sorted(actions):
+        if name not in called:
+            findings.append(Finding(
+                rule="wiring-cli-action-unwired", path=_FLEET_PATH,
+                line=line,
+                message=(f"fleet.{name} has no cli/main.py call "
+                         f"site; dead surface")))
+    return findings
+
+
+@rule("wiring-kinds-help-stale", family="wiring")
+def check_kinds_help_stale(ctx: AnalysisContext) -> list[Finding]:
+    """The chaos ``--kinds`` help must be BUILT from
+    chaos.plan.INJECTION_KINDS (a ``.join(INJECTION_KINDS)``), not
+    hardcoded: a hand-written list goes stale silently the day a
+    kind is added, and operators pass kinds they read in --help.
+
+    Provenance: the PR 10 node_preempt_notice review
+    (test_names_consistency), where the help was derived precisely
+    so this could never drift."""
+    cli_src = ctx.get(_CLI_PATH)
+    if cli_src is None:
+        return []
+    joins = 0
+    kinds_options = 0
+    for node in ast.walk(cli_src.tree):
+        if isinstance(node, ast.Call):
+            if call_name(node) == "join" and node.args and \
+                    isinstance(node.args[0], ast.Attribute) and \
+                    node.args[0].attr == "INJECTION_KINDS":
+                joins += 1
+            if call_name(node) == "option" and any(
+                    isinstance(a, ast.Constant)
+                    and a.value == "--kinds" for a in node.args):
+                kinds_options += 1
+    # One derived join per --kinds option: a NEW option with a
+    # hand-written help must not hide behind the existing derived
+    # ones.
+    if joins < kinds_options:
+        return [Finding(
+            rule="wiring-kinds-help-stale", path=_CLI_PATH, line=1,
+            message=(f"{kinds_options} --kinds option(s) but only "
+                     f"{joins} help string(s) derive from "
+                     f"chaos.plan.INJECTION_KINDS via "
+                     f"', '.join(INJECTION_KINDS)"))]
+    return []
+
+
+@rule("wiring-compile-cache-optout", family="wiring")
+def check_compile_cache_optout(ctx: AnalysisContext) -> list[Finding]:
+    """Every workload that builds a parallel.train harness must call
+    compilecache.enable_from_args AND add_compile_cache_args: a
+    workload that silently opts out pays a cold XLA compile on every
+    node and every restart — exactly the compile badput the
+    warm-start pipeline (PR 4) removes.
+
+    Provenance: migrated verbatim from test_names_consistency's
+    train-workload scan."""
+    findings = []
+    for src in ctx.python_files:
+        if not (src.rel.startswith("batch_shipyard_tpu/workloads/"
+                                   "train_")
+                and src.rel.endswith(".py")):
+            continue
+        uses_train = any(
+            isinstance(node, ast.ImportFrom) and
+            node.module == "batch_shipyard_tpu.parallel" and
+            any(alias.name == "train" for alias in node.names)
+            for node in ast.walk(src.tree))
+        if not uses_train:
+            continue
+        calls = {
+            call_name(node)
+            for node in ast.walk(src.tree)
+            if isinstance(node, ast.Call)}
+        for required in ("enable_from_args", "add_compile_cache_args"):
+            if required not in calls:
+                findings.append(Finding(
+                    rule="wiring-compile-cache-optout", path=src.rel,
+                    line=1,
+                    message=(f"parallel.train workload never calls "
+                             f"compilecache.{required}; it silently "
+                             f"opts out of the persistent compile "
+                             f"cache")))
+    return findings

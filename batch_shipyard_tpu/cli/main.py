@@ -698,6 +698,46 @@ def trace_prune(click_ctx, older_than_hours):
     click.echo(f"pruned {removed} spans from pool {ctx.pool.id}")
 
 
+# -------------------------------- lint ---------------------------------
+
+@cli.command("lint")
+@click.option("--baseline-update", is_flag=True, default=False,
+              help="Rewrite .shipyard-lint-baseline.json from the "
+                   "current findings (sorted, path-relative, "
+                   "deterministic)")
+@click.option("--rules", default="",
+              help="Comma-separated rule ids to run (default all)")
+@click.option("--list-rules", is_flag=True, default=False,
+              help="Print the rule inventory with bug provenance")
+@click.pass_context
+def lint(click_ctx, baseline_update, rules, list_rules):
+    """Run the distributed-invariant static analyzer (docs/34):
+    store-race, hot-loop, env-contract, goodput/trace-registry, JAX,
+    wiring, and shell rules over this source tree. Exits 1 on any
+    finding not in the checked-in baseline; suppress intentional
+    sites inline with `# shipyard-lint: disable=<rule-id>`."""
+    rule_ids = tuple(r.strip() for r in rules.split(",")
+                     if r.strip()) or None
+    if baseline_update and rule_ids:
+        raise click.UsageError(
+            "--baseline-update rewrites the WHOLE baseline and "
+            "cannot be combined with --rules")
+    if rule_ids:
+        # A flag typo must read as a usage error, not as findings.
+        from batch_shipyard_tpu import analysis
+        unknown = [r for r in rule_ids if r not in analysis.RULES]
+        if unknown:
+            raise click.UsageError(
+                f"unknown rule(s) {', '.join(unknown)}; see "
+                f"`shipyard-tpu lint --list-rules`")
+    report = fleet.action_lint(
+        None, baseline_update=baseline_update, rules=rule_ids,
+        list_rules=list_rules, raw=click_ctx.obj["raw"])
+    if not baseline_update and not list_rules and \
+            not report.get("clean", True):
+        raise SystemExit(1)
+
+
 # ------------------------------- chaos ---------------------------------
 
 @cli.group()

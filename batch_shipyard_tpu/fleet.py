@@ -801,6 +801,52 @@ def action_data_stream(ctx: Context, job_id: str, task_id: str,
 
 # ----------------------------- diagnostics -----------------------------
 
+def action_lint(ctx_or_none, baseline_update: bool = False,
+                rules: Optional[tuple[str, ...]] = None,
+                list_rules: bool = False,
+                raw: bool = False) -> dict:
+    """Run the distributed-invariant static analyzer (analysis/) over
+    this source tree and report findings against the checked-in
+    baseline. Needs no live pool or config context — it is the same
+    gate tests/test_analysis.py runs in tier-1.
+
+    ``baseline_update=True`` rewrites .shipyard-lint-baseline.json
+    deterministically (sorted, path-relative, line numbers omitted)
+    from the current findings, so triage diffs review like code.
+    Returns the report dict; callers exit nonzero on new findings."""
+    from batch_shipyard_tpu import analysis
+    if list_rules:
+        rows = [{"rule": r.id, "family": r.family,
+                 "doc": " ".join(r.doc.split())}
+                for r in sorted(analysis.RULES.values(),
+                                key=lambda r: (r.family, r.id))]
+        _emit({"rules": rows}, raw)
+        return {"rules": rows}
+    if baseline_update and rules:
+        # The baseline is rewritten WHOLE from the run's findings: a
+        # partial-rule run would silently drop every other rule's
+        # triaged entries.
+        raise ValueError(
+            "--baseline-update requires a full-rule run; drop "
+            "--rules")
+    root = analysis.repo_root()
+    report = analysis.analyze(root=root, rule_ids=rules)
+    if baseline_update:
+        analysis.write_baseline(
+            root / analysis.BASELINE_FILENAME, report.all_active)
+        payload = {"baseline": analysis.BASELINE_FILENAME,
+                   "recorded": len(report.all_active)}
+        _emit(payload, raw)
+        return payload
+    payload = report.to_dict()
+    # Stale entries fail here too, exactly like the tier-1 pytest
+    # gate — the two surfaces must agree or triage debt stops
+    # shrinking.
+    payload["clean"] = not report.new and not report.stale_baseline
+    _emit(payload, raw)
+    return payload
+
+
 def action_perf_events(ctx: Context, raw: bool = False) -> None:
     from batch_shipyard_tpu.agent import perf
     events = [{"t": e["timestamp"], "node": e["node_id"],

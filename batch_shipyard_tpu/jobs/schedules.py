@@ -23,12 +23,13 @@ from typing import Optional
 from batch_shipyard_tpu.config.settings import JobSettings, PoolSettings
 from batch_shipyard_tpu.jobs import manager as jobs_mgr
 from batch_shipyard_tpu.state import names
-from batch_shipyard_tpu.state.base import NotFoundError, StateStore
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
 
-_SCHED_TABLE = "jobschedules"
+_SCHED_TABLE = names.TABLE_JOBSCHEDULES
 
 
 def _parse_ts(value: Optional[str]) -> Optional[float]:
@@ -82,13 +83,35 @@ def run_due_schedules(store: StateStore, pool: PoolSettings,
                 pass
         run_number = int(state.get("run_number", 0))
         inst = instance_id(job.id, run_number)
-        instance_settings = _instantiate(job, inst)
-        jobs_mgr.add_jobs(store, pool, [instance_settings])
-        store.upsert_entity(_SCHED_TABLE, pool.id, job.id, {
+        # Claim the recurrence BEFORE submitting: evaluators run
+        # concurrently (CLI daemon + pool service VM are both
+        # documented run modes, docs/04), and the old blind upsert
+        # after add_jobs let two of them read run_number=N and both
+        # launch instance N. insert-as-claim covers the first run,
+        # etag-guarded merge every later one; losing the race means
+        # another evaluator owns this recurrence. If add_jobs then
+        # fails, the claimed run is skipped — the next interval fires
+        # normally — which beats a double submission.
+        claim = {
             "run_number": run_number + 1,
             "last_run_at": now,
             "active_instance": inst,
-        })
+        }
+        try:
+            etag = state.get("_etag")
+            if etag:
+                store.merge_entity(_SCHED_TABLE, pool.id, job.id,
+                                   claim, if_match=etag)
+            else:
+                store.insert_entity(_SCHED_TABLE, pool.id, job.id,
+                                    claim)
+        except (EtagMismatchError, EntityExistsError):
+            logger.info("schedule %s: recurrence %d claimed by a "
+                        "concurrent evaluator; skipping", job.id,
+                        run_number)
+            continue
+        instance_settings = _instantiate(job, inst)
+        jobs_mgr.add_jobs(store, pool, [instance_settings])
         launched.append(inst)
         logger.info("schedule %s launched instance %s", job.id, inst)
     return launched
@@ -122,6 +145,11 @@ def register_schedules(store: StateStore, pool_id: str,
             raise ValueError(
                 f"schedule {raw['id']}: recurrence.schedule."
                 f"recurrence_interval_seconds is required")
+        # Template rows are operator-CLI single-writer surface and
+        # re-registration REPLACES the spec by design — blind upsert
+        # is the intended semantics here, unlike the multi-evaluator
+        # schedule-state rows above.
+        # shipyard-lint: disable=store-blind-upsert
         store.upsert_entity(
             _SCHED_TABLE, f"{pool_id}#templates", raw["id"],
             {"spec": raw})

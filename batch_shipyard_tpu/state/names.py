@@ -20,6 +20,8 @@ TABLE_PERF = "perf"            # pk=f"{pool}",         rk=f"{ts}${uniq}"
 TABLE_GOODPUT = "goodput"      # pk=pool_id,           rk=f"{ts}${uniq}"
 TABLE_TRACE = "trace"          # pk=pool_id,           rk=f"{ts}${uniq}"
 TABLE_IMAGES = "images"        # pk=pool_id,           rk=image hash
+TABLE_JOBSCHEDULES = "jobschedules"  # pk=pool_id (templates:
+#                                      f"{pool}#templates"), rk=job_id
 TABLE_MONITOR = "monitor"      # pk="monitor",         rk=resource id
 TABLE_FEDERATIONS = "federations"  # pk="fed",         rk=federation_id
 TABLE_FEDJOBS = "fedjobs"      # pk=federation_id,     rk=job id

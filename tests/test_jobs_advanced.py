@@ -141,6 +141,75 @@ def test_migrate_job_between_pools():
         substrate.stop_all()
 
 
+class _StaleScheduleReadStore:
+    """Proxy store replaying a CANNED read of the schedule row — the
+    deterministic form of a concurrent evaluator that snapshotted
+    state before the other evaluator wrote. Every other operation
+    (including the claim write) hits the live store."""
+
+    def __init__(self, store, stale_entity):
+        self._store = store
+        self._stale = stale_entity
+
+    def get_entity(self, table, pk, rk):
+        if table == names.TABLE_JOBSCHEDULES:
+            from batch_shipyard_tpu.state.base import NotFoundError
+            if self._stale is None:
+                raise NotFoundError(f"{table}:{pk}:{rk}")
+            return dict(self._stale)
+        return self._store.get_entity(table, pk, rk)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def test_schedule_concurrent_evaluators_launch_once():
+    """Regression (PR 11, found by shipyard lint's
+    store-blind-upsert): two schedule evaluators racing on one
+    recurrence — both read run_number=N before either writes — must
+    launch exactly ONE instance. The loser's claim hits
+    EntityExistsError (first run) or EtagMismatchError (later runs)
+    and skips; the old blind upsert let both launch instance N."""
+    store, substrate, pool = make_env()
+    try:
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "race",
+            "recurrence": {"schedule": {
+                "recurrence_interval_seconds": 1}},
+            "tasks": [{"command": "echo tick"}],
+        }]})
+        t0 = time.time()
+        # Evaluator A wins the first recurrence.
+        assert schedules.run_due_schedules(
+            store, pool, jobs, now=t0) == ["race-r00000"]
+        # Evaluator B read BEFORE A wrote (no row yet): its
+        # insert-claim must collide and skip — no duplicate
+        # race-r00000 submission, no exception.
+        stale = _StaleScheduleReadStore(store, None)
+        assert schedules.run_due_schedules(
+            stale, pool, jobs, now=t0) == []
+        # Later recurrence: A launches r00001; B holds the row as it
+        # was BEFORE (run_number=1, stale etag) — its etag-guarded
+        # merge must lose, not double-launch r00001.
+        row_before = store.get_entity(
+            names.TABLE_JOBSCHEDULES, pool.id, "race")
+        assert schedules.run_due_schedules(
+            store, pool, jobs, now=t0 + 1.5) == ["race-r00001"]
+        stale = _StaleScheduleReadStore(store, row_before)
+        assert schedules.run_due_schedules(
+            stale, pool, jobs, now=t0 + 1.5) == []
+        # Exactly one row per instance; run_number advanced once per
+        # real launch (the lost updates never landed).
+        final = store.get_entity(
+            names.TABLE_JOBSCHEDULES, pool.id, "race")
+        assert final["run_number"] == 2
+        assert final["active_instance"] == "race-r00001"
+        for inst in ("race-r00000", "race-r00001"):
+            assert jobs_mgr.get_job(store, pool.id, inst)
+    finally:
+        substrate.stop_all()
+
+
 def test_schedule_launches_instances():
     store, substrate, pool = make_env()
     try:

@@ -1,151 +1,70 @@
-"""Static consistency: every state-store table the package touches is
-declared in state/names.py — a new table (e.g. TABLE_GOODPUT) cannot
-be typo-forked into a parallel name nobody reads.
+"""Static consistency — now a thin wrapper over `shipyard lint`.
 
-Pure AST scan over batch_shipyard_tpu/**/*.py; cheap by design (no
-imports of the scanned modules, no JAX)."""
+The table/event/span/state/CLI-action AST scans that used to live
+here are registered analyzer rules (batch_shipyard_tpu/analysis/,
+PR 11); each historical test keeps its name and coverage but runs
+the corresponding rule over the real tree, so tier-1 sees the same
+gates while the CLI (`shipyard lint`) and tests/test_analysis.py
+share one implementation. Checks with no analyzer analog (committed
+bench artifacts, tools/ cross-file wiring) stay native below.
+"""
 
 import ast
 import pathlib
 
+from batch_shipyard_tpu import analysis
 from batch_shipyard_tpu.state import names
 
 PACKAGE = pathlib.Path(names.__file__).resolve().parent.parent
 
-# StateStore methods whose first argument is a table name.
-_TABLE_METHODS = {
-    "insert_entity", "upsert_entity", "merge_entity", "get_entity",
-    "query_entities", "delete_entity", "insert_entities",
-}
-
-_DECLARED_ATTRS = {attr for attr in dir(names)
-                   if attr.startswith("TABLE_")}
-_DECLARED_VALUES = {getattr(names, attr) for attr in _DECLARED_ATTRS}
+_CTX = analysis.AnalysisContext.from_tree()
 
 
-def _iter_package_sources():
-    for path in sorted(PACKAGE.rglob("*.py")):
-        yield path, ast.parse(path.read_text(encoding="utf-8"),
-                              filename=str(path))
+def _run(rule_id: str) -> list:
+    """Active findings of one analyzer rule over the real tree
+    (inline-suppressed sites excluded, like the lint gate)."""
+    active, _ = analysis.run_rules(_CTX, [rule_id])
+    return active
+
+
+def _fail_lines(findings) -> str:
+    return "\n".join(f.render() for f in findings)
 
 
 def test_declared_table_values_are_unique():
-    assert len(_DECLARED_VALUES) == len(_DECLARED_ATTRS), (
+    declared = {a for a in dir(names) if a.startswith("TABLE_")}
+    values = {getattr(names, a) for a in declared}
+    assert len(values) == len(declared), (
         "two TABLE_* constants in state/names.py share a value")
 
 
 def test_every_table_literal_is_declared():
-    problems = []
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            # Any TABLE_* attribute/name reference must resolve to a
-            # declared constant.
-            if isinstance(node, ast.Attribute) and \
-                    node.attr.startswith("TABLE_"):
-                if node.attr not in _DECLARED_ATTRS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: undeclared "
-                        f"{node.attr}")
-            # A string literal passed as the table argument of a
-            # store call must be a declared table VALUE.
-            if isinstance(node, ast.Call) and isinstance(
-                    node.func, ast.Attribute) and \
-                    node.func.attr in _TABLE_METHODS and node.args:
-                first = node.args[0]
-                if isinstance(first, ast.Constant) and \
-                        isinstance(first.value, str):
-                    if first.value not in _DECLARED_VALUES:
-                        problems.append(
-                            f"{rel}:{node.lineno}: table literal "
-                            f"{first.value!r} not declared in "
-                            f"state/names.py")
-    assert not problems, "\n".join(problems)
+    findings = _run("registry-table-undeclared")
+    assert not findings, _fail_lines(findings)
 
 
 def test_goodput_table_declared():
     # The event log's table rides the same registry as every other
     # coordination surface.
     assert names.TABLE_GOODPUT == "goodput"
-    assert "TABLE_GOODPUT" in _DECLARED_ATTRS
+    assert hasattr(names, "TABLE_GOODPUT")
+    # PR 11: the schedule table joined the registry when the analyzer
+    # caught its hand-rolled literal.
+    assert names.TABLE_JOBSCHEDULES == "jobschedules"
 
 
 def test_goodput_program_constants_are_declared():
-    """Every PROGRAM_* constant referenced at an emit site resolves
-    to a declared constant in goodput/events.py whose value is a
-    registered EVENT_KIND — a typo'd phase name cannot silently
-    produce events the accounting drops."""
-    from batch_shipyard_tpu.goodput import events as gp_events
-    problems = []
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Attribute) and \
-                    node.attr.startswith("PROGRAM_"):
-                value = getattr(gp_events, node.attr, None)
-                if value is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} not "
-                        f"declared in goodput/events.py")
-                elif value not in gp_events.EVENT_KINDS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} value "
-                        f"{value!r} missing from EVENT_KINDS")
-    assert not problems, "\n".join(problems)
+    """Every event-kind constant referenced through a goodput/events
+    alias resolves there and is registered in EVENT_KINDS (analyzer
+    rule goodput-kind-undeclared, generalizing the old PROGRAM_*
+    scan)."""
+    findings = _run("goodput-kind-undeclared")
+    assert not findings, _fail_lines(findings)
 
 
 def test_task_state_literals_come_from_the_registry():
-    """Every task-state string literal compared against or written to
-    a task entity's "state" must be a member of names.TASK_STATES (or
-    the auxiliary vocabularies) — a typo'd state ("quarantined" vs
-    "quarantine") would silently dodge every terminal-state check in
-    the fleet. Scans comparisons (==, in) whose other side mentions
-    "state" and dict literals with a "state" key."""
-    allowed = (set(names.TASK_STATES) | set(names.NODE_STATES)
-               | set(names.AUX_STATES))
-    problems = []
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            # {"state": "<literal>"} entity patches.
-            if isinstance(node, ast.Dict):
-                for key, value in zip(node.keys, node.values):
-                    if isinstance(key, ast.Constant) and \
-                            key.value == "state" and \
-                            isinstance(value, ast.Constant) and \
-                            isinstance(value.value, str):
-                        if value.value not in allowed:
-                            problems.append(
-                                f"{rel}:{node.lineno}: state "
-                                f"literal {value.value!r} not in "
-                                f"state/names.py vocabularies")
-            # state == "<literal>" / state in ("<literal>", ...)
-            if isinstance(node, ast.Compare):
-                mentions_state = "state" in ast.dump(node.left).lower()
-                if not mentions_state:
-                    continue
-                for comparator in node.comparators:
-                    literals = []
-                    if isinstance(comparator, ast.Constant) and \
-                            isinstance(comparator.value, str):
-                        literals = [comparator.value]
-                    elif isinstance(comparator, (ast.Tuple, ast.List,
-                                                 ast.Set)):
-                        literals = [
-                            e.value for e in comparator.elts
-                            if isinstance(e, ast.Constant) and
-                            isinstance(e.value, str)]
-                    for literal in literals:
-                        # Upper-case literals are cloud-API enums
-                        # (GCE VM states), not our vocabulary.
-                        if literal and literal not in allowed and \
-                                literal.isidentifier() and \
-                                literal == literal.lower():
-                            problems.append(
-                                f"{rel}:{node.lineno}: state "
-                                f"literal {literal!r} not in "
-                                f"state/names.py vocabularies")
-    assert not problems, "\n".join(problems)
+    findings = _run("registry-state-literal")
+    assert not findings, _fail_lines(findings)
 
 
 def test_quarantine_and_health_names_declared():
@@ -161,46 +80,25 @@ def test_quarantine_and_health_names_declared():
 
 
 def test_task_and_backoff_event_constants_are_declared():
-    """Every TASK_* event constant referenced at an emit site (the
-    retry supervisor's TASK_RETRY/TASK_BACKOFF among them) resolves
-    to a declared goodput/events.py constant registered in
-    EVENT_KINDS, and the backoff category is priced by the
-    accounting sweep (not silently dropped into 'unaccounted')."""
+    """The retry supervisor's TASK_RETRY/TASK_BACKOFF (and every
+    other event constant) are covered by the undeclared-kind rule;
+    the backoff pricing invariant is covered by the unpriced-kind
+    rule plus the direct asserts."""
     from batch_shipyard_tpu.goodput import accounting
     from batch_shipyard_tpu.goodput import events as gp_events
-    problems = []
-    event_attrs = {"TASK_QUEUED", "TASK_IMAGE_PULL",
-                   "TASK_CONTAINER_START", "TASK_RUNNING",
-                   "TASK_RETRY", "TASK_BACKOFF"}
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Attribute) and \
-                    node.attr in event_attrs:
-                value = getattr(gp_events, node.attr, None)
-                if value is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} not "
-                        f"declared in goodput/events.py")
-                elif value not in gp_events.EVENT_KINDS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} value "
-                        f"{value!r} missing from EVENT_KINDS")
-    assert not problems, "\n".join(problems)
+    findings = _run("goodput-kind-undeclared")
+    findings += _run("goodput-kind-unpriced")
+    assert not findings, _fail_lines(findings)
     assert accounting._KIND_CATEGORY[
         gp_events.TASK_BACKOFF] == "backoff"
     assert "backoff" in accounting.BADPUT_CATEGORIES
 
 
 def test_preemption_and_resize_names_declared():
-    """PR 10's vocabulary rides the registries: the preempted task
-    state is NON-terminal and claimable; every TASK_PREEMPT_* /
-    GANG_RESIZE event constant referenced at an emit site resolves to
-    a declared goodput/events.py constant registered in EVENT_KINDS;
-    the recovery interval is priced as the preemption_recovery badput
-    category (never silently 'unaccounted'); and the preempt/resize
-    trace spans ride SPAN_KINDS (enforced by the generic SPAN_ scan
-    too)."""
+    """PR 10's vocabulary: preempted is NON-terminal and claimable;
+    the TASK_PREEMPT_*/GANG_RESIZE kinds are declared+registered
+    (rule), actually referenced at emit sites (native scan — dead
+    registry check), and the recovery leg is priced."""
     from batch_shipyard_tpu.goodput import accounting
     from batch_shipyard_tpu.goodput import events as gp_events
     from batch_shipyard_tpu.trace import spans as trace_spans
@@ -210,29 +108,18 @@ def test_preemption_and_resize_names_declared():
         names.TERMINAL_TASK_STATES
     assert names.TASK_STATE_PREEMPTED in names.CLAIMABLE_TASK_STATES
     assert set(names.CLAIMABLE_TASK_STATES) <= set(names.TASK_STATES)
-    problems = []
+    findings = _run("goodput-kind-undeclared")
+    assert not findings, _fail_lines(findings)
+    # Every kind of the family is actually referenced at an emit
+    # site — a declared-but-never-emitted kind is dead registry.
     event_attrs = {"TASK_PREEMPT_NOTICE", "TASK_PREEMPT_EXIT",
                    "TASK_PREEMPT_RECOVERY", "GANG_RESIZE"}
     referenced = set()
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
+    for src in _CTX.python_files:
+        for node in ast.walk(src.tree):
             if isinstance(node, ast.Attribute) and \
-                    (node.attr in event_attrs
-                     or node.attr.startswith("TASK_PREEMPT_")):
+                    node.attr in event_attrs:
                 referenced.add(node.attr)
-                value = getattr(gp_events, node.attr, None)
-                if value is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} not "
-                        f"declared in goodput/events.py")
-                elif value not in gp_events.EVENT_KINDS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} value "
-                        f"{value!r} missing from EVENT_KINDS")
-    assert not problems, "\n".join(problems)
-    # Every kind of the new family is actually referenced at an emit
-    # site — a declared-but-never-emitted kind is dead registry.
     assert event_attrs <= referenced, event_attrs - referenced
     assert accounting._KIND_CATEGORY[
         gp_events.TASK_PREEMPT_RECOVERY] == "preemption_recovery"
@@ -242,26 +129,13 @@ def test_preemption_and_resize_names_declared():
 
 
 def test_chaos_kinds_help_lists_node_preempt_notice():
-    """`chaos plan --kinds` (and drill) inline the valid kinds from
-    INJECTION_KINDS — the new advance-notice kind must be in the
-    registry AND the CLI help must actually derive from it (a
-    hardcoded help string would go stale silently)."""
+    """The --kinds help derives from INJECTION_KINDS (analyzer rule
+    wiring-kinds-help-stale) and the rendered help really names the
+    advance-notice kind."""
     from batch_shipyard_tpu.chaos.plan import INJECTION_KINDS
     assert "node_preempt_notice" in INJECTION_KINDS
-    cli_tree = ast.parse(
-        (PACKAGE / "cli" / "main.py").read_text(encoding="utf-8"))
-    # Each --kinds option's help is built by joining INJECTION_KINDS.
-    joins = 0
-    for node in ast.walk(cli_tree):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr == "join" and node.args and \
-                isinstance(node.args[0], ast.Attribute) and \
-                node.args[0].attr == "INJECTION_KINDS":
-            joins += 1
-    assert joins >= 2, (
-        "--kinds help no longer derives from INJECTION_KINDS")
-    # And the rendered help really names the new kind.
+    findings = _run("wiring-kinds-help-stale")
+    assert not findings, _fail_lines(findings)
     import click
 
     from batch_shipyard_tpu.cli import main as cli_main
@@ -296,38 +170,8 @@ def test_scheduler_scale_workload_dispatched_and_rendered():
 
 
 def test_train_workloads_enable_the_compile_cache():
-    """Every workload that builds a parallel.train harness must go
-    through the compilecache enable hook (compilecache.
-    enable_from_args) AND register its flag surface
-    (add_compile_cache_args) — a workload that silently opts out of
-    the persistent cache pays a cold XLA compile on every node and
-    every restart, exactly the badput the warm-start pipeline exists
-    to remove (mirrors the no-blocking-checkpoint-save check)."""
-    problems = []
-    for path in sorted((PACKAGE / "workloads").glob("train_*.py")):
-        source = path.read_text(encoding="utf-8")
-        tree = ast.parse(source, filename=str(path))
-        rel = path.relative_to(PACKAGE.parent)
-        uses_train = any(
-            isinstance(node, ast.ImportFrom) and
-            node.module == "batch_shipyard_tpu.parallel" and
-            any(alias.name == "train" for alias in node.names)
-            for node in ast.walk(tree))
-        if not uses_train:
-            continue
-        calls = {
-            node.func.attr
-            for node in ast.walk(tree)
-            if isinstance(node, ast.Call) and
-            isinstance(node.func, ast.Attribute)}
-        for required in ("enable_from_args",
-                         "add_compile_cache_args"):
-            if required not in calls:
-                problems.append(
-                    f"{rel}: parallel.train workload never calls "
-                    f"compilecache.{required} — it silently opts "
-                    f"out of the persistent compile cache")
-    assert not problems, "\n".join(problems)
+    findings = _run("wiring-compile-cache-optout")
+    assert not findings, _fail_lines(findings)
 
 
 def _tpu_checks_names():
@@ -337,21 +181,21 @@ def _tpu_checks_names():
     path = PACKAGE.parent / "tools" / "tpu_checks.py"
     tree = ast.parse(path.read_text(encoding="utf-8"),
                      filename=str(path))
-    names = set()
+    out = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for target in node.targets:
                 if isinstance(target, ast.Name) and \
                         target.id == "CHECKS" and \
                         isinstance(node.value, ast.Dict):
-                    names |= {k.value for k in node.value.keys
-                              if isinstance(k, ast.Constant)}
+                    out |= {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)}
                 if isinstance(target, ast.Subscript) and \
                         isinstance(target.value, ast.Name) and \
                         target.value.id == "CHECKS" and \
                         isinstance(target.slice, ast.Constant):
-                    names.add(target.slice.value)
-    return names
+                    out.add(target.slice.value)
+    return out
 
 
 def test_kernel_select_names_are_backed_by_tpu_checks():
@@ -359,14 +203,13 @@ def test_kernel_select_names_are_backed_by_tpu_checks():
     dispatch (kernel_select.resolve_auto / kernel_validated) must be
     a tools/tpu_checks.py CHECKS entry — a typo'd gate name would
     keep a Pallas fast path off forever with no failing check to say
-    why (the ring_collectives / dense_decode_int8 gates among
-    them)."""
+    why (stays native: tpu_checks.py lives outside the analyzer's
+    package scope)."""
     check_names = _tpu_checks_names()
     assert check_names, "could not parse tpu_checks.CHECKS"
     problems = []
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
+    for src in _CTX.python_files:
+        for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
@@ -380,8 +223,8 @@ def test_kernel_select_names_are_backed_by_tpu_checks():
                 check = node.args[0].value
                 if check not in check_names:
                     problems.append(
-                        f"{rel}:{node.lineno}: kernel_select gate "
-                        f"{check!r} has no tools/tpu_checks.py "
+                        f"{src.rel}:{node.lineno}: kernel_select "
+                        f"gate {check!r} has no tools/tpu_checks.py "
                         f"CHECKS entry")
     assert not problems, "\n".join(problems)
 
@@ -455,86 +298,26 @@ def test_benchgen_phase_and_workload_names_exist():
     assert not missing, (
         f"silicon_proof.py invokes bench workloads {sorted(missing)} "
         f"that bench.py never dispatches")
-    # The new kernel phase is wired end to end.
+    # The kernel phase is wired end to end.
     assert "ring_collectives" in recorded
     assert "ring_collectives" in dispatched
 
 
 def test_span_kinds_are_declared_in_trace_spans():
-    """Every SPAN_* constant referenced at an emit site anywhere in
-    the package must resolve to a declared constant in trace/spans.py
-    whose value is registered in SPAN_KINDS — a typo'd span kind
-    would silently produce spans the exporter drops (the same rule
-    the goodput PROGRAM_* constants live under)."""
-    from batch_shipyard_tpu.trace import spans as trace_spans
-    problems = []
-    for path, tree in _iter_package_sources():
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Attribute) and \
-                    node.attr.startswith("SPAN_"):
-                value = getattr(trace_spans, node.attr, None)
-                if value is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} not "
-                        f"declared in trace/spans.py")
-                elif value not in trace_spans.SPAN_KINDS:
-                    problems.append(
-                        f"{rel}:{node.lineno}: {node.attr} value "
-                        f"{value!r} missing from SPAN_KINDS")
-    assert not problems, "\n".join(problems)
+    findings = _run("trace-span-undeclared")
+    assert not findings, _fail_lines(findings)
     # The span log's table rides the names registry like every other
     # coordination surface.
     assert names.TABLE_TRACE == "trace"
-    assert "TABLE_TRACE" in _DECLARED_ATTRS
 
 
 def test_trace_and_profile_fleet_actions_are_wired_in_cli():
-    """Every fleet trace/profile action (action_trace_* and
-    action_jobs_profile) must have a cli/main.py call site — an
-    unwired action is dead surface nobody can reach (`shipyard trace
-    show|export`, `shipyard jobs profile`)."""
-    fleet_tree = ast.parse(
-        (PACKAGE / "fleet.py").read_text(encoding="utf-8"))
-    actions = {
-        node.name for node in ast.walk(fleet_tree)
-        if isinstance(node, ast.FunctionDef)
-        and (node.name.startswith("action_trace_")
-             or node.name == "action_jobs_profile")}
-    assert actions, "no trace/profile actions found in fleet.py"
-    cli_tree = ast.parse(
-        (PACKAGE / "cli" / "main.py").read_text(encoding="utf-8"))
-    called = {
-        node.func.attr for node in ast.walk(cli_tree)
-        if isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Attribute)
-        and isinstance(node.func.value, ast.Name)
-        and node.func.value.id == "fleet"}
-    missing = actions - called
-    assert not missing, (
-        f"fleet trace/profile actions {sorted(missing)} are not "
-        f"wired in cli/main.py")
+    """Widened by the analyzer: EVERY fleet action_* needs a
+    cli/main.py call site now, not just the trace/profile family."""
+    findings = _run("wiring-cli-action-unwired")
+    assert not findings, _fail_lines(findings)
 
 
 def test_train_loops_never_call_blocking_checkpoint_save():
-    """The train workloads must drive checkpoints through
-    checkpoint.TrainCheckpointer (which routes to the async manager
-    under --async-checkpoint): a direct blocking ``checkpoint.save``
-    in a step loop reintroduces the full-persist stall the zero-stall
-    pipeline exists to remove, and skips the stale-step guard."""
-    problems = []
-    for path in sorted((PACKAGE / "workloads").glob("train_*.py")):
-        tree = ast.parse(path.read_text(encoding="utf-8"),
-                         filename=str(path))
-        rel = path.relative_to(PACKAGE.parent)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr == "save" and \
-                    isinstance(node.func.value, ast.Name) and \
-                    node.func.value.id == "checkpoint":
-                problems.append(
-                    f"{rel}:{node.lineno}: direct blocking "
-                    f"checkpoint.save() in a train workload — use "
-                    f"checkpoint.TrainCheckpointer")
-    assert not problems, "\n".join(problems)
+    findings = _run("jax-blocking-save-in-train")
+    assert not findings, _fail_lines(findings)

@@ -258,3 +258,25 @@ def test_retention_time_removes_task_dir():
                                   "t").strip() == b"kept"
     finally:
         substrate.stop_all()
+
+
+def test_docker_env_contract_forwards_task_dir_and_slot():
+    """Regression (PR 11, found by shipyard lint's
+    env-docker-unmapped): SHIPYARD_TASK_SLOT must cross the docker
+    boundary as a passthrough and SHIPYARD_TASK_DIR as the REMAPPED
+    container path — docker run starts from an empty env, so before
+    the fix both vars existed for runtime=none tasks and silently
+    vanished inside containers."""
+    from batch_shipyard_tpu.agent import task_runner
+    execution = task_runner.TaskExecution(
+        pool_id="p", job_id="j", task_id="t", node_id="n",
+        node_index=0, command="echo x", runtime="docker",
+        image="busybox", env={}, task_dir="/tmp/envmap-test", slot=3)
+    argv = task_runner.synthesize_command(execution)
+    pairs = set(zip(argv, argv[1:]))
+    assert ("-e", "SHIPYARD_TASK_SLOT") in pairs
+    # The host path would be a lie inside the container: the task
+    # dir is mounted at /shipyard/task, so the forwarded value must
+    # be the mount, not the passthrough.
+    assert ("-e", "SHIPYARD_TASK_DIR=/shipyard/task") in pairs
+    assert ("-e", "SHIPYARD_TASK_DIR") not in pairs
