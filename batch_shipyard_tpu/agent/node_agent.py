@@ -182,6 +182,16 @@ class NodeAgent:
         # same dedup protocol as _profile_delivered (one drain per
         # request; disk markers persist the dedup across restarts).
         self._preempt_delivered: set[tuple] = set()
+        # (job_id, task_id) keys THIS agent hard-killed through the
+        # eviction escalation: the completion path classifies the
+        # exit as evicted (claimable, full budget, neutral health)
+        # instead of a wedge/failure. Popped at classification.
+        self._evicted_locally: set[tuple[str, str]] = set()
+        # Stale preempt-request file janitor cadence (heartbeat
+        # loop, per-node disk sweep — shares the gang janitor's
+        # interval knob but needs no leader gate: each node owns its
+        # own task dirs).
+        self._last_preempt_file_sweep = time.monotonic()
         # Short-TTL per-task preempt_request cache ((request, at)):
         # the heartbeat forwarding loop must not cost one store read
         # per live task per beat on cloud backends just to learn no
@@ -420,6 +430,7 @@ class NodeAgent:
                 self._sweep_retention()
                 self._sweep_orphaned_gangs()
                 self._sweep_preemptions()
+                self._sweep_stale_preempt_files()
                 self._forward_profile_requests()
                 self._forward_preempt_requests()
                 self._ingest_live_trace_spans()
@@ -1011,6 +1022,20 @@ class NodeAgent:
                     names.TASK_COL_PREEMPT_COUNT, 0)},
                 trace_id=entity.get(trace_context.COL_TRACE_ID),
                 span_id=entity.get(trace_context.COL_TRACE_SPAN))
+        # Eviction-recovery interval: hard-killed exit -> this claim,
+        # priced as the distinct `eviction` leg (same claim-side,
+        # once-per-eviction protocol as the preemption leg above).
+        evicted_at = entity.get(names.TASK_COL_EVICTED_AT)
+        if evicted_at and now > float(evicted_at):
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_EVICTION_RECOVERY, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=float(evicted_at), end=now,
+                attrs={"evict_count": entity.get(
+                    names.TASK_COL_EVICT_COUNT, 0)},
+                trace_id=entity.get(trace_context.COL_TRACE_ID),
+                span_id=entity.get(trace_context.COL_TRACE_SPAN))
 
     def _ensure_images_timed(self, job_id: str, task_id: str,
                              spec: dict,
@@ -1268,7 +1293,16 @@ class NodeAgent:
         workload drains to a step boundary, commits, and exits
         EXIT_PREEMPTED — requeued at full budget. One victim per
         starved task per sweep: cooperative preemption converges over
-        sweeps instead of mass-evicting a pool in one pass."""
+        sweeps instead of mass-evicting a pool in one pass.
+
+        ESCALATION (the same scan): a victim whose pending request is
+        older than preempt_grace_seconds never drained — the sweep
+        stamps the request escalated, and the owning node's heartbeat
+        loop hard-kills the process (_enforce_eviction). The exit is
+        then classified `evicted`: claimable at full budget like
+        `preempted`, but resuming from the last COMMITTED checkpoint
+        BEFORE the notice, and priced as the distinct `eviction`
+        badput leg."""
         if self.preempt_sweep_interval <= 0:
             return
         if (time.monotonic() - self._last_preempt_sweep
@@ -1298,8 +1332,15 @@ class NodeAgent:
                     continue
                 starved.append((priority, since, row))
             elif state in ("assigned", "running"):
-                if row.get(names.TASK_COL_PREEMPT_REQUEST):
-                    continue  # already draining; one request each
+                request = row.get(names.TASK_COL_PREEMPT_REQUEST)
+                if isinstance(request, dict):
+                    # Already draining — unless the notice lapsed, in
+                    # which case the ladder's next rung fires: stamp
+                    # the escalation so the owning node hard-kills.
+                    self._maybe_escalate_eviction(row, request, now)
+                    continue
+                if request:
+                    continue  # malformed stamp; never a victim twice
                 victims.append((priority, row))
         if not starved or not victims:
             return
@@ -1331,6 +1372,136 @@ class NodeAgent:
             if not isinstance(request, dict):
                 continue
             self._deliver_preempt_request(job_id, task_id, request)
+            # Escalation enforcement is LOCAL: the leader stamped the
+            # decision on the entity; only the node holding the live
+            # process can actually kill it (gang instances each die
+            # on their own node).
+            if request.get("escalated_at"):
+                self._enforce_eviction(job_id, task_id)
+
+    def _maybe_escalate_eviction(self, row: dict, request: dict,
+                                 now: float) -> None:
+        """Leader-side escalation decision: a pending preempt request
+        older than preempt_grace_seconds means the victim ignored its
+        notice — stamp ``escalated_at`` on the request (etag-guarded,
+        exactly one escalation per request) so the owning node's
+        heartbeat loop hard-kills it. The stamp is what classifies
+        the subsequent exit as ``evicted`` rather than a failure."""
+        if request.get("escalated_at"):
+            return
+        requested = goodput_events.iso_to_epoch(
+            request.get("requested_at"))
+        if requested is None or \
+                now - requested <= self.preempt_grace_seconds:
+            return
+        pk_parts = row["_pk"].split("$", 1)
+        job_id = pk_parts[1] if len(pk_parts) == 2 else row["_pk"]
+        try:
+            self.store.merge_entity(
+                names.TABLE_TASKS, row["_pk"], row["_rk"],
+                {names.TASK_COL_PREEMPT_REQUEST: {
+                    **request,
+                    "escalated_at": util.datetime_utcnow_iso()}},
+                if_match=row["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return  # a concurrent transition (e.g. the drain) won
+        logger.warning(
+            "task %s/%s ignored its preempt notice for %.1fs "
+            "(grace %.1fs); escalating to forcible eviction",
+            job_id, row["_rk"], now - requested,
+            self.preempt_grace_seconds)
+
+    def _enforce_eviction(self, job_id: str, task_id: str) -> None:
+        """Hard-kill an escalated victim's live process group on THIS
+        node: docker containers are force-removed first (SIGKILL is
+        never proxied by the docker client — the task_runner wedge
+        lesson), then the group eats SIGKILL. The local marker makes
+        the completion path classify the exit as evicted."""
+        key = (job_id, task_id)
+        proc = self._live_procs.get(key)
+        if proc is None or key in self._evicted_locally:
+            return
+        self._evicted_locally.add(key)
+        logger.warning("evicting %s/%s: hard kill after ignored "
+                       "preempt notice", job_id, task_id)
+        import shutil as shutil_mod
+        import signal as signal_mod
+        import subprocess as subprocess_mod
+        if shutil_mod.which("docker"):
+            # Fixed-name convention (task_runner.container_name):
+            # one rm -f per possible instance container of this task.
+            rc, out, _err = util.subprocess_capture(
+                ["docker", "ps", "--filter",
+                 f"name=shipyard-{job_id}-{task_id}-",
+                 "--format", "{{.Names}}"])
+            for name in (out.split() if rc == 0 else []):
+                subprocess_mod.call(
+                    ["docker", "rm", "-f", name],
+                    stdout=subprocess_mod.DEVNULL,
+                    stderr=subprocess_mod.DEVNULL)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal_mod.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def _sweep_stale_preempt_files(self) -> None:
+        """Per-node janitor for stale preempt-request files: an
+        EVICTED (never-drained) task's request file + .delivered
+        marker are only cleaned at next-attempt launch on the same
+        node — a node that never reclaims the task would leak them
+        forever (and its in-memory dedup key with them). Sweep this
+        node's task dirs on the gang-janitor cadence: any request
+        file whose task is not live here and no longer pending a
+        request (terminal, gone, re-owned, or already re-requested
+        under a newer requested_at) is garbage."""
+        if (time.monotonic() - self._last_preempt_file_sweep
+                < self.gang_sweep_interval):
+            return
+        self._last_preempt_file_sweep = time.monotonic()
+        root = os.path.join(self.work_dir, "tasks")
+        if not os.path.isdir(root):
+            return
+        for job_id in os.listdir(root):
+            job_dir = os.path.join(root, job_id)
+            if not os.path.isdir(job_dir):
+                continue
+            for task_id in os.listdir(job_dir):
+                if (job_id, task_id) in self._live_procs:
+                    continue  # delivery may still be in flight
+                targets = self._task_dir_targets(job_id, task_id)
+                paths = [os.path.join(d, "preempt_request.json")
+                         for d in targets]
+                if not any(os.path.exists(p) or
+                           os.path.exists(p + ".delivered")
+                           for p in paths):
+                    continue
+                try:
+                    entity = self._task_entity(job_id, task_id)
+                    pending = entity.get(
+                        names.TASK_COL_PREEMPT_REQUEST)
+                    stale = (
+                        entity.get("state")
+                        in names.TERMINAL_TASK_STATES
+                        or entity.get("node_id")
+                        != self.identity.node_id
+                        or not isinstance(pending, dict))
+                except NotFoundError:
+                    stale = True
+                except Exception:  # noqa: BLE001 - janitor survives
+                    logger.debug("preempt-file sweep probe failed",
+                                 exc_info=True)
+                    continue
+                if not stale:
+                    continue
+                for path in paths:
+                    for victim in (path, path + ".delivered"):
+                        try:
+                            os.remove(victim)
+                        except OSError:
+                            pass
+                    self._preempt_delivered = {
+                        k for k in self._preempt_delivered
+                        if k[0] != path}
 
     def _cached_task_preempt_request(self, job_id: str,
                                      task_id: str) -> Optional[dict]:
@@ -1356,6 +1527,15 @@ class NodeAgent:
             self._task_preempt_cache.clear()
         self._task_preempt_cache[key] = (request, now)
         return request
+
+    def _escalated_request_pending(self, job_id: str,
+                                   task_id: str) -> bool:
+        """True when the task's pending preempt request carries the
+        sweep's escalation stamp — the durable classification signal
+        for an evicted exit (one cached entity read)."""
+        request = self._cached_task_preempt_request(job_id, task_id)
+        return (isinstance(request, dict)
+                and bool(request.get("escalated_at")))
 
     def _task_dir_targets(self, job_id: str,
                           task_id: str) -> list[str]:
@@ -1501,6 +1681,92 @@ class NodeAgent:
         logger.warning(
             "task %s/%s preempted (count %d); requeued at full "
             "retry budget", job_id, task_id, count)
+        return True
+
+    def _requeue_evicted(self, job_id: str, task_id: str,
+                         spec: dict,
+                         instances: Optional[int] = None) -> bool:
+        """Evicted requeue: the victim ignored its notice and was
+        hard-killed after the grace window. Externally caused — so,
+        like a preemption, the retry counter is untouched (full
+        budget), no backoff is stamped, and node health is never
+        debited. UNLIKE a preemption the drain never happened: the
+        rerun resumes from the last COMMITTED checkpoint BEFORE the
+        notice, and the steps since that barrier are replayed — the
+        rework the distinct `eviction` badput leg prices. Requires a
+        pending ESCALATED preempt request on the entity (the sweep's
+        stamp is the classification); returns False otherwise so the
+        caller falls back to the retry supervisor."""
+        now = time.time()
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            return False
+        if entity.get("state") in names.TERMINAL_TASK_STATES:
+            return False
+        request = entity.get(names.TASK_COL_PREEMPT_REQUEST)
+        if not isinstance(request, dict) or \
+                not request.get("escalated_at"):
+            # A hard-killed exit WITHOUT an escalated request is not
+            # an eviction — the retry supervisor prices it (the
+            # spurious-75 rule's forcible sibling).
+            return False
+        count = int(
+            entity.get(names.TASK_COL_EVICT_COUNT, 0) or 0) + 1
+        try:
+            self._merge_task(job_id, task_id, {
+                "state": names.TASK_STATE_EVICTED,
+                "node_id": None,
+                names.TASK_COL_EVICTED_AT: now,
+                names.TASK_COL_EVICT_COUNT: count,
+                names.TASK_COL_PREEMPT_REQUEST: None,
+                "not_before": None,
+                "requeued_at": util.datetime_utcnow_iso(),
+            }, if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return False
+        goodput_events.emit(
+            self.store, self.identity.pool_id,
+            goodput_events.TASK_EVICTED, job_id=job_id,
+            task_id=task_id, node_id=self.identity.node_id,
+            attrs={"evict_count": count,
+                   "reason": request.get("reason")},
+            trace_id=entity.get(trace_context.COL_TRACE_ID),
+            span_id=entity.get(trace_context.COL_TRACE_SPAN))
+        # The burned notice window (notice -> hard-killed exit) on
+        # the trace: how long the victim squatted past its notice.
+        requested = goodput_events.iso_to_epoch(
+            request.get("requested_at"))
+        trace_spans.emit(
+            self.store, self.identity.pool_id,
+            trace_spans.SPAN_EVICT,
+            trace_context.TraceContext.from_entity(entity),
+            job_id=job_id, task_id=task_id,
+            node_id=self.identity.node_id,
+            start=(requested if requested and requested < now
+                   else now),
+            end=now,
+            attrs={"evict_count": count,
+                   "reason": request.get("reason")})
+        queue = names.task_queue_for(
+            self.identity.pool_id, task_id,
+            self.pool.task_queue_shards,
+            priority=int(spec.get("priority", 0) or 0))
+        message = {"job_id": job_id, "task_id": task_id}
+        if entity.get(trace_context.COL_TRACE_ID):
+            message["trace_id"] = entity[trace_context.COL_TRACE_ID]
+        if instances:
+            self.store.put_messages(
+                queue,
+                [json.dumps({**message, "instance": k}).encode()
+                 for k in range(instances)])
+        else:
+            self.store.put_message(queue,
+                                   json.dumps(message).encode())
+        logger.warning(
+            "task %s/%s evicted (count %d); requeued at full retry "
+            "budget — rerun resumes from the pre-notice COMMITTED "
+            "barrier", job_id, task_id, count)
         return True
 
     def _elastic_size(self, spec: dict,
@@ -1916,16 +2182,17 @@ class NodeAgent:
         if self.node_quarantined():
             return None
         try:
-            # preempted_at is consumed here: the claim closes the
-            # preemption-recovery interval (_goodput_work_started
-            # emits it from the pre-claim entity snapshot), and a
+            # preempted_at/evicted_at are consumed here: the claim
+            # closes the recovery intervals (_goodput_work_started
+            # emits them from the pre-claim entity snapshot), and a
             # LATER failure-requeue of this attempt must not re-open
-            # the old window.
+            # the old windows.
             return self._merge_task(
                 job_id, task_id,
                 {"state": "assigned",
                  "node_id": self.identity.node_id,
-                 names.TASK_COL_PREEMPTED_AT: None},
+                 names.TASK_COL_PREEMPTED_AT: None,
+                 names.TASK_COL_EVICTED_AT: None},
                 if_match=entity["_etag"])
         except (EtagMismatchError, NotFoundError):
             return None
@@ -2005,8 +2272,17 @@ class NodeAgent:
         # scheduling transition, never a failure — full retry budget,
         # no node-health debit, no backoff.
         preempted = result.exit_code == preempt_mod.EXIT_PREEMPTED
+        # The evicted status (the escalation ladder's hard kill): we
+        # killed it ourselves (local marker), or the sweep's
+        # escalation stamp is on the entity (cached read — covers a
+        # restart between kill and classification). Externally caused
+        # either way: never a wedge, never a node-health debit.
+        evicted = not ok and not preempted and (
+            (job_id, task_id) in self._evicted_locally
+            or self._escalated_request_pending(job_id, task_id))
+        self._evicted_locally.discard((job_id, task_id))
         self._note_task_outcome(ok, wedged=result.wedged,
-                                neutral=preempted)
+                                neutral=preempted or evicted)
         retries = entity.get("retries", 0)
         max_retries = spec.get("max_task_retries", 0)
         reason = ("wedged: no progress beat within "
@@ -2015,9 +2291,16 @@ class NodeAgent:
                   f"exit code {result.exit_code}")
         decision = ("complete" if ok
                     else "preempted" if preempted
+                    else "evicted" if evicted
                     else self._retry_decision(retries, max_retries))
         if decision == "preempted":
             if self._requeue_preempted(job_id, task_id, spec):
+                self._heartbeat(state="idle")
+                self.store.delete_message(msg)
+                return
+            decision = self._retry_decision(retries, max_retries)
+        if decision == "evicted":
+            if self._requeue_evicted(job_id, task_id, spec):
                 self._heartbeat(state="idle")
                 self.store.delete_message(msg)
                 return
@@ -2139,14 +2422,17 @@ class NodeAgent:
 
     @staticmethod
     def _gang_attempt(entity: dict) -> int:
-        """Rendezvous attempt index: retries + preempt_count. A
-        preempted requeue keeps the retry budget untouched but must
-        STILL re-form in a fresh partition — reusing the drained
-        attempt's partition would race its row cleanup against the
-        rerun's claims (a fast claimer could insert rows the
-        finalizer's clear then deletes, wedging the rendezvous)."""
+        """Rendezvous attempt index: retries + preempt_count +
+        evict_count. A preempted/evicted requeue keeps the retry
+        budget untouched but must STILL re-form in a fresh partition
+        — reusing the drained attempt's partition would race its row
+        cleanup against the rerun's claims (a fast claimer could
+        insert rows the finalizer's clear then deletes, wedging the
+        rendezvous)."""
         return (int(entity.get("retries", 0) or 0)
                 + int(entity.get(names.TASK_COL_PREEMPT_COUNT, 0)
+                      or 0)
+                + int(entity.get(names.TASK_COL_EVICT_COUNT, 0)
                       or 0))
 
     def _gang_pk(self, job_id: str, task_id: str,
@@ -2665,9 +2951,10 @@ class NodeAgent:
                 self._merge_task(job_id, task_id, {
                     "state": "running",
                     "started_at": util.datetime_utcnow_iso(),
-                    # Recovery interval closed by this attempt (the
+                    # Recovery intervals closed by this attempt (the
                     # gang analog of _claim_regular's clear).
-                    names.TASK_COL_PREEMPTED_AT: None})
+                    names.TASK_COL_PREEMPTED_AT: None,
+                    names.TASK_COL_EVICTED_AT: None})
             except NotFoundError:
                 pass
         gang_members = [
@@ -2748,9 +3035,12 @@ class NodeAgent:
             finally:
                 with self._running_lock:
                     self._running_tasks -= 1
+        gang_evicted = (job_id, task_id) in self._evicted_locally
+        self._evicted_locally.discard((job_id, task_id))
         self._note_task_outcome(
             result.exit_code == 0, wedged=result.wedged,
-            neutral=result.exit_code == preempt_mod.EXIT_PREEMPTED)
+            neutral=(result.exit_code == preempt_mod.EXIT_PREEMPTED
+                     or gang_evicted))
         try:
             self.store.merge_entity(
                 names.TABLE_GANGS, gang_pk, f"i{instance}",
@@ -2826,10 +3116,25 @@ class NodeAgent:
         spec = entity["spec"]
         retries = int(entity.get("retries", 0))
         max_retries = spec.get("max_task_retries", 0)
+        # An escalated request on the entity classifies a nonzero
+        # gang exit as evicted: every member was hard-killed (or died
+        # with the kill racing its own exit) — one externally-caused
+        # transition for the whole gang, never a budgeted failure.
+        request = entity.get(names.TASK_COL_PREEMPT_REQUEST)
+        evicted = (exit_code not in (0, preempt_mod.EXIT_PREEMPTED)
+                   and isinstance(request, dict)
+                   and bool(request.get("escalated_at")))
         decision = ("complete" if exit_code == 0
                     else "preempted"
                     if exit_code == preempt_mod.EXIT_PREEMPTED
+                    else "evicted" if evicted
                     else self._retry_decision(retries, max_retries))
+        if decision == "evicted":
+            if self._requeue_evicted(job_id, task_id, spec,
+                                     instances=num_instances):
+                self._clear_gang_rows(gang_pk)
+                return
+            decision = self._retry_decision(retries, max_retries)
         if decision == "preempted":
             # The whole gang drained cooperatively (every member ran
             # the same preempt-aware program): requeue all instances

@@ -81,6 +81,49 @@ def check_unpartitioned_scan(ctx: AnalysisContext) -> list[Finding]:
     return findings
 
 
+@rule("preempt-grace-unbounded", family="loop")
+def check_preempt_grace_unbounded(ctx: AnalysisContext
+                                  ) -> list[Finding]:
+    """A sweep that stamps preemption notices
+    (``request_preemption``) must have a reachable ESCALATION path
+    in the same function — a call whose name mentions escalate or
+    evict. Without one, a victim that ignores its notice squats on
+    the slot forever: the notice is a request, and a request with no
+    enforcement ladder is an unbounded grace window.
+
+    Provenance: the PR 10 -> PR 12 gap this rule's PR fixes —
+    cooperative-only preemption shipped a sweep that stamped notices
+    with NO escalation rung, documented only as an honesty paragraph
+    in docs/19; the forcible-eviction drill exists because nothing
+    structural kept the next sweep from repeating the shape. Scoped
+    to sweep/heartbeat-cadence functions: a manual CLI preempt and
+    the chaos injectors carry their own follow-through."""
+    findings = []
+    for src in ctx.python_files:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if not _is_hot(fn):
+                continue
+            calls = {call_name(node)
+                     for node in ast.walk(fn)
+                     if isinstance(node, ast.Call)}
+            calls.discard(None)
+            if "request_preemption" not in calls:
+                continue
+            if any("escalat" in name or "evict" in name
+                   for name in calls):
+                continue
+            findings.append(Finding(
+                rule="preempt-grace-unbounded", path=src.rel,
+                line=fn.lineno,
+                message=(f"sweep {fn.name!r} stamps preemption "
+                         f"notices but has no reachable escalation "
+                         f"path (no escalate/evict call) — a victim "
+                         f"that ignores its notice is never "
+                         f"evicted")))
+    return findings
+
+
 @rule("loop-sleep-in-sweep", family="loop")
 def check_sleep_in_sweep(ctx: AnalysisContext) -> list[Finding]:
     """``time.sleep`` inside a heartbeat/sweep function: the sweep

@@ -38,7 +38,8 @@ _DECLARED_TABLE_VALUES = {getattr(names, a)
 # a kind is a marker, not an interval.
 MARKER_EVENT_KINDS = frozenset({
     gp_events.TASK_RETRY, gp_events.TASK_PREEMPT_NOTICE,
-    gp_events.TASK_PREEMPT_EXIT, gp_events.GANG_RESIZE,
+    gp_events.TASK_PREEMPT_EXIT, gp_events.TASK_EVICTED,
+    gp_events.GANG_RESIZE,
 })
 
 _EVENTS_MODULE = "batch_shipyard_tpu.goodput.events"

@@ -163,6 +163,55 @@ def apply_injection(injection: Injection, substrate,
 
             threading.Thread(target=_revive, daemon=True,
                              name="chaos-revive").start()
+    elif injection.kind == "victim_ignore_notice":
+        # Forcible-eviction shape: stamp the cooperative request on a
+        # RUNNING task and stop there. The victim (an
+        # --ignore-notice probe) squats past preempt_grace_seconds;
+        # the sweep's escalation + the owning agent's enforcement —
+        # the code under test — must do the killing, so unlike
+        # node_preempt_notice there is NO injector follow-through.
+        victim = _pick_live_proc(agents, preferred=agent)
+        deadline = time.monotonic() + 2.0
+        while victim is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            victim = _pick_live_proc(
+                _live_agents(substrate, pool_id), preferred=None)
+        if victim is None:
+            return record
+        node, _proc = victim
+        live = list(node._live_procs.items())
+        if not live:
+            return record
+        (job_id, task_id), _proc = live[0]
+        record["node_id"] = node.identity.node_id
+        record["job_id"] = job_id
+        record["task_id"] = task_id
+        from batch_shipyard_tpu.jobs import manager as jobs_mgr
+        record["applied"] = bool(jobs_mgr.request_preemption(
+            node.store, pool_id, job_id, task_id,
+            reason="chaos victim_ignore_notice"))
+    elif injection.kind == "host_loss_resize":
+        # Permanent capacity loss: crash `count` nodes with NO
+        # revive — the elastic gang must re-form smaller and
+        # reshard-on-restore across the size change.
+        count = max(1, int(injection.param("count", 1)))
+        crashed = []
+        for k in range(count):
+            target = agents[(injection.node_index + k) % len(agents)]
+            if _crash_host(substrate, pool_id, target):
+                crashed.append(target.identity.node_id)
+        record["crashed"] = crashed
+        record["applied"] = bool(crashed)
+    elif injection.kind == "pool_capacity_loss":
+        # Total capacity loss: crash EVERY node of the pool, no
+        # revive. Nothing inside the pool can finish the job —
+        # recovery is the federation's cross-pool migration.
+        crashed = []
+        for target in agents:
+            if _crash_host(substrate, pool_id, target):
+                crashed.append(target.identity.node_id)
+        record["crashed"] = crashed
+        record["applied"] = bool(crashed)
     elif injection.kind == "node_preempt_notice":
         # Advance-notice preemption (the cloud spot shape): stamp a
         # cooperative preempt request on a RUNNING task, give the
@@ -219,6 +268,20 @@ def apply_injection(injection: Injection, substrate,
         threading.Thread(target=_follow_through, daemon=True,
                          name="chaos-preempt-notice").start()
     return record
+
+
+def _crash_host(substrate, pool_id: str, agent) -> bool:
+    """Kill a whole fakepod 'host': its task processes die WITH it
+    (a real host loss takes the workload down too — crash_node alone
+    only stops the agent threads), then the agent is crashed with no
+    offline write and no revival."""
+    for proc in list(agent._live_procs.values()):
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    return substrate.crash_node(pool_id,
+                                agent.identity.node_id) is not None
 
 
 def _live_agents(substrate, pool_id: str) -> list:

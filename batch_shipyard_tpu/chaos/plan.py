@@ -37,9 +37,35 @@ from typing import Optional
 #                        if the task is still running — a
 #                        preempt-aware workload drains and exits
 #                        first; an oblivious one eats the hard kill
+#   victim_ignore_notice — stamp a cooperative preempt request on a
+#                        running task and do NOTHING else: the
+#                        victim (an --ignore-notice probe) squats
+#                        past the grace window, and the sweep's
+#                        eviction escalation — not the injector —
+#                        must hard-kill it (the forcible-eviction
+#                        drill's shape)
+#   host_loss_resize   — crash `count` nodes of the pool with no
+#                        revive: permanent capacity loss mid-gang,
+#                        forcing the elastic resize + multi-host
+#                        reshard-on-restore path
+#   pool_capacity_loss — crash EVERY node of the pool: the gang can
+#                        never re-form here, and only cross-pool
+#                        migration (federation) can finish the job
 INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
                    "task_kill", "task_wedge", "node_preempt",
-                   "node_preempt_notice")
+                   "node_preempt_notice", "victim_ignore_notice",
+                   "host_loss_resize", "pool_capacity_loss")
+
+# Kinds a GENERIC drill's recovery invariants can absorb — the
+# default schedule. The fleet-elasticity kinds are excluded: they
+# exist to drive their dedicated drills (eviction / host-resize /
+# migration, chaos/drill.py), and e.g. pool_capacity_loss in a
+# single-pool generic drill is unrecoverable by construction (only
+# cross-pool migration finishes the job).
+DEFAULT_DRILL_KINDS = ("store_delay", "store_error",
+                       "heartbeat_blackout", "task_kill",
+                       "task_wedge", "node_preempt",
+                       "node_preempt_notice")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,8 +100,10 @@ class ChaosPlan:
         ``injections_per_kind`` (time, target, params) tuples from a
         seed-keyed RNG. Faults land in the middle 70% of the drill
         window so the pool has formed before the first one and has
-        runway to recover after the last."""
-        kinds = tuple(kinds or INJECTION_KINDS)
+        runway to recover after the last. Default kinds are the
+        generic-drill-recoverable set (DEFAULT_DRILL_KINDS); the
+        fleet-elasticity kinds must be requested explicitly."""
+        kinds = tuple(kinds or DEFAULT_DRILL_KINDS)
         unknown = [k for k in kinds if k not in INJECTION_KINDS]
         if unknown:
             raise ValueError(f"unknown injection kinds {unknown}")
@@ -105,6 +133,8 @@ class ChaosPlan:
                               round(rng.uniform(0.4, 1.2), 3),
                               "revive_after":
                               round(rng.uniform(0.3, 1.0), 3)}
+                elif kind == "host_loss_resize":
+                    params = {"count": 1}
                 out.append(Injection(
                     at=at, kind=kind, node_index=node_index,
                     params=tuple(sorted(params.items()))))

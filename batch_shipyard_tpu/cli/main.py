@@ -795,16 +795,39 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
                    "4-node gang — cooperative drain, forced "
                    "COMMITTED checkpoint, zero lost steps, retry "
                    "budget and node health untouched")
+@click.option("--evict", is_flag=True, default=False,
+              help="Run the forcible-eviction drill: a seeded "
+                   "victim_ignore_notice schedule against an "
+                   "--ignore-notice probe — hard kill after the "
+                   "grace window, exit classified evicted (full "
+                   "budget, neutral health), resume from the "
+                   "pre-notice COMMITTED barrier, eviction leg "
+                   "priced")
+@click.option("--resize", is_flag=True, default=False,
+              help="Run the multi-host resize drill: a seeded "
+                   "host_loss_resize schedule permanently crashes "
+                   "one host of a 2-host sharded gang — elastic "
+                   "re-form at 1 host, per-host reshard-on-restore "
+                   "plan followed exactly, bit-exact state, "
+                   "loss-trajectory oracle")
+@click.option("--migrate", is_flag=True, default=False,
+              help="Run the cross-pool migration drill: a seeded "
+                   "pool_capacity_loss schedule crashes every node "
+                   "under a federated gang — the elastic evaluator "
+                   "re-targets it onto the sibling pool, one trace "
+                   "spans the migration, migration leg priced")
 @click.pass_context
 def chaos_drill(click_ctx, seed, tasks, duration, kinds,
-                injections_per_kind, preempt):
+                injections_per_kind, preempt, evict, resize,
+                migrate):
     """Run the seeded drill on a local fakepod pool and assert the
     recovery invariants (nonzero exit = a self-healing regression)."""
     fleet.action_chaos_drill(
         None, seed, tasks=tasks, duration=duration,
         kinds=_parse_kinds(kinds),
         injections_per_kind=injections_per_kind,
-        preempt=preempt,
+        preempt=preempt, evict=evict, resize=resize,
+        migrate=migrate,
         raw=click_ctx.obj["raw"])
 
 

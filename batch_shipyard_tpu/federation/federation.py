@@ -61,10 +61,15 @@ from batch_shipyard_tpu.jobs import manager as jobs_mgr
 from batch_shipyard_tpu.pool import manager as pool_mgr
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
-    EntityExistsError, NotFoundError, StateStore)
+    EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
+
+
+def _iso_epoch(value):
+    from batch_shipyard_tpu.goodput import events as gp_events
+    return gp_events.iso_to_epoch(value)
 
 GLOBAL_LOCK_KEY = "federation/global-lock"
 LOCK_SECONDS = 30.0
@@ -260,10 +265,15 @@ def list_federation_jobs(store: StateStore,
 
 # --------------------------- constraint match --------------------------
 
-def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
+def _pool_facts(store: StateStore, pool_id: str,
+                stale_seconds: float = 30.0) -> Optional[dict]:
     """Assemble the scheduling facts for one member pool, including
     per-node occupancy (the node-level facts behind the reference's
-    _filter_pool_nodes_with_constraints, federation.py:1939)."""
+    _filter_pool_nodes_with_constraints, federation.py:1939) and
+    per-node LIVENESS (heartbeat/registration freshness — the
+    elastic evaluator's capacity signal: a crashed node's row lingers
+    in a non-offline state, and counting it as capacity would hide
+    exactly the starvation cross-pool migration exists to fix)."""
     try:
         entity = pool_mgr.get_pool(store, pool_id)
     except pool_mgr.PoolNotFoundError:
@@ -274,17 +284,25 @@ def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
     except (ValueError, KeyError):
         return None
     nodes = []
+    now = time.time()
     for row in store.query_entities(names.TABLE_NODES,
                                     partition_key=pool_id):
         slots = int(row.get("task_slots",
                             pool.task_slots_per_node) or 1)
         running = int(row.get("running_tasks", 0) or 0)
+        last_seen = float(row.get("heartbeat_at", 0) or 0)
+        if last_seen <= 0:
+            last_seen = float(row.get("registered_at", 0) or 0)
+        fresh = (row.get("state") not in ("offline",)
+                 and last_seen > 0
+                 and now - last_seen <= stale_seconds)
         nodes.append({
             "node_id": row["_rk"],
             "state": row.get("state", "unknown"),
             "task_slots": slots,
             "running_tasks": running,
             "free_slots": max(0, slots - running),
+            "fresh": fresh,
         })
     idle = [n for n in nodes if n["state"] == "idle"]
     ready = [n for n in nodes if n["state"] in pool_mgr.READY_STATES]
@@ -304,6 +322,7 @@ def _pool_facts(store: StateStore, pool_id: str) -> Optional[dict]:
         "nodes_total": len(nodes),
         "nodes_idle": len(idle),
         "nodes_ready": len(ready),
+        "nodes_live": sum(1 for n in nodes if n["fresh"]),
         "free_slots": sum(n["free_slots"] for n in ready),
         "backlog": backlog,
         "backlog_ratio": backlog / slots,
@@ -477,12 +496,25 @@ class FederationProcessor:
                  poll_interval: float = 1.0,
                  action_retry_delay: float = 5.0,
                  gc_interval: float = 300.0,
-                 after_success_blackout: float = 0.0) -> None:
+                 after_success_blackout: float = 0.0,
+                 elastic_interval: float = 30.0,
+                 elastic_grace_seconds: float = 60.0,
+                 node_stale_seconds: float = 30.0) -> None:
         self.store = store
         self.owner = owner or f"fedproc-{uuid.uuid4().hex[:8]}"
         self.poll_interval = poll_interval
         self.action_retry_delay = action_retry_delay
         self.gc_interval = gc_interval
+        # Cross-pool elasticity: every elastic_interval the lock
+        # holder re-examines PLACED jobs — a gang starved below its
+        # min_instances floor (or stranded on a pool with no live
+        # capacity) for elastic_grace_seconds is atomically
+        # re-targeted onto a sibling pool that satisfies its
+        # constraints. <=0 disables the evaluator.
+        self.elastic_interval = elastic_interval
+        self.elastic_grace_seconds = elastic_grace_seconds
+        self.node_stale_seconds = node_stale_seconds
+        self._last_elastic = 0.0
         # proxy_options.scheduling.after_success_blackout_interval: a
         # pool that just received a job is deprioritized for this many
         # seconds, spreading rapid-fire placements across members
@@ -529,6 +561,17 @@ class FederationProcessor:
                 except Exception:
                     logger.exception("federation GC failed for %s",
                                      fed["_rk"])
+        if self.elastic_interval > 0 and \
+                now - self._last_elastic >= self.elastic_interval:
+            self._last_elastic = now
+            for fed in feds:
+                try:
+                    processed += self.evaluate_elastic(fed["_rk"],
+                                                       fed)
+                except Exception:
+                    logger.exception(
+                        "federation elastic evaluation failed for "
+                        "%s", fed["_rk"])
         return processed
 
     def _is_zapped(self, federation_id: str, action_id: str) -> bool:
@@ -643,6 +686,11 @@ class FederationProcessor:
                     "pool_id": pool.id,
                     "action_id": action_id,
                     "action_ids": [action_id],
+                    # Persisted so the elastic evaluator can re-apply
+                    # the job's constraints when it later picks a
+                    # MIGRATION target (the action blob is not
+                    # consulted again after placement).
+                    "constraints": constraints,
                     "scheduled_at": util.datetime_utcnow_iso(),
                 })
         except EntityExistsError:
@@ -763,6 +811,267 @@ class FederationProcessor:
             "on pool %s", federation_id, added, action_id, job.id,
             pool_id)
         return True
+
+    # ------------------------- elastic actions -------------------------
+
+    def evaluate_elastic(self, federation_id: str,
+                         fed: dict) -> int:
+        """Cross-pool elasticity pass: for every PLACED job, migrate
+        gangs that are starved on their pool — preempted/evicted/
+        pending past the grace window, or stranded by total capacity
+        loss — onto a sibling pool that satisfies the job's recorded
+        constraints and its gang-size floor. Elasticity inside a pool
+        (the agent's resize paths) always gets first refusal: a pool
+        whose live capacity still covers min_instances is never
+        migrated away from. Returns the number of jobs migrated."""
+        rows = [r for r in self.store.query_entities(
+                    names.TABLE_FEDJOBS, partition_key=federation_id)
+                if not r["_rk"].startswith("zap$")]
+        if not rows:
+            return 0
+        facts = {}
+        for pool_id in fed.get("pools", []):
+            fact = _pool_facts(self.store, pool_id,
+                               stale_seconds=self.node_stale_seconds)
+            if fact is not None:
+                facts[pool_id] = fact
+        migrated = 0
+        for row in rows:
+            try:
+                migrated += self._maybe_migrate_job(federation_id,
+                                                    row, facts)
+            except Exception:
+                logger.exception(
+                    "elastic evaluation of job %s failed",
+                    row["_rk"])
+        return migrated
+
+    def _maybe_migrate_job(self, federation_id: str, row: dict,
+                           facts: dict) -> int:
+        job_id = row["_rk"]
+        src = row.get("pool_id")
+        src_fact = facts.get(src)
+        live = src_fact["nodes_live"] if src_fact else 0
+        try:
+            tasks = jobs_mgr.list_tasks(self.store, src, job_id)
+        except Exception:  # noqa: BLE001 - pool/job may be mid-GC
+            return 0
+        starved_since: Optional[float] = None
+        required = 0
+        now = util.utcnow().timestamp()
+        for task in tasks:
+            state = task.get("state")
+            if state in names.TERMINAL_TASK_STATES:
+                continue
+            spec = task.get("spec") or {}
+            mi = spec.get("multi_instance") or {}
+            size = int(mi.get("num_instances") or 1)
+            if size <= 1:
+                continue  # gang migration only (this evaluator)
+            floor = int(mi.get("min_instances") or size)
+            if live >= floor:
+                continue  # in-pool elastic resize can still win
+            if state in ("assigned", "running"):
+                # Stranded mid-run: only reclaimable when the WHOLE
+                # pool is dead (no live node could still be running a
+                # member whose results we would orphan). The reclaim
+                # stamps requeued_at; the grace clock below runs from
+                # it, so migration follows on a later pass.
+                if live == 0:
+                    self._reclaim_stranded_task(src, task)
+                continue
+            if state not in names.CLAIMABLE_TASK_STATES:
+                continue
+            since = _iso_epoch(task.get("requeued_at")
+                               or task.get("submitted_at"))
+            if since is None or \
+                    now - since < self.elastic_grace_seconds:
+                continue
+            required = max(required, floor)
+            starved_since = (since if starved_since is None
+                             else min(starved_since, since))
+        if not required or starved_since is None:
+            return 0
+        constraints = dict(row.get("constraints") or {})
+        if (constraints.get("required_target") or {}).get("pool_id"):
+            return 0  # operator pinned the pool; never migrate
+        candidates = [f for p, f in facts.items() if p != src]
+        eligible = filter_pools_hard_constraints(candidates,
+                                                 constraints)
+        eligible = filter_pool_nodes(eligible, constraints,
+                                     required_nodes=required)
+        # Migration needs capacity NOW: an autoscale-pending bin is a
+        # bet, and the job already lost one.
+        eligible = [f for f in eligible
+                    if not f.get("via_autoscale")
+                    and f["nodes_live"] >= required]
+        choice = greedy_best_fit(eligible)
+        if choice is None:
+            logger.info(
+                "federation %s: job %s starved on %s (live=%d < "
+                "floor=%d) but no sibling pool qualifies",
+                federation_id, job_id, src, live, required)
+            return 0
+        return self._migrate_starved_job(
+            federation_id, row, src, choice["pool_id"],
+            starved_since)
+
+    def _reclaim_stranded_task(self, pool_id: str,
+                               task: dict) -> None:
+        """Reset a task stranded on an all-dead pool to pending
+        (etag-guarded — exactly one evaluator wins), stamping
+        requeued_at so the starvation grace clock starts now."""
+        try:
+            self.store.merge_entity(
+                names.TABLE_TASKS, task["_pk"], task["_rk"],
+                {"state": "pending", "node_id": None,
+                 "requeued_at": util.datetime_utcnow_iso()},
+                if_match=task["_etag"])
+            logger.warning(
+                "federation: reclaimed task %s/%s stranded on dead "
+                "pool %s", task["_pk"], task["_rk"], pool_id)
+        except (EtagMismatchError, NotFoundError):
+            pass  # a peer evaluator (or the task itself) moved first
+
+    def _migrate_starved_job(self, federation_id: str, row: dict,
+                             src: str, dst: str,
+                             starved_since: float) -> int:
+        """Atomically re-target one job: claim the locator row first
+        (etag-guarded merge — a concurrent evaluator loses cleanly),
+        then disable -> migrate -> enable through the jobs manager,
+        carry the compile-cache seed across, and price/trace the
+        migration window. Task entities move verbatim, so checkpoint
+        references in specs and the submission's trace ids survive —
+        one trace spans the migration."""
+        job_id = row["_rk"]
+        try:
+            job_entity = jobs_mgr.get_job(self.store, src, job_id)
+        except jobs_mgr.JobNotFoundError:
+            return 0
+        # Claim the move WITHOUT re-pointing the locator yet: a
+        # migration that fails mid-flight (a src agent claimed a task
+        # in the race window, a transient store error) must leave the
+        # locator still naming the pool that actually holds the job,
+        # or every later evaluator pass would look for it in the
+        # wrong place forever.
+        try:
+            self.store.merge_entity(
+                names.TABLE_FEDJOBS, federation_id, job_id,
+                {"migrating_to": dst,
+                 "migrated_at": util.datetime_utcnow_iso()},
+                if_match=row["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            return 0  # another evaluator/replica claimed the move
+        disabled = False
+        moved = None
+        try:
+            if job_entity.get("state") == "active":
+                jobs_mgr.disable_job(self.store, src, job_id)
+                disabled = True
+            moved = jobs_mgr.migrate_job(self.store, src, job_id,
+                                         dst)
+            jobs_mgr.enable_job(self.store, dst, job_id)
+        except Exception:
+            logger.exception(
+                "federation %s: migration of job %s %s -> %s failed "
+                "mid-flight; rolling back for a later retry",
+                federation_id, job_id, src, dst)
+            if moved is None and disabled:
+                # The job never left the source: re-enable it there
+                # (best-effort — a failure here just leaves it
+                # disabled until the operator or the next pass acts).
+                try:
+                    jobs_mgr.enable_job(self.store, src, job_id)
+                except Exception:  # noqa: BLE001 - rollback is
+                    # best-effort by design
+                    logger.exception(
+                        "federation %s: re-enable of job %s on %s "
+                        "failed during rollback", federation_id,
+                        job_id, src)
+            try:
+                # Release the claim so a later pass can retry. When
+                # the tasks DID move but enable failed, point the
+                # locator at the destination anyway — that is where
+                # the job now lives.
+                self.store.merge_entity(
+                    names.TABLE_FEDJOBS, federation_id, job_id,
+                    ({"pool_id": dst, "migrated_from": src,
+                      "migrating_to": None}
+                     if moved is not None
+                     else {"migrating_to": None}))
+            except Exception:  # noqa: BLE001 - locator repair is
+                # best-effort; GC/retry reconciles
+                logger.exception(
+                    "federation %s: locator repair for job %s "
+                    "failed", federation_id, job_id)
+            return 0
+        # Success: re-point the locator (we hold the claim — the
+        # migrating_to stamp — so no concurrent evaluator writes it).
+        self.store.merge_entity(
+            names.TABLE_FEDJOBS, federation_id, job_id,
+            {"pool_id": dst, "migrated_from": src,
+             "migrating_to": None})
+        self._carry_compile_cache(src, dst)
+        now = util.utcnow().timestamp()
+        from batch_shipyard_tpu.goodput import events as gp_events
+        from batch_shipyard_tpu.trace import context as trace_ctx
+        from batch_shipyard_tpu.trace import spans as trace_spans
+        ctx = trace_ctx.TraceContext.from_entity(job_entity)
+        # Priced on the DESTINATION pool: that is where the resumed
+        # run's report is read, and the interval has fully elapsed
+        # (starved -> re-targeted) so nothing is future-dated.
+        gp_events.emit(
+            self.store, dst, gp_events.GANG_MIGRATE, job_id=job_id,
+            start=starved_since, end=now,
+            attrs={"from_pool": src, "to_pool": dst,
+                   "tasks": moved},
+            trace_id=job_entity.get(trace_ctx.COL_TRACE_ID),
+            span_id=job_entity.get(trace_ctx.COL_TRACE_SPAN))
+        trace_spans.emit(
+            self.store, dst, trace_spans.SPAN_GANG_MIGRATE, ctx,
+            job_id=job_id, start=starved_since, end=now,
+            attrs={"from_pool": src, "to_pool": dst,
+                   "tasks": moved})
+        logger.warning(
+            "federation %s: migrated job %s from starved pool %s to "
+            "%s (%d task(s), %.1fs starved)", federation_id, job_id,
+            src, dst, moved, now - starved_since)
+        return 1
+
+    def _carry_compile_cache(self, src: str, dst: str) -> None:
+        """Carry the source pool's compile-cache seed references to
+        the destination: identities the destination has never seen
+        get the tar copied and the dst latest.json pointed at it, so
+        the migrated gang compiles warm on arrival. Best-effort by
+        design — a failed carry costs one cold compile, never the
+        migration."""
+        from batch_shipyard_tpu.compilecache import (
+            seeding as cc_seeding)
+        try:
+            src_latest = cc_seeding.latest_info(self.store, src)
+            if not src_latest:
+                return
+            dst_latest = (cc_seeding.latest_info(self.store, dst)
+                          or {"identities": {}})
+            for identity, record in sorted(
+                    (src_latest.get("identities") or {}).items()):
+                if identity in dst_latest["identities"]:
+                    continue  # dst already has a seed; never clobber
+                src_key = record.get("key") or \
+                    names.compile_cache_key(src, identity)
+                dst_key = names.compile_cache_key(dst, identity)
+                self.store.put_object(
+                    dst_key, self.store.get_object(src_key))
+                cc_seeding._update_latest(
+                    self.store, dst, identity, {
+                        **{k: v for k, v in record.items()},
+                        "key": dst_key, "migrated_from": src})
+                logger.info(
+                    "carried compile-cache seed %s: pool %s -> %s",
+                    identity, src, dst)
+        except Exception:  # noqa: BLE001 - warm start is optional
+            logger.warning("compile-cache carry %s -> %s failed",
+                           src, dst, exc_info=True)
 
     def run(self) -> None:
         while not self.stop_event.is_set():

@@ -761,6 +761,9 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                        kinds: Optional[tuple[str, ...]] = None,
                        injections_per_kind: int = 1,
                        preempt: bool = False,
+                       evict: bool = False,
+                       resize: bool = False,
+                       migrate: bool = False,
                        raw: bool = False) -> dict:
     """Run a seeded chaos drill against a self-contained fakepod pool
     (chaos/drill.py) and report the recovery invariants: every task
@@ -772,11 +775,38 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     node_preempt_notice schedule against a running 4-node gang —
     cooperative drain, forced COMMITTED checkpoint, zero lost steps,
     retry budget + node health untouched, preemption_recovery
-    populated."""
+    populated.
+
+    The fleet-elasticity drills (one flag each, ISSUE 12):
+    ``evict=True`` — an --ignore-notice victim burns its grace
+    window, is hard-killed by the escalation ladder, classified
+    evicted (full budget, neutral health) and resumes from the
+    pre-notice COMMITTED barrier, with the ``eviction`` leg priced;
+    ``resize=True`` — a 2-host sharded gang loses a host permanently,
+    re-forms at 1 host and restores bit-exactly through the per-host
+    reshard plan; ``migrate=True`` — a two-pool federation loses ALL
+    capacity under a gang, which migrates to the sibling pool with
+    one trace spanning the move and the ``migration`` leg priced."""
     from batch_shipyard_tpu.chaos import drill
+    picked = [flag for flag, on in (("preempt", preempt),
+                                    ("evict", evict),
+                                    ("resize", resize),
+                                    ("migrate", migrate)) if on]
+    if len(picked) > 1:
+        raise ValueError(
+            f"pick at most one drill flag, got {picked}")
     if preempt:
         report = drill.run_preemption_drill(seed=seed,
                                             duration=duration)
+    elif evict:
+        report = drill.run_eviction_drill(seed=seed,
+                                          duration=duration)
+    elif resize:
+        report = drill.run_host_resize_drill(seed=seed,
+                                             duration=duration)
+    elif migrate:
+        report = drill.run_migration_drill(seed=seed,
+                                           duration=duration)
     else:
         report = drill.run_drill(
             seed=seed, tasks=tasks, duration=duration, kinds=kinds,

@@ -39,7 +39,8 @@ from batch_shipyard_tpu.state.base import StateStore
 # a real category.
 BADPUT_CATEGORIES = (
     "provisioning", "queueing", "backoff", "image_pull", "compile",
-    "checkpoint", "preemption_recovery", "idle", "unaccounted",
+    "checkpoint", "preemption_recovery", "eviction", "migration",
+    "idle", "unaccounted",
 )
 
 PRODUCTIVE = "productive"
@@ -65,6 +66,15 @@ _KIND_CATEGORY = {
     # pays (arxiv 2502.06982) — outranks queueing in the sweep, like
     # backoff, so the wait is charged to its more specific cause.
     ev.TASK_PREEMPT_RECOVERY: "preemption_recovery",
+    # Evicted exit -> re-claim: the forcible sibling of the
+    # preemption-recovery leg. Distinct because an eviction ALSO pays
+    # the steps replayed since the pre-notice barrier (the drain
+    # never happened), and fleet operators tune the grace window by
+    # comparing exactly these two legs.
+    ev.TASK_EVICTION_RECOVERY: "eviction",
+    # Cross-pool migration wait: starved/preempted in the source pool
+    # -> re-targeted and claimable on the sibling pool.
+    ev.GANG_MIGRATE: "migration",
     ev.TASK_IMAGE_PULL: "image_pull",
     ev.TASK_CONTAINER_START: "image_pull",
     ev.PROGRAM_COMPILE: "compile",
@@ -101,7 +111,13 @@ _RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
 # re-claim), and the sweep must charge those seconds to the more
 # specific cause exactly once.
 _PRIORITY = (
-    "preemption_recovery", "checkpoint", "compile", PRODUCTIVE,
+    # "eviction"/"migration" sit with "preemption_recovery": each is
+    # a recovery wait nested inside the victim's queued span, charged
+    # to its more specific cause exactly once. Migration outranks
+    # eviction outranks preemption: a migrated gang's window subsumes
+    # the starvation that triggered it.
+    "migration", "eviction", "preemption_recovery",
+    "checkpoint", "compile", PRODUCTIVE,
     "checkpoint_async",
     "image_pull", "provisioning", "backoff", "queueing", "idle",
     "_running",

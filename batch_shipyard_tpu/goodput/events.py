@@ -76,9 +76,32 @@ TASK_PREEMPT_RECOVERY = "preempt_recovery"  # interval: preempted exit
                                          # emitted by the CLAIM side
                                          # once the wait has elapsed,
                                          # like TASK_BACKOFF
+# Forcible eviction (the escalation ladder past the cooperative
+# notice; agent/node_agent.py _sweep_preemptions + _enforce_eviction):
+TASK_EVICTED = "evicted"                 # instantaneous: the victim
+                                         # ignored its notice past
+                                         # preempt_grace_seconds and
+                                         # was hard-killed; requeued
+                                         # at full budget
+TASK_EVICTION_RECOVERY = "eviction_recovery"  # interval: evicted exit
+                                         # -> re-claim; priced as the
+                                         # "eviction" badput leg,
+                                         # distinct from
+                                         # preemption_recovery (an
+                                         # eviction also pays the
+                                         # steps replayed since the
+                                         # pre-notice barrier) —
+                                         # emitted by the CLAIM side
+                                         # once the wait has elapsed
 # Elastic gang resize (instantaneous marker: a broken gang re-formed
 # at a new size; attrs carry old_size/new_size/live_nodes).
 GANG_RESIZE = "gang_resize"
+# Cross-pool gang migration (federation/federation.py elastic
+# evaluator): INTERVAL from when the gang was first starved/preempted
+# in its pool to the re-target completing on the sibling pool —
+# priced as the "migration" badput leg. Emitted at migration time
+# (the window has fully elapsed; never future-dated).
+GANG_MIGRATE = "gang_migrate"
 
 # Program phases (emitted from inside the workload process)
 PROGRAM_COMPILE = "compile"            # jit compile / warm-up steps
@@ -99,7 +122,8 @@ EVENT_KINDS = frozenset({
     TASK_QUEUED, TASK_IMAGE_PULL, TASK_CONTAINER_START, TASK_RUNNING,
     TASK_RETRY, TASK_BACKOFF,
     TASK_PREEMPT_NOTICE, TASK_PREEMPT_EXIT, TASK_PREEMPT_RECOVERY,
-    GANG_RESIZE,
+    TASK_EVICTED, TASK_EVICTION_RECOVERY,
+    GANG_RESIZE, GANG_MIGRATE,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,

@@ -530,7 +530,14 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
             num_instances = (entity.get("spec", {}).get(
                 "multi_instance") or {}).get("num_instances")
             if num_instances:
-                for k in range(num_instances):
+                # Elastic override: a resized gang migrates at its
+                # CURRENT effective size — fanning out the spec size
+                # onto the destination would wedge the rendezvous the
+                # same way it would have on the source.
+                effective = int(
+                    entity.get(names.TASK_COL_GANG_SIZE)
+                    or num_instances)
+                for k in range(effective):
                     store.put_message(
                         dst_queue,
                         json.dumps({**message,
@@ -539,6 +546,28 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
                 store.put_message(
                     dst_queue, json.dumps(message).encode())
             moved += 1
+        if (entity.get("spec", {}).get("multi_instance")
+                or {}).get("num_instances"):
+            # Source-pool rendezvous rows would otherwise orphan:
+            # gang partitions are POOL-scoped, so the destination's
+            # janitor can never sweep them, and the source pool may
+            # have no live agents left to (the migration trigger).
+            attempts = (int(entity.get("retries", 0) or 0)
+                        + int(entity.get(
+                            names.TASK_COL_PREEMPT_COUNT, 0) or 0)
+                        + int(entity.get(
+                            names.TASK_COL_EVICT_COUNT, 0) or 0))
+            for attempt in range(attempts + 1):
+                gang_pk = names.gang_pk(src_pool_id, job_id,
+                                        task["_rk"], attempt=attempt)
+                for gang_row in list(store.query_entities(
+                        names.TABLE_GANGS, partition_key=gang_pk)):
+                    try:
+                        store.delete_entity(names.TABLE_GANGS,
+                                            gang_pk,
+                                            gang_row["_rk"])
+                    except NotFoundError:
+                        pass
     store.delete_entity(names.TABLE_JOBS, src_pool_id, job_id)
     return moved
 

@@ -200,6 +200,19 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "the node's most recent step window (stale/offline nodes "
         "excluded).",
         "# TYPE node_last_step_seconds gauge",
+        "# HELP shipyard_evictions_total Forcible evictions "
+        "(victims hard-killed after ignoring their preempt notice "
+        "past the grace window) over the trailing export window — "
+        "WINDOWED like the serving histograms: counts shrink as "
+        "events age out or are pruned. Events attributed to "
+        "stale/offline nodes are excluded "
+        "(NODE_GAUGE_STALE_SECONDS).",
+        "# TYPE shipyard_evictions_total gauge",
+        "# HELP shipyard_gang_migrations_total Cross-pool gang "
+        "migrations (federation elastic re-targets) landing on this "
+        "pool over the trailing export window (same windowed "
+        "semantics).",
+        "# TYPE shipyard_gang_migrations_total gauge",
     ]
     from batch_shipyard_tpu.goodput import events as goodput_events
     for pool in store.query_entities(names.TABLE_POOLS,
@@ -234,9 +247,47 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
                 quarantined += 1
         lines.append(f'nodes_quarantined{{pool="{pool["_rk"]}"}} '
                      f'{quarantined}')
+        lines.extend(_fleet_elasticity_metrics(pool["_rk"], now,
+                                               node_rows, events))
         lines.extend(_pool_latency_metrics(store, pool["_rk"], now,
                                            node_rows, events))
     return lines
+
+
+def _fleet_elasticity_metrics(pool_id: str, now: float,
+                              node_rows: list[dict],
+                              events: list[dict]) -> list[str]:
+    """Eviction/migration counters for one pool over the trailing
+    export window. The per-pool eviction/migration badput-SECONDS
+    ride the standard badput_seconds{category=...} gauges
+    (accounting.prometheus_lines — the new categories are part of
+    the partition); these counters answer the operator's other
+    question: how OFTEN is the escalation ladder firing, and how
+    often do gangs leave/arrive by migration. Node-attributed events
+    honor the NODE_GAUGE_STALE_SECONDS rule like every other
+    per-node export."""
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    fresh = {node["_rk"] for node in node_rows
+             if _node_fresh(node, now)}
+    cutoff = now - GOODPUT_EXPORT_WINDOW_SECONDS
+    evictions = 0
+    migrations = 0
+    for event in events:
+        if float(event.get("end", event.get("start", 0.0))) < cutoff:
+            continue
+        node_id = event.get("node_id")
+        if node_id is not None and node_id not in fresh:
+            continue
+        kind = event.get("kind")
+        if kind == goodput_events.TASK_EVICTED:
+            evictions += 1
+        elif kind == goodput_events.GANG_MIGRATE:
+            migrations += 1
+    return [
+        f'shipyard_evictions_total{{pool="{pool_id}"}} {evictions}',
+        f'shipyard_gang_migrations_total{{pool="{pool_id}"}} '
+        f'{migrations}',
+    ]
 
 
 def _pool_latency_metrics(store: StateStore, pool_id: str,

@@ -1,0 +1,99 @@
+"""Per-host restore planning: which checkpoint shards does host m of
+an M-host target mesh actually need?
+
+The multi-host leg of reshard-on-restore (parallel/sharding.py): a
+checkpoint saved by an N-host gang holds N contiguous shards per
+sharded leading axis (the `.MESH` sidecar records that source
+layout). When the gang re-forms at M hosts, restoring the FULL array
+on every host — the single-host PR 10 behavior — multiplies restore
+IO by M and, on real pods, blows host RAM for any model that needed
+sharding in the first place. The plan computed here is the
+intersection: for each target host, the source shards (and the slice
+of each) that overlap the index range its addressable devices own.
+
+Deliberately jax-free and stdlib-only: the same math drives
+
+  * ``sharding.reshard_on_restore``'s per-host read path (where the
+    target ranges come from the real NamedSharding index maps — the
+    1-D contiguous case below is cross-checked against jax's maps in
+    tests/test_fleet_elasticity.py), and
+  * ``workloads/reshard_probe.py``, the drill trainer whose gang
+    instances read exactly the shard files this plan names (the
+    host_loss_resize chaos drill asserts the reads match the plan).
+
+Shards are the jax convention: an axis of size ``dim`` split over
+``parts`` equal contiguous blocks (divisibility required, exactly as
+jax requires it for a sharded axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRead:
+    """One read: take ``[lo, hi)`` (source-shard-local indices) from
+    source shard ``shard`` and place it at ``[dst_lo, dst_lo + hi -
+    lo)`` of the target host's block."""
+
+    shard: int
+    lo: int
+    hi: int
+    dst_lo: int
+
+
+def shard_ranges(dim: int, parts: int) -> list[tuple[int, int]]:
+    """The ``parts`` contiguous [lo, hi) blocks of an axis of size
+    ``dim`` (the jax even-split convention; raises on indivisible
+    axes exactly like a jax sharding would)."""
+    if parts <= 0:
+        raise ValueError(f"parts must be positive, got {parts}")
+    if dim % parts:
+        raise ValueError(
+            f"axis of size {dim} does not split over {parts} shards")
+    block = dim // parts
+    return [(k * block, (k + 1) * block) for k in range(parts)]
+
+
+def host_reads(dim: int, source_parts: int, target_parts: int,
+               target_index: int) -> list[ShardRead]:
+    """The reads target host ``target_index`` (of ``target_parts``)
+    must issue against a checkpoint laid out as ``source_parts``
+    shards — each read names a source shard and the slice of it that
+    overlaps this host's target block. Covers the whole target block
+    exactly once, in order."""
+    if not 0 <= target_index < target_parts:
+        raise ValueError(
+            f"target_index {target_index} out of range "
+            f"[0, {target_parts})")
+    t_lo, t_hi = shard_ranges(dim, target_parts)[target_index]
+    reads: list[ShardRead] = []
+    for shard, (s_lo, s_hi) in enumerate(
+            shard_ranges(dim, source_parts)):
+        lo = max(t_lo, s_lo)
+        hi = min(t_hi, s_hi)
+        if hi <= lo:
+            continue
+        reads.append(ShardRead(shard=shard, lo=lo - s_lo,
+                               hi=hi - s_lo, dst_lo=lo - t_lo))
+    return reads
+
+
+def plan(dim: int, source_parts: int,
+         target_parts: int) -> dict[int, list[ShardRead]]:
+    """The full N->M plan: target host index -> its reads. Every
+    source element is read by at least one host, and each host reads
+    only what its block needs (the two invariants the drill
+    asserts)."""
+    return {m: host_reads(dim, source_parts, target_parts, m)
+            for m in range(target_parts)}
+
+
+def read_fraction(dim: int, source_parts: int, target_parts: int,
+                  target_index: int) -> float:
+    """Fraction of the axis this host reads — the honesty number the
+    restore path logs (1/M for an even resize; 1.0 would mean the
+    plan degenerated to the full-array restore)."""
+    reads = host_reads(dim, source_parts, target_parts, target_index)
+    return sum(r.hi - r.lo for r in reads) / float(dim)

@@ -24,6 +24,10 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from batch_shipyard_tpu.utils import util
+
+logger = util.get_logger(__name__)
+
 _TRANSFORMER_RULES: list[tuple[str, P]] = [
     (r".*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel$",
      P("fsdp", "tp")),
@@ -86,7 +90,11 @@ def place_like(template, tree):
     """Re-lay-out ``tree``'s leaves onto ``template``'s shardings and
     dtypes (host round trip: works for ANY source layout, including
     plain numpy and int8-quantized leaves — the dtype is preserved
-    bit-for-bit, never promoted through float)."""
+    bit-for-bit, never promoted through float). On a multi-host mesh
+    the leaf is assembled per-shard (make_array_from_callback), so
+    each process materializes ONLY its addressable shards on device —
+    device_put of a full array against a sharding spanning
+    non-addressable devices is not a thing."""
     import numpy as np
 
     def _place(t, v):
@@ -101,27 +109,117 @@ def place_like(template, tree):
                 f"to a different model config")
         if arr.dtype != t.dtype:
             arr = arr.astype(t.dtype)
-        return jax.device_put(arr, t.sharding)
+        sharding = t.sharding
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            tuple(arr.shape), sharding, lambda idx: arr[idx])
 
     return jax.tree_util.tree_map(_place, template, tree)
 
 
+def host_restore_plan(params_template, opt_state_template=None,
+                      devices=None):
+    """Per-host restore plan: for each sharded leaf of the templates,
+    the unique global index slices the given device set needs —
+    ``devices=None`` means THIS process's addressable devices (the
+    real multi-host case); an explicit device subset simulates one
+    virtual host of an M-host mesh on a single-process CPU pod (how
+    the plan is exercised in tests without silicon).
+
+    Returns ``{"leaves": [...], "read_fraction": float}`` where each
+    leaf entry carries path/shape/dtype, its normalized slices, and
+    its own read fraction; the top-level fraction is element-weighted
+    — 1/M for an even M-way resize, 1.0 when the plan degenerates to
+    the full-array restore. The pure 1-D contiguous math lives in
+    parallel/restore_plan.py (shared with the jax-free drill probe);
+    this function derives the truth from the actual jax index maps,
+    so any sharding — nested axes included — plans correctly."""
+    template = {"params": params_template}
+    if opt_state_template is not None:
+        template["opt_state"] = opt_state_template
+    leaves = []
+    total = 0
+    needed_total = 0
+    flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    for path, leaf in flat:
+        if not hasattr(leaf, "sharding") or not hasattr(leaf, "shape"):
+            continue
+        shape = tuple(leaf.shape)
+        sharding = leaf.sharding
+        if devices is None:
+            index_values = list(
+                sharding.addressable_devices_indices_map(
+                    shape).values())
+        else:
+            wanted = set(devices)
+            index_values = [
+                idx for dev, idx in
+                sharding.devices_indices_map(shape).items()
+                if dev in wanted]
+        unique: dict[tuple, tuple] = {}
+        for idx in index_values:
+            norm = tuple(
+                (s.start or 0,
+                 shape[d] if s.stop is None else s.stop)
+                for d, s in enumerate(idx))
+            unique[norm] = norm
+        size = 1
+        for dim in shape:
+            size *= dim
+        needed = sum(
+            _prod(hi - lo for lo, hi in norm)
+            for norm in unique.values())
+        leaves.append({
+            "path": _path_str(path), "shape": shape,
+            "dtype": str(leaf.dtype),
+            "slices": sorted(unique.values()),
+            "read_fraction": needed / size if size else 1.0,
+        })
+        total += size
+        needed_total += needed
+    return {"leaves": leaves,
+            "read_fraction": (needed_total / total if total
+                              else 1.0)}
+
+
+def _prod(values) -> int:
+    out = 1
+    for value in values:
+        out *= max(0, value)
+    return out
+
+
 def reshard_on_restore(checkpoint_dir: str, params_template,
-                       opt_state_template):
+                       opt_state_template, per_host=None):
     """Elastic resume: load the latest COMMITTED checkpoint — saved
     at mesh size N — and re-shard params/opt-state onto the
     templates' mesh (size M). Returns (params, opt_state, step) or
     None when nothing is committed.
 
-    The mechanism is deliberately layout-agnostic: full arrays are
-    restored HOST-side against shape/dtype templates (no device
-    shardings handed to Orbax — the checkpoint's layout metadata may
-    describe a mesh that no longer exists), then laid out onto the
-    M-mesh shardings the templates carry. Global shapes are
-    mesh-independent, so N->M needs no tensor surgery — only a
-    re-placement. The equivalence oracle (tests/test_reshard_restore)
-    pins the contract: a resume-at-M loss trajectory matches a
-    fresh-at-M run restored from the same step."""
+    Two mechanisms, chosen by host count:
+
+    * **Per-host** (``per_host=True``, the default on a multi-host
+      mesh): restore_args are built from the TARGET templates'
+      shardings, so Orbax/TensorStore reads, on each host, only the
+      checkpoint chunks that host's addressable devices need — the
+      restore plan (``host_restore_plan``) is logged so the IO claim
+      is inspectable. An N-host gang re-forms at M hosts without any
+      host paying N-host restore IO (or RAM). Falls back to the
+      host-side path below if this Orbax version refuses the
+      cross-mesh sharded restore.
+    * **Host-side** (single host): full arrays are restored against
+      shape/dtype templates (no device shardings handed to Orbax —
+      the checkpoint's layout metadata may describe a mesh that no
+      longer exists), then laid out onto the M-mesh shardings the
+      templates carry; ``place_like`` assembles per-shard on
+      non-fully-addressable meshes.
+
+    Global shapes are mesh-independent, so N->M needs no tensor
+    surgery — only a re-placement. The equivalence oracle
+    (tests/test_reshard_restore) pins the contract: a resume-at-M
+    loss trajectory matches a fresh-at-M run restored from the same
+    step."""
     import numpy as np
 
     from batch_shipyard_tpu.goodput import events as goodput_events
@@ -134,6 +232,37 @@ def reshard_on_restore(checkpoint_dir: str, params_template,
     path = ckpt_mod._step_path(checkpoint_dir, step)
     template = {"params": params_template,
                 "opt_state": opt_state_template, "step": step}
+    import orbax.checkpoint as ocp
+    if per_host is None:
+        per_host = jax.process_count() > 1
+    if per_host:
+        plan = host_restore_plan(params_template, opt_state_template)
+        logger.info(
+            "per-host reshard-on-restore of step %d: this host reads "
+            "%.1f%% of the checkpoint bytes (%d sharded leaves)",
+            step, 100.0 * plan["read_fraction"],
+            len(plan["leaves"]))
+        try:
+            with goodput_events.phase(
+                    goodput_events.PROGRAM_CHECKPOINT_RESTORE,
+                    step=step, resharded=True, per_host=True), \
+                    trace_spans.phase(trace_spans.SPAN_CKPT_RESTORE,
+                                      step=step, resharded=True,
+                                      per_host=True):
+                restored = ckpt_mod._checkpointer().restore(
+                    path, item=template,
+                    restore_args=(
+                        ocp.checkpoint_utils.construct_restore_args(
+                            template)))
+            return (restored["params"], restored["opt_state"],
+                    int(restored["step"]))
+        except Exception as exc:  # noqa: BLE001 - orbax cross-mesh
+            # support varies by version; the host-side path is the
+            # recovery that works for all of them
+            logger.warning(
+                "per-host sharded restore of step %d failed (%s); "
+                "falling back to the host-side full-array path",
+                step, exc)
 
     def _host_leaf(leaf):
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
@@ -141,7 +270,6 @@ def reshard_on_restore(checkpoint_dir: str, params_template,
         return leaf
 
     host_template = jax.tree_util.tree_map(_host_leaf, template)
-    import orbax.checkpoint as ocp
     with goodput_events.phase(
             goodput_events.PROGRAM_CHECKPOINT_RESTORE, step=step,
             resharded=True), \

@@ -45,15 +45,24 @@ TASK_STATE_QUARANTINED = "quarantined"
 # NON-terminal and claimable like "pending" — the requeue consumed no
 # retry budget, and the next claim restores from the forced commit.
 TASK_STATE_PREEMPTED = "preempted"
+# "evicted" is the FORCIBLE sibling of "preempted": the victim never
+# honored its preempt notice within preempt_grace_seconds, so the
+# escalation path hard-killed it (no drain, no forced commit). Still
+# externally caused — claimable, full retry budget, neutral node
+# health — but the rerun resumes from the last COMMITTED checkpoint
+# BEFORE the notice, and the wait is priced as the distinct
+# "eviction" badput leg.
+TASK_STATE_EVICTED = "evicted"
 TASK_STATES = ("pending", "assigned", "running", "completed",
                "failed", "blocked", TASK_STATE_QUARANTINED,
-               TASK_STATE_PREEMPTED)
+               TASK_STATE_PREEMPTED, TASK_STATE_EVICTED)
 TERMINAL_TASK_STATES = ("completed", "failed", "blocked",
                         TASK_STATE_QUARANTINED)
-# Task states a node may claim for execution: "preempted" is a
-# requeued-waiting state, not a failure — the claim path treats it
-# exactly like "pending".
-CLAIMABLE_TASK_STATES = ("pending", TASK_STATE_PREEMPTED)
+# Task states a node may claim for execution: "preempted"/"evicted"
+# are requeued-waiting states, not failures — the claim path treats
+# them exactly like "pending".
+CLAIMABLE_TASK_STATES = ("pending", TASK_STATE_PREEMPTED,
+                         TASK_STATE_EVICTED)
 NODE_STATES = ("creating", "starting", "idle", "running", "offline",
                "unusable", "start_task_failed", "suspended",
                "preempted")
@@ -85,6 +94,15 @@ TASK_COL_PREEMPT_REQUEST = "preempt_request"
 TASK_COL_PREEMPTED_AT = "preempted_at"
 TASK_COL_PREEMPT_COUNT = "preempt_count"
 TASK_COL_GANG_SIZE = "gang_size"
+# Forcible-eviction columns (the escalation ladder's bookkeeping):
+#   evicted_at  — epoch of the last hard-killed (evicted) exit; the
+#                 eviction-recovery interval's start, cleared at the
+#                 next claim (the preempted_at pattern)
+#   evict_count — lifetime forcible evictions survived (never
+#                 consumes the retry budget; namespaces the gang
+#                 rendezvous attempt like preempt_count)
+TASK_COL_EVICTED_AT = "evicted_at"
+TASK_COL_EVICT_COUNT = "evict_count"
 
 
 def task_pk(pool_id: str, job_id: str) -> str:

@@ -62,8 +62,16 @@ SPAN_TASK_RUN = "task_run"               # task process start -> exit
 SPAN_CACHE_SEED = "compile_cache_seed"   # pre-task pool-cache seed
 SPAN_PREEMPT = "preempt"                 # preempt notice -> drained
                                          # exit (cooperative window)
+SPAN_EVICT = "evict"                     # preempt notice -> hard
+                                         # kill (the escalation
+                                         # window a victim burned by
+                                         # ignoring its notice)
 SPAN_GANG_RESIZE = "gang_resize"         # instantaneous: broken gang
                                          # re-formed at a new size
+SPAN_GANG_MIGRATE = "gang_migrate"       # starved in source pool ->
+                                         # re-targeted on the sibling
+                                         # pool (one trace spans the
+                                         # migration)
 
 # Program phases (process-local emitters inside the task)
 SPAN_COMPILE = "compile"                 # jit warm-up / AOT precompile
@@ -86,7 +94,8 @@ SPAN_SERVE_DECODE = "serve_decode"       # first token -> last token;
 SPAN_KINDS = frozenset({
     SPAN_SUBMIT, SPAN_QUEUE_WAIT, SPAN_CLAIM, SPAN_BACKOFF_WAIT,
     SPAN_REQUEUE, SPAN_RENDEZVOUS, SPAN_IMAGE_PULL, SPAN_TASK_RUN,
-    SPAN_CACHE_SEED, SPAN_PREEMPT, SPAN_GANG_RESIZE,
+    SPAN_CACHE_SEED, SPAN_PREEMPT, SPAN_EVICT, SPAN_GANG_RESIZE,
+    SPAN_GANG_MIGRATE,
     SPAN_COMPILE, SPAN_STEP_WINDOW, SPAN_CKPT_SNAPSHOT,
     SPAN_CKPT_PERSIST, SPAN_CKPT_RESTORE, SPAN_PROFILE,
     SPAN_SERVE_REQUEST, SPAN_SERVE_QUEUED, SPAN_SERVE_PREFILL,

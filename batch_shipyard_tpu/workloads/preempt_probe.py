@@ -77,6 +77,14 @@ def main() -> int:
     parser.add_argument("--checkpoint-every", type=int, default=0,
                         help="cadenced commits every N steps (the "
                              "preempt drain commits regardless)")
+    parser.add_argument("--ignore-notice", action="store_true",
+                        help="UNCOOPERATIVE victim mode (eviction "
+                             "drills): observe the preempt request, "
+                             "log it to the ledger, and keep "
+                             "stepping — the sweep's post-grace "
+                             "hard kill is the only way off the "
+                             "node, exactly the workload shape "
+                             "forcible eviction exists for")
     args = parser.parse_args()
 
     instance = int(os.environ.get("SHIPYARD_TASK_INSTANCE", "0"))
@@ -93,12 +101,30 @@ def main() -> int:
                 time.time(), step_start=executed[0],
                 step_end=end_step, tokens=len(executed))
 
+    ignoring = False
     for step in range(start_step, args.steps):
         time.sleep(args.step_seconds)
         progress.beat()
         executed.append(step)
         done = step + 1
         if watcher.poll() is not None:
+            if args.ignore_notice:
+                # The uncooperative shape eviction exists for: a
+                # victim that neither drains NOR commits once
+                # noticed (a healthy cadenced committer would have
+                # drained cooperatively) — it squats on the slot,
+                # still stepping/beating, until the escalation hard
+                # kill. Acknowledge the notice in the ledger so the
+                # drill can assert the resume barrier is strictly
+                # PRE-notice, then stop committing.
+                ignoring = True
+                if writer:
+                    with open(args.ckpt + ".steps.log", "a",
+                              encoding="utf-8") as fh:
+                        fh.write(f"i{instance} "
+                                 f"{executed[0]}..{done} "
+                                 f"notice-ignored\n")
+                continue
             # Drain: this boundary is the barrier — commit, ledger,
             # distinct preempted exit. Non-writers exit on the same
             # boundary without touching the shared state (the
@@ -111,7 +137,7 @@ def main() -> int:
                              f"preempted\n")
             _flush_window(done)
             return preemption.EXIT_PREEMPTED
-        if writer and args.checkpoint_every and \
+        if writer and not ignoring and args.checkpoint_every and \
                 done % args.checkpoint_every == 0:
             _commit(args.ckpt, done)
     if writer:

@@ -746,6 +746,58 @@ def bench_scheduler_scale(num_tasks: int = 100_000, nodes: int = 8,
     return result
 
 
+def bench_fleet_elasticity(seed: int = 1,
+                           artifact: bool = True) -> dict:
+    """Fleet-elasticity proof (ROADMAP item 5 / ISSUE 12): run the
+    three chaos drills — forcible eviction, multi-host resize with
+    per-host reshard-on-restore, cross-pool migration — and record
+    seeds, the invariants each asserted, pass/fail, and the priced
+    recovery-leg seconds. Every invariant is asserted INSIDE the
+    drill (chaos/drill.py), so a recorded "pass" is a replayed
+    proof, not a summary.
+
+    CPU marker: orchestration + recovery measurement on the CPU
+    fakepod substrate — no accelerator is involved, and none is
+    claimed."""
+    from batch_shipyard_tpu.chaos import drill as chaos_drill
+
+    drills = (
+        ("eviction", chaos_drill.run_eviction_drill,
+         "eviction"),
+        ("host_resize", chaos_drill.run_host_resize_drill,
+         "preemption_recovery"),
+        ("migration", chaos_drill.run_migration_drill,
+         "migration"),
+    )
+    result: dict = {"seed": seed, "cpu_marker": True, "drills": {}}
+    for name, runner, leg in drills:
+        started = time.monotonic()
+        entry: dict = {"seed": seed, "recovery_leg": leg}
+        try:
+            report = runner(seed=seed)
+            entry.update({
+                "passed": bool(report["invariants"].get("ok")),
+                "fingerprint": report["fingerprint"],
+                "invariants_checked": sorted(
+                    k for k in report["invariants"] if k != "ok"),
+                "recovery_leg_seconds": report.get(
+                    "goodput", {}).get("badput_seconds", {}).get(
+                    leg, 0.0),
+                "wall_seconds": round(
+                    time.monotonic() - started, 2),
+            })
+        except Exception as exc:  # noqa: BLE001 - record the failure
+            entry.update({"passed": False, "error": str(exc)})
+        result["drills"][name] = entry
+    result["all_passed"] = all(d.get("passed")
+                               for d in result["drills"].values())
+    if artifact:
+        with open(REPO_ROOT / "BENCH_fleet_elasticity.json", "w",
+                  encoding="utf-8") as fh:
+            json.dump({"fleet_elasticity": result}, fh, indent=2)
+    return result
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -942,6 +994,13 @@ def main(argv: list[str] | None = None) -> int:
                     num_tasks=args.scale_tasks)
             except Exception as exc:  # noqa: BLE001
                 details["scheduler_scale"] = {"error": str(exc)}
+        if "fleet_elasticity" in workloads:
+            # CPU-fakepod recovery drills: no accelerator involved.
+            try:
+                details["fleet_elasticity"] = (
+                    bench_fleet_elasticity())
+            except Exception as exc:  # noqa: BLE001
+                details["fleet_elasticity"] = {"error": str(exc)}
         details["error"] = (f"accelerator unreachable "
                             f"({probe_error}); compute benches "
                             f"not run")
@@ -1079,6 +1138,13 @@ def main(argv: list[str] | None = None) -> int:
                 num_tasks=args.scale_tasks)
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["scheduler_scale"] = {"error": str(exc)}
+    if "fleet_elasticity" in workloads:
+        # Opt-in (the ISSUE 12 fleet-elasticity drills): CPU fakepod
+        # recovery proof, no accelerator involved.
+        try:
+            details["fleet_elasticity"] = bench_fleet_elasticity()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["fleet_elasticity"] = {"error": str(exc)}
     with open(details_out, "w", encoding="utf-8") as fh:
         json.dump(details, fh, indent=2)
     if resnet is not None:

@@ -445,7 +445,8 @@ def test_goodput_kind_unpriced_fires_when_marker_unregistered(
     monkeypatch.setattr(rules_registry, "MARKER_EVENT_KINDS",
                         frozenset())
     found = _rules_of(events_stub, "goodput-kind-unpriced")
-    assert len(found) == 4  # retry, preempt notice/exit, gang resize
+    # retry, preempt notice/exit, evicted, gang resize
+    assert len(found) == 5
 
 
 def test_trace_span_undeclared_fires_via_alias():
@@ -558,6 +559,35 @@ def test_jax_blocking_save_in_train_fires():
 
 
 # ---------------------------- wiring family ----------------------------
+
+def test_preempt_grace_unbounded_fires_and_blessed():
+    """A sweep-cadence function stamping preemption notices with no
+    escalate/evict call in reach = an unbounded grace window (the
+    PR 12 bug class); the blessed shape calls an escalation helper.
+    Non-sweep callers (manual CLI preempt, chaos injectors) are out
+    of scope."""
+    firing = {"batch_shipyard_tpu/mod.py": (
+        "def _sweep_preemptions(self):\n"
+        "    for row in rows:\n"
+        "        request_preemption(store, 'p', 'j', 't')\n")}
+    found = _rules_of(firing, "preempt-grace-unbounded")
+    assert len(found) == 1
+    assert "escalation" in found[0].message
+    blessed = {"batch_shipyard_tpu/mod.py": (
+        "def _sweep_preemptions(self):\n"
+        "    for row in rows:\n"
+        "        if overdue(row):\n"
+        "            self._maybe_escalate_eviction(row)\n"
+        "            continue\n"
+        "        request_preemption(store, 'p', 'j', 't')\n")}
+    assert not _rules_of(blessed, "preempt-grace-unbounded")
+    # A non-sweep function stamping a notice (the manual override,
+    # the chaos injector) is out of the rule's scope.
+    manual = {"batch_shipyard_tpu/mod.py": (
+        "def action_jobs_preempt(ctx):\n"
+        "    request_preemption(ctx.store, 'p', 'j', 't')\n")}
+    assert not _rules_of(manual, "preempt-grace-unbounded")
+
 
 def test_wiring_cli_action_unwired_fires():
     firing = {

@@ -1,0 +1,311 @@
+"""Fleet-grade elasticity (ISSUE 12 / ROADMAP item 5): forcible
+eviction, multi-host reshard-on-restore planning, and cross-pool gang
+migration — each pinned by its seeded chaos drill, plus the unit
+surfaces underneath (restore-plan math vs the real jax index maps,
+the per-host Orbax restore path, the stale-request-file janitor, and
+the heimdall eviction/migration exports)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.goodput import events as goodput_events
+from batch_shipyard_tpu.parallel import restore_plan
+from batch_shipyard_tpu.state import names
+
+
+# ------------------------- restore-plan math ---------------------------
+
+def test_shard_ranges_and_divisibility():
+    assert restore_plan.shard_ranges(8, 2) == [(0, 4), (4, 8)]
+    assert restore_plan.shard_ranges(6, 1) == [(0, 6)]
+    with pytest.raises(ValueError):
+        restore_plan.shard_ranges(8, 3)
+    with pytest.raises(ValueError):
+        restore_plan.shard_ranges(8, 0)
+
+
+@pytest.mark.parametrize("dim,src,dst", [
+    (24, 2, 1), (24, 1, 2), (24, 4, 2), (24, 2, 4), (24, 3, 4),
+])
+def test_host_reads_cover_target_exactly_once(dim, src, dst):
+    """Every target host's reads tile its block exactly (no gap, no
+    overlap), and the union of all hosts' reads covers every source
+    element at least once."""
+    covered_global = set()
+    for m in range(dst):
+        t_lo, t_hi = restore_plan.shard_ranges(dim, dst)[m]
+        cursor = 0
+        for read in restore_plan.host_reads(dim, src, dst, m):
+            assert read.dst_lo == cursor, (m, read)
+            cursor += read.hi - read.lo
+            s_lo, _ = restore_plan.shard_ranges(dim, src)[read.shard]
+            covered_global.update(
+                range(s_lo + read.lo, s_lo + read.hi))
+        assert cursor == t_hi - t_lo, f"host {m} block not tiled"
+    assert covered_global == set(range(dim))
+
+
+def test_read_fraction_is_one_over_m_for_even_resize():
+    assert restore_plan.read_fraction(24, 2, 4, 0) == pytest.approx(
+        0.25)
+    assert restore_plan.read_fraction(24, 4, 1, 0) == pytest.approx(
+        1.0)
+    with pytest.raises(ValueError):
+        restore_plan.host_reads(24, 2, 2, 5)
+
+
+def test_host_restore_plan_matches_pure_math():
+    """The jax-truth plan (host_restore_plan over the real
+    NamedSharding index maps, with an explicit device subset playing
+    one virtual host of a 2-host mesh) agrees with the pure 1-D math
+    the drill probe uses — same ranges, same read fraction."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+    from batch_shipyard_tpu.parallel import sharding as shard_rules
+    mesh = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(4),
+                              devices=jax.devices()[:4])
+    x = jax.device_put(
+        jax.numpy.arange(32, dtype=jax.numpy.float32).reshape(8, 4),
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+    hosts = [jax.devices()[:2], jax.devices()[2:4]]
+    for host_index, devices in enumerate(hosts):
+        plan = shard_rules.host_restore_plan({"x": x},
+                                             devices=devices)
+        assert plan["read_fraction"] == pytest.approx(
+            restore_plan.read_fraction(8, 4, 2, host_index))
+        leaf = plan["leaves"][0]
+        t_lo, t_hi = restore_plan.shard_ranges(8, 2)[host_index]
+        covered = set()
+        for (lo, hi), _cols in leaf["slices"]:
+            covered.update(range(lo, hi))
+        assert covered == set(range(t_lo, t_hi))
+    # The full-process plan (all devices addressable — the
+    # single-host case) needs everything.
+    full = shard_rules.host_restore_plan({"x": x})
+    assert full["read_fraction"] == pytest.approx(1.0)
+
+
+def test_reshard_per_host_restore_roundtrip(tmp_path):
+    """The per-host restore path (restore_args built from the TARGET
+    templates' shardings — what each host of a multi-host mesh runs)
+    restores a 4-device checkpoint onto a 2-device mesh bit-exactly,
+    dtypes preserved, leaves laid out on the target shardings."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from batch_shipyard_tpu.parallel import mesh as mesh_mod
+    from batch_shipyard_tpu.parallel import sharding as shard_rules
+    from batch_shipyard_tpu.workloads import checkpoint as ckpt_mod
+    mesh4 = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(4),
+                               devices=jax.devices()[:4])
+    spec = P(("dp", "fsdp"))
+    x = jax.device_put(
+        jax.numpy.arange(32, dtype=jax.numpy.float32).reshape(8, 4),
+        NamedSharding(mesh4, spec))
+    kv = jax.device_put(
+        (jax.numpy.arange(32) % 251 - 125).astype(
+            jax.numpy.int8).reshape(8, 4),
+        NamedSharding(mesh4, spec))
+    ckpt_mod.save(str(tmp_path), 5, {"x": x, "kv": kv},
+                  {"mu": x * 0.5})
+    mesh2 = mesh_mod.make_mesh(mesh_mod.auto_axis_sizes(2),
+                               devices=jax.devices()[:2])
+
+    def target(leaf):
+        return jax.device_put(
+            jax.numpy.zeros(leaf.shape, leaf.dtype),
+            NamedSharding(mesh2, spec))
+
+    params_t = {"x": target(x), "kv": target(kv)}
+    opt_t = {"mu": target(x)}
+    restored = shard_rules.reshard_on_restore(
+        str(tmp_path), params_t, opt_t, per_host=True)
+    assert restored is not None
+    params, opt_state, step = restored
+    assert step == 5
+    assert np.array_equal(np.asarray(params["x"]), np.asarray(x))
+    assert params["kv"].dtype == jax.numpy.int8
+    assert np.array_equal(np.asarray(params["kv"]), np.asarray(kv))
+    assert np.array_equal(np.asarray(opt_state["mu"]),
+                          np.asarray(x) * 0.5)
+    assert params["x"].sharding.mesh.devices.size == 2
+
+
+# ----------------------------- the drills ------------------------------
+
+def test_eviction_drill_acceptance():
+    """`shipyard chaos drill --evict`: uncooperative victim is
+    hard-killed after grace, classified evicted (full budget,
+    neutral health), resumes from the pre-notice COMMITTED barrier,
+    eviction leg populated, partition exact."""
+    from batch_shipyard_tpu.chaos import drill
+    report = drill.run_eviction_drill(seed=1)
+    invariants = report["invariants"]
+    assert invariants["ok"]
+    assert invariants["retries"] == 0
+    assert invariants["evict_count"] >= 1
+    assert invariants["resumed_from"] <= invariants["notice_step"]
+    assert invariants["eviction_seconds"] > 0
+
+
+def test_host_resize_drill_acceptance():
+    """`shipyard chaos drill --resize`: a 2-host sharded gang loses
+    a host permanently, re-forms at 1 host, restores bit-exactly
+    through the per-host reshard plan, loss trajectory matching the
+    oracle at every commit."""
+    from batch_shipyard_tpu.chaos import drill
+    report = drill.run_host_resize_drill(seed=1)
+    invariants = report["invariants"]
+    assert invariants["ok"]
+    assert invariants["gang_size"] == 1
+    assert invariants["state_bit_exact"]
+    assert invariants["recorded_reads"][-2:] == \
+        invariants["planned_reads"]
+
+
+def test_migration_drill_acceptance():
+    """`shipyard chaos drill --migrate`: total capacity loss under a
+    federated gang; the elastic evaluator re-targets it onto the
+    sibling pool, one trace spans the migration, the migration leg
+    is priced, and the gang completes from its committed barrier."""
+    from batch_shipyard_tpu.chaos import drill
+    report = drill.run_migration_drill(seed=1)
+    invariants = report["invariants"]
+    assert invariants["ok"]
+    assert invariants["trace_id_preserved"]
+    assert invariants["migration_seconds"] > 0
+    assert invariants["resumed_from"] > 0
+
+
+# ------------------------ stale-request janitor ------------------------
+
+def _bare_agent(store, tmp_path, pool_id="p"):
+    from batch_shipyard_tpu.agent.node_agent import (
+        NodeAgent, NodeIdentity)
+    conf = {"pool_specification": {
+        "id": pool_id, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 1}},
+        "max_wait_time_seconds": 30}}
+    pool = settings_mod.pool_settings(conf)
+    identity = NodeIdentity(pool_id=pool_id, node_id="n0",
+                            node_index=0, hostname="h",
+                            internal_ip="127.0.0.1")
+    return NodeAgent(store, identity, pool, work_dir=str(tmp_path))
+
+
+def test_stale_preempt_file_janitor(mem_statestore, tmp_path):
+    """Satellite: request files + .delivered markers of EVICTED
+    (never-drained) tasks were only cleaned at next-attempt launch
+    on the same node — the janitor sweep now retires them when the
+    task is terminal/re-owned/gone, without touching a live task's
+    pending delivery."""
+    store = mem_statestore
+    agent = _bare_agent(store, tmp_path)
+    pk = names.task_pk("p", "j")
+
+    def plant(task_id):
+        task_dir = os.path.join(str(tmp_path), "tasks", "j", task_id)
+        os.makedirs(task_dir, exist_ok=True)
+        path = os.path.join(task_dir, "preempt_request.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"requested_at": "x"}))
+        with open(path + ".delivered", "w", encoding="utf-8") as fh:
+            fh.write("x")
+        agent._preempt_delivered.add((path, "x"))
+        return path
+
+    # Terminal task: files are garbage.
+    store.insert_entity(names.TABLE_TASKS, pk, "t-done",
+                        {"state": "completed", "spec": {}})
+    done_path = plant("t-done")
+    # Task re-owned by ANOTHER node: this node's files are garbage.
+    store.insert_entity(names.TABLE_TASKS, pk, "t-moved",
+                        {"state": "running", "node_id": "other",
+                         "spec": {},
+                         names.TASK_COL_PREEMPT_REQUEST: {
+                             "requested_at": "x"}})
+    moved_path = plant("t-moved")
+    # Pending request on a task still owned here (delivery may be in
+    # flight between claim and launch): kept.
+    store.insert_entity(names.TABLE_TASKS, pk, "t-mine",
+                        {"state": "running", "node_id": "n0",
+                         "spec": {},
+                         names.TASK_COL_PREEMPT_REQUEST: {
+                             "requested_at": "x"}})
+    mine_path = plant("t-mine")
+    agent._last_preempt_file_sweep = 0.0
+    agent._sweep_stale_preempt_files()
+    assert not os.path.exists(done_path)
+    assert not os.path.exists(done_path + ".delivered")
+    assert not os.path.exists(moved_path)
+    assert os.path.exists(mine_path)
+    remaining = {k[0] for k in agent._preempt_delivered}
+    assert done_path not in remaining
+    assert moved_path not in remaining
+    assert mine_path in remaining
+
+
+def test_live_task_files_survive_janitor(mem_statestore, tmp_path):
+    """A task live in _live_procs is never swept, whatever its row
+    says — the kill/exit path owns its files."""
+    store = mem_statestore
+    agent = _bare_agent(store, tmp_path)
+    pk = names.task_pk("p", "j")
+    store.insert_entity(names.TABLE_TASKS, pk, "t-live",
+                        {"state": "completed", "spec": {}})
+    task_dir = os.path.join(str(tmp_path), "tasks", "j", "t-live")
+    os.makedirs(task_dir, exist_ok=True)
+    path = os.path.join(task_dir, "preempt_request.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("{}")
+    agent._live_procs[("j", "t-live")] = object()
+    agent._last_preempt_file_sweep = 0.0
+    agent._sweep_stale_preempt_files()
+    assert os.path.exists(path)
+
+
+# ------------------------- heimdall exports ----------------------------
+
+def test_heimdall_eviction_and_migration_exports(mem_statestore):
+    """Satellite: per-pool eviction/migration counters (honoring
+    NODE_GAUGE_STALE_SECONDS for node-attributed events) plus the
+    eviction/migration badput-seconds gauges riding the standard
+    category export."""
+    from batch_shipyard_tpu.monitor import heimdall
+    store = mem_statestore
+    store.upsert_entity(names.TABLE_POOLS, "pools", "p1",
+                        {"state": "ready"})
+    now = time.time()
+    store.upsert_entity(names.TABLE_NODES, "p1", "n-fresh",
+                        {"state": "idle", "heartbeat_at": now})
+    store.upsert_entity(names.TABLE_NODES, "p1", "n-stale",
+                        {"state": "idle",
+                         "heartbeat_at": now - 7 * 24 * 3600})
+    goodput_events.emit(store, "p1", goodput_events.TASK_EVICTED,
+                        job_id="j", task_id="t",
+                        node_id="n-fresh", start=now)
+    # Attributed to a long-stale node: excluded from the counter.
+    goodput_events.emit(store, "p1", goodput_events.TASK_EVICTED,
+                        job_id="j", task_id="t2",
+                        node_id="n-stale", start=now)
+    # Migrations carry no node id (the federation emits them):
+    # always counted.
+    goodput_events.emit(store, "p1", goodput_events.GANG_MIGRATE,
+                        job_id="j", start=now - 3.0, end=now)
+    lines = heimdall.build_goodput_metrics(store)
+    assert 'shipyard_evictions_total{pool="p1"} 1' in lines
+    assert 'shipyard_gang_migrations_total{pool="p1"} 1' in lines
+    assert any(ln.startswith(
+        'badput_seconds{pool="p1",category="eviction"}')
+        for ln in lines)
+    migration_gauge = [ln for ln in lines if ln.startswith(
+        'badput_seconds{pool="p1",category="migration"}')]
+    assert migration_gauge
+    assert float(migration_gauge[0].rsplit(" ", 1)[1]) > 0.0
