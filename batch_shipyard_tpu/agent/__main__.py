@@ -50,7 +50,13 @@ def main(argv: list[str]) -> int:
         nodeprep=(run_node_prep if boot.get("run_nodeprep", True)
                   else None),
         image_provisioner=provisioner,
-        output_upload_cap_bytes=boot.get("output_upload_cap_bytes"))
+        output_upload_cap_bytes=boot.get("output_upload_cap_bytes"),
+        # Store-outage ride-through ON by default for real agent
+        # processes: critical ops retry through outages, advisory
+        # goodput/trace/heartbeat publishes journal to the node-local
+        # WAL and replay in order on recovery (state/resilient.py).
+        # Opt out (or tune) via the bootstrap's "resilience" block.
+        resilience=boot.get("resilience", {}))
 
     def _stop(signum, frame):
         agent.stop()

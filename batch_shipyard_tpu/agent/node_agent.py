@@ -22,6 +22,7 @@ the localhost substrate (process), and on a real TPU VM worker
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -40,7 +41,9 @@ from batch_shipyard_tpu.config.settings import (
     JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.jobs import launcher
+from batch_shipyard_tpu.state import leases as state_leases
 from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state import resilient as state_resilient
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
 from batch_shipyard_tpu.trace import context as trace_context
@@ -69,6 +72,17 @@ class NodeUnusableError(Exception):
     """Raised by a nodeprep callable to mark the node unusable (as
     opposed to start-task-failed): the node finished booting but cannot
     serve tasks — triggers attempt_recovery_on_unusable handling."""
+
+
+class _AdoptedProc:
+    """Handle for a process this agent did not spawn (crash-restart
+    adoption): exposes the ``pid`` every _live_procs consumer —
+    term_task, eviction enforcement, zap, the chaos injectors —
+    actually uses. There is no Popen to wait() on; the adoption
+    watcher polls liveness and reads the exit-code sentinel."""
+
+    def __init__(self, pid: Optional[int]) -> None:
+        self.pid = pid or -1
 
 
 @dataclasses.dataclass
@@ -113,7 +127,26 @@ class NodeAgent:
                  gang_sweep_interval: float = 60.0,
                  preempt_sweep_interval: float = 30.0,
                  preempt_grace_seconds: float = 20.0,
+                 leader_lease_seconds: Optional[float] = None,
+                 resilience: Optional[dict] = None,
                  ) -> None:
+        # Store-outage ride-through (state/resilient.py): when
+        # configured, every store op this agent issues goes through
+        # the resilient wrapper — critical ops retry through outages,
+        # advisory ops (goodput/trace/heartbeat) ride the per-node
+        # local WAL and replay in order on recovery. The real agent
+        # process (agent/__main__.py) enables it by default; tests
+        # and drills opt in via the kwarg so seeded fault schedules
+        # keep their historical semantics.
+        if resilience is not None:
+            store = state_resilient.ResilientStore(
+                store,
+                journal_path=os.path.join(work_dir,
+                                          "store_wal.jsonl"),
+                pool_id=identity.pool_id,
+                node_id=identity.node_id,
+                stop_check=lambda: self.stop_event.is_set(),
+                **resilience)
         self.store = store
         self.identity = identity
         self.pool = pool
@@ -178,10 +211,46 @@ class NodeAgent:
         self.preempt_sweep_interval = preempt_sweep_interval
         self.preempt_grace_seconds = preempt_grace_seconds
         self._last_preempt_sweep = time.monotonic()
+        # Lease-based sweep leadership (state/leases.py): one named
+        # lease per leader-gated loop, acquired at the loop's own
+        # cadence and renewed every heartbeat; the term's fencing
+        # epoch is stamped into every sweep write. Default duration
+        # scales with the heartbeat so failover latency tracks the
+        # deployment's clock (drills with 0.2s beats fail over in
+        # ~2s; production's 5s beats in ~20s).
+        self.leader_lease_seconds = (
+            leader_lease_seconds
+            if leader_lease_seconds is not None
+            else max(2.0, 4.0 * heartbeat_interval))
+        self._sweep_leases: dict[str, state_leases.LeaderLease] = {}
+        # Chaos seam (leader_partition): while wall-clock < this, NO
+        # lease traffic reaches the store — the leader is partitioned
+        # from it, and its authority decays on the local clock alone.
+        self.lease_blackout_until = 0.0
+        # Chaos seam (agent_restart, fakepod crash_agent_hard):
+        # threads cannot be killed, so a simulated agent-process
+        # death sets this flag — in-flight completion paths cut off
+        # before their first post-exit store write, exactly like the
+        # real process dying mid-task. The REVIVED agent's adoption
+        # path owns the task from there.
+        self._abandoned = False
+        # Crash-restart adoption: slots whose previous-process task
+        # is still running under an adoption watcher — the worker
+        # slot waits its turn instead of oversubscribing the node.
+        self._adopted_slots: set[int] = set()
+        # Predecessor's last heartbeat (captured by start() before
+        # the first upsert overwrites it): the adoption leg's start.
+        self._pre_restart_heartbeat: Optional[float] = None
         # (path, requested_at) preempt requests already delivered —
         # same dedup protocol as _profile_delivered (one drain per
         # request; disk markers persist the dedup across restarts).
         self._preempt_delivered: set[tuple] = set()
+        # First-seen clock per stale-epoch preempt stamp being held
+        # for confirmation before delivery (consumer-side fence for
+        # the author-retraction race; _confirm_stale_epoch_request),
+        # plus the TTL-cached observer view of the sweep lease term.
+        self._preempt_forward_hold: dict[tuple, float] = {}
+        self._preempt_leader_cache: Optional[tuple] = None
         # (job_id, task_id) keys THIS agent hard-killed through the
         # eviction escalation: the completion path classifies the
         # exit as evicted (claimable, full budget, neutral health)
@@ -308,6 +377,12 @@ class NodeAgent:
                 names.NODE_COL_HEALTH: self._health,
                 names.NODE_COL_QUARANTINED: self._node_quarantined,
             }
+        # Resilient-store WAL backlog rides every heartbeat so
+        # heimdall exports shipyard_journal_backlog_entries per node
+        # (0 when the wrapper is off or the journal is drained).
+        backlog_fn = getattr(self.store, "journal_backlog", None)
+        if callable(backlog_fn):
+            health_cols[names.NODE_COL_JOURNAL_BACKLOG] = backlog_fn()
         try:
             self.store.merge_entity(
                 names.TABLE_NODES, pool_id, node_id,
@@ -319,6 +394,26 @@ class NodeAgent:
 
     def start(self) -> None:
         """Run node prep, then start worker + heartbeat threads."""
+        # Crash-restart adoption needs the PREDECESSOR's last
+        # heartbeat (the adoption leg's start) BEFORE the first state
+        # upsert below overwrites it. One read, only when a previous
+        # process left slot ledgers behind.
+        slots_dir = os.path.join(self.work_dir, "slots")
+        if os.path.isdir(slots_dir) and os.listdir(slots_dir):
+            try:
+                # Bounded: a restart DURING a store outage must not
+                # park the boot thread in the resilient wrapper's
+                # 900s retry loop before adoption or any worker slot
+                # exists — fail fast into the degrade path instead.
+                with self._store_bounded(
+                        max(10.0, 2.0 * self.heartbeat_interval)):
+                    row = self.store.get_entity(names.TABLE_NODES,
+                                                *self._nid)
+                self._pre_restart_heartbeat = \
+                    float(row.get("heartbeat_at") or 0) or None
+            except Exception:  # noqa: BLE001 - adoption degrades
+                logger.debug("pre-restart heartbeat probe failed",
+                             exc_info=True)
         self._set_node_state("starting")
         marker = os.path.join(self.work_dir, ".nodeprep_finished")
         prep_started = time.time()
@@ -348,6 +443,11 @@ class NodeAgent:
         self._set_node_state("idle")
         self._goodput_idle_since = time.time()
         self._rescan_retention_markers()
+        # Re-adopt the previous process's still-running work BEFORE
+        # the worker slots start polling: the live-proc registry must
+        # already name the adopted tasks when the first redelivered
+        # message asks whether they are orphans.
+        self._adopt_restart_state()
         for slot in range(self.pool.task_slots_per_node):
             thread = threading.Thread(
                 target=self._worker_loop, args=(slot,),
@@ -426,17 +526,53 @@ class NodeAgent:
             # permanently "dead" node (orphan reclaim would then
             # steal its running tasks).
             try:
+                # Never-blocking duties first: the advisory heartbeat
+                # publish (journals through an outage), lease renewal
+                # (unwrapped — fails fast so a partitioned leader
+                # abdicates honestly) and retention deletes (purely
+                # local) must keep their cadence even when the store
+                # is dark.
                 self._heartbeat()
+                self._renew_sweep_leases()
                 self._sweep_retention()
-                self._sweep_orphaned_gangs()
-                self._sweep_preemptions()
-                self._sweep_stale_preempt_files()
-                self._forward_profile_requests()
-                self._forward_preempt_requests()
-                self._ingest_live_trace_spans()
             except Exception:
                 logger.exception("heartbeat iteration failed; "
                                  "continuing")
+            try:
+                # Store-coordination duties ride a bounded critical-
+                # retry window: without it, one get_entity inside a
+                # store outage would park THIS thread in the
+                # resilient wrapper's retry loop for up to
+                # max_outage_seconds, starving every duty above —
+                # the sleep-in-sweep class the lint rules forbid.
+                # On the bound firing, skip the rest of the beat;
+                # the next beat re-probes.
+                with self._store_bounded(
+                        max(5.0, 2.0 * self.heartbeat_interval)):
+                    self._sweep_orphaned_gangs()
+                    self._sweep_preemptions()
+                    self._sweep_stale_preempt_files()
+                    self._forward_profile_requests()
+                    self._forward_preempt_requests()
+                    self._ingest_live_trace_spans()
+            except state_resilient.StoreOutageError:
+                logger.warning(
+                    "store outage: coordination sweeps skipped "
+                    "this beat")
+            except Exception:
+                logger.exception("heartbeat iteration failed; "
+                                 "continuing")
+        # Graceful abdication: release any held sweep leases so the
+        # successor acquires immediately instead of waiting out the
+        # expiry. A simulated crash (_abandoned) must NOT release —
+        # a real dead process couldn't, and the failover-by-expiry
+        # path is exactly what the partition drill exercises.
+        if not self._abandoned:
+            for lease in self._sweep_leases.values():
+                try:
+                    lease.release()
+                except Exception:  # noqa: BLE001 - expiry reclaims
+                    pass
         # Final state write must NOT resurrect a node entity the
         # substrate already deleted (teardown race) — _heartbeat
         # merges and tolerates a missing row. Best-effort: a store
@@ -491,6 +627,12 @@ class NodeAgent:
         skip = {0: 0, 2: 0}  # band index -> cycles left to skip
         streak = {0: 0, 2: 0}
         while not self.stop_event.is_set():
+            # An adoption watcher owns this slot's capacity until the
+            # adopted task finishes: polling for NEW work here would
+            # oversubscribe the node past task_slots_per_node.
+            if slot in self._adopted_slots:
+                time.sleep(self.poll_interval)
+                continue
             # Quarantined node: auto-drain means claim NOTHING — do
             # not even pop messages. Each pop would hide a message
             # from healthy nodes for a visibility window and churn
@@ -868,8 +1010,17 @@ class NodeAgent:
         if state not in ("assigned", "running") or not owner:
             return entity
         if owner == self.identity.node_id:
-            # Our own pre-crash claim (agent restart): take it back.
-            pass
+            if (job_id, task_id) in self._live_procs:
+                # Crash-restart ADOPTION (not reclaim): the restarted
+                # agent found the pre-crash process still running and
+                # adopted it (slot ledger, _adopt_restart_state).
+                # Resetting here would double-run the task under its
+                # own feet — back off; the adoption watcher owns the
+                # completion, and the redelivered message dies on the
+                # terminal-state check afterwards.
+                return None
+            # Our own pre-crash claim with NO surviving process:
+            # take it back (the pre-adoption restart semantics).
         else:
             if self._node_alive(owner):
                 return None
@@ -907,6 +1058,12 @@ class NodeAgent:
 
         def _renew() -> None:
             while not stop.wait(interval):
+                if self._abandoned:
+                    # Simulated agent death: a dead process renews
+                    # nothing — the claim must lapse so observers see
+                    # the truth (the adoption watcher keeps the task,
+                    # not the message).
+                    return
                 try:
                     self.store.update_message(
                         msg, visibility_timeout=visibility)
@@ -1309,8 +1466,11 @@ class NodeAgent:
                 < self.preempt_sweep_interval):
             return
         self._last_preempt_sweep = time.monotonic()
-        if not self._is_gang_sweep_leader():
+        epoch = self._sweep_leader_epoch(
+            state_leases.ROLE_PREEMPT_SWEEP)
+        if epoch is None:
             return
+        lease = self._sweep_lease(state_leases.ROLE_PREEMPT_SWEEP)
         prefix = f"{self.identity.pool_id}$"
         now = time.time()
         starved: list[tuple] = []   # (priority, waited_since, row)
@@ -1337,7 +1497,11 @@ class NodeAgent:
                     # Already draining — unless the notice lapsed, in
                     # which case the ladder's next rung fires: stamp
                     # the escalation so the owning node hard-kills.
-                    self._maybe_escalate_eviction(row, request, now)
+                    # Fenced like every other sweep write.
+                    if not lease.fenced(epoch):
+                        return
+                    self._maybe_escalate_eviction(row, request, now,
+                                                  leader_epoch=epoch)
                     continue
                 if request:
                     continue  # malformed stamp; never a victim twice
@@ -1350,26 +1514,86 @@ class NodeAgent:
         for priority, _since, row in starved:
             if not victims or victims[0][0] >= priority:
                 break  # nothing running is strictly lower anymore
+            # Fencing re-check BEFORE each stamp (satellite audit):
+            # the scan above can outlive the term, and a preemption
+            # stamp is NOT idempotent across two leaders — two terms
+            # electing different victims for the same starved task is
+            # exactly the double-fire the partition drill forbids.
+            if not lease.fenced(epoch):
+                return
             victim_priority, victim = victims.pop(0)
             victim_job = victim["_pk"][len(prefix):]
             starved_job = row["_pk"][len(prefix):]
-            jobs_mgr.request_preemption(
+            stamped = jobs_mgr.request_preemption(
                 self.store, self.identity.pool_id, victim_job,
                 victim["_rk"],
                 reason=(f"priority {priority} task "
                         f"{starved_job}/{row['_rk']} cannot place "
                         f"(victim priority {victim_priority})"),
-                by_job_id=starved_job, by_task_id=row["_rk"])
+                by_job_id=starved_job, by_task_id=row["_rk"],
+                leader_epoch=epoch, defer_notice=True)
+            if stamped and not lease.fenced(epoch):
+                # The pre-write fence cannot bound the WRITE's own
+                # latency: under store retries the merge can land
+                # after our term ended, while the successor elects a
+                # DIFFERENT victim for the same starved task. The
+                # author is the only party that can tell "issued in
+                # term E, landed late" apart from a legitimate term-E
+                # stamp — so it retracts its own late stamp. The
+                # notice was deferred, so the retraction leaves no
+                # dangling TASK_PREEMPT_NOTICE event behind either.
+                self._retract_stale_preempt_stamp(
+                    victim["_pk"], victim["_rk"], epoch)
+                return
+            if callable(stamped):
+                stamped()  # the stamp stands: publish its notice
+
+    def _retract_stale_preempt_stamp(self, pk: str, rk: str,
+                                     epoch: int) -> None:
+        """Undo OUR OWN preemption stamp that landed after the term
+        ended (write latency outlived the lease margin). Only a
+        still-unescalated request carrying exactly our epoch is
+        retracted; anything else means the world moved on."""
+        try:
+            row = self.store.get_entity(names.TABLE_TASKS, pk, rk)
+        except Exception:  # noqa: BLE001 - stamp stays attributable
+            logger.warning("could not retract stale preempt stamp "
+                           "for %s/%s", pk, rk, exc_info=True)
+            return
+        request = row.get(names.TASK_COL_PREEMPT_REQUEST)
+        if not (isinstance(request, dict)
+                and request.get("leader_epoch") == epoch
+                and not request.get("escalated_at")):
+            return
+        try:
+            self.store.merge_entity(
+                names.TABLE_TASKS, pk, rk,
+                {names.TASK_COL_PREEMPT_REQUEST: None},
+                if_match=row["_etag"])
+            logger.warning(
+                "retracted preempt stamp on %s/%s: it landed after "
+                "leadership term %d ended", pk, rk, epoch)
+        except (EtagMismatchError, NotFoundError):
+            pass  # a concurrent transition owns the row now
+        except Exception:  # noqa: BLE001 - best effort
+            logger.warning("could not retract stale preempt stamp "
+                           "for %s/%s", pk, rk, exc_info=True)
 
     def _forward_preempt_requests(self) -> None:
         """Heartbeat-loop delivery of pending preempt requests into
         this node's LIVE tasks' dirs (the profile-request channel):
         one short-TTL-cached entity read per live task, one file drop
-        per (target, requested_at)."""
+        per (target, requested_at). Stale-epoch stamps are held for
+        one confirmation cycle before delivery (see
+        _confirm_stale_epoch_request)."""
         for job_id, task_id in list(self._live_procs.keys()):
             request = self._cached_task_preempt_request(job_id,
                                                         task_id)
             if not isinstance(request, dict):
+                continue
+            request = self._confirm_stale_epoch_request(
+                job_id, task_id, request)
+            if request is None:
                 continue
             self._deliver_preempt_request(job_id, task_id, request)
             # Escalation enforcement is LOCAL: the leader stamped the
@@ -1380,13 +1604,19 @@ class NodeAgent:
                 self._enforce_eviction(job_id, task_id)
 
     def _maybe_escalate_eviction(self, row: dict, request: dict,
-                                 now: float) -> None:
+                                 now: float,
+                                 leader_epoch: Optional[int] = None,
+                                 ) -> None:
         """Leader-side escalation decision: a pending preempt request
         older than preempt_grace_seconds means the victim ignored its
         notice — stamp ``escalated_at`` on the request (etag-guarded,
         exactly one escalation per request) so the owning node's
         heartbeat loop hard-kills it. The stamp is what classifies
-        the subsequent exit as ``evicted`` rather than a failure."""
+        the subsequent exit as ``evicted`` rather than a failure.
+        ``leader_epoch`` (the sweep term's fencing epoch) rides the
+        stamp so a deposed leader's in-flight escalation is
+        attributable — and its etag merge loses cleanly to any write
+        the successor landed first."""
         if request.get("escalated_at"):
             return
         requested = goodput_events.iso_to_epoch(
@@ -1401,7 +1631,8 @@ class NodeAgent:
                 names.TABLE_TASKS, row["_pk"], row["_rk"],
                 {names.TASK_COL_PREEMPT_REQUEST: {
                     **request,
-                    "escalated_at": util.datetime_utcnow_iso()}},
+                    "escalated_at": util.datetime_utcnow_iso(),
+                    "leader_epoch": leader_epoch}},
                 if_match=row["_etag"])
         except (EtagMismatchError, NotFoundError):
             return  # a concurrent transition (e.g. the drain) won
@@ -1424,25 +1655,7 @@ class NodeAgent:
         self._evicted_locally.add(key)
         logger.warning("evicting %s/%s: hard kill after ignored "
                        "preempt notice", job_id, task_id)
-        import shutil as shutil_mod
-        import signal as signal_mod
-        import subprocess as subprocess_mod
-        if shutil_mod.which("docker"):
-            # Fixed-name convention (task_runner.container_name):
-            # one rm -f per possible instance container of this task.
-            rc, out, _err = util.subprocess_capture(
-                ["docker", "ps", "--filter",
-                 f"name=shipyard-{job_id}-{task_id}-",
-                 "--format", "{{.Names}}"])
-            for name in (out.split() if rc == 0 else []):
-                subprocess_mod.call(
-                    ["docker", "rm", "-f", name],
-                    stdout=subprocess_mod.DEVNULL,
-                    stderr=subprocess_mod.DEVNULL)
-        try:
-            os.killpg(os.getpgid(proc.pid), signal_mod.SIGKILL)
-        except (ProcessLookupError, PermissionError, OSError):
-            pass
+        self._hard_kill_task_group(job_id, task_id, proc.pid)
 
     def _sweep_stale_preempt_files(self) -> None:
         """Per-node janitor for stale preempt-request files: an
@@ -1527,6 +1740,85 @@ class NodeAgent:
             self._task_preempt_cache.clear()
         self._task_preempt_cache[key] = (request, now)
         return request
+
+    def _confirm_stale_epoch_request(self, job_id: str, task_id: str,
+                                     request: dict
+                                     ) -> Optional[dict]:
+        """Consumer-side fence for the author-retraction race: a
+        request stamped with a leader_epoch OLDER than the preempt
+        sweep's current term is exactly the shape of a deposed
+        leader's late-landing stamp — which its author is about to
+        retract (_retract_stale_preempt_stamp). Delivering it in
+        that window drains a victim for a decision that no longer
+        stands, while the successor may stamp a DIFFERENT victim for
+        the same starved task: a double drain the partition drill's
+        notice count cannot see, because the deferred notice was
+        never published. A stale epoch alone is NOT proof of a bad
+        stamp, though — a legitimate term-E stamp survives into term
+        E+1 whenever leadership turns over mid-drain, and the
+        successor deliberately escalates rather than re-stamps it
+        (the "already draining" branch of the sweep). So first
+        delivery of a stale-epoch stamp is HELD for one confirmation
+        cycle, then re-read fresh: a retracted stamp has vanished; a
+        stamp that survives confirmation is the world's will and is
+        delivered. Current-term and epoch-less (manual ``jobs
+        preempt``) stamps pass straight through."""
+        stamp_epoch = request.get("leader_epoch")
+        if stamp_epoch is None:
+            return request
+        leader = self._observed_preempt_leader()
+        if (leader is None or leader.get("epoch") is None
+                or stamp_epoch >= leader["epoch"]):
+            return request
+        key = (job_id, task_id, str(request.get("requested_at")),
+               bool(request.get("escalated_at")))
+        now = time.monotonic()
+        first_seen = self._preempt_forward_hold.get(key)
+        if first_seen is None:
+            if len(self._preempt_forward_hold) > 256:
+                self._preempt_forward_hold.clear()
+            self._preempt_forward_hold[key] = now
+            return None
+        if now - first_seen < max(self.heartbeat_interval, 0.5):
+            return None
+        try:
+            entity = self._task_entity(job_id, task_id)
+        except NotFoundError:
+            self._preempt_forward_hold.pop(key, None)
+            return None
+        except Exception:  # noqa: BLE001 - heartbeat survives
+            return None  # transient: hold stands, retry next beat
+        fresh = entity.get(names.TASK_COL_PREEMPT_REQUEST)
+        if not (isinstance(fresh, dict)
+                and fresh.get("requested_at")
+                == request.get("requested_at")):
+            # Retracted (or replaced): the hold did its job — drop
+            # the cached copy so the next beat sees the fresh world.
+            self._preempt_forward_hold.pop(key, None)
+            self._task_preempt_cache.pop((job_id, task_id), None)
+            logger.warning(
+                "held stale-epoch preempt stamp on %s/%s was "
+                "retracted before delivery (epoch %s < current %s)",
+                job_id, task_id, stamp_epoch, leader["epoch"])
+            return None
+        self._preempt_forward_hold.pop(key, None)
+        return fresh
+
+    def _observed_preempt_leader(self) -> Optional[dict]:
+        """Observer view of the preempt-sweep lease's current term,
+        cached for _job_state_ttl — the epoch comparison above runs
+        every beat for as long as any live task is draining, and must
+        not cost two store reads each time."""
+        now = time.monotonic()
+        cached = self._preempt_leader_cache
+        if cached is not None and now - cached[1] < self._job_state_ttl:
+            return cached[0]
+        leader = state_leases.read_leader(
+            self.store,
+            names.leader_epoch_key(self.identity.pool_id,
+                                   state_leases.ROLE_PREEMPT_SWEEP))
+        self._preempt_leader_cache = (leader, now)
+        return leader
 
     def _escalated_request_pending(self, job_id: str,
                                    task_id: str) -> bool:
@@ -2076,17 +2368,30 @@ class NodeAgent:
             self._live_procs.pop(key, None)
 
     def _run_task_registered(self, key: tuple[str, str],
-                             execution: task_runner.TaskExecution
+                             execution: task_runner.TaskExecution,
+                             ledger_slot: Optional[int] = None,
+                             ledger_gang: bool = False,
                              ) -> task_runner.TaskResult:
         """run_task with live-proc registration (term_task control
         verbs and chaos task_kill/task_wedge target the proc through
         _live_procs), unregistering only its own entry on exit (see
-        _drop_live_proc). Shared by the regular and gang paths."""
+        _drop_live_proc). Shared by the regular and gang paths.
+        ``ledger_slot`` arms the crash-restart slot ledger: the
+        launched pid is persisted so a restarted agent can re-adopt
+        the still-running process instead of reclaim-rerunning it
+        (the ledger is cleared by the completion path, not here — a
+        crash between exit and classification must stay
+        adoptable). ``ledger_gang`` marks the record as a gang
+        member, which a restarted agent fences (kills) rather than
+        adopts."""
         mine: list = []
 
         def _register(proc):
             mine.append(proc)
             self._live_procs[key] = proc
+            if ledger_slot is not None:
+                self._write_slot_ledger(ledger_slot, key, execution,
+                                        proc, gang=ledger_gang)
 
         try:
             return task_runner.run_task(execution,
@@ -2250,11 +2555,51 @@ class NodeAgent:
                 self._running_tasks += 1
             try:
                 result = self._run_task_registered(
-                    (job_id, task_id), execution)
+                    (job_id, task_id), execution, ledger_slot=slot)
             finally:
                 with self._running_lock:
                     self._running_tasks -= 1
-        self._upload_outputs(job_id, task_id, execution)
+        if self._abandoned:
+            # Simulated agent-process death (chaos agent_restart):
+            # this thread is a zombie of the dead "process" — the
+            # completion belongs to the restarted agent's adoption
+            # watcher, which reads the slot ledger + exit-code
+            # sentinel. A single store write here would
+            # double-classify the exit.
+            return
+        self._finish_regular_result(slot, job_id, task_id, spec,
+                                    entity, execution, result,
+                                    msg=msg)
+
+    def _finish_regular_result(self, slot: int, job_id: str,
+                               task_id: str, spec: dict,
+                               entity: dict,
+                               execution: task_runner.TaskExecution,
+                               result: task_runner.TaskResult,
+                               msg=None) -> None:
+        """Post-exit half of the regular-task path: uploads, goodput
+        ingest, exit classification, requeue/quarantine/finish.
+        Shared by the worker slot (msg = the claimed queue message)
+        and the crash-restart adoption watcher (msg=None — the
+        redelivered message dies on the terminal-state check once
+        the entity goes terminal). Clears the slot ledger last: a
+        crash anywhere before that leaves the task adoptable."""
+        try:
+            self._upload_outputs(job_id, task_id, execution)
+        except Exception as exc:  # noqa: BLE001 - classify anyway
+            # Classification must never be hostage to an upload: an
+            # exception escaping here (store outage past the retry
+            # ceiling, injected fault) would skip the exit handling
+            # below and orphan-reclaim a FINISHED task into a rerun.
+            # Lost stdout/stderr blobs are recorded and survivable;
+            # a double execution is not.
+            logger.exception("output upload failed for %s/%s",
+                             job_id, task_id)
+            try:
+                self._merge_task(job_id, task_id,
+                                 {"output_error": str(exc)})
+            except Exception:  # noqa: BLE001 - best effort
+                pass
         self._ingest_goodput(job_id, task_id, execution)
         self._upload_profile_artifacts(job_id, task_id, execution)
         self._export_compile_cache()
@@ -2267,69 +2612,81 @@ class NodeAgent:
                              job_id, task_id)
             self._merge_task(job_id, task_id,
                              {"output_error": str(exc)})
-        ok = result.exit_code == 0
-        # The distinct preempted status: a cooperative drain is a
-        # scheduling transition, never a failure — full retry budget,
-        # no node-health debit, no backoff.
-        preempted = result.exit_code == preempt_mod.EXIT_PREEMPTED
-        # The evicted status (the escalation ladder's hard kill): we
-        # killed it ourselves (local marker), or the sweep's
-        # escalation stamp is on the entity (cached read — covers a
-        # restart between kill and classification). Externally caused
-        # either way: never a wedge, never a node-health debit.
-        evicted = not ok and not preempted and (
-            (job_id, task_id) in self._evicted_locally
-            or self._escalated_request_pending(job_id, task_id))
-        self._evicted_locally.discard((job_id, task_id))
-        self._note_task_outcome(ok, wedged=result.wedged,
-                                neutral=preempted or evicted)
-        retries = entity.get("retries", 0)
-        max_retries = spec.get("max_task_retries", 0)
-        reason = ("wedged: no progress beat within "
-                  f"{spec.get('progress_deadline_seconds')}s"
-                  if result.wedged else
-                  f"exit code {result.exit_code}")
-        decision = ("complete" if ok
-                    else "preempted" if preempted
-                    else "evicted" if evicted
-                    else self._retry_decision(retries, max_retries))
-        if decision == "preempted":
-            if self._requeue_preempted(job_id, task_id, spec):
+        try:
+            ok = result.exit_code == 0
+            # The distinct preempted status: a cooperative drain is a
+            # scheduling transition, never a failure — full retry
+            # budget, no node-health debit, no backoff.
+            preempted = result.exit_code == preempt_mod.EXIT_PREEMPTED
+            # The evicted status (the escalation ladder's hard kill):
+            # we killed it ourselves (local marker), or the sweep's
+            # escalation stamp is on the entity (cached read — covers
+            # a restart between kill and classification). Externally
+            # caused either way: never a wedge, never a node-health
+            # debit.
+            evicted = not ok and not preempted and (
+                (job_id, task_id) in self._evicted_locally
+                or self._escalated_request_pending(job_id, task_id))
+            self._evicted_locally.discard((job_id, task_id))
+            self._note_task_outcome(ok, wedged=result.wedged,
+                                    neutral=preempted or evicted)
+            retries = entity.get("retries", 0)
+            max_retries = spec.get("max_task_retries", 0)
+            reason = ("wedged: no progress beat within "
+                      f"{spec.get('progress_deadline_seconds')}s"
+                      if result.wedged else
+                      f"exit code {result.exit_code}")
+            decision = ("complete" if ok
+                        else "preempted" if preempted
+                        else "evicted" if evicted
+                        else self._retry_decision(retries,
+                                                  max_retries))
+            if decision == "preempted":
+                if self._requeue_preempted(job_id, task_id, spec):
+                    self._heartbeat(state="idle")
+                    self._ack_message(msg)
+                    return
+                decision = self._retry_decision(retries, max_retries)
+            if decision == "evicted":
+                if self._requeue_evicted(job_id, task_id, spec):
+                    self._heartbeat(state="idle")
+                    self._ack_message(msg)
+                    return
+                decision = self._retry_decision(retries, max_retries)
+            if decision == "requeue":
+                # Retry supervisor: exponential backoff + jitter, the
+                # not_before stamp honored by every claimer.
+                self._requeue_with_backoff(
+                    job_id, task_id, spec, retries + 1,
+                    result.exit_code, reason)
                 self._heartbeat(state="idle")
-                self.store.delete_message(msg)
+                self._ack_message(msg)
                 return
-            decision = self._retry_decision(retries, max_retries)
-        if decision == "evicted":
-            if self._requeue_evicted(job_id, task_id, spec):
-                self._heartbeat(state="idle")
-                self.store.delete_message(msg)
-                return
-            decision = self._retry_decision(retries, max_retries)
-        if decision == "requeue":
-            # Retry supervisor: exponential backoff + jitter, the
-            # not_before stamp honored by every claimer.
-            self._requeue_with_backoff(
-                job_id, task_id, spec, retries + 1,
-                result.exit_code, reason)
-            self._heartbeat(state="idle")
+            if decision == "quarantine":
+                # Poison quarantine: the budget is burned — park the
+                # task with its post-mortem instead of plain "failed".
+                if self._quarantine_task(
+                        job_id, task_id, result.exit_code, reason,
+                        stderr_path=result.stderr_path):
+                    self._schedule_retention(spec, job_id, task_id)
+                    self._heartbeat(state="idle")
+                    self._ack_message(msg)
+                    self._maybe_autocomplete_job(job_id)
+                    return
+            self._schedule_retention(spec, job_id, task_id)
+            self._finish_task(job_id, task_id, result,
+                              error=None if ok else reason)
+            self._ack_message(msg)
+            self._maybe_autocomplete_job(job_id)
+        finally:
+            self._clear_slot_ledger(slot, (job_id, task_id))
+
+    def _ack_message(self, msg) -> None:
+        """delete_message tolerant of the adoption path's msg=None
+        (the watcher holds no queue message; redelivered copies die
+        on the terminal-state check)."""
+        if msg is not None:
             self.store.delete_message(msg)
-            return
-        if decision == "quarantine":
-            # Poison quarantine: the budget is burned — park the task
-            # with its post-mortem instead of plain "failed".
-            if self._quarantine_task(job_id, task_id,
-                                     result.exit_code, reason,
-                                     stderr_path=result.stderr_path):
-                self._schedule_retention(spec, job_id, task_id)
-                self._heartbeat(state="idle")
-                self.store.delete_message(msg)
-                self._maybe_autocomplete_job(job_id)
-                return
-        self._schedule_retention(spec, job_id, task_id)
-        self._finish_task(job_id, task_id, result,
-                          error=None if ok else reason)
-        self.store.delete_message(msg)
-        self._maybe_autocomplete_job(job_id)
 
     _RETENTION_MARKER = ".shipyard_retention_deadline"
 
@@ -2384,6 +2741,514 @@ class NodeAgent:
         if found:
             logger.info("re-registered %d retention sweeps from "
                         "markers", found)
+
+    # --------------------- crash-restart adoption ----------------------
+
+    def _slot_ledger_path(self, slot: int) -> str:
+        return os.path.join(self.work_dir, "slots",
+                            f"slot{slot}.json")
+
+    def _write_slot_ledger(self, slot: int, key: tuple[str, str],
+                           execution: task_runner.TaskExecution,
+                           proc, gang: bool = False) -> None:
+        """Persist this slot's live claim (task identity, pid,
+        container, the post-task env paths) at launch — the
+        _atomic_write idiom (tmp + fsync + rename) so a crash
+        mid-write can never surface a torn ledger. A restarted agent
+        re-adopts from exactly this record instead of letting the
+        janitor/orphan paths quarantine-rerun a task that never
+        stopped running. ``gang`` marks a gang-member launch, whose
+        restart handling is fence-by-kill rather than adoption (see
+        _adopt_restart_state)."""
+        pid = getattr(proc, "pid", None)
+        record = {
+            "slot": slot, "job_id": key[0], "task_id": key[1],
+            "pid": pid,
+            # Pid-identity anchor for _ledger_pid_matches: a pid the
+            # OS recycled while the agent was down won't carry it.
+            "pid_start_ticks": self._proc_start_ticks(pid),
+            "runtime": execution.runtime,
+            "container": task_runner.container_name(execution),
+            "task_dir": execution.task_dir,
+            "command": execution.command,
+            # Only the framework's own path contract survives the
+            # restart (goodput/trace sinks, profile dirs): resolved
+            # user secrets must never touch the node's disk.
+            "env": {k: v for k, v in execution.env.items()
+                    if k.startswith("SHIPYARD_")},
+            "started_at": util.datetime_utcnow_iso(),
+        }
+        path = self._slot_ledger_path(slot)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            util.atomic_write(path,
+                              json.dumps(record).encode("utf-8"))
+        except OSError:
+            logger.exception("slot ledger write failed for slot %d",
+                             slot)
+
+    def _clear_slot_ledger(self, slot: int,
+                           key: Optional[tuple[str, str]] = None
+                           ) -> None:
+        """Retire a slot's ledger once its task is fully classified.
+        ``key`` guards cross-task races: a ledger now naming a
+        DIFFERENT task (the slot moved on) is someone else's."""
+        path = self._slot_ledger_path(slot)
+        if key is not None:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    record = json.load(fh)
+                if (record.get("job_id"),
+                        record.get("task_id")) != key:
+                    return
+            except (OSError, ValueError):
+                return
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _pid_alive(pid: Optional[int]) -> bool:
+        if not pid or pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except (PermissionError, OSError):
+            return True
+        return True
+
+    @staticmethod
+    def _proc_start_ticks(pid: Optional[int]) -> Optional[int]:
+        """Kernel start time (clock ticks since boot) of ``pid`` from
+        /proc — the cheap pid-identity disambiguator: a recycled pid
+        never shares its predecessor's start tick. None off-Linux or
+        once the process is gone."""
+        if not pid or pid <= 0:
+            return None
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as fh:
+                stat = fh.read().decode("ascii", "replace")
+            # Field 22 (starttime); comm can embed spaces/parens, so
+            # split only after the closing paren.
+            return int(stat.rpartition(")")[2].split()[19])
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def _ledger_pid_matches(self, pid: Optional[int],
+                            record: dict) -> bool:
+        """Liveness AND identity of a ledgered pid: alive, still a
+        session/group leader (every task launches with
+        start_new_session, so pgid == pid), and carrying the same
+        kernel start tick the ledger recorded at launch. An agent
+        down long enough for the OS to recycle the number must not
+        adopt-wait on — or worse, hard-kill — the stranger that
+        inherited it."""
+        if not self._pid_alive(pid):
+            return False
+        try:
+            if os.getpgid(pid) != pid:
+                return False
+        except OSError:
+            return False
+        recorded = record.get("pid_start_ticks")
+        current = self._proc_start_ticks(pid)
+        if recorded is not None and current is not None \
+                and recorded != current:
+            return False
+        return True
+
+    @staticmethod
+    def _read_adopted_exit(record: dict) -> Optional[int]:
+        """The exit-code sentinel the task's own session (or the
+        reaping runner) persisted — task_runner.EXIT_CODE_FILENAME
+        in the ledgered task_dir. None while the task still runs (or
+        when the outcome is truly unknown)."""
+        task_dir = record.get("task_dir") or ""
+        try:
+            with open(os.path.join(
+                    task_dir, task_runner.EXIT_CODE_FILENAME),
+                    encoding="utf-8") as fh:
+                return int(fh.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _adopt_restart_state(self) -> int:
+        """Crash-restart adoption (start()-time): every slot ledger a
+        previous agent process left behind is either ADOPTED — the
+        claim is still ours and the process (or its exit-code
+        sentinel) survives, so a watcher thread takes over the wait +
+        completion path and the task finishes with retries untouched
+        and neutral health — or retired, leaving the ordinary
+        orphan-reclaim rerun semantics. The control-plane gap (last
+        pre-crash heartbeat -> adoption) is priced as the `adoption`
+        badput leg and traced as SPAN_AGENT_RESTART."""
+        root = os.path.join(self.work_dir, "slots")
+        if not os.path.isdir(root):
+            return 0
+        adopted = 0
+        now = time.time()
+        window_start = self._pre_restart_heartbeat
+        if not window_start or window_start > now:
+            window_start = now
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    record = json.load(fh)
+                slot = int(record["slot"])
+                job_id = record["job_id"]
+                task_id = record["task_id"]
+            except (OSError, ValueError, KeyError, TypeError):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if record.get("gang"):
+                # Gang members are FENCED, not adopted: the
+                # rendezvous context this launch belonged to (member
+                # list, gang env, the i{instance} row merge and gang
+                # finalize) died with the old agent process, so no
+                # watcher could classify the exit honestly — and the
+                # gang recovery paths (orphaned-gang janitor,
+                # requeue-as-a-unit) already own the rerun. What must
+                # NOT survive is the process itself: a live leftover
+                # member writing into the task dir while the requeued
+                # gang re-runs is exactly the double execution the
+                # ledger exists to prevent. No store read needed —
+                # fencing is purely local, so it works with the store
+                # dark at boot.
+                pid = record.get("pid")
+                if self._ledger_pid_matches(pid, record) and \
+                        self._read_adopted_exit(record) is None:
+                    logger.warning(
+                        "fencing leftover gang member %s/%s (pid %s) "
+                        "after agent restart", job_id, task_id, pid)
+                    self._hard_kill_task_group(job_id, task_id, pid)
+                self._clear_slot_ledger(slot)
+                continue
+            try:
+                # Bounded like the heartbeat probe above: boot must
+                # not block max_outage_seconds per ledger when the
+                # store is dark.
+                with self._store_bounded(
+                        max(10.0, 2.0 * self.heartbeat_interval)):
+                    entity = self._task_entity(job_id, task_id)
+            except NotFoundError:
+                entity = None
+            except Exception:  # noqa: BLE001 - store down at boot
+                logger.debug("adoption probe failed", exc_info=True)
+                continue  # ledger kept: retry next restart
+            if (entity is None
+                    or entity.get("node_id") != self.identity.node_id
+                    or entity.get("state") not in ("assigned",
+                                                   "running")):
+                # The world moved on (terminal, re-owned, gone):
+                # nothing left to adopt. A leftover process that IS
+                # still ours-by-ledger gets fenced first — the claim
+                # it served no longer exists, and the rerun that
+                # replaced it must never share output dirs with a
+                # live predecessor.
+                pid = record.get("pid")
+                if self._ledger_pid_matches(pid, record) and \
+                        self._read_adopted_exit(record) is None:
+                    logger.warning(
+                        "fencing leftover process for re-owned task "
+                        "%s/%s (pid %s) after agent restart",
+                        job_id, task_id, pid)
+                    self._hard_kill_task_group(job_id, task_id, pid)
+                self._clear_slot_ledger(slot)
+                continue
+            pid = record.get("pid")
+            alive = self._ledger_pid_matches(pid, record)
+            exit_code = self._read_adopted_exit(record)
+            if not alive and exit_code is None:
+                # Process gone AND outcome unknown: adoption cannot
+                # classify honestly — leave the rerun to the
+                # orphan-reclaim path (retries budgeted, as today).
+                self._clear_slot_ledger(slot)
+                continue
+            proc = _AdoptedProc(pid)
+            self._adopted_slots.add(slot)
+            # Register EVERY adoption (dead-pid ones included): the
+            # registration is what makes _maybe_reclaim_orphan back
+            # off a redelivered message on a SIBLING slot — without
+            # it, a dead-pid adoption races its own reclaim-rerun
+            # into a double execution. Kill-path consumers tolerate
+            # a dead pid (ProcessLookupError handled everywhere).
+            self._live_procs[(job_id, task_id)] = proc
+            ctx = trace_context.TraceContext.from_entity(entity)
+            goodput_events.emit(
+                self.store, self.identity.pool_id,
+                goodput_events.TASK_ADOPTION, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=window_start, end=now,
+                attrs={"pid": pid, "proc_alive": alive,
+                       "retries": entity.get("retries", 0)},
+                trace_id=entity.get(trace_context.COL_TRACE_ID),
+                span_id=entity.get(trace_context.COL_TRACE_SPAN))
+            trace_spans.emit(
+                self.store, self.identity.pool_id,
+                trace_spans.SPAN_AGENT_RESTART, ctx, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                start=window_start, end=now,
+                attrs={"pid": pid, "proc_alive": alive})
+            thread = threading.Thread(
+                target=self._adopt_watch,
+                args=(record, entity, proc, alive),
+                name=(f"adopt-{self.identity.node_id}"
+                      f"-s{slot}"), daemon=True)
+            thread.start()
+            self._threads.append(thread)
+            adopted += 1
+            logger.warning(
+                "adopted %s task %s/%s on slot %d after agent "
+                "restart (pid %s)",
+                "running" if alive else "exited", job_id, task_id,
+                slot, pid)
+        return adopted
+
+    def _adopt_watch(self, record: dict, entity: dict, proc,
+                     was_alive: bool) -> None:
+        """Adoption watcher: stand in for the dead worker slot's
+        blocking wait — poll the adopted pid, read the exit-code
+        sentinel its session persisted, and drive the SAME
+        completion path a live slot would have (uploads, goodput,
+        classification). The task's retry budget is untouched by
+        construction: no requeue ever happened."""
+        slot = int(record["slot"])
+        job_id, task_id = record["job_id"], record["task_id"]
+        key = (job_id, task_id)
+        counted = False
+        # Adoption must not shed the task's runtime limits: the
+        # original run_task watchdog died with the old agent, so THIS
+        # loop re-arms wall-time (elapsed since the original launch)
+        # and the progress watchdog (the beat file's mtime survives
+        # the restart). Without them a wedged adopted task would hold
+        # its slot forever — the exact hang class the watchdog
+        # exists to bound.
+        spec = entity.get("spec") or {}
+        wall_limit = spec.get("max_wall_time_seconds")
+        watchdog = spec.get("progress_deadline_seconds")
+        progress_file = (record.get("env") or {}).get(
+            progress_mod.PROGRESS_FILE_ENV)
+        started_epoch = goodput_events.iso_to_epoch(
+            record.get("started_at"))
+        adopted_at = time.time()
+        timed_out = False
+        wedged = False
+        try:
+            with self._running_lock:
+                self._goodput_idle_since = None
+                self._goodput_busy_slots.add(slot)
+                if was_alive:
+                    self._running_tasks += 1
+                    counted = True
+            while self._ledger_pid_matches(proc.pid, record) and \
+                    not self.stop_event.is_set():
+                # The sentinel outranks pid liveness: once the task's
+                # own session wrote its exit code, the command IS
+                # done. Liveness itself is the full identity check
+                # (_ledger_pid_matches, not _pid_alive): a pid the OS
+                # recycles MID-WATCH would otherwise strand this
+                # watcher "running" forever — or worse, hand the
+                # wall/wedge enforcement below a stranger's process
+                # group to hard-kill.
+                if self._read_adopted_exit(record) is not None:
+                    break
+                now = time.time()
+                elapsed = now - (started_epoch or adopted_at)
+                if wall_limit is not None and elapsed > wall_limit:
+                    timed_out = True
+                    logger.warning(
+                        "adopted task %s/%s exceeded wall time "
+                        "%.1fs; killing", job_id, task_id,
+                        float(wall_limit))
+                    self._hard_kill_task_group(job_id, task_id,
+                                             proc.pid)
+                    break
+                if watchdog is not None and progress_file:
+                    beat = progress_mod.last_beat(progress_file)
+                    # A missing beat file restarts the clock at
+                    # adoption (conservative: the full deadline
+                    # again, never a false wedge from lost state).
+                    stale = (now - beat if beat is not None
+                             else now - adopted_at)
+                    if stale > watchdog:
+                        wedged = True
+                        logger.warning(
+                            "adopted task %s/%s made no progress "
+                            "for %.1fs (deadline %.1fs); killing as "
+                            "wedged", job_id, task_id, stale,
+                            float(watchdog))
+                        self._hard_kill_task_group(job_id, task_id,
+                                                 proc.pid)
+                        break
+                time.sleep(max(0.05, min(0.25, self.poll_interval)))
+            if self.stop_event.is_set() and \
+                    self._ledger_pid_matches(proc.pid, record) and \
+                    self._read_adopted_exit(record) is None:
+                # Stopping again mid-adoption: the ledger stays — the
+                # NEXT restart adopts the still-running task.
+                return
+            if timed_out or wedged:
+                # Our own kill: the classification is known — no
+                # sentinel will appear (SIGKILL never runs the
+                # trailer) and a handback would erase a genuine
+                # wall/wedge verdict.
+                exit_code = -9
+            else:
+                # The sentinel can lag the pid death by the shell
+                # trailer's mv; poll briefly.
+                exit_code = None
+                deadline = time.monotonic() + 5.0
+                while exit_code is None and \
+                        time.monotonic() < deadline:
+                    exit_code = self._read_adopted_exit(record)
+                    if exit_code is None:
+                        time.sleep(0.05)
+                if exit_code is None and record.get("container"):
+                    # Containerized task: only the shell trailer of
+                    # runtime "none" writes the sentinel from inside
+                    # the task's session, so ask the runtime itself.
+                    exit_code = self._container_exit_code(
+                        record["container"])
+                if exit_code is None:
+                    if record.get("runtime", "none") == "none":
+                        # The trailer writes the sentinel on ANY
+                        # normal exit; its absence means the session
+                        # was hard-killed externally — classify as
+                        # the kill it was; the retry supervisor
+                        # prices the rerun.
+                        exit_code = -9
+                    else:
+                        # Containerized outcome genuinely unknowable
+                        # (e.g. --rm removed the container before we
+                        # could ask). Never guess a FAILURE for a
+                        # task that may have succeeded: hand it back
+                        # through the orphan-reclaim semantics —
+                        # reset pending, no retry consumed, no
+                        # health debit.
+                        self._abandon_adoption_to_reclaim(
+                            job_id, task_id, slot)
+                        return
+            task_dir = record.get("task_dir") or os.path.join(
+                self.work_dir, "tasks", job_id, task_id)
+            execution = task_runner.TaskExecution(
+                pool_id=self.identity.pool_id, job_id=job_id,
+                task_id=task_id, node_id=self.identity.node_id,
+                node_index=self.identity.node_index,
+                command=record.get("command", ""),
+                runtime=record.get("runtime", "none"),
+                env=dict(record.get("env") or {}),
+                task_dir=task_dir, slot=slot,
+                record_exit_code=True)
+            started_at = record.get("started_at") or \
+                util.datetime_utcnow_iso()
+            started = goodput_events.iso_to_epoch(started_at) or \
+                time.time()
+            result = task_runner.TaskResult(
+                exit_code=exit_code,
+                stdout_path=os.path.join(task_dir, "stdout.txt"),
+                stderr_path=os.path.join(task_dir, "stderr.txt"),
+                started_at=started_at,
+                completed_at=util.datetime_utcnow_iso(),
+                wall_seconds=max(0.0, time.time() - started),
+                timed_out=timed_out, wedged=wedged)
+            try:
+                fresh = self._task_entity(job_id, task_id)
+            except Exception:  # noqa: BLE001 - keep the snapshot
+                fresh = entity
+            self._finish_regular_result(
+                slot, job_id, task_id, fresh.get("spec") or {},
+                fresh, execution, result, msg=None)
+        except Exception:
+            logger.exception("adoption watcher failed for %s/%s",
+                             job_id, task_id)
+        finally:
+            if counted:
+                with self._running_lock:
+                    self._running_tasks -= 1
+            if self._live_procs.get(key) is proc:
+                self._live_procs.pop(key, None)
+            self._goodput_work_done(slot)
+            self._adopted_slots.discard(slot)
+
+    @staticmethod
+    def _hard_kill_task_group(job_id: str, task_id: str,
+                              pid: int) -> None:
+        """Hard-kill a task's live process group on THIS node
+        (eviction enforcement, adopted-task wall/wedge enforcement):
+        docker containers force-removed first — SIGKILL is never
+        proxied by the docker client (the task_runner wedge lesson;
+        fixed-name convention from task_runner.container_name, one
+        rm -f per possible instance container) — then the session
+        group eats SIGKILL (tasks launch with start_new_session, so
+        pgid == pid)."""
+        import shutil as shutil_mod
+        import signal as signal_mod
+        import subprocess as subprocess_mod
+        if shutil_mod.which("docker"):
+            rc, out, _err = util.subprocess_capture(
+                ["docker", "ps", "--filter",
+                 f"name=shipyard-{job_id}-{task_id}-",
+                 "--format", "{{.Names}}"])
+            for name in (out.split() if rc == 0 else []):
+                subprocess_mod.call(
+                    ["docker", "rm", "-f", name],
+                    stdout=subprocess_mod.DEVNULL,
+                    stderr=subprocess_mod.DEVNULL)
+        try:
+            os.killpg(os.getpgid(pid), signal_mod.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    @staticmethod
+    def _container_exit_code(container: str) -> Optional[int]:
+        """The runtime's own record of a finished container's exit
+        code (`docker inspect`); None when docker is absent or the
+        container is gone (e.g. --rm already removed it)."""
+        import shutil as shutil_mod
+        if not shutil_mod.which("docker"):
+            return None
+        rc, out, _err = util.subprocess_capture(
+            ["docker", "inspect", "-f", "{{.State.ExitCode}}",
+             container])
+        if rc != 0:
+            return None
+        try:
+            return int(out.strip())
+        except ValueError:
+            return None
+
+    def _abandon_adoption_to_reclaim(self, job_id: str,
+                                     task_id: str,
+                                     slot: int) -> None:
+        """Unknown-outcome adoption exit: reset the claim exactly
+        like the orphan-reclaim path would (pending, no retry bump,
+        requeued_at restarts the queue clock) so the rerun costs
+        repeat work but never budget or health."""
+        logger.warning(
+            "adopted task %s/%s finished with an unknowable exit; "
+            "handing back to the reclaim path", job_id, task_id)
+        try:
+            entity = self._task_entity(job_id, task_id)
+            if entity.get("state") in ("assigned", "running") and \
+                    entity.get("node_id") == self.identity.node_id:
+                self._merge_task(
+                    job_id, task_id,
+                    {"state": "pending", "node_id": None,
+                     "requeued_at": util.datetime_utcnow_iso()},
+                    if_match=entity["_etag"])
+        except (EtagMismatchError, NotFoundError):
+            pass
+        except Exception:  # noqa: BLE001 - orphan reclaim retries
+            logger.exception("adoption handback failed for %s/%s",
+                             job_id, task_id)
+        self._clear_slot_ledger(slot, (job_id, task_id))
 
     def _sweep_retention(self) -> None:
         now = time.monotonic()
@@ -2583,31 +3448,62 @@ class NodeAgent:
             except NotFoundError:
                 pass
 
-    def _is_gang_sweep_leader(self) -> bool:
-        """Deterministic sweeper election without a lease: the
-        lowest-indexed node with a fresh heartbeat (or fresh
-        registration — the _node_alive grace rule) leads. One
-        partition-scoped nodes query per sweep interval."""
-        now = time.time()
-        best: Optional[int] = None
-        for node in self.store.query_entities(
-                names.TABLE_NODES,
-                partition_key=self.identity.pool_id):
-            if node.get("state") in ("offline",):
-                continue
-            heartbeat = float(node.get("heartbeat_at", 0) or 0)
-            if heartbeat > 0:
-                fresh = now - heartbeat < self.node_stale_seconds
-            else:
-                registered = float(node.get("registered_at", 0) or 0)
-                fresh = (registered > 0 and
-                         now - registered < self.node_stale_seconds)
-            if not fresh:
-                continue
-            index = int(node.get("node_index", 1 << 30))
-            if best is None or index < best:
-                best = index
-        return best is not None and best == self.identity.node_index
+    def _sweep_lease(self, role: str) -> state_leases.LeaderLease:
+        """The named leadership lease of one leader-gated loop,
+        created lazily so a node whose sweep never runs (disabled
+        preempt interval) never competes for its lease."""
+        lease = self._sweep_leases.get(role)
+        if lease is None:
+            lease = state_leases.LeaderLease(
+                self.store,
+                key=names.leader_lease_key(self.identity.pool_id,
+                                           role),
+                epoch_key=names.leader_epoch_key(
+                    self.identity.pool_id, role),
+                owner=self.identity.node_id,
+                duration_seconds=self.leader_lease_seconds,
+                blocked=lambda: (time.time()
+                                 < self.lease_blackout_until))
+            self._sweep_leases[role] = lease
+        return lease
+
+    def _sweep_leader_epoch(self, role: str) -> Optional[int]:
+        """Leadership gate for leader-gated sweeps: the current
+        term's fencing epoch while THIS node holds the role's lease,
+        None otherwise. Replaces the old heartbeat-freshness election
+        (`_is_gang_sweep_leader`): a lease can only be extended
+        through the store, so a partitioned leader abdicates on its
+        own clock strictly before a successor can acquire — there is
+        no double-leader window — and the epoch fences every sweep
+        write a deposed leader might still have in flight."""
+        try:
+            return self._sweep_lease(role).epoch()
+        except Exception:  # noqa: BLE001 - store hiccup = not leader
+            logger.debug("sweep lease check failed for %s", role,
+                         exc_info=True)
+            return None
+
+    def _store_bounded(self, seconds: float):
+        """Bounded critical-retry window when the store wrapper
+        supports it (state/resilient.py ``bounded``); an identity
+        context on a bare store, where transport errors surface
+        immediately anyway."""
+        bounded = getattr(self.store, "bounded", None)
+        if callable(bounded):
+            return bounded(seconds)
+        return contextlib.nullcontext()
+
+    def _renew_sweep_leases(self) -> None:
+        """Heartbeat-cadence renewal of HELD sweep leases (sweep
+        intervals can exceed the lease duration; the heartbeat is
+        the keepalive). Renew-only: acquisition belongs to the gated
+        loops themselves."""
+        for lease in self._sweep_leases.values():
+            try:
+                lease.maintain()
+            except Exception:  # noqa: BLE001 - heartbeat survives
+                logger.debug("sweep lease renew failed",
+                             exc_info=True)
 
     def _sweep_orphaned_gangs(self) -> None:
         """Janitor for leaked rendezvous rows: a gang cleanup
@@ -2626,13 +3522,17 @@ class NodeAgent:
         # One sweeper per pool: the table scan below is unpartitioned
         # (no prefix query in the store interface), so N nodes each
         # scanning every interval would multiply fleet-wide read
-        # traffic for zero extra safety. Lowest-indexed LIVE node
-        # sweeps; a brief double-leader window during failover is
-        # harmless because clearing is idempotent.
-        if not self._is_gang_sweep_leader():
+        # traffic for zero extra safety. The janitor lease elects
+        # exactly one sweeper per term (state/leases.py) — no
+        # failover window at all, unlike the old heartbeat-freshness
+        # election.
+        epoch = self._sweep_leader_epoch(
+            state_leases.ROLE_GANG_JANITOR)
+        if epoch is None:
             return
         prefix = f"{self.identity.pool_id}$"
         seen: set[str] = set()
+        lease = self._sweep_lease(state_leases.ROLE_GANG_JANITOR)
         for row in list(self.store.query_entities(names.TABLE_GANGS)):
             pk = row["_pk"]
             if pk in seen or not pk.startswith(prefix):
@@ -2657,6 +3557,14 @@ class NodeAgent:
                     and self._gang_attempt(entity) <= attempt):
                 # Live (or future) rendezvous attempt — not garbage.
                 continue
+            # Fencing re-check BEFORE the write: the scan above can
+            # outlive the term (satellite audit — the verdict cached
+            # at the top of the loop must not authorize a stale
+            # clear). Clearing is idempotent, so this only bounds
+            # the deposed leader's wasted work, but the discipline
+            # is uniform across every fenced sweep.
+            if not lease.fenced(epoch):
+                return
             logger.warning("sweeping orphaned gang rows in %s", pk)
             self._clear_gang_rows(pk)
 
@@ -3030,11 +3938,26 @@ class NodeAgent:
                     # Register the live proc like the regular path:
                     # term_task control verbs and chaos task_kill/
                     # task_wedge injections target gang instances too.
+                    # The slot ledger is armed as a GANG record: a
+                    # restarted agent cannot re-join the in-memory
+                    # rendezvous this launch belonged to, but it must
+                    # learn a member process may still be alive and
+                    # fence it before the gang's requeue re-runs it.
                     result = self._run_task_registered(
-                        (job_id, task_id), execution)
+                        (job_id, task_id), execution,
+                        ledger_slot=slot, ledger_gang=True)
             finally:
                 with self._running_lock:
                     self._running_tasks -= 1
+        if self._abandoned:
+            # Simulated agent-process death mid-gang-run (chaos
+            # agent_restart): a dead process writes nothing — the
+            # gang's recovery paths own the task from here.
+            return
+        # The member process exited and we're alive to record it: the
+        # gang ledger's only job (fencing a leftover live process on
+        # restart) is done.
+        self._clear_slot_ledger(slot, (job_id, task_id))
         gang_evicted = (job_id, task_id) in self._evicted_locally
         self._evicted_locally.discard((job_id, task_id))
         self._note_task_outcome(
@@ -3059,8 +3982,19 @@ class NodeAgent:
                                         instance=instance)
             self.store.delete_message(msg)
             return
-        self._upload_outputs(job_id, task_id, execution,
-                             suffix=f"i{instance}")
+        try:
+            self._upload_outputs(job_id, task_id, execution,
+                                 suffix=f"i{instance}")
+        except Exception as exc:  # noqa: BLE001 - classify anyway
+            # Same rule as _finish_regular_result: the gang finalize
+            # below must run even when the blob upload fails.
+            logger.exception("gang output upload failed for %s/%s",
+                             job_id, task_id)
+            try:
+                self._merge_task(job_id, task_id,
+                                 {"output_error": str(exc)})
+            except Exception:  # noqa: BLE001 - best effort
+                pass
         self._ingest_goodput(job_id, task_id, execution)
         self._upload_profile_artifacts(job_id, task_id, execution,
                                        suffix=f"i{instance}")
@@ -3411,6 +4345,10 @@ class NodeAgent:
                 spec.get("additional_docker_run_options", [])),
             additional_singularity_options=tuple(
                 spec.get("additional_singularity_options", [])),
+            # Crash-restart adoption contract: the task's exit code
+            # is persisted in its task_dir so a restarted agent can
+            # classify an exit it never wait()ed on.
+            record_exit_code=True,
         )
 
     def _ensure_job_prep(self, job_id: str, spec: dict,

@@ -93,6 +93,14 @@ class TaskExecution:
     additional_singularity_options: tuple[str, ...] = ()
     docker_exec_in: Optional[str] = None  # exec into a running container
     interactive: bool = False
+    # Crash-restart adoption contract (agent/node_agent.py slot
+    # ledger): when set, the task's exit code is persisted to
+    # EXIT_CODE_FILENAME in task_dir — by a shell trailer inside the
+    # task's own session for runtime "none" (survives the agent
+    # process dying) AND by run_task after reaping (covers kill
+    # paths). A restarted agent adopting the still-running process
+    # reads the file to classify the exit it never got to wait() on.
+    record_exit_code: bool = False
 
 
 @dataclasses.dataclass
@@ -194,6 +202,26 @@ def _run_inproc(execution: TaskExecution) -> TaskResult:
         wall_seconds=time.monotonic() - start)
 
 
+# Where the exit-code sentinel lands, relative to task_dir (the
+# command runs with cwd=task_dir, so the shell trailer needs no
+# absolute path and no env remap).
+EXIT_CODE_FILENAME = ".shipyard_exitcode"
+
+
+def _exit_recorded_command(command: str) -> str:
+    """Wrap a runtime-"none" command so its exit code lands in
+    EXIT_CODE_FILENAME from INSIDE the task's own session: the
+    write happens even when the spawning agent process is long dead
+    (tasks run start_new_session=True and outlive an agent crash —
+    the adoption scenario). tmp+mv so a reader never sees a torn
+    write; the original exit code is preserved."""
+    return (f"( {command}\n); __shipyard_ec=$?; "
+            f"printf '%s' \"$__shipyard_ec\" "
+            f"> {EXIT_CODE_FILENAME}.tmp && "
+            f"mv {EXIT_CODE_FILENAME}.tmp {EXIT_CODE_FILENAME}; "
+            f"exit $__shipyard_ec")
+
+
 def synthesize_command(execution: TaskExecution) -> list[str]:
     """Build the argv for the task's runtime.
 
@@ -202,7 +230,10 @@ def synthesize_command(execution: TaskExecution) -> list[str]:
     device passthrough in place of --gpus.
     """
     if execution.runtime == "none":
-        return ["/bin/bash", "-c", execution.command]
+        command = execution.command
+        if execution.record_exit_code:
+            command = _exit_recorded_command(command)
+        return ["/bin/bash", "-c", command]
     if execution.runtime == "docker":
         if execution.docker_exec_in:
             argv = ["docker", "exec", execution.docker_exec_in,
@@ -320,6 +351,14 @@ def run_task(execution: TaskExecution,
     if execution.runtime == "inproc":
         return _run_inproc(execution)
     os.makedirs(execution.task_dir, exist_ok=True)
+    if execution.record_exit_code:
+        # A stale sentinel from a previous attempt in the same task
+        # dir must never classify THIS attempt's exit.
+        for stale in (EXIT_CODE_FILENAME, EXIT_CODE_FILENAME + ".tmp"):
+            try:
+                os.remove(os.path.join(execution.task_dir, stale))
+            except OSError:
+                pass
     stdout_path = os.path.join(execution.task_dir, "stdout.txt")
     stderr_path = os.path.join(execution.task_dir, "stderr.txt")
     env = build_task_env(execution, base_env)
@@ -390,6 +429,19 @@ def run_task(execution: TaskExecution,
                         container=container_name(execution))
                     break
     wall = time.monotonic() - start
+    if execution.record_exit_code:
+        # Belt to the shell trailer's suspenders: kill paths (wedge /
+        # wall-time SIGKILL) never run the trailer, so the reaping
+        # process records the code it saw. tmp+rename like the
+        # trailer; best-effort — the adoption reader treats a missing
+        # sentinel as an unknown (failed) exit.
+        sentinel = os.path.join(execution.task_dir,
+                                EXIT_CODE_FILENAME)
+        try:
+            util.atomic_write(sentinel, str(exit_code).encode())
+        except OSError:
+            logger.debug("exit-code sentinel write failed",
+                         exc_info=True)
     return TaskResult(
         exit_code=exit_code, stdout_path=stdout_path,
         stderr_path=stderr_path, started_at=started_at,

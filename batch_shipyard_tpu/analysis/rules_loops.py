@@ -5,11 +5,14 @@ The node agent's heartbeat thread drives every sweep
 janitor, preemption sweep, request forwarding. Anything slow or
 store-heavy inside that path multiplies by pool size and by heartbeat
 rate — the PR 10 review settled the discipline: unpartitioned table
-scans are allowed only behind the lowest-live-node leader gate
-(_is_gang_sweep_leader), so a pool pays ONE scan per interval, not
-one per node; and a sweep must never sleep (a blocked sweep starves
-the heartbeat itself, and a heartbeat-stale node gets its running
-tasks reclaimed as orphans).
+scans are allowed only behind a leader gate (today the lease-backed
+_sweep_leader_epoch; historically _is_gang_sweep_leader), so a pool
+pays ONE scan per interval, not one per node; and a sweep must never
+sleep (a blocked sweep starves the heartbeat itself, and a
+heartbeat-stale node gets its running tasks reclaimed as orphans).
+Since PR 13 the gate must be a NAMED LEASE with a fencing epoch
+(leader-sweep-no-lease): heartbeat-freshness elections have a
+double-leader window that fences nothing.
 """
 
 from __future__ import annotations
@@ -31,9 +34,10 @@ def _is_hot(fn: ast.FunctionDef) -> bool:
 
 
 def _leader_gated(fn: ast.FunctionDef) -> bool:
-    """A call to the leader-election helper anywhere in the function
-    body (the _is_gang_sweep_leader idiom) marks the whole function
-    as one-scan-per-pool."""
+    """A call to a leadership helper anywhere in the function body
+    (the _sweep_leader_epoch idiom; the deleted
+    _is_gang_sweep_leader also matched) marks the whole function as
+    one-scan-per-pool."""
     for node in ast.walk(fn):
         if isinstance(node, ast.Call):
             name = call_name(node)
@@ -78,6 +82,81 @@ def check_unpartitioned_scan(ctx: AnalysisContext) -> list[Finding]:
                                  f"{fn.name!r} without a leader "
                                  f"gate; every node pays it every "
                                  f"interval")))
+    return findings
+
+
+@rule("leader-sweep-no-lease", family="loop")
+def check_leader_sweep_no_lease(ctx: AnalysisContext
+                                ) -> list[Finding]:
+    """A sweep-cadence function that performs unpartitioned scans or
+    stamps cross-node decisions (``request_preemption``) must hold a
+    NAMED LEASE with a fencing epoch — a call whose name carries the
+    ``leader_epoch`` / ``sweep_lease`` idiom (state/leases.py) — and
+    any ``request_preemption`` it fires must thread the epoch
+    through (a ``leader_epoch=`` keyword). A heartbeat-freshness
+    election is not a lease: it cannot fence a deposed leader's
+    in-flight writes.
+
+    Provenance: the PR 12 gang janitor shipped with "a brief
+    double-leader window during failover is harmless because
+    clearing is idempotent" — true for the janitor, already false
+    for the preempt sweep sharing the same election, whose stamps
+    elect victims (two leaders, two victims for one starved task).
+    PR 13 deleted that comment by deleting the window: the election
+    became a store lease whose holder abdicates on its own clock
+    strictly before a successor can acquire, fenced by a monotonic
+    term epoch. This rule keeps the next sweep from re-growing the
+    window."""
+    findings = []
+    for src in ctx.python_files:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if not _is_hot(fn):
+                continue
+            calls = [n for n in ast.walk(fn)
+                     if isinstance(n, ast.Call)]
+            names_called = {call_name(n) for n in calls}
+            names_called.discard(None)
+            unpartitioned = False
+            for node in calls:
+                if call_name(node) != "query_entities":
+                    continue
+                pk = (keyword_arg(node, "partition_key")
+                      or (node.args[1] if len(node.args) > 1
+                          else None))
+                if pk is None or (isinstance(pk, ast.Constant)
+                                  and pk.value is None):
+                    unpartitioned = True
+            stamps = "request_preemption" in names_called
+            if not unpartitioned and not stamps:
+                continue
+            leased = any(("leader_epoch" in name
+                          or "sweep_lease" in name)
+                         for name in names_called)
+            if not leased:
+                findings.append(Finding(
+                    rule="leader-sweep-no-lease", path=src.rel,
+                    line=fn.lineno,
+                    message=(f"sweep {fn.name!r} performs "
+                             f"{'unpartitioned scans' if unpartitioned else 'cross-node stamps'} "
+                             f"without holding a named lease (no "
+                             f"leader_epoch/sweep_lease call) — a "
+                             f"heartbeat-freshness election has a "
+                             f"double-leader window and no fencing")))
+                continue
+            for node in calls:
+                if call_name(node) == "request_preemption" and \
+                        keyword_arg(node, "leader_epoch") is None:
+                    findings.append(Finding(
+                        rule="leader-sweep-no-lease", path=src.rel,
+                        line=node.lineno,
+                        message=(f"request_preemption in sweep "
+                                 f"{fn.name!r} does not thread the "
+                                 f"lease epoch through "
+                                 f"(leader_epoch=...) — a deposed "
+                                 f"leader's stamp would be "
+                                 f"indistinguishable from the "
+                                 f"successor's")))
     return findings
 
 

@@ -860,6 +860,498 @@ def _check_migration_invariants(store, fed_mod, task_rows: list,
     invariants["ok"] = True
 
 
+def run_store_outage_drill(seed: int = 0, tasks: int = 6,
+                           outage: float = 2.0,
+                           task_sleep: float = 1.0,
+                           duration: float = 6.0,
+                           wait_timeout: float = 120.0) -> dict:
+    """Store-outage ride-through drill: agents run on the resilient
+    wrapper (state/resilient.py) over a chaos store, and a seeded
+    ``store_outage`` injection takes the store DOWN for a sustained
+    window mid-run — every op fails, not a per-op burst. Asserts the
+    control-plane acceptance invariants:
+
+      * every task completed with ZERO retries — the outage never
+        killed or requeued running work (critical ops rode it out),
+      * zero lost advisory events: exactly one TASK_QUEUED and one
+        TASK_RUNNING interval per task survive into the store (the
+        WAL journaled what the outage would have dropped and
+        replayed it in order),
+      * the ``store_outage`` badput leg is populated with the exact
+        outage window and the journal actually replayed entries,
+      * every agent's journal drained to zero after recovery,
+      * the goodput partition stayed exact ACROSS the outage."""
+    from batch_shipyard_tpu.goodput import events as gp_events
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    raw_store = MemoryStateStore()
+    chaos_store = injectors_mod.ChaosStore(raw_store)
+    substrate = FakePodSubstrate(chaos_store,
+                                 heartbeat_interval=0.2,
+                                 node_stale_seconds=30.0)
+    substrate.agent_kwargs = {
+        "claim_visibility_seconds": 5.0,
+        "gang_sweep_interval": 1.0,
+        # THE knob under test: the resilient wrapper, tuned for a
+        # seconds-scale drill (production keeps the defaults).
+        "resilience": {"retry_base": 0.05, "retry_cap": 0.5,
+                       "probe_interval": 0.25,
+                       "max_outage_seconds": 60.0}}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "task_slots_per_node": 2,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    plan = ChaosPlan.generate(seed, duration=duration, num_nodes=2,
+                              kinds=("store_outage",))
+    # Deterministic sequencing: the outage must land with work in
+    # flight (claims made, tasks running) and last the configured
+    # window. Pure function of the seed, still.
+    plan = dataclasses.replace(plan, injections=tuple(
+        dataclasses.replace(
+            inj, at=min(max(inj.at, 1.2), 2.0),
+            params=tuple(sorted(
+                {**dict(inj.params), "window": outage}.items())))
+        for inj in plan.injections))
+    report: dict = {"seed": plan.seed,
+                    "fingerprint": plan.fingerprint(),
+                    "plan": plan.to_dict(),
+                    "applied": [], "invariants": {}}
+    try:
+        pool_mgr.create_pool(raw_store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": JOB_ID,
+            "tasks": [{"id": f"t{i:03d}",
+                       "command": (f"sleep {task_sleep} && "
+                                   f"echo outage-{i}"),
+                       "max_task_retries": 3}
+                      for i in range(tasks)],
+        }]})
+        started = time.monotonic()
+        jobs_mgr.add_jobs(raw_store, pool, jobs)
+        driver = threading.Thread(
+            target=_inject_schedule,
+            args=(plan, started, substrate, chaos_store, report),
+            daemon=True, name="chaos-outage-driver")
+        driver.start()
+        task_rows = jobs_mgr.wait_for_tasks(
+            raw_store, POOL_ID, JOB_ID, timeout=wait_timeout,
+            poll_interval=0.25)
+        driver.join(timeout=5.0)
+        invariants = report["invariants"]
+        states = {}
+        total_retries = 0
+        for task in task_rows:
+            states[task.get("state")] = \
+                states.get(task.get("state"), 0) + 1
+            total_retries += int(task.get("retries", 0) or 0)
+        invariants["tasks"] = states
+        assert states == {"completed": tasks}, states
+        invariants["retries"] = total_retries
+        assert total_retries == 0, (
+            f"the outage cost retries: {total_retries}")
+        # Zero lost advisory events: with zero retries there is
+        # EXACTLY one queued + one running interval per task — any
+        # event the outage swallowed breaks the count.
+        events = gp_events.query(raw_store, POOL_ID)
+        queued = [e for e in events
+                  if e["kind"] == gp_events.TASK_QUEUED]
+        running = [e for e in events
+                   if e["kind"] == gp_events.TASK_RUNNING]
+        invariants["queued_events"] = len(queued)
+        invariants["running_events"] = len(running)
+        assert len(queued) == tasks, (
+            f"lost queued intervals: {len(queued)} != {tasks}")
+        assert len(running) == tasks, (
+            f"lost running intervals: {len(running)} != {tasks}")
+        outages = [e for e in events
+                   if e["kind"] == gp_events.STORE_OUTAGE]
+        invariants["outage_events"] = len(outages)
+        assert outages, "no store_outage interval was recorded"
+        replayed = sum(int((e.get("attrs") or {})
+                           .get("replayed", 0)) for e in outages)
+        invariants["journal_replayed"] = replayed
+        assert replayed >= 1, (
+            "the WAL never buffered anything — the outage was "
+            "vacuous")
+        # Journals drained on every agent.
+        deadline = time.monotonic() + 15.0
+        backlog = None
+        while time.monotonic() < deadline:
+            backlog = sum(
+                agent.store.journal_backlog()
+                for agent in injectors_mod._live_agents(substrate,
+                                                        POOL_ID))
+            if backlog == 0:
+                break
+            time.sleep(0.2)
+        invariants["journal_backlog"] = backlog
+        assert backlog == 0, f"undrained WAL backlog: {backlog}"
+        pool_report = _assert_partition_exact(raw_store, POOL_ID,
+                                              invariants)
+        leg = pool_report["badput_seconds"].get("store_outage", 0.0)
+        invariants["store_outage_seconds"] = leg
+        assert leg > 0.0, (
+            f"store_outage leg not populated: "
+            f"{pool_report['badput_seconds']}")
+        report["goodput"] = {
+            "goodput_ratio": pool_report["goodput_ratio"],
+            "badput_seconds": pool_report["badput_seconds"],
+        }
+        invariants["ok"] = True
+    finally:
+        substrate.stop_all()
+    return report
+
+
+def run_leader_partition_drill(seed: int = 0,
+                               victim_steps: int = 140,
+                               step_seconds: float = 0.05,
+                               wait_timeout: float = 120.0) -> dict:
+    """Leader-partition drill: the preempt-sweep LEADER's heartbeats
+    and lease renewals stall (its sweep loop keeps running — the
+    exact shape the old heartbeat-freshness election double-fired
+    under) while a starved high-priority task is waiting. Asserts
+    the lease acceptance invariants:
+
+      * exactly ONE preemption stamp fired across the leadership
+        change (zero double-fired stamps: the deposed leader
+        abdicated on its own clock before the successor could act),
+      * the stamp carries the SUCCESSOR's fencing epoch — strictly
+        newer than the pre-partition term — and that epoch is the
+        one live term at drill end (exactly one local lease holder),
+      * the victim drained cooperatively with its retry budget
+        untouched; every task completed; partition exact."""
+    from batch_shipyard_tpu.state import leases as state_leases
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.2,
+                                 node_stale_seconds=5.0)
+    substrate.agent_kwargs = {
+        "claim_visibility_seconds": 5.0,
+        "gang_sweep_interval": 1.0,
+        # Sweep fast, short lease: failover must fit the drill
+        # window. Grace doubles as the starvation threshold, so it
+        # must EXCEED the partitioned leader's residual authority
+        # (one lease duration) — the stamp then provably belongs to
+        # the successor's term.
+        "preempt_sweep_interval": 0.8,
+        "preempt_grace_seconds": 2.0,
+        "leader_lease_seconds": 1.0,
+        "job_state_ttl": 0.2}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    plan = ChaosPlan.generate(seed, duration=6.0, num_nodes=2,
+                              kinds=("leader_partition",))
+    plan = dataclasses.replace(plan, injections=tuple(
+        dataclasses.replace(inj, params=tuple(sorted(
+            {**dict(inj.params), "window": 4.0}.items())))
+        for inj in plan.injections))
+    report: dict = {"seed": plan.seed,
+                    "fingerprint": plan.fingerprint(),
+                    "plan": plan.to_dict(),
+                    "applied": [], "invariants": {}}
+    epoch_key = names.leader_epoch_key(
+        POOL_ID, state_leases.ROLE_PREEMPT_SWEEP)
+    ckpt = os.path.join(substrate.work_root, "probe", "state.json")
+    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        victims = settings_mod.job_settings_list(
+            {"job_specifications": [{
+                "id": "victims",
+                # Long enough that the stamp — landing AFTER the
+                # grace window + the leadership failover — always
+                # finds its victim still running with drain runway:
+                # a victim finishing naturally before the drain
+                # races would make the preemption vacuous.
+                # priority -1: victims live in the LO queue band, so
+                # the starved task's normal-band message — which the
+                # worker scan never idle-skips — deterministically
+                # wins the freed slot ahead of the drained victim's
+                # own requeue. (With both in the same band, the
+                # rerun can win the race and the sweep legitimately
+                # re-stamps each interval — correct behavior, but it
+                # would make the exactly-one-stamp assertion about
+                # claim-race luck instead of leadership.)
+                "tasks": [{"id": f"v{i}",
+                           "command": (
+                               f"{sys.executable} -m "
+                               f"batch_shipyard_tpu.workloads"
+                               f".preempt_probe "
+                               f"--steps {victim_steps} "
+                               f"--step-seconds {step_seconds} "
+                               f"--checkpoint-every 10 "
+                               f"--ckpt {ckpt}.v{i}"),
+                           "environment_variables": {
+                               "PYTHONPATH": repo_root},
+                           "priority": -1,
+                           "max_task_retries": 3}
+                          for i in range(2)],
+            }]})
+        jobs_mgr.add_jobs(store, pool, victims)
+        # Both victims running + a preempt-sweep term recorded: only
+        # then is "partition the leader" well-defined.
+        _wait_for(
+            lambda: (sum(1 for t in jobs_mgr.list_tasks(
+                store, POOL_ID, "victims")
+                if t.get("state") == "running") == 2) or None,
+            30.0, "both victims running")
+        before = _wait_for(
+            lambda: state_leases.read_leader(store, epoch_key),
+            30.0, "preempt-sweep leadership term")
+        report["leader_before"] = before
+        hi = settings_mod.job_settings_list({"job_specifications": [{
+            "id": "hi",
+            "tasks": [{"id": "h0", "command": "echo placed",
+                       "priority": 0, "max_task_retries": 2}],
+        }]})
+        jobs_mgr.add_jobs(store, pool, hi)
+        # Partition the leader NOW — before the starvation grace can
+        # elapse — so the stamp decision crosses the failover.
+        for injection in plan.injections:
+            try:
+                record = injectors_mod.apply_injection(
+                    injection, substrate, POOL_ID)
+            except Exception as exc:  # noqa: BLE001 - record it
+                record = {"kind": injection.kind, "error": str(exc)}
+            logger.info("chaos injection %s", record)
+            report["applied"].append(record)
+        hi_rows = jobs_mgr.wait_for_tasks(
+            store, POOL_ID, "hi", timeout=wait_timeout,
+            poll_interval=0.25)
+        victim_rows = jobs_mgr.wait_for_tasks(
+            store, POOL_ID, "victims", timeout=wait_timeout,
+            poll_interval=0.25)
+        _check_partition_invariants(
+            store, substrate, state_leases, epoch_key, before,
+            hi_rows, victim_rows, report)
+    finally:
+        substrate.stop_all()
+    return report
+
+
+def _check_partition_invariants(store, substrate, state_leases,
+                                epoch_key: str, before: dict,
+                                hi_rows: list, victim_rows: list,
+                                report: dict) -> None:
+    from batch_shipyard_tpu.goodput import events as gp_events
+    invariants = report["invariants"]
+    assert hi_rows[0].get("state") == "completed", hi_rows[0]
+    states = {t["_rk"]: t.get("state") for t in victim_rows}
+    invariants["victim_states"] = states
+    assert all(s == "completed" for s in states.values()), states
+    # ZERO double-fired stamps: exactly one preemption notice across
+    # the whole drill, leadership change included.
+    notices = [e for e in gp_events.query(store, POOL_ID)
+               if e["kind"] == gp_events.TASK_PREEMPT_NOTICE]
+    invariants["preempt_notices"] = len(notices)
+    fired = [(n.get("job_id"), n.get("task_id"), n.get("attrs"))
+             for n in notices]
+    assert len(notices) == 1, (
+        f"double-fired preemption stamps under partition: {fired}")
+    # The stamp belongs to the SUCCESSOR's term: its fencing epoch
+    # is strictly newer than the pre-partition term and matches the
+    # term live at drill end.
+    after = state_leases.read_leader(store, epoch_key)
+    report["leader_after"] = after
+    invariants["epoch_before"] = before["epoch"]
+    invariants["epoch_after"] = after["epoch"]
+    assert after["epoch"] > before["epoch"], (
+        f"no leadership term change: {before} -> {after}")
+    assert after.get("owner") != before.get("owner"), (
+        f"the partitioned leader kept the lease: {after}")
+    stamp_epoch = (notices[0].get("attrs") or {}).get("leader_epoch")
+    invariants["stamp_epoch"] = stamp_epoch
+    assert stamp_epoch == after["epoch"], (
+        f"stamp epoch {stamp_epoch} is not the successor term "
+        f"{after['epoch']} — a deposed leader fired it")
+    # Exactly one LIVE lease holder at drill end.
+    holders = [
+        agent.identity.node_id
+        for agent in injectors_mod._live_agents(substrate, POOL_ID)
+        if (lease := agent._sweep_leases.get(
+            state_leases.ROLE_PREEMPT_SWEEP)) is not None
+        and lease.held_locally()]
+    invariants["lease_holders"] = holders
+    assert len(holders) == 1, (
+        f"not exactly one live lease epoch: holders={holders}")
+    # The preempted victim paid NO retry budget; the other victim
+    # was never touched.
+    preempted = [t for t in victim_rows
+                 if int(t.get(names.TASK_COL_PREEMPT_COUNT, 0)
+                        or 0) > 0]
+    invariants["victims_preempted"] = len(preempted)
+    assert len(preempted) == 1, (
+        f"expected exactly one preempted victim: {states}")
+    assert int(preempted[0].get("retries", 0) or 0) == 0, (
+        f"preemption consumed retry budget: {preempted[0]}")
+    pool_report = _assert_partition_exact(store, POOL_ID, invariants)
+    report["goodput"] = {
+        "goodput_ratio": pool_report["goodput_ratio"],
+        "badput_seconds": pool_report["badput_seconds"],
+    }
+    invariants["ok"] = True
+
+
+def run_agent_restart_drill(seed: int = 0, task_sleep: float = 2.5,
+                            wait_timeout: float = 120.0) -> dict:
+    """Agent crash-restart adoption drill: a seeded ``agent_restart``
+    injection kills the agent PROCESS under a running task — no
+    offline write, no lease release, every in-flight completion path
+    abandoned — while the task's own session keeps running; the
+    revived agent on the same work_dir must re-adopt it from the
+    slot ledger. Asserts the adoption acceptance invariants:
+
+      * the task ran EXACTLY once (its start marker appears once —
+        adoption, not the reclaim-rerun path) and completed with
+        retries == 0,
+      * the adopted completion ran the full exit path (stdout
+        uploaded),
+      * the ``adoption`` badput leg is populated (the control-plane
+        gap: last pre-crash heartbeat -> re-adoption) and a
+        SPAN_AGENT_RESTART span joined the task's trace,
+      * node health neutral (an agent crash says nothing about the
+        task), queues drained, partition exact."""
+    from batch_shipyard_tpu.goodput import events as gp_events
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    from batch_shipyard_tpu.trace import spans as trace_spans
+
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.2,
+                                 node_stale_seconds=5.0)
+    substrate.agent_kwargs = {"claim_visibility_seconds": 3.0,
+                              "gang_sweep_interval": 1.0}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 1}},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    plan = ChaosPlan.generate(seed, duration=4.0, num_nodes=1,
+                              kinds=("agent_restart",))
+    # The crash must land while the task RUNS (claimed within
+    # ~0.3s; finishes at ~task_sleep) and the revival must leave
+    # adoption runway. Pure function of the seed, still.
+    plan = dataclasses.replace(plan, injections=tuple(
+        dataclasses.replace(
+            inj, at=min(max(inj.at, 0.8), task_sleep - 1.0),
+            params=tuple(sorted(
+                {**dict(inj.params),
+                 "revive_after": max(0.4, inj.param(
+                     "revive_after", 0.5))}.items())))
+        for inj in plan.injections))
+    report: dict = {"seed": plan.seed,
+                    "fingerprint": plan.fingerprint(),
+                    "plan": plan.to_dict(),
+                    "applied": [], "invariants": {}}
+    probe_dir = os.path.join(substrate.work_root, "probe")
+    starts_log = os.path.join(probe_dir, "starts.log")
+    try:
+        os.makedirs(probe_dir, exist_ok=True)
+        pool_mgr.create_pool(store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": JOB_ID,
+            "tasks": [{"id": "t0",
+                       "command": (f"echo start-$$ >> {starts_log} "
+                                   f"&& sleep {task_sleep} && "
+                                   f"echo adopted-done"),
+                       "max_task_retries": 2}],
+        }]})
+        started = time.monotonic()
+        jobs_mgr.add_jobs(store, pool, jobs)
+        driver = threading.Thread(
+            target=_inject_schedule,
+            args=(plan, started, substrate, None, report),
+            daemon=True, name="chaos-restart-driver")
+        driver.start()
+        task_rows = jobs_mgr.wait_for_tasks(
+            store, POOL_ID, JOB_ID, timeout=wait_timeout,
+            poll_interval=0.25)
+        driver.join(timeout=5.0)
+        invariants = report["invariants"]
+        task = task_rows[0]
+        invariants["state"] = task.get("state")
+        assert task.get("state") == "completed", task
+        invariants["retries"] = int(task.get("retries", 0) or 0)
+        assert invariants["retries"] == 0, (
+            f"the restart cost retries (reclaim-rerun, not "
+            f"adoption): {task}")
+        assert any(r.get("applied") for r in report["applied"]), (
+            f"agent_restart never applied: {report['applied']}")
+        # Exactly ONE start: the process ran THROUGH the restart.
+        with open(starts_log, encoding="utf-8") as fh:
+            starts = [ln for ln in fh.read().splitlines() if ln]
+        invariants["task_starts"] = len(starts)
+        assert len(starts) == 1, (
+            f"task re-ran instead of being adopted: {starts}")
+        # The adopted completion ran the full exit path.
+        out = jobs_mgr.get_task_output(store, POOL_ID, JOB_ID, "t0")
+        assert out.strip() == b"adopted-done", out
+        # Adoption leg + trace span.
+        adoptions = [e for e in gp_events.query(store, POOL_ID)
+                     if e["kind"] == gp_events.TASK_ADOPTION]
+        invariants["adoption_events"] = len(adoptions)
+        assert adoptions, "no adoption interval was recorded"
+        assert all(float(e["end"]) > float(e["start"])
+                   for e in adoptions), adoptions
+        restart_spans = [
+            s for s in trace_spans.query(store, POOL_ID)
+            if s.get("kind") == trace_spans.SPAN_AGENT_RESTART]
+        invariants["agent_restart_spans"] = len(restart_spans)
+        assert restart_spans, "no SPAN_AGENT_RESTART recorded"
+        # Neutral health: an agent crash says nothing about the node
+        # or the task.
+        for node in store.query_entities(names.TABLE_NODES,
+                                         partition_key=POOL_ID):
+            health = float(node.get(names.NODE_COL_HEALTH, 1.0)
+                           or 1.0)
+            assert health >= 1.0, (
+                f"adoption debited node health: "
+                f"{node['_rk']}={health}")
+            assert not node.get(names.NODE_COL_QUARANTINED), node
+        invariants["node_health_untouched"] = True
+        # Queues drain once the redelivered message meets the
+        # terminal entity.
+        deadline = time.monotonic() + 30.0
+        queues = names.task_queues(POOL_ID, 1)
+        depth = None
+        while time.monotonic() < deadline:
+            depth = sum(store.queue_length(q) for q in queues)
+            if depth == 0:
+                break
+            time.sleep(0.25)
+        invariants["queue_depth"] = depth
+        assert depth == 0, f"undrained task queues: {depth}"
+        pool_report = _assert_partition_exact(store, POOL_ID,
+                                              invariants)
+        leg = pool_report["badput_seconds"].get("adoption", 0.0)
+        invariants["adoption_seconds"] = leg
+        assert leg > 0.0, (
+            f"adoption leg not populated: "
+            f"{pool_report['badput_seconds']}")
+        report["goodput"] = {
+            "goodput_ratio": pool_report["goodput_ratio"],
+            "badput_seconds": pool_report["badput_seconds"],
+        }
+        invariants["ok"] = True
+    finally:
+        substrate.stop_all()
+    return report
+
+
 def _inject_schedule(plan: ChaosPlan, started: float, substrate,
                      chaos_store, report: dict) -> None:
     for injection in plan.injections:

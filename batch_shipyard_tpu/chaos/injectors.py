@@ -37,6 +37,10 @@ _FAULTED_OPS = frozenset({
     "query_entities", "delete_entity", "insert_entities",
     "put_message", "put_messages", "get_messages", "delete_message",
     "update_message",
+    # Stream ops fault at CALL time (before any chunk moves) so the
+    # outage drill proves output uploads ride through too — the
+    # resilient wrapper spools-and-retries put, opens-and-retries get.
+    "put_object_stream", "get_object_stream",
 })
 
 
@@ -58,6 +62,7 @@ class ChaosStore:
         self._delay_until = 0.0
         self._delay_seconds = 0.0
         self._error_budget = 0
+        self._outage_until = 0.0
 
     # -- fault control (called by the drill driver) --------------------
 
@@ -71,15 +76,25 @@ class ChaosStore:
         with self._lock:
             self._error_budget += max(0, int(ops))
 
+    def inject_outage(self, window_seconds: float) -> None:
+        """Sustained outage: EVERY faulted op fails for the window —
+        the store is down, not flaky. Only the resilient-store
+        ride-through (state/resilient.py) survives this shape."""
+        with self._lock:
+            self._outage_until = time.monotonic() + window_seconds
+
     # -- delegation ----------------------------------------------------
 
     def _gate(self) -> None:
         with self._lock:
+            outage = time.monotonic() < self._outage_until
             delay = (self._delay_seconds
                      if time.monotonic() < self._delay_until else 0.0)
-            err = self._error_budget > 0
+            err = self._error_budget > 0 and not outage
             if err:
                 self._error_budget -= 1
+        if outage:
+            raise ChaosError("chaos: store outage in progress")
         if err:
             raise ChaosError("chaos: injected store error")
         if delay:
@@ -115,6 +130,11 @@ def apply_injection(injection: Injection, substrate,
             store.inject_errors(injection.param("ops", 3))
             record["applied"] = True
         return record
+    if injection.kind == "store_outage":
+        if store is not None:
+            store.inject_outage(injection.param("window", 2.0))
+            record["applied"] = True
+        return record
 
     agents = _live_agents(substrate, pool_id)
     if not agents:
@@ -126,6 +146,62 @@ def apply_injection(injection: Injection, substrate,
         agent.heartbeat_blackout_until = (
             time.time() + injection.param("window", 2.0))
         record["applied"] = True
+    elif injection.kind == "leader_partition":
+        # Partition exactly the CURRENT sweep leader from the
+        # control plane: heartbeats AND lease renewals stall while
+        # its sweep loop keeps running — the shape the old
+        # heartbeat-freshness election double-fired under. The
+        # leader is resolved from the preempt-sweep epoch object
+        # (the observable record of the live term); fall back to
+        # the scheduled target when no term exists yet.
+        from batch_shipyard_tpu.state import leases as state_leases
+        from batch_shipyard_tpu.state import names as names_mod
+        target = agent
+        leader = state_leases.read_leader(
+            agents[0].store,
+            names_mod.leader_epoch_key(
+                pool_id, state_leases.ROLE_PREEMPT_SWEEP))
+        if leader is not None:
+            for candidate in agents:
+                if candidate.identity.node_id == \
+                        leader.get("owner"):
+                    target = candidate
+                    break
+        window = injection.param("window", 3.0)
+        target.heartbeat_blackout_until = time.time() + window
+        target.lease_blackout_until = time.time() + window
+        record["node_id"] = target.identity.node_id
+        record["window"] = window
+        record["leader_epoch"] = (leader or {}).get("epoch")
+        record["applied"] = True
+    elif injection.kind == "agent_restart":
+        # The agent PROCESS dies — in-flight completion paths
+        # abandoned, no offline write, no lease release — while its
+        # task subprocesses (own sessions) keep running; the revived
+        # agent on the SAME work_dir must re-adopt them from the
+        # slot ledgers.
+        victim = _pick_live_proc(agents, preferred=agent)
+        deadline = time.monotonic() + 2.0
+        while victim is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            victim = _pick_live_proc(
+                _live_agents(substrate, pool_id), preferred=None)
+        if victim is None:
+            return record
+        node, _proc = victim
+        record["node_id"] = node.identity.node_id
+        context = substrate.crash_agent_hard(pool_id,
+                                             node.identity.node_id)
+        if context is not None:
+            record["applied"] = True
+            revive_after = injection.param("revive_after", 0.5)
+
+            def _revive_restart():
+                time.sleep(revive_after)
+                substrate.revive_node(pool_id, context)
+
+            threading.Thread(target=_revive_restart, daemon=True,
+                             name="chaos-agent-restart").start()
     elif injection.kind in ("task_kill", "task_wedge"):
         # Prefer the target node's live task; fall back to any node
         # actually running one (the schedule is deterministic, the

@@ -51,17 +51,40 @@ from typing import Optional
 #   pool_capacity_loss — crash EVERY node of the pool: the gang can
 #                        never re-form here, and only cross-pool
 #                        migration (federation) can finish the job
+#   store_outage       — the state store goes DOWN for a sustained
+#                        window (every faulted op fails, not a
+#                        per-op burst): only the resilient-store
+#                        ride-through (critical retry + advisory
+#                        WAL, state/resilient.py) survives it
+#   leader_partition   — stall ONLY the current sweep leader's
+#                        heartbeats and lease renewals while its
+#                        sweep loop keeps running: the exact shape
+#                        the old heartbeat-freshness election
+#                        double-fired under; the lease must make it
+#                        abdicate on its own clock
+#   agent_restart      — the agent PROCESS dies (in-flight
+#                        completion paths cut off, no offline write)
+#                        while its task subprocesses keep running,
+#                        then restarts on the same work_dir: the
+#                        crash-restart adoption shape
 INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
                    "task_kill", "task_wedge", "node_preempt",
                    "node_preempt_notice", "victim_ignore_notice",
-                   "host_loss_resize", "pool_capacity_loss")
+                   "host_loss_resize", "pool_capacity_loss",
+                   "store_outage", "leader_partition",
+                   "agent_restart")
 
 # Kinds a GENERIC drill's recovery invariants can absorb — the
 # default schedule. The fleet-elasticity kinds are excluded: they
 # exist to drive their dedicated drills (eviction / host-resize /
 # migration, chaos/drill.py), and e.g. pool_capacity_loss in a
 # single-pool generic drill is unrecoverable by construction (only
-# cross-pool migration finishes the job).
+# cross-pool migration finishes the job). The control-plane kinds
+# (store_outage / leader_partition / agent_restart) are likewise
+# dedicated-drill shapes: a sustained outage without the resilient
+# wrapper armed is unrecoverable by construction, and the other two
+# need their drills' orchestrated setups to make the invariants
+# non-vacuous.
 DEFAULT_DRILL_KINDS = ("store_delay", "store_error",
                        "heartbeat_blackout", "task_kill",
                        "task_wedge", "node_preempt",
@@ -135,6 +158,15 @@ class ChaosPlan:
                               round(rng.uniform(0.3, 1.0), 3)}
                 elif kind == "host_loss_resize":
                     params = {"count": 1}
+                elif kind == "store_outage":
+                    params = {"window": round(rng.uniform(1.0, 2.5),
+                                              3)}
+                elif kind == "leader_partition":
+                    params = {"window": round(rng.uniform(2.0, 4.0),
+                                              3)}
+                elif kind == "agent_restart":
+                    params = {"revive_after":
+                              round(rng.uniform(0.3, 0.8), 3)}
                 out.append(Injection(
                     at=at, kind=kind, node_index=node_index,
                     params=tuple(sorted(params.items()))))

@@ -816,10 +816,32 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
                    "under a federated gang — the elastic evaluator "
                    "re-targets it onto the sibling pool, one trace "
                    "spans the migration, migration leg priced")
+@click.option("--outage", is_flag=True, default=False,
+              help="Run the store-outage drill: a seeded "
+                   "store_outage schedule takes the state store "
+                   "DOWN for a sustained window — resilient-store "
+                   "agents ride it out with zero retries, zero "
+                   "lost advisory events (WAL replay), drained "
+                   "journals, and the store_outage leg priced with "
+                   "the exact window")
+@click.option("--partition", is_flag=True, default=False,
+              help="Run the leader-partition drill: a seeded "
+                   "leader_partition schedule stalls the preempt-"
+                   "sweep leader's heartbeats/lease renewals while "
+                   "its sweep keeps running — exactly one "
+                   "preemption stamp fires, carrying the successor "
+                   "term's fencing epoch, with exactly one live "
+                   "lease epoch at the end")
+@click.option("--restart", is_flag=True, default=False,
+              help="Run the agent crash-restart drill: a seeded "
+                   "agent_restart schedule kills the agent process "
+                   "under a running task — the revived agent "
+                   "re-adopts it from the slot ledger (one start, "
+                   "retries==0, adoption leg priced)")
 @click.pass_context
 def chaos_drill(click_ctx, seed, tasks, duration, kinds,
                 injections_per_kind, preempt, evict, resize,
-                migrate):
+                migrate, outage, partition, restart):
     """Run the seeded drill on a local fakepod pool and assert the
     recovery invariants (nonzero exit = a self-healing regression)."""
     fleet.action_chaos_drill(
@@ -827,7 +849,8 @@ def chaos_drill(click_ctx, seed, tasks, duration, kinds,
         kinds=_parse_kinds(kinds),
         injections_per_kind=injections_per_kind,
         preempt=preempt, evict=evict, resize=resize,
-        migrate=migrate,
+        migrate=migrate, outage=outage, partition=partition,
+        restart=restart,
         raw=click_ctx.obj["raw"])
 
 

@@ -59,6 +59,7 @@ from batch_shipyard_tpu.agent import cascade
 from batch_shipyard_tpu.config import settings as settings_mod
 from batch_shipyard_tpu.jobs import manager as jobs_mgr
 from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import leases as state_leases
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, EtagMismatchError, NotFoundError, StateStore)
@@ -526,6 +527,15 @@ class FederationProcessor:
         self.stop_event = threading.Event()
         self._lease = None
         self._last_gc = 0.0
+        # Cross-pool migration is NOT idempotent across two
+        # evaluators (two replicas re-targeting one job double-fans
+        # the gang): the elastic pass holds its own named lease per
+        # federation — fenced by the term epoch stamped into every
+        # locator claim — on top of the coarse global lock, which
+        # only serializes a single processor generation and has no
+        # fencing for in-flight writes of a deposed holder.
+        self._elastic_leases: dict[str, "state_leases.LeaderLease"] \
+            = {}
 
     # -- lock ----------------------------------------------------------
 
@@ -824,6 +834,9 @@ class FederationProcessor:
         (the agent's resize paths) always gets first refusal: a pool
         whose live capacity still covers min_instances is never
         migrated away from. Returns the number of jobs migrated."""
+        epoch = self._elastic_epoch(federation_id)
+        if epoch is None:
+            return 0  # another evaluator leads this federation
         rows = [r for r in self.store.query_entities(
                     names.TABLE_FEDJOBS, partition_key=federation_id)
                 if not r["_rk"].startswith("zap$")]
@@ -837,17 +850,53 @@ class FederationProcessor:
                 facts[pool_id] = fact
         migrated = 0
         for row in rows:
+            # Fencing re-check before each job's decision: the fact
+            # gathering above can outlive the term, and a migration
+            # fired on a stale verdict double-fans the gang.
+            if not self._elastic_lease(federation_id).fenced(epoch):
+                return migrated
             try:
                 migrated += self._maybe_migrate_job(federation_id,
-                                                    row, facts)
+                                                    row, facts,
+                                                    epoch)
             except Exception:
                 logger.exception(
                     "elastic evaluation of job %s failed",
                     row["_rk"])
         return migrated
 
+    def _elastic_lease(self,
+                       federation_id: str
+                       ) -> state_leases.LeaderLease:
+        lease = self._elastic_leases.get(federation_id)
+        if lease is None:
+            scope = f"fed-{federation_id}"
+            lease = state_leases.LeaderLease(
+                self.store,
+                key=names.leader_lease_key(
+                    scope, state_leases.ROLE_FED_ELASTIC),
+                epoch_key=names.leader_epoch_key(
+                    scope, state_leases.ROLE_FED_ELASTIC),
+                owner=self.owner,
+                duration_seconds=max(2.0, 4.0 * self.poll_interval))
+            self._elastic_leases[federation_id] = lease
+        return lease
+
+    def _elastic_epoch(self, federation_id: str) -> Optional[int]:
+        """Leadership gate for one federation's elastic pass: the
+        term's fencing epoch while this processor holds the
+        ``fed-elastic`` lease, None otherwise (the sweep-lease
+        protocol of agent/node_agent.py, federation-scoped)."""
+        try:
+            return self._elastic_lease(federation_id).epoch()
+        except Exception:  # noqa: BLE001 - store hiccup = not leader
+            logger.debug("elastic lease check failed for %s",
+                         federation_id, exc_info=True)
+            return None
+
     def _maybe_migrate_job(self, federation_id: str, row: dict,
-                           facts: dict) -> int:
+                           facts: dict,
+                           leader_epoch: Optional[int] = None) -> int:
         job_id = row["_rk"]
         src = row.get("pool_id")
         src_fact = facts.get(src)
@@ -914,7 +963,7 @@ class FederationProcessor:
             return 0
         return self._migrate_starved_job(
             federation_id, row, src, choice["pool_id"],
-            starved_since)
+            starved_since, leader_epoch)
 
     def _reclaim_stranded_task(self, pool_id: str,
                                task: dict) -> None:
@@ -935,7 +984,9 @@ class FederationProcessor:
 
     def _migrate_starved_job(self, federation_id: str, row: dict,
                              src: str, dst: str,
-                             starved_since: float) -> int:
+                             starved_since: float,
+                             leader_epoch: Optional[int] = None,
+                             ) -> int:
         """Atomically re-target one job: claim the locator row first
         (etag-guarded merge — a concurrent evaluator loses cleanly),
         then disable -> migrate -> enable through the jobs manager,
@@ -958,7 +1009,11 @@ class FederationProcessor:
             self.store.merge_entity(
                 names.TABLE_FEDJOBS, federation_id, job_id,
                 {"migrating_to": dst,
-                 "migrated_at": util.datetime_utcnow_iso()},
+                 "migrated_at": util.datetime_utcnow_iso(),
+                 # The elastic term's fencing epoch: a deposed
+                 # evaluator's stale claim is attributable, and its
+                 # etag merge loses cleanly to the successor's.
+                 "leader_epoch": leader_epoch},
                 if_match=row["_etag"])
         except (EtagMismatchError, NotFoundError):
             return 0  # another evaluator/replica claimed the move
@@ -1085,4 +1140,9 @@ class FederationProcessor:
             try:
                 self.store.release_lease(self._lease)
             except Exception:
+                pass
+        for lease in self._elastic_leases.values():
+            try:
+                lease.release()
+            except Exception:  # noqa: BLE001 - expiry reclaims
                 pass

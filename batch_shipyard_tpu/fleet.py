@@ -764,6 +764,9 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                        evict: bool = False,
                        resize: bool = False,
                        migrate: bool = False,
+                       outage: bool = False,
+                       partition: bool = False,
+                       restart: bool = False,
                        raw: bool = False) -> dict:
     """Run a seeded chaos drill against a self-contained fakepod pool
     (chaos/drill.py) and report the recovery invariants: every task
@@ -786,12 +789,27 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     re-forms at 1 host and restores bit-exactly through the per-host
     reshard plan; ``migrate=True`` — a two-pool federation loses ALL
     capacity under a gang, which migrates to the sibling pool with
-    one trace spanning the move and the ``migration`` leg priced."""
+    one trace spanning the move and the ``migration`` leg priced.
+
+    The control-plane drills (one flag each, ISSUE 13):
+    ``outage=True`` — the state store goes DOWN for a sustained
+    window; resilient-store agents ride it out (zero retries, zero
+    lost advisory events, journals drained, the ``store_outage`` leg
+    priced with the exact window); ``partition=True`` — the preempt-
+    sweep leader's heartbeats/lease renewals stall while its sweep
+    keeps running: exactly one preemption stamp fires, carrying the
+    successor term's fencing epoch, with exactly one live lease at
+    the end; ``restart=True`` — the agent process dies under a
+    running task and the revived agent re-adopts it from the slot
+    ledger (one start, retries==0, the ``adoption`` leg priced)."""
     from batch_shipyard_tpu.chaos import drill
     picked = [flag for flag, on in (("preempt", preempt),
                                     ("evict", evict),
                                     ("resize", resize),
-                                    ("migrate", migrate)) if on]
+                                    ("migrate", migrate),
+                                    ("outage", outage),
+                                    ("partition", partition),
+                                    ("restart", restart)) if on]
     if len(picked) > 1:
         raise ValueError(
             f"pick at most one drill flag, got {picked}")
@@ -807,6 +825,12 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     elif migrate:
         report = drill.run_migration_drill(seed=seed,
                                            duration=duration)
+    elif outage:
+        report = drill.run_store_outage_drill(seed=seed)
+    elif partition:
+        report = drill.run_leader_partition_drill(seed=seed)
+    elif restart:
+        report = drill.run_agent_restart_drill(seed=seed)
     else:
         report = drill.run_drill(
             seed=seed, tasks=tasks, duration=duration, kinds=kinds,

@@ -40,7 +40,7 @@ from batch_shipyard_tpu.state.base import StateStore
 BADPUT_CATEGORIES = (
     "provisioning", "queueing", "backoff", "image_pull", "compile",
     "checkpoint", "preemption_recovery", "eviction", "migration",
-    "idle", "unaccounted",
+    "adoption", "store_outage", "idle", "unaccounted",
 )
 
 PRODUCTIVE = "productive"
@@ -75,6 +75,17 @@ _KIND_CATEGORY = {
     # Cross-pool migration wait: starved/preempted in the source pool
     # -> re-targeted and claimable on the sibling pool.
     ev.GANG_MIGRATE: "migration",
+    # Agent crash -> restarted agent re-adopts the still-running
+    # task: the control-plane gap an agent restart costs. Distinct
+    # from the recovery legs above because NO work was lost — the
+    # task ran through it — so the leg prices pure coordination
+    # downtime.
+    ev.TASK_ADOPTION: "adoption",
+    # State-store outage window (state/resilient.py latch): the
+    # control plane was down; whatever productive step windows cover
+    # of it stays productive (the sweep ranks productive higher), and
+    # only the uncovered remainder is charged here.
+    ev.STORE_OUTAGE: "store_outage",
     ev.TASK_IMAGE_PULL: "image_pull",
     ev.TASK_CONTAINER_START: "image_pull",
     ev.PROGRAM_COMPILE: "compile",
@@ -116,10 +127,19 @@ _PRIORITY = (
     # to its more specific cause exactly once. Migration outranks
     # eviction outranks preemption: a migrated gang's window subsumes
     # the starvation that triggered it.
-    "migration", "eviction", "preemption_recovery",
+    # "adoption" rides with them: the restart gap is a recovery wait
+    # on the task's timeline, charged to its specific cause before
+    # any generic wait could claim the seconds.
+    "migration", "eviction", "preemption_recovery", "adoption",
     "checkpoint", "compile", PRODUCTIVE,
     "checkpoint_async",
-    "image_pull", "provisioning", "backoff", "queueing", "idle",
+    # "store_outage" sits below the work-shaped categories (a task
+    # that kept stepping through the outage keeps its productive
+    # seconds — the ride-through working is not badput) but above
+    # idle: control-plane downtime is a more specific story for
+    # uncovered seconds than "nothing scheduled".
+    "image_pull", "provisioning", "backoff", "queueing",
+    "store_outage", "idle",
     "_running",
 )
 _PRIORITY_RANK = {c: i for i, c in enumerate(_PRIORITY)}

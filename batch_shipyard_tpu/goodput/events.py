@@ -102,6 +102,25 @@ GANG_RESIZE = "gang_resize"
 # priced as the "migration" badput leg. Emitted at migration time
 # (the window has fully elapsed; never future-dated).
 GANG_MIGRATE = "gang_migrate"
+# Control-plane legs (state/resilient.py + agent crash-restart
+# adoption):
+STORE_OUTAGE = "store_outage"     # interval: first failed store op ->
+                                  # first successful one; emitted by
+                                  # the resilient wrapper on latch
+                                  # close with the journal-replay
+                                  # counts in attrs — the exact
+                                  # partition of the outage window,
+                                  # priced as the "store_outage"
+                                  # badput leg
+TASK_ADOPTION = "adoption"        # interval: the crashed agent's last
+                                  # heartbeat -> the restarted agent
+                                  # re-adopting the still-running
+                                  # task (agent/node_agent.py
+                                  # _adopt_restart_state) — the
+                                  # control-plane gap an agent crash
+                                  # costs, priced as the "adoption"
+                                  # badput leg; the task itself never
+                                  # stopped
 
 # Program phases (emitted from inside the workload process)
 PROGRAM_COMPILE = "compile"            # jit compile / warm-up steps
@@ -123,7 +142,7 @@ EVENT_KINDS = frozenset({
     TASK_RETRY, TASK_BACKOFF,
     TASK_PREEMPT_NOTICE, TASK_PREEMPT_EXIT, TASK_PREEMPT_RECOVERY,
     TASK_EVICTED, TASK_EVICTION_RECOVERY,
-    GANG_RESIZE, GANG_MIGRATE,
+    GANG_RESIZE, GANG_MIGRATE, STORE_OUTAGE, TASK_ADOPTION,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,

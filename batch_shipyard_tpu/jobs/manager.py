@@ -626,7 +626,9 @@ def terminate_task(store: StateStore, pool_id: str, job_id: str,
 def request_preemption(store: StateStore, pool_id: str, job_id: str,
                        task_id: str, reason: str = "",
                        by_job_id: Optional[str] = None,
-                       by_task_id: Optional[str] = None) -> bool:
+                       by_task_id: Optional[str] = None,
+                       leader_epoch: Optional[int] = None,
+                       defer_notice: bool = False):
     """Stamp a cooperative preempt request on a RUNNING task. The
     owning node's agent heartbeat loop delivers it into the live task
     dirs (every gang instance gets its copy); an instrumented workload
@@ -634,7 +636,21 @@ def request_preemption(store: StateStore, pool_id: str, job_id: str,
     and exits EXIT_PREEMPTED — requeued at full retry budget. Returns
     False when the task is not in a preemptible state (or a concurrent
     transition won the merge). Idempotent: re-stamping an already
-    pending request is a no-op (one drain per request)."""
+    pending request is a no-op (one drain per request).
+
+    ``leader_epoch`` is the preempt-sweep term's fencing epoch
+    (state/leases.py): stamped into the request and the notice event
+    so every stamp is attributable to exactly one leadership term —
+    the partition drill's zero-double-fire invariant reads it.
+    Manual CLI preemptions carry None (no term to fence).
+
+    ``defer_notice``: return the notice-emitting closure (truthy)
+    instead of publishing the TASK_PREEMPT_NOTICE event here — for
+    the leader sweep, whose post-write fence check may RETRACT a
+    stamp that landed after its term ended; emitting eagerly would
+    leave a dangling notice event for a preemption that never
+    happened. The caller invokes the closure once the stamp is known
+    to stand."""
     from batch_shipyard_tpu.goodput import events as goodput_events
     task = get_task(store, pool_id, job_id, task_id)
     if task.get("state") not in ("assigned", "running"):
@@ -645,6 +661,7 @@ def request_preemption(store: StateStore, pool_id: str, job_id: str,
         "requested_at": util.datetime_utcnow_iso(),
         "reason": reason or "preempted by scheduler",
         "by_job_id": by_job_id, "by_task_id": by_task_id,
+        "leader_epoch": leader_epoch,
     }
     try:
         store.merge_entity(
@@ -653,15 +670,22 @@ def request_preemption(store: StateStore, pool_id: str, job_id: str,
             if_match=task["_etag"])
     except (EtagMismatchError, NotFoundError):
         return False
-    goodput_events.emit(
-        store, pool_id, goodput_events.TASK_PREEMPT_NOTICE,
-        job_id=job_id, task_id=task_id,
-        attrs={"reason": request["reason"],
-               "by_job_id": by_job_id, "by_task_id": by_task_id},
-        trace_id=task.get(trace_ctx.COL_TRACE_ID),
-        span_id=task.get(trace_ctx.COL_TRACE_SPAN))
-    logger.warning("preempt requested for %s/%s: %s", job_id, task_id,
-                   request["reason"])
+
+    def _emit_notice() -> None:
+        goodput_events.emit(
+            store, pool_id, goodput_events.TASK_PREEMPT_NOTICE,
+            job_id=job_id, task_id=task_id,
+            attrs={"reason": request["reason"],
+                   "by_job_id": by_job_id, "by_task_id": by_task_id,
+                   "leader_epoch": leader_epoch},
+            trace_id=task.get(trace_ctx.COL_TRACE_ID),
+            span_id=task.get(trace_ctx.COL_TRACE_SPAN))
+        logger.warning("preempt requested for %s/%s: %s", job_id,
+                       task_id, request["reason"])
+
+    if defer_notice:
+        return _emit_notice
+    _emit_notice()
     return True
 
 

@@ -213,6 +213,27 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
         "pool over the trailing export window (same windowed "
         "semantics).",
         "# TYPE shipyard_gang_migrations_total gauge",
+        "# HELP shipyard_store_outage_seconds_total State-store "
+        "outage seconds ridden out by the pool's resilient-store "
+        "wrappers over the trailing export window (store_outage "
+        "goodput intervals; WINDOWED — counts shrink as events age "
+        "out or are pruned).",
+        "# TYPE shipyard_store_outage_seconds_total gauge",
+        "# HELP shipyard_task_adoptions_total Crash-restart "
+        "adoptions (a restarted agent re-adopting its predecessor's "
+        "still-running tasks) over the trailing export window (same "
+        "windowed semantics; stale/offline-node events excluded).",
+        "# TYPE shipyard_task_adoptions_total gauge",
+        "# HELP shipyard_journal_backlog_entries Per-node "
+        "resilient-store WAL backlog (advisory store ops journaled "
+        "during an outage, awaiting replay) from the node's last "
+        "heartbeat; stale/offline nodes excluded.",
+        "# TYPE shipyard_journal_backlog_entries gauge",
+        "# HELP shipyard_leader_epoch Current fencing epoch of each "
+        "leader-gated sweep's named lease (state/leases.py): bumps "
+        "once per leadership term, so a flapping value is a "
+        "flapping leader.",
+        "# TYPE shipyard_leader_epoch gauge",
     ]
     from batch_shipyard_tpu.goodput import events as goodput_events
     for pool in store.query_entities(names.TABLE_POOLS,
@@ -249,8 +270,88 @@ def build_goodput_metrics(store: StateStore) -> list[str]:
                      f'{quarantined}')
         lines.extend(_fleet_elasticity_metrics(pool["_rk"], now,
                                                node_rows, events))
+        lines.extend(_control_plane_metrics(store, pool["_rk"], now,
+                                            node_rows, events))
         lines.extend(_pool_latency_metrics(store, pool["_rk"], now,
                                            node_rows, events))
+    lines.extend(_federation_lease_metrics(store))
+    return lines
+
+
+def _federation_lease_metrics(store: StateStore) -> list[str]:
+    """The fed-elastic lease epoch per federation — the lease whose
+    double-fire (a double-fanned gang migration) is the least
+    idempotent of all the leader-gated sweeps, so its flapping signal
+    matters most. Federation-scoped, not pool-scoped: exported once
+    per federation row, alongside the pools' sweep leases."""
+    from batch_shipyard_tpu.state import leases as state_leases
+    lines: list[str] = []
+    for fed in store.query_entities(names.TABLE_FEDERATIONS,
+                                    partition_key="fed"):
+        leader = state_leases.read_leader(
+            store, names.leader_epoch_key(
+                f"fed-{fed['_rk']}", state_leases.ROLE_FED_ELASTIC))
+        if leader is None:
+            continue
+        lines.append(
+            f'shipyard_leader_epoch'
+            f'{{lease="{state_leases.ROLE_FED_ELASTIC}",'
+            f'federation="{fed["_rk"]}"}} {int(leader["epoch"])}')
+    return lines
+
+
+def _control_plane_metrics(store: StateStore, pool_id: str,
+                           now: float, node_rows: list[dict],
+                           events: list[dict]) -> list[str]:
+    """Control-plane health for one pool: outage seconds ridden out
+    and adoptions performed (windowed, from the caller's
+    already-fetched goodput events), per-node WAL backlog (from the
+    heartbeat-published column), and each sweep lease's current
+    fencing epoch (from its epoch object — one tiny metadata read
+    per role per poll)."""
+    from batch_shipyard_tpu.goodput import events as goodput_events
+    from batch_shipyard_tpu.state import leases as state_leases
+    fresh = {node["_rk"] for node in node_rows
+             if _node_fresh(node, now)}
+    cutoff = now - GOODPUT_EXPORT_WINDOW_SECONDS
+    outage_seconds = 0.0
+    adoptions = 0
+    for event in events:
+        end = float(event.get("end", event.get("start", 0.0)))
+        if end < cutoff:
+            continue
+        node_id = event.get("node_id")
+        if node_id is not None and node_id not in fresh:
+            continue
+        kind = event.get("kind")
+        if kind == goodput_events.STORE_OUTAGE:
+            outage_seconds += max(
+                0.0, end - float(event.get("start", end)))
+        elif kind == goodput_events.TASK_ADOPTION:
+            adoptions += 1
+    lines = [
+        f'shipyard_store_outage_seconds_total{{pool="{pool_id}"}} '
+        f'{outage_seconds:.3f}',
+        f'shipyard_task_adoptions_total{{pool="{pool_id}"}} '
+        f'{adoptions}',
+    ]
+    for node in node_rows:
+        if node["_rk"] not in fresh:
+            continue
+        backlog = node.get(names.NODE_COL_JOURNAL_BACKLOG)
+        if backlog is None:
+            continue
+        lines.append(
+            f'shipyard_journal_backlog_entries{{node="{node["_rk"]}"'
+            f',pool="{pool_id}"}} {int(backlog)}')
+    for role in state_leases.AGENT_LEADER_ROLES:
+        leader = state_leases.read_leader(
+            store, names.leader_epoch_key(pool_id, role))
+        if leader is None:
+            continue
+        lines.append(
+            f'shipyard_leader_epoch{{lease="{role}",'
+            f'pool="{pool_id}"}} {int(leader["epoch"])}')
     return lines
 
 

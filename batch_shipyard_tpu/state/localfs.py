@@ -27,15 +27,7 @@ from batch_shipyard_tpu.state import base
 from batch_shipyard_tpu.state.base import (
     EntityExistsError, EtagMismatchError, LeaseHandle, LeaseLostError,
     NotFoundError, ObjectMeta, PreconditionFailedError, QueueMessage)
-
-
-def _atomic_write(path: str, data: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+from batch_shipyard_tpu.utils.util import atomic_write as _atomic_write
 
 
 class LocalFSStateStore(base.StateStore):
@@ -114,6 +106,12 @@ class LocalFSStateStore(base.StateStore):
                 for chunk in chunks:
                     fh.write(chunk)
                     size += len(chunk)
+                # Mirror _atomic_write: flush+fsync BEFORE the locked
+                # os.replace, so a crash between the rename and the
+                # page cache landing can never surface a torn object
+                # under a committed metadata row.
+                fh.flush()
+                os.fsync(fh.fileno())
             with self._locked():
                 db = self._load_db("objects")
                 meta = db.get(key)

@@ -221,6 +221,26 @@ def federation_job_blob_key(federation_id: str, job_id: str,
     return f"fedjobs/{federation_id}/{job_id}/{unique}"
 
 
+# Leader leases (state/leases.py): one named lease per leader-gated
+# loop — the gang janitor, the preempt sweep, the federation elastic
+# evaluator — plus a per-lease epoch object whose generation is the
+# monotonic fencing epoch stamped into every sweep write. ``scope``
+# is the pool id for agent sweeps, "fed-<federation_id>" for the
+# federation evaluator.
+def leader_lease_key(scope: str, role: str) -> str:
+    return f"leader/{scope}/{role}"
+
+
+def leader_epoch_key(scope: str, role: str) -> str:
+    return f"leader/{scope}/{role}.epoch"
+
+
+# Node-entity column: the local store-outage WAL backlog
+# (state/resilient.py), published on every heartbeat so heimdall can
+# export shipyard_journal_backlog_entries per node.
+NODE_COL_JOURNAL_BACKLOG = "journal_backlog"
+
+
 # Pool-wide compile-cache seeding (compilecache/seeding.py): one tar
 # artifact per cache identity, a latest.json pointer read before
 # download, and a lease so exactly one node uploads per identity.

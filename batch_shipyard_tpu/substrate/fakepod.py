@@ -324,6 +324,32 @@ class FakePodSubstrate(base.ComputeSubstrate):
             self._boot_threads.pop(node_id, None)
         return context
 
+    def crash_agent_hard(self, pool_id: str,
+                         node_id: str) -> Optional[dict]:
+        """Simulate the agent PROCESS dying while its tasks live on
+        (the crash-restart adoption shape): threads cannot be killed
+        in-process, so the agent is marked abandoned — every
+        in-flight completion path cuts off before its first
+        post-exit store write, heartbeats stop (no offline write, no
+        graceful lease release), and the task subprocesses — their
+        own sessions, exactly like a real agent crash — keep
+        running. Revive with revive_node on the SAME work_dir; the
+        restarted agent re-adopts from the slot ledgers."""
+        with self._lock:
+            agent = self._agents.get(pool_id, {}).get(node_id)
+        if agent is None:
+            return None
+        context = {"identity": agent.identity, "pool": agent.pool,
+                   "work_dir": agent.work_dir}
+        agent._abandoned = True
+        agent.heartbeat_blackout_until = float("inf")
+        agent.lease_blackout_until = float("inf")
+        agent.stop_event.set()
+        with self._lock:
+            self._agents.get(pool_id, {}).pop(node_id, None)
+            self._boot_threads.pop(node_id, None)
+        return context
+
     def revive_node(self, pool_id: str, context: dict) -> None:
         """Reboot a crashed node with the same identity."""
         kwargs = {

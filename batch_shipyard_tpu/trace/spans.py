@@ -68,6 +68,12 @@ SPAN_EVICT = "evict"                     # preempt notice -> hard
                                          # ignoring its notice)
 SPAN_GANG_RESIZE = "gang_resize"         # instantaneous: broken gang
                                          # re-formed at a new size
+SPAN_AGENT_RESTART = "agent_restart"     # crashed agent's last
+#                                          heartbeat -> restarted
+#                                          agent re-adopted the
+#                                          still-running task (the
+#                                          crash-restart adoption
+#                                          recovery leg)
 SPAN_GANG_MIGRATE = "gang_migrate"       # starved in source pool ->
                                          # re-targeted on the sibling
                                          # pool (one trace spans the
@@ -95,7 +101,7 @@ SPAN_KINDS = frozenset({
     SPAN_SUBMIT, SPAN_QUEUE_WAIT, SPAN_CLAIM, SPAN_BACKOFF_WAIT,
     SPAN_REQUEUE, SPAN_RENDEZVOUS, SPAN_IMAGE_PULL, SPAN_TASK_RUN,
     SPAN_CACHE_SEED, SPAN_PREEMPT, SPAN_EVICT, SPAN_GANG_RESIZE,
-    SPAN_GANG_MIGRATE,
+    SPAN_GANG_MIGRATE, SPAN_AGENT_RESTART,
     SPAN_COMPILE, SPAN_STEP_WINDOW, SPAN_CKPT_SNAPSHOT,
     SPAN_CKPT_PERSIST, SPAN_CKPT_RESTORE, SPAN_PROFILE,
     SPAN_SERVE_REQUEST, SPAN_SERVE_QUEUED, SPAN_SERVE_PREFILL,

@@ -50,6 +50,21 @@ def get_logger(name: str) -> logging.Logger:
     return logger
 
 
+def atomic_write(path: str, data: bytes) -> None:
+    """Crash-safe file replace: write to a uniquely-named sibling
+    temp file, flush + fsync, then os.replace. THE durability idiom
+    every ledger/journal/metadata writer in the framework shares
+    (state store DBs, the agent's slot ledger, the resilient-store
+    WAL) — a crash at any instant leaves either the old content or
+    the new, never a torn file behind a committed rename."""
+    tmp = f"{path}.tmp.{os.getpid()}.{random.getrandbits(32):08x}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
 def is_none_or_empty(value: Any) -> bool:
     return value is None or (hasattr(value, "__len__") and len(value) == 0)
 

@@ -283,6 +283,58 @@ def test_loop_unpartitioned_scan_needs_leader_gate():
     assert not _rules_of(partitioned, "loop-unpartitioned-scan")
 
 
+def test_leader_sweep_no_lease_requires_epoch_idiom():
+    # A heartbeat-freshness election gates the scan rule but is NOT a
+    # lease: the new rule still fires.
+    elected = {"batch_shipyard_tpu/agent/mod.py": (
+        "from batch_shipyard_tpu.state import names\n"
+        "class A:\n"
+        "    def _sweep_things(self):\n"
+        "        if not self._is_gang_sweep_leader():\n"
+        "            return\n"
+        "        for row in self.store.query_entities(\n"
+        "                names.TABLE_TASKS):\n"
+        "            pass\n")}
+    assert len(_rules_of(elected, "leader-sweep-no-lease")) == 1
+    # The lease idiom (a leader_epoch call) is blessed.
+    leased = {"batch_shipyard_tpu/agent/mod.py": (
+        "from batch_shipyard_tpu.state import names\n"
+        "class A:\n"
+        "    def _sweep_things(self):\n"
+        "        epoch = self._sweep_leader_epoch('janitor')\n"
+        "        if epoch is None:\n"
+        "            return\n"
+        "        for row in self.store.query_entities(\n"
+        "                names.TABLE_TASKS):\n"
+        "            pass\n")}
+    assert not _rules_of(leased, "leader-sweep-no-lease")
+    # A leased sweep whose stamp does NOT thread the epoch through
+    # still fires — the fencing is the point.
+    unfenced = {"batch_shipyard_tpu/agent/mod.py": (
+        "class A:\n"
+        "    def _sweep_preempt(self):\n"
+        "        epoch = self._sweep_leader_epoch('preempt')\n"
+        "        if epoch is None:\n"
+        "            return\n"
+        "        request_preemption(self.store, 'p', 'j', 't')\n")}
+    assert len(_rules_of(unfenced, "leader-sweep-no-lease")) == 1
+    fenced = {"batch_shipyard_tpu/agent/mod.py": (
+        "class A:\n"
+        "    def _sweep_preempt(self):\n"
+        "        epoch = self._sweep_leader_epoch('preempt')\n"
+        "        if epoch is None:\n"
+        "            return\n"
+        "        request_preemption(self.store, 'p', 'j', 't',\n"
+        "                           leader_epoch=epoch)\n")}
+    assert not _rules_of(fenced, "leader-sweep-no-lease")
+    # Non-sweep functions are out of scope (manual CLI preempts
+    # carry their own follow-through).
+    manual = {"batch_shipyard_tpu/agent/mod.py": (
+        "def action_jobs_preempt(store):\n"
+        "    request_preemption(store, 'p', 'j', 't')\n")}
+    assert not _rules_of(manual, "leader-sweep-no-lease")
+
+
 def test_loop_sleep_in_sweep_fires_only_on_hot_functions():
     firing = {"batch_shipyard_tpu/agent/mod.py": (
         "import time\n"

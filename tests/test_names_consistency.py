@@ -227,6 +227,111 @@ def test_fleet_elasticity_dispatched_and_rendered():
     assert data.get("cpu_marker") is True
 
 
+def test_control_plane_vocabulary_declared():
+    """ISSUE 13's vocabulary: the STORE_OUTAGE / TASK_ADOPTION kinds
+    are declared+registered (rule), priced as their own badput
+    categories, actually referenced at emit sites (native scan —
+    dead registry check); SPAN_AGENT_RESTART rides SPAN_KINDS and is
+    emitted; the leader-lease roles and key helpers exist."""
+    from batch_shipyard_tpu.goodput import accounting
+    from batch_shipyard_tpu.goodput import events as gp_events
+    from batch_shipyard_tpu.state import leases as state_leases
+    from batch_shipyard_tpu.trace import spans as trace_spans
+    findings = _run("goodput-kind-undeclared")
+    findings += _run("goodput-kind-unpriced")
+    findings += _run("trace-span-undeclared")
+    assert not findings, _fail_lines(findings)
+    event_attrs = {"STORE_OUTAGE", "TASK_ADOPTION",
+                   "SPAN_AGENT_RESTART"}
+    referenced = set()
+    for src in _CTX.python_files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in event_attrs:
+                referenced.add(node.attr)
+    assert event_attrs <= referenced, event_attrs - referenced
+    assert accounting._KIND_CATEGORY[
+        gp_events.STORE_OUTAGE] == "store_outage"
+    assert accounting._KIND_CATEGORY[
+        gp_events.TASK_ADOPTION] == "adoption"
+    assert "store_outage" in accounting.BADPUT_CATEGORIES
+    assert "adoption" in accounting.BADPUT_CATEGORIES
+    assert trace_spans.SPAN_AGENT_RESTART in trace_spans.SPAN_KINDS
+    # Leader-lease vocabulary: role registry + key helpers + the
+    # heartbeat-published WAL backlog column.
+    assert state_leases.ROLE_GANG_JANITOR in \
+        state_leases.AGENT_LEADER_ROLES
+    assert state_leases.ROLE_PREEMPT_SWEEP in \
+        state_leases.AGENT_LEADER_ROLES
+    assert names.leader_epoch_key("p", "r") == \
+        names.leader_lease_key("p", "r") + ".epoch"
+    assert names.NODE_COL_JOURNAL_BACKLOG == "journal_backlog"
+
+
+def test_control_plane_chaos_kinds_wired():
+    """The three ISSUE 13 chaos kinds are registered in
+    INJECTION_KINDS (validation + --kinds help, which derives from
+    it), excluded from the generic default schedule (a sustained
+    outage without the resilient wrapper armed is unrecoverable by
+    construction), actually APPLIED by the injector, and actually
+    requested by at least one drill — a kind nothing injects is
+    dead vocabulary. The three drill flags are rendered by the CLI
+    help."""
+    from batch_shipyard_tpu.chaos.plan import (
+        DEFAULT_DRILL_KINDS, INJECTION_KINDS)
+    new_kinds = {"store_outage", "leader_partition", "agent_restart"}
+    assert new_kinds <= set(INJECTION_KINDS)
+    assert not new_kinds & set(DEFAULT_DRILL_KINDS)
+    injectors_src = (PACKAGE / "chaos" / "injectors.py").read_text(
+        encoding="utf-8")
+    drill_src = (PACKAGE / "chaos" / "drill.py").read_text(
+        encoding="utf-8")
+    for kind in sorted(new_kinds):
+        assert f'"{kind}"' in injectors_src, (
+            f"chaos kind {kind} has no injector")
+        assert f'"{kind}"' in drill_src, (
+            f"chaos kind {kind} is not injected by any drill")
+    import click
+
+    from batch_shipyard_tpu.cli import main as cli_main
+    ctx = click.Context(cli_main.chaos_plan, info_name="plan")
+    rendered = "".join(cli_main.chaos_plan.get_help(ctx).split())
+    for kind in sorted(new_kinds):
+        assert kind in rendered
+    ctx = click.Context(cli_main.chaos_drill, info_name="drill")
+    rendered = cli_main.chaos_drill.get_help(ctx)
+    for flag in ("--outage", "--partition", "--restart"):
+        assert flag in rendered, f"drill flag {flag} not wired"
+
+
+def test_control_plane_dispatched_and_rendered():
+    """The control-plane drills are wired end to end: bench.py
+    dispatches the control_plane workload, benchgen renders the
+    committed BENCH_control_plane.json artifact, and the artifact
+    records all three drills passing."""
+    import json
+    bench_src = (PACKAGE.parent / "bench.py").read_text(
+        encoding="utf-8")
+    assert '"control_plane" in workloads' in bench_src
+    benchgen_src = (PACKAGE.parent / "tools" / "benchgen.py"
+                    ).read_text(encoding="utf-8")
+    assert "BENCH_control_plane.json" in benchgen_src
+    artifact = PACKAGE.parent / "BENCH_control_plane.json"
+    assert artifact.exists(), (
+        "BENCH_control_plane.json not committed — run "
+        "`python bench.py --workloads control_plane`")
+    data = json.loads(artifact.read_text(
+        encoding="utf-8"))["control_plane"]
+    assert data["all_passed"] is True
+    assert set(data["drills"]) == {"store_outage",
+                                   "leader_partition",
+                                   "agent_restart"}
+    for entry in data["drills"].values():
+        assert entry["passed"] is True
+        assert entry["invariants_checked"]
+    assert data.get("cpu_marker") is True
+
+
 def test_chaos_kinds_help_lists_node_preempt_notice():
     """The --kinds help derives from INJECTION_KINDS (analyzer rule
     wiring-kinds-help-stale) and the rendered help really names the
