@@ -22,6 +22,7 @@ the localhost substrate (process), and on a real TPU VM worker
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import json
@@ -54,6 +55,11 @@ from batch_shipyard_tpu.utils import util
 logger = util.get_logger(__name__)
 
 _OUTPUT_STREAM_CHUNK = 4 * 1024 * 1024
+
+# How often a worker slot re-reads the pool's task-queue shard count
+# to pick up grow-only autoscale (jobs/manager.py). Slow on purpose:
+# a stale scan only under-uses new fan-out, it never loses messages.
+_SHARD_REFRESH_SECONDS = 20.0
 
 # Identity file worker 0 writes inside a shared scratch dir; other
 # workers read it THROUGH the published path to decide whether they
@@ -223,6 +229,20 @@ class NodeAgent:
             if leader_lease_seconds is not None
             else max(2.0, 4.0 * heartbeat_interval))
         self._sweep_leases: dict[str, state_leases.LeaderLease] = {}
+        # Claim batching: a worker poll takes up to slot-count
+        # messages (capped) under one visibility window and parks the
+        # surplus on this node-local deque; every slot drains the
+        # deque before touching the store again, so a busy node pays
+        # ~1 queue round trip per k tasks instead of per task.
+        self._claim_prefetch: collections.deque = collections.deque()
+        self._claim_prefetch_lock = threading.Lock()
+        # Server-side task-factory expansion (jobs/expansion.py):
+        # the ROLE_EXPANDER leader materializes parked generator
+        # specs on a dedicated thread; the heartbeat sweep only
+        # checks for work and spawns it (lint forbids slow sweeps).
+        self._expander_thread: Optional[threading.Thread] = None
+        self._last_expansion_sweep = 0.0
+        self.expansion_sweep_interval = max(2.0, heartbeat_interval)
         # Chaos seam (leader_partition): while wall-clock < this, NO
         # lease traffic reaches the store — the leader is partitioned
         # from it, and its authority decays on the local clock alone.
@@ -550,6 +570,7 @@ class NodeAgent:
                 with self._store_bounded(
                         max(5.0, 2.0 * self.heartbeat_interval)):
                     self._sweep_orphaned_gangs()
+                    self._sweep_task_expansions()
                     self._sweep_preemptions()
                     self._sweep_stale_preempt_files()
                     self._forward_profile_requests()
@@ -618,6 +639,21 @@ class NodeAgent:
         # sitting in lower bands.
         bands = names.task_queues_by_band(pool_id, shards)
         stagger = self.identity.node_index + slot
+        # Claim batch size: up to one message per node slot (capped)
+        # per poll. A 1-slot node claims one at a time — exactly the
+        # legacy behavior — while an 8-slot node amortizes the queue
+        # round trip 8x. The cap bounds how long a surplus claim can
+        # sit parked relative to its visibility window.
+        claim_batch = max(
+            1, min(int(self.pool.task_slots_per_node), 16))
+        # Queue-shard autoscale pickup: the submitter may grow the
+        # pool's shard fan-out mid-run (jobs/manager.py
+        # maybe_autoscale_queue_shards, grow-only). Refresh the
+        # cached count on a slow cadence and rebuild the band scan —
+        # old shard names are a strict subset of the new set, so a
+        # stale scan misses no in-flight message, it only under-uses
+        # the new fan-out until the refresh lands.
+        shards_checked = time.monotonic()
         # Idle-poll backoff for the hi/lo bands: most pools only ever
         # use priority 0, and probing three bands instead of one
         # every cycle would triple steady-state store traffic. A band
@@ -640,9 +676,24 @@ class NodeAgent:
             # per-message guard in _process_task_message stays as a
             # backstop for races across this check.)
             if self.node_quarantined():
+                self._release_prefetched()
                 time.sleep(self.poll_interval)
                 continue
-            msg = None
+            if (time.monotonic() - shards_checked
+                    >= _SHARD_REFRESH_SECONDS):
+                shards_checked = time.monotonic()
+                fresh = self._current_queue_shards(shards)
+                if fresh > shards:
+                    shards = fresh
+                    bands = names.task_queues_by_band(pool_id, shards)
+            # Drain the node-local prefetch before polling: surplus
+            # claims from a prior batched poll are already invisible
+            # to other nodes, so they must be worked first.
+            msg = self._pop_prefetched()
+            if msg is not None:
+                stagger += 1
+                self._dispatch_task_message(slot, msg)
+                continue
             for b, band_queues in enumerate(bands):
                 if b in skip and skip[b] > 0:
                     skip[b] -= 1
@@ -653,7 +704,7 @@ class NodeAgent:
                     taskq = band_queues[(stagger + k) % n]
                     try:
                         msgs = self.store.get_messages(
-                            taskq, max_messages=1,
+                            taskq, max_messages=claim_batch,
                             visibility_timeout=(
                                 self.claim_visibility_seconds))
                     except Exception:  # noqa: BLE001 - slot survives
@@ -664,6 +715,9 @@ class NodeAgent:
                         msgs = []
                     if msgs:
                         msg = msgs[0]
+                        if len(msgs) > 1:
+                            with self._claim_prefetch_lock:
+                                self._claim_prefetch.extend(msgs[1:])
                         found = True
                         break
                 if b in skip:
@@ -686,22 +740,58 @@ class NodeAgent:
                 time.sleep(self.poll_interval)
                 continue
             stagger += 1
+            self._dispatch_task_message(slot, msg)
+        # Shutdown: surplus claims parked here would hide from the
+        # rest of the pool until their visibility window lapsed.
+        self._release_prefetched()
+
+    def _dispatch_task_message(self, slot: int, msg) -> None:
+        try:
+            self._process_task_message(
+                slot, json.loads(msg.payload), msg)
+        except Exception:
+            logger.exception("error processing task message; requeue")
+            # Release this slot's goodput claim (idempotent; the
+            # exception may have struck before or after the
+            # claim) so idle accounting survives the crash.
+            self._goodput_work_done(slot)
             try:
-                self._process_task_message(
-                    slot, json.loads(msg.payload), msg)
-            except Exception:
-                logger.exception("error processing task message; requeue")
-                # Release this slot's goodput claim (idempotent; the
-                # exception may have struck before or after the
-                # claim) so idle accounting survives the crash.
-                self._goodput_work_done(slot)
-                try:
-                    self.store.update_message(msg, visibility_timeout=5.0)
-                except Exception:  # noqa: BLE001 - slot must survive
-                    # A store error in the error handler must not
-                    # kill the worker slot; visibility timeout will
-                    # redeliver the message anyway.
-                    pass
+                self.store.update_message(msg, visibility_timeout=5.0)
+            except Exception:  # noqa: BLE001 - slot must survive
+                # A store error in the error handler must not
+                # kill the worker slot; visibility timeout will
+                # redeliver the message anyway.
+                pass
+
+    def _pop_prefetched(self):
+        with self._claim_prefetch_lock:
+            if self._claim_prefetch:
+                return self._claim_prefetch.popleft()
+        return None
+
+    def _release_prefetched(self) -> None:
+        """Hand surplus batched claims straight back (quarantine or
+        shutdown): a parked message would otherwise stay invisible to
+        healthy nodes for a full visibility window."""
+        while True:
+            msg = self._pop_prefetched()
+            if msg is None:
+                return
+            try:
+                self.store.update_message(msg, visibility_timeout=0.0)
+            except Exception:  # noqa: BLE001 - expiry redelivers
+                pass
+
+    def _current_queue_shards(self, fallback: int) -> int:
+        """The pool's current task-queue shard count via the jobs
+        manager's TTL cache (one pool-entity read per TTL across
+        every slot on the node)."""
+        try:
+            from batch_shipyard_tpu.jobs import manager as jobs_mgr
+            return max(int(jobs_mgr.pool_queue_shards(
+                self.store, self.identity.pool_id)), 1)
+        except Exception:  # noqa: BLE001 - scan keeps old fan-out
+            return fallback
 
     def _handle_control(self, control: dict) -> None:
         kind = control.get("type")
@@ -3448,6 +3538,53 @@ class NodeAgent:
             except NotFoundError:
                 pass
 
+    def _sweep_task_expansions(self) -> None:
+        """Leader-gated pickup of parked server-side task-factory
+        expansions (jobs/expansion.py). The sweep itself only looks —
+        one partition query to learn whether any row owes work — then
+        spawns at most one dedicated expander thread for the slow
+        materialization: a 10^6-task expansion runs for minutes and
+        must never ride the heartbeat thread. Every chunk the thread
+        commits is fenced on this term's epoch, so a deposed leader's
+        in-flight expander goes inert instead of double-writing."""
+        if (time.monotonic() - self._last_expansion_sweep
+                < self.expansion_sweep_interval):
+            return
+        self._last_expansion_sweep = time.monotonic()
+        thread = self._expander_thread
+        if thread is not None and thread.is_alive():
+            return  # the running expander drains pending rows itself
+        # Look BEFORE leading: the pending probe is one tiny
+        # partition query, and taking the lease first would keep the
+        # whole pool churning expander terms forever after the last
+        # expansion completes. Pending rows only ever appear via
+        # `jobs add`, so a pre-lease probe can't miss work for longer
+        # than one sweep interval.
+        from batch_shipyard_tpu.jobs import expansion as expansion_mod
+        if not expansion_mod.pending_expansions(
+                self.store, self.identity.pool_id):
+            return
+        epoch = self._sweep_leader_epoch(state_leases.ROLE_EXPANDER)
+        if epoch is None:
+            return
+        lease = self._sweep_lease(state_leases.ROLE_EXPANDER)
+
+        def _run() -> None:
+            try:
+                expansion_mod.run_pending_expansions(
+                    self.store, self.identity.pool_id,
+                    node_id=self.identity.node_id,
+                    fenced=lambda: lease.fenced(epoch),
+                    stop_check=self.stop_event.is_set)
+            except Exception:
+                logger.exception("task expansion run failed")
+
+        thread = threading.Thread(
+            target=_run,
+            name=f"expander-{self.identity.node_id}", daemon=True)
+        self._expander_thread = thread
+        thread.start()
+
     def _sweep_lease(self, role: str) -> state_leases.LeaderLease:
         """The named leadership lease of one leader-gated loop,
         created lazily so a node whose sweep never runs (disabled
@@ -4925,7 +5062,9 @@ class NodeAgent:
                 names.TABLE_JOBPREP, partition_key=pk):
             if row["_rk"].startswith("#"):
                 continue
-            self.store.put_message(
+            # Distinct per-node control queue each iteration — there
+            # is nothing to batch.
+            self.store.put_message(  # shipyard-lint: disable=store-write-in-loop
                 names.control_queue(self.identity.pool_id, row["_rk"]),
                 json.dumps({
                     "type": "job_release", "job_id": job_id}).encode())

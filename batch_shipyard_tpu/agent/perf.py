@@ -25,7 +25,9 @@ def emit(store: StateStore, pool_id: str, node_id: str, source: str,
     for bump in range(100):
         row_key = f"{ts + bump * 1e-6:017.6f}${node_id}${event}"
         try:
-            store.insert_entity(names.TABLE_PERF, pool_id, row_key, {
+            # Collision-bump claim retry: ONE row, re-keyed until the
+            # insert wins — not an n-item loop.
+            store.insert_entity(names.TABLE_PERF, pool_id, row_key, {  # shipyard-lint: disable=store-write-in-loop
                 "node_id": node_id, "source": source, "event": event,
                 "message": message, "timestamp": ts,
             })

@@ -185,6 +185,55 @@ def check_rmw_no_etag(ctx: AnalysisContext) -> list[Finding]:
     return findings
 
 
+_BATCHABLE_WRITES = {
+    "insert_entity": "insert_entities",
+    "put_message": "put_messages",
+}
+
+
+@rule("store-write-in-loop", family="store")
+def check_write_in_loop(ctx: AnalysisContext) -> list[Finding]:
+    """Per-item ``insert_entity``/``put_message`` inside a ``for``
+    loop: each iteration is a store round trip, so the loop costs
+    O(n) wire latency where the batch APIs (``insert_entities``,
+    ``put_messages``) cost O(n / chunk). At submission scale the
+    difference is the whole ballgame — the 10^6-task bench's submit
+    leg is built entirely out of the batch forms.
+
+    Provenance: the streaming-bulk-submission PR — `migrate_job`'s
+    copy loop wrote one row and one message per task (a 10^5-task
+    migration paid 2x10^5 round trips); rewritten to build the rows
+    and per-queue message lists first and commit via the batch APIs.
+    Legitimate per-iteration writes (distinct per-node control
+    queues, the base-class batch fallbacks themselves) carry an
+    inline suppression stating why."""
+    findings = []
+    seen: set[tuple[str, int]] = set()
+    for src in ctx.python_files:
+        for loop in ast.walk(src.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node) in _BATCHABLE_WRITES):
+                    continue
+                key = (src.rel, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                name = call_name(node)
+                findings.append(Finding(
+                    rule="store-write-in-loop", path=src.rel,
+                    line=node.lineno,
+                    message=(f"{name} inside a for loop is one store "
+                             f"round trip per iteration; collect the "
+                             f"items and use "
+                             f"{_BATCHABLE_WRITES[name]} (or "
+                             f"suppress with a comment saying why "
+                             f"per-item is required)")))
+    return findings
+
+
 @rule("store-etag-retry-no-refetch", family="store")
 def check_etag_retry_no_refetch(ctx: AnalysisContext) -> list[Finding]:
     """An ``except EtagMismatchError`` handler that writes again

@@ -25,6 +25,7 @@ import dataclasses
 import os
 import pathlib
 import sys
+import tempfile
 import threading
 import time
 from typing import Optional
@@ -36,9 +37,27 @@ from batch_shipyard_tpu.goodput import accounting
 from batch_shipyard_tpu.jobs import manager as jobs_mgr
 from batch_shipyard_tpu.pool import manager as pool_mgr
 from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state import resilient as state_resilient
 from batch_shipyard_tpu.utils import util
 
 logger = util.get_logger(__name__)
+
+
+def _submit_jobs(store, pool, jobs) -> dict:
+    """Every drill's submission leg rides the group-commit lane
+    (state/resilient.py ``group_commit``): task rows and queue
+    messages buffer, coalesce, and land in combined round trips —
+    the same seeds that pin the recovery layer now also pin that
+    write-combining preserves submission semantics exactly (any
+    lost or double-applied write breaks the drill's completion,
+    exactly-once, or goodput-partition invariants)."""
+    gc_store = state_resilient.ResilientStore(
+        store,
+        journal_path=os.path.join(
+            tempfile.gettempdir(),
+            f"shipyard-drill-gc-{os.getpid()}-{id(store)}.jsonl"))
+    with gc_store.group_commit():
+        return jobs_mgr.add_jobs(gc_store, pool, jobs)
 
 POOL_ID = "chaos-drill"
 JOB_ID = "drill"
@@ -122,7 +141,7 @@ def run_drill(seed: int = 0, tasks: int = 16,
                              "num_instances": GANG_INSTANCES}}],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(raw_store, pool, jobs)
+        _submit_jobs(raw_store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, chaos_store, report),
@@ -214,7 +233,7 @@ def run_preemption_drill(seed: int = 0, instances: int = 4,
                            "jax_distributed": {"enabled": False}}}],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(store, pool, jobs)
+        _submit_jobs(store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, None, report),
@@ -400,7 +419,7 @@ def run_eviction_drill(seed: int = 0, steps: int = 140,
                        "max_task_retries": 2}],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(store, pool, jobs)
+        _submit_jobs(store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, None, report),
@@ -562,7 +581,7 @@ def run_host_resize_drill(seed: int = 0, steps: int = 100,
                            "jax_distributed": {"enabled": False}}}],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(store, pool, jobs)
+        _submit_jobs(store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, None, report),
@@ -931,7 +950,7 @@ def run_store_outage_drill(seed: int = 0, tasks: int = 6,
                       for i in range(tasks)],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(raw_store, pool, jobs)
+        _submit_jobs(raw_store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, chaos_store, report),
@@ -1099,7 +1118,7 @@ def run_leader_partition_drill(seed: int = 0,
                            "max_task_retries": 3}
                           for i in range(2)],
             }]})
-        jobs_mgr.add_jobs(store, pool, victims)
+        _submit_jobs(store, pool, victims)
         # Both victims running + a preempt-sweep term recorded: only
         # then is "partition the leader" well-defined.
         _wait_for(
@@ -1116,7 +1135,7 @@ def run_leader_partition_drill(seed: int = 0,
             "tasks": [{"id": "h0", "command": "echo placed",
                        "priority": 0, "max_task_retries": 2}],
         }]})
-        jobs_mgr.add_jobs(store, pool, hi)
+        _submit_jobs(store, pool, hi)
         # Partition the leader NOW — before the starvation grace can
         # elapse — so the stamp decision crosses the failover.
         for injection in plan.injections:
@@ -1271,7 +1290,7 @@ def run_agent_restart_drill(seed: int = 0, task_sleep: float = 2.5,
                        "max_task_retries": 2}],
         }]})
         started = time.monotonic()
-        jobs_mgr.add_jobs(store, pool, jobs)
+        _submit_jobs(store, pool, jobs)
         driver = threading.Thread(
             target=_inject_schedule,
             args=(plan, started, substrate, None, report),

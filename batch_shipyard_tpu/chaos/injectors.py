@@ -35,6 +35,7 @@ _FAULTED_OPS = frozenset({
     "put_object", "get_object", "get_object_meta", "delete_object",
     "insert_entity", "upsert_entity", "merge_entity", "get_entity",
     "query_entities", "delete_entity", "insert_entities",
+    "count_entities_by",
     "put_message", "put_messages", "get_messages", "delete_message",
     "update_message",
     # Stream ops fault at CALL time (before any chunk moves) so the

@@ -551,6 +551,13 @@ class JobSettings:
     # tears it down when the job completes (reference
     # _construct_auto_pool_specification, fleet.py:1768).
     auto_pool: Optional[dict]
+    # Server-side task-factory expansion: submit the generator spec
+    # as ONE expansion row and let the pool's leader-gated expander
+    # (jobs/expansion.py) materialize task rows + queue messages —
+    # the client round-trips O(1) instead of O(tasks). Requires every
+    # task to carry a task_factory (there is no per-task payload to
+    # ship otherwise).
+    server_side_expansion: bool = False
 
 
 def job_settings_list(config: dict) -> list[JobSettings]:
@@ -603,7 +610,59 @@ def _job_settings(job: dict) -> JobSettings:
         federation_constraints=_get(
             job, "federation_constraints", default={}),
         auto_pool=_get(job, "auto_pool"),
+        server_side_expansion=_get(job, "server_side_expansion",
+                                   default=False),
     )
+
+
+def job_settings_to_raw(job: JobSettings) -> dict:
+    """Invert ``_job_settings``: a raw job dict that parses back to an
+    equal JobSettings. This is what the server-side expansion row
+    stores — the expander re-derives the full settings pool-side from
+    one JSON-serializable dict, so the wire format stays the config
+    schema itself rather than a second pickled shape."""
+    raw: dict = {
+        "id": job.id,
+        "pool_id": job.pool_id,
+        "auto_complete": job.auto_complete,
+        "priority": job.priority,
+        "max_task_retries": job.max_task_retries,
+        "max_wall_time_seconds": job.max_wall_time_seconds,
+        "allow_run_on_missing_image": job.allow_run_on_missing_image,
+        "environment_variables": dict(job.environment_variables),
+        "auto_scratch": job.auto_scratch,
+        "input_data": [dict(d) for d in job.input_data],
+        "tasks": [dict(t) for t in job.tasks],
+        "merge_task": job.merge_task,
+        "federation_constraints": dict(job.federation_constraints),
+        "auto_pool": job.auto_pool,
+        "server_side_expansion": job.server_side_expansion,
+    }
+    if job.environment_variables_secret_id is not None:
+        raw["environment_variables_keyvault_secret_id"] = \
+            job.environment_variables_secret_id
+    if job.job_preparation_command is not None:
+        raw["job_preparation"] = {
+            "command": job.job_preparation_command}
+    if job.job_release_command is not None:
+        raw["job_release"] = {"command": job.job_release_command}
+    if job.recurrence is not None:
+        rec = job.recurrence
+        raw["recurrence"] = {
+            "schedule": {
+                "recurrence_interval_seconds":
+                    rec.recurrence_interval_seconds,
+                "do_not_run_until": rec.do_not_run_until,
+                "do_not_run_after": rec.do_not_run_after,
+                "start_window_seconds": rec.start_window_seconds,
+            },
+            "job_manager": {
+                "monitor_task_completion":
+                    rec.monitor_task_completion,
+                "run_exclusive": rec.run_exclusive,
+            },
+        }
+    return raw
 
 
 def task_settings(task: dict, job: JobSettings,

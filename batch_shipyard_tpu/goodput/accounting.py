@@ -38,9 +38,9 @@ from batch_shipyard_tpu.state.base import StateStore
 # no event covers — surfaced explicitly instead of silently inflating
 # a real category.
 BADPUT_CATEGORIES = (
-    "provisioning", "queueing", "backoff", "image_pull", "compile",
-    "checkpoint", "preemption_recovery", "eviction", "migration",
-    "adoption", "store_outage", "idle", "unaccounted",
+    "provisioning", "queueing", "expansion", "backoff", "image_pull",
+    "compile", "checkpoint", "preemption_recovery", "eviction",
+    "migration", "adoption", "store_outage", "idle", "unaccounted",
 )
 
 PRODUCTIVE = "productive"
@@ -61,6 +61,12 @@ _KIND_CATEGORY = {
     ev.NODE_PREP: "provisioning",
     ev.NODE_PREEMPTED: "provisioning",   # reclaim -> re-provision time
     ev.TASK_QUEUED: "queueing",
+    # Server-side task-factory expansion: the expander leader
+    # materializing a generator spec into rows + messages. Scheduling
+    # machinery like queueing, but its own leg so the 10^6-task bench
+    # can show the submit work that moved pool-side instead of it
+    # vanishing into the queued wait it overlaps.
+    ev.TASK_EXPANSION: "expansion",
     ev.TASK_BACKOFF: "backoff",
     # Preempted exit -> re-claim: the recovery leg every preemption
     # pays (arxiv 2502.06982) — outranks queueing in the sweep, like
@@ -104,7 +110,8 @@ _KIND_CATEGORY = {
 # overlapped persist) needs no tuple — it is whatever remains of run
 # time after productive, so program goodput is computed directly as
 # productive / run time.
-_SCHEDULING_BADPUT = ("provisioning", "queueing", "backoff")
+_SCHEDULING_BADPUT = ("provisioning", "queueing", "expansion",
+                      "backoff")
 _RESOURCE_BADPUT = ("image_pull", "idle", "unaccounted")
 
 # Sweep priority, highest first. SAME-PROGRAM overheads (rework,
@@ -138,7 +145,10 @@ _PRIORITY = (
     # seconds — the ride-through working is not badput) but above
     # idle: control-plane downtime is a more specific story for
     # uncovered seconds than "nothing scheduled".
-    "image_pull", "provisioning", "backoff", "queueing",
+    # "expansion" outranks "queueing": while the expander is still
+    # materializing a job's rows, that job's queued seconds have a
+    # more specific cause than a generic backlog wait.
+    "image_pull", "provisioning", "backoff", "expansion", "queueing",
     "store_outage", "idle",
     "_running",
 )

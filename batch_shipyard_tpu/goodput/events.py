@@ -112,6 +112,15 @@ STORE_OUTAGE = "store_outage"     # interval: first failed store op ->
                                   # partition of the outage window,
                                   # priced as the "store_outage"
                                   # badput leg
+TASK_EXPANSION = "expansion"      # interval: a server-side task-
+                                  # factory expansion run (generator
+                                  # row claimed -> all chunks
+                                  # materialized) on the expander
+                                  # leader — scheduling machinery, so
+                                  # its own badput leg next to
+                                  # "queueing"; attrs carry the
+                                  # submit-leg breakdown (expanded,
+                                  # entity/enqueue/encode seconds)
 TASK_ADOPTION = "adoption"        # interval: the crashed agent's last
                                   # heartbeat -> the restarted agent
                                   # re-adopting the still-running
@@ -143,6 +152,7 @@ EVENT_KINDS = frozenset({
     TASK_PREEMPT_NOTICE, TASK_PREEMPT_EXIT, TASK_PREEMPT_RECOVERY,
     TASK_EVICTED, TASK_EVICTION_RECOVERY,
     GANG_RESIZE, GANG_MIGRATE, STORE_OUTAGE, TASK_ADOPTION,
+    TASK_EXPANSION,
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,

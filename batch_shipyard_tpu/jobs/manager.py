@@ -12,8 +12,12 @@ batch.py:4177) so depends_on_range works identically.
 from __future__ import annotations
 
 import json
+import math
+import queue as queue_mod
 import re
+import threading
 import time
+import weakref
 from typing import Iterator, Optional
 
 from batch_shipyard_tpu.config import settings as settings_mod
@@ -111,14 +115,35 @@ def _expand_job_tasks(store: StateStore, job: JobSettings,
     task_number = start_number
     all_task_ids: list[str] = []
     pending: list[tuple[str, dict]] = []
+    # Spec memoization: a repeat/sweep factory yields runs of equal
+    # raw tasks, and settings-merge + spec construction dominate
+    # large expansions (they are pure functions of the raw dict).
+    # Re-deriving only when the raw task changes turns a 10^6-repeat
+    # expansion from 10^6 merges into 1 — and the shared spec object
+    # also collapses the submission's memory footprint. The spec must
+    # then never be mutated per-task below (required_node is uniform
+    # across the call), which also holds for every downstream reader.
+    prev_raw: Optional[dict] = None
+    prev_spec: Optional[dict] = None
+    prev_explicit_id: Optional[str] = None
     for raw_task in job.tasks:
         for expanded in expand_task_factory(raw_task, store):
-            task = settings_mod.task_settings(expanded, job, pool)
-            task_id = task.id or f"task-{task_number:05d}"
+            if prev_raw is not None and (
+                    expanded is prev_raw or expanded == prev_raw):
+                spec = prev_spec
+                task_id = prev_explicit_id or \
+                    f"task-{task_number:05d}"
+            else:
+                task = settings_mod.task_settings(expanded, job, pool)
+                spec = _task_spec(task, job, pool)
+                if required_node:
+                    spec["required_node"] = required_node
+                prev_raw = expanded if expanded is not raw_task \
+                    else dict(expanded)
+                prev_spec = spec
+                prev_explicit_id = task.id
+                task_id = task.id or f"task-{task_number:05d}"
             task_number += 1
-            spec = _task_spec(task, job, pool)
-            if required_node:
-                spec["required_node"] = required_node
             pending.append((task_id, spec))
             all_task_ids.append(task_id)
     if job.merge_task is not None:
@@ -155,7 +180,10 @@ def add_jobs(store: StateStore, pool: PoolSettings,
         trace = trace_ctx.TraceContext.new()
         submit_started = time.time()
         try:
-            store.insert_entity(names.TABLE_JOBS, pool_id, job.id, {
+            # One insert-as-claim per JOB (EntityExistsError below is
+            # the duplicate-submission guard); jobs-per-call is O(1),
+            # the per-task fan-out under it is fully batched.
+            store.insert_entity(names.TABLE_JOBS, pool_id, job.id, {  # shipyard-lint: disable=store-write-in-loop
                 "state": "active",
                 trace_ctx.COL_TRACE_ID: trace.trace_id,
                 trace_ctx.COL_TRACE_SPAN: trace.span_id,
@@ -173,6 +201,25 @@ def add_jobs(store: StateStore, pool: PoolSettings,
             })
         except EntityExistsError:
             raise JobExistsError(f"job {job.id} exists on pool {pool_id}")
+        if job.server_side_expansion:
+            # O(1) client leg: park the generator spec as ONE
+            # expansion row; the pool's leader-gated expander
+            # (jobs/expansion.py) materializes rows + messages.
+            from batch_shipyard_tpu.jobs import expansion as \
+                expansion_mod
+            expansion_mod.submit_expansion(
+                store, pool_id, job, trace=trace,
+                required_node=required_node)
+            trace_spans.emit(
+                store, pool_id, trace_spans.SPAN_SUBMIT, trace,
+                job_id=job.id, start=submit_started, end=time.time(),
+                attrs={"tasks": 0, "server_side_expansion": True},
+                self_span=True)
+            logger.info(
+                "job %s submitted for server-side expansion under "
+                "trace %s", job.id, trace.trace_id)
+            submitted[job.id] = 0
+            continue
         pending = _expand_job_tasks(store, job, pool,
                                     required_node=required_node)
         _submit_tasks_batched(store, pool_id, job.id, pending,
@@ -265,71 +312,363 @@ def merge_tasks_into_job(store: StateStore, pool: PoolSettings,
     return len(out)
 
 
-_SUBMIT_CHUNK = 100
+# Adaptive submission chunking: start at the reference's 100-task
+# TaskAddCollection size and grow while a chunk's store-commit time
+# stays under the target — large batches amortize round trips, but an
+# unbounded chunk would turn one slow backend call into a visibility
+# cliff (and a giant all-or-nothing batch on the atomic backends).
+_SUBMIT_CHUNK_MIN = 100
+_SUBMIT_CHUNK_MAX = 10_000
+_SUBMIT_CHUNK_TARGET_SECONDS = 0.25
+
+# Queue-shard autoscale: grow the pool's task_queue_shards while the
+# observed submission rate exceeds what the current shard set should
+# carry. Grow-only — the old shard names are a strict subset of the
+# new set (names.task_queue), so in-flight messages stay claimable
+# and producers/consumers may disagree about the count transiently
+# without stranding a queue.
+_SHARD_TASKS_PER_SECOND = 2500.0
+_MAX_AUTOSCALE_SHARDS = 32
+
+# pool_queue_shards cache: per-(store, pool), TTL-bounded. Bulk
+# submission used to pay one pool-entity read per chunk for a value
+# that changes only on resize/autoscale; the WeakKey keeps a store's
+# cache from outliving the store (tests build thousands).
+_SHARDS_CACHE_TTL = 15.0
+_shards_cache: "weakref.WeakKeyDictionary[StateStore, dict]" = \
+    weakref.WeakKeyDictionary()
+_shards_cache_lock = threading.Lock()
 
 
-def pool_queue_shards(store: StateStore, pool_id: str) -> int:
+def pool_queue_shards(store: StateStore, pool_id: str,
+                      ttl: Optional[float] = _SHARDS_CACHE_TTL) -> int:
     """Task-queue shard count for a pool, read from its stored spec
     (so cross-pool producers — federation, migrate — route to the
-    TARGET pool's sharding, not the caller's)."""
+    TARGET pool's sharding, not the caller's). Cached per
+    (store, pool) for ``ttl`` seconds; pass ``ttl=0`` to force a
+    fresh read. Resize/autoscale invalidate the writer's own cache
+    eagerly (invalidate_pool_queue_shards); other processes converge
+    within the TTL, which grow-only sharding makes safe."""
+    now = time.monotonic()
+    if ttl:
+        with _shards_cache_lock:
+            hit = _shards_cache.get(store, {}).get(pool_id)
+            if hit is not None and now - hit[1] < ttl:
+                return hit[0]
     try:
         pool = store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+        shards = int(pool.get("spec", {})
+                     .get("pool_specification", {})
+                     .get("task_queue_shards", 1))
     except NotFoundError:
-        return 1
-    return int(pool.get("spec", {}).get("pool_specification", {})
-               .get("task_queue_shards", 1))
+        return 1  # transient (pool mid-create): never cache it
+    with _shards_cache_lock:
+        try:
+            _shards_cache.setdefault(store, {})[pool_id] = (shards,
+                                                            now)
+        except TypeError:
+            pass  # un-weakref-able store stand-in: skip caching
+    return shards
+
+
+def invalidate_pool_queue_shards(store: Optional[StateStore] = None,
+                                 pool_id: Optional[str] = None
+                                 ) -> None:
+    """Drop cached shard counts — for one (store, pool), one store,
+    or everything. Called by pool resize and the submission-rate
+    autoscale so the writer's next routing decision sees its own
+    update immediately."""
+    with _shards_cache_lock:
+        if store is None:
+            for per_store in _shards_cache.values():
+                if pool_id is None:
+                    per_store.clear()
+                else:
+                    per_store.pop(pool_id, None)
+        elif pool_id is None:
+            _shards_cache.pop(store, None)
+        else:
+            _shards_cache.get(store, {}).pop(pool_id, None)
+
+
+def maybe_autoscale_queue_shards(store: StateStore, pool_id: str,
+                                 tasks_per_second: float) -> int:
+    """Grow ``task_queue_shards`` to match an observed submission
+    rate (the tentpole's autoscale hook: called by the streaming
+    submitter and the server-side expander once they can measure
+    their own throughput). Returns the effective shard count.
+    Grow-only and etag-guarded; a lost race just means the other
+    writer's (also grow-only) value stands."""
+    desired = min(_MAX_AUTOSCALE_SHARDS,
+                  max(1, math.ceil(tasks_per_second
+                                   / _SHARD_TASKS_PER_SECOND)))
+    current = pool_queue_shards(store, pool_id, ttl=0)
+    if desired <= current:
+        return current
+    try:
+        pool = store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+        spec = dict(pool.get("spec", {}))
+        pool_spec = dict(spec.get("pool_specification", {}))
+        if int(pool_spec.get("task_queue_shards", 1)) >= desired:
+            return int(pool_spec["task_queue_shards"])
+        pool_spec["task_queue_shards"] = desired
+        spec["pool_specification"] = pool_spec
+        store.merge_entity(names.TABLE_POOLS, "pools", pool_id,
+                           {"spec": spec}, if_match=pool["_etag"])
+    except (NotFoundError, EtagMismatchError):
+        return current
+    invalidate_pool_queue_shards(store, pool_id)
+    logger.info("task queue shards for pool %s grown %d -> %d "
+                "(observed %.0f tasks/s)", pool_id, current, desired,
+                tasks_per_second)
+    return desired
+
+
+def _encode_chunk_messages(pool_id: str, job_id: str,
+                           chunk: list[tuple[str, dict]],
+                           shards: int, priority: int,
+                           trace: Optional[trace_ctx.TraceContext],
+                           ) -> dict[str, list[bytes]]:
+    """Encode one chunk's queue payloads, amortizing the JSON work:
+    the shared head/tail of every message is serialized once and the
+    per-task/per-instance remainder is string-assembled — emitting
+    bytes identical to a per-message json.dumps of
+    {"job_id", "task_id"[, "trace_id"][, "instance"]} in that key
+    order (the equivalence property test pins this)."""
+    head = '{"job_id": ' + json.dumps(job_id) + ', "task_id": '
+    tail = (', "trace_id": ' + json.dumps(trace.trace_id)
+            if trace is not None else '')
+    by_queue: dict[str, list[bytes]] = {}
+    for task_id, spec in chunk:
+        # Per-task numeric priority routes the band (a task may
+        # override its job's priority); the job-level param is the
+        # legacy fallback for specs without one.
+        queue = names.task_queue_for(
+            pool_id, task_id, shards,
+            priority=int(spec.get("priority", priority) or 0))
+        base = head + json.dumps(task_id) + tail
+        num_instances = (spec.get("multi_instance") or {}).get(
+            "num_instances")
+        if num_instances:
+            # Gang fan-out is part of the batched encode: one shared
+            # body + the instance index, not one json.dumps per
+            # instance.
+            by_queue.setdefault(queue, []).extend(
+                (base + ', "instance": ' + str(k) + '}').encode()
+                for k in range(num_instances))
+        else:
+            by_queue.setdefault(queue, []).append(
+                (base + '}').encode())
+    return by_queue
+
+
+def _insert_rows_tolerant(store: StateStore, rows: list[tuple]) -> None:
+    """Batch insert that treats EntityExistsError as already-applied
+    (the WAL replay discipline): the server-side expander's resume
+    path re-submits the chunk its predecessor may have half-landed,
+    and re-inserted rows must converge instead of erroring."""
+    try:
+        store.insert_entities(names.TABLE_TASKS, rows)
+    except EntityExistsError:
+        for pk, rk, entity in rows:
+            try:
+                store.insert_entity(names.TABLE_TASKS, pk, rk,  # shipyard-lint: disable=store-write-in-loop
+                                    entity)
+            except EntityExistsError:
+                pass
 
 
 def _submit_tasks_batched(store: StateStore, pool_id: str, job_id: str,
                           tasks: list[tuple[str, dict]],
                           priority: int = 0,
                           trace: Optional[
-                              trace_ctx.TraceContext] = None) -> None:
-    """Chunked batch submission (the reference's 100-task
-    TaskAddCollection chunks, batch.py:4313): one entity batch + one
-    message batch per shard per chunk instead of 2N store round
-    trips, with messages fanned out over the pool's queue shards.
+                              trace_ctx.TraceContext] = None,
+                          stats: Optional[dict] = None,
+                          tolerate_existing: bool = False) -> None:
+    """Streaming pipelined batch submission (supersedes the fixed
+    100-task chunks of the reference's TaskAddCollection,
+    batch.py:4313). Three overlapped legs connected by bounded
+    queues:
+
+        encode (caller thread) -> entity insert -> queue enqueue
+
+    so while chunk N's rows commit, chunk N+1 encodes and chunk N-1's
+    messages enqueue — a chunk's messages still strictly FOLLOW its
+    rows (an agent must never claim a message whose task row is not
+    yet readable). Chunk size adapts to the measured store-commit
+    time (slow start from _SUBMIT_CHUNK_MIN toward the target
+    seconds), and the shard autoscale hook runs once a rate is
+    observable.
+
     ``priority`` selects the queue band agents drain first. ``trace``
-    is the submission's context: each task row is stamped with the
-    trace id plus its own root span (child of the submit span), and
-    queue messages carry the trace id."""
+    stamps each row with the trace id + its own root span and each
+    message with the trace id. ``stats`` (optional dict) accumulates
+    the submit-leg breakdown: encode/entity/enqueue seconds and task/
+    message counts. ``tolerate_existing`` re-applies rows
+    idempotently (expander resume)."""
+    if not tasks:
+        return
     pk = names.task_pk(pool_id, job_id)
     shards = pool_queue_shards(store, pool_id)
     submitted_at = util.datetime_utcnow_iso()
-    for chunk_start in range(0, len(tasks), _SUBMIT_CHUNK):
-        chunk = tasks[chunk_start:chunk_start + _SUBMIT_CHUNK]
+    out: dict = {"encode_seconds": 0.0, "entity_seconds": 0.0,
+                 "enqueue_seconds": 0.0, "tasks": 0, "messages": 0,
+                 "chunks": 0, "shards": shards}
+    insert_rows = _insert_rows_tolerant if tolerate_existing else (
+        lambda s, rows: s.insert_entities(names.TABLE_TASKS, rows))
+
+    if len(tasks) <= _SUBMIT_CHUNK_MIN:
+        # Inline path: one chunk needs no pipeline (and retry
+        # requeues / unit submissions shouldn't pay two thread
+        # spawns per task).
+        t0 = time.monotonic()
         rows = []
-        for task_id, spec in chunk:
-            entity = {
-                "state": "pending", "spec": spec, "retries": 0,
-                "submitted_at": submitted_at,
-            }
+        for task_id, spec in tasks:
+            entity = {"state": "pending", "spec": spec, "retries": 0,
+                      "submitted_at": submitted_at}
             if trace is not None:
                 entity.update(trace.child().entity_columns())
             rows.append((pk, task_id, entity))
-        store.insert_entities(names.TABLE_TASKS, rows)
-        by_queue: dict[str, list[bytes]] = {}
-        for task_id, spec in chunk:
-            # Per-task numeric priority routes the band (a task may
-            # override its job's priority); the job-level param is
-            # the legacy fallback for specs without one.
-            queue = names.task_queue_for(
-                pool_id, task_id, shards,
-                priority=int(spec.get("priority", priority) or 0))
-            message = {"job_id": job_id, "task_id": task_id}
+        by_queue = _encode_chunk_messages(pool_id, job_id, tasks,
+                                          shards, priority, trace)
+        out["encode_seconds"] = time.monotonic() - t0
+        t0 = time.monotonic()
+        insert_rows(store, rows)
+        out["entity_seconds"] = time.monotonic() - t0
+        t0 = time.monotonic()
+        for queue_name, payloads in by_queue.items():
+            store.put_messages(queue_name, payloads)
+            out["messages"] += len(payloads)
+        out["enqueue_seconds"] = time.monotonic() - t0
+        out["tasks"] = len(tasks)
+        out["chunks"] = 1
+        if stats is not None:
+            for key, value in out.items():
+                if isinstance(value, (int, float)) and key != "shards":
+                    stats[key] = stats.get(key, 0) + value
+            stats["shards"] = out["shards"]
+        return
+
+    # Bounded handoffs: depth 2 keeps all three legs busy without
+    # letting a fast encoder pile unbounded row batches in memory.
+    entity_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+    enqueue_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+    errors: list[BaseException] = []
+    # Feedback from the store legs to the (caller-thread) encoder:
+    # the slowest observed store-commit time for the last chunk size
+    # drives the adaptation.
+    feedback = {"commit_seconds": 0.0, "rows": 0}
+    feedback_lock = threading.Lock()
+
+    def entity_leg() -> None:
+        try:
+            while True:
+                item = entity_q.get()
+                if item is None:
+                    enqueue_q.put(None)
+                    return
+                rows, by_queue = item
+                t0 = time.monotonic()
+                insert_rows(store, rows)
+                dt = time.monotonic() - t0
+                out["entity_seconds"] += dt
+                with feedback_lock:
+                    feedback["commit_seconds"] = dt
+                    feedback["rows"] = len(rows)
+                enqueue_q.put((len(rows), by_queue))
+        except BaseException as exc:  # noqa: BLE001 - rethrown below
+            errors.append(exc)
+            enqueue_q.put(None)
+            # Drain so the producer's bounded put never deadlocks.
+            while entity_q.get() is not None:
+                pass
+
+    def enqueue_leg() -> None:
+        try:
+            while True:
+                item = enqueue_q.get()
+                if item is None:
+                    return
+                nrows, by_queue = item
+                t0 = time.monotonic()
+                for queue_name, payloads in by_queue.items():
+                    store.put_messages(queue_name, payloads)
+                    out["messages"] += len(payloads)
+                out["enqueue_seconds"] += time.monotonic() - t0
+                out["tasks"] += nrows
+                out["chunks"] += 1
+        except BaseException as exc:  # noqa: BLE001 - rethrown below
+            errors.append(exc)
+            while enqueue_q.get() is not None:
+                pass
+
+    threads = [threading.Thread(target=entity_leg,
+                                name="submit-entities", daemon=True),
+               threading.Thread(target=enqueue_leg,
+                                name="submit-enqueue", daemon=True)]
+    for t in threads:
+        t.start()
+    chunk_size = _SUBMIT_CHUNK_MIN
+    started = time.monotonic()
+    autoscaled = False
+    position = 0
+    try:
+        while position < len(tasks) and not errors:
+            chunk = tasks[position:position + chunk_size]
+            position += len(chunk)
+            t0 = time.monotonic()
+            rows = []
             if trace is not None:
-                message["trace_id"] = trace.trace_id
-            num_instances = (spec.get("multi_instance") or {}).get(
-                "num_instances")
-            if num_instances:
-                by_queue.setdefault(queue, []).extend(
-                    json.dumps({**message, "instance": k}).encode()
-                    for k in range(num_instances))
+                for task_id, spec in chunk:
+                    entity = {"state": "pending", "spec": spec,
+                              "retries": 0,
+                              "submitted_at": submitted_at}
+                    entity.update(trace.child().entity_columns())
+                    rows.append((pk, task_id, entity))
             else:
-                by_queue.setdefault(queue, []).append(
-                    json.dumps(message).encode())
-        for queue, payloads in by_queue.items():
-            store.put_messages(queue, payloads)
+                rows = [(pk, task_id,
+                         {"state": "pending", "spec": spec,
+                          "retries": 0, "submitted_at": submitted_at})
+                        for task_id, spec in chunk]
+            by_queue = _encode_chunk_messages(
+                pool_id, job_id, chunk, shards, priority, trace)
+            out["encode_seconds"] += time.monotonic() - t0
+            entity_q.put((rows, by_queue))
+            # Adapt: grow while the store leg commits chunks faster
+            # than the target, shrink when a chunk blew past it.
+            with feedback_lock:
+                commit, nrows = (feedback["commit_seconds"],
+                                 feedback["rows"])
+            if nrows:
+                if commit < _SUBMIT_CHUNK_TARGET_SECONDS / 2:
+                    chunk_size = min(_SUBMIT_CHUNK_MAX,
+                                     chunk_size * 2)
+                elif commit > _SUBMIT_CHUNK_TARGET_SECONDS * 2:
+                    chunk_size = max(_SUBMIT_CHUNK_MIN,
+                                     chunk_size // 2)
+            if not autoscaled:
+                elapsed = time.monotonic() - started
+                if elapsed >= 1.0 and position < len(tasks):
+                    autoscaled = True
+                    rate = position / elapsed
+                    new_shards = maybe_autoscale_queue_shards(
+                        store, pool_id, rate)
+                    if new_shards > shards:
+                        # Grow-only: chunks already routed with the
+                        # old count stay claimable (subset property).
+                        shards = new_shards
+                        out["shards"] = shards
+    finally:
+        entity_q.put(None)
+        for t in threads:
+            t.join()
+    if stats is not None:
+        for key, value in out.items():
+            if isinstance(value, (int, float)) and key != "shards":
+                stats[key] = stats.get(key, 0) + value
+        stats["shards"] = out["shards"]
+    if errors:
+        raise errors[0]
 
 
 def _submit_task(store: StateStore, pool_id: str, job_id: str,
@@ -364,22 +703,64 @@ def get_task(store: StateStore, pool_id: str, job_id: str,
         raise JobNotFoundError(f"{job_id}/{task_id}")
 
 
-def wait_for_tasks(store: StateStore, pool_id: str, job_id: str,
-                   timeout: float = 600.0,
-                   poll_interval: float = 0.2) -> list[dict]:
-    """Block until all tasks of a job are terminal; returns them."""
+def job_task_summary(store: StateStore, pool_id: str,
+                     job_id: str) -> dict:
+    """Terminal-state summary of one job via the server-side group
+    count (count_entities_by): {"total", "terminal", "by_state"} —
+    one aggregate read instead of listing every task row. At 10^6
+    tasks this is what makes a wait poll loop usable."""
+    counts = store.count_entities_by(
+        names.TABLE_TASKS, names.task_pk(pool_id, job_id))
+    total = sum(counts.values())
+    terminal = sum(counts.get(state, 0)
+                   for state in names.TERMINAL_TASK_STATES)
+    return {"total": total, "terminal": terminal, "by_state": counts}
+
+
+def wait_for_job_summary(store: StateStore, pool_id: str, job_id: str,
+                         timeout: float = 600.0,
+                         poll_interval: float = 0.2,
+                         on_progress=None) -> dict:
+    """Block until every task of a job is terminal, polling the O(1)
+    summary (never the task list). A pending server-side expansion
+    gates completion: until the expander reports the job fully
+    materialized, an all-terminal count only covers the prefix it has
+    landed so far. Returns the final summary; ``on_progress`` (if
+    given) is called with each interim summary."""
+    from batch_shipyard_tpu.jobs import expansion as expansion_mod
     deadline = time.monotonic() + timeout
     while True:
-        tasks = list_tasks(store, pool_id, job_id)
-        if tasks and all(t.get("state") in
-                         names.TERMINAL_TASK_STATES
-                         for t in tasks):
-            return tasks
+        summary = job_task_summary(store, pool_id, job_id)
+        expansion = expansion_mod.expansion_state(store, pool_id,
+                                                 job_id)
+        if expansion == "failed":
+            raise RuntimeError(
+                f"server-side expansion of {job_id} failed: "
+                f"{expansion_mod.expansion_error(store, pool_id, job_id)}")
+        expanded = expansion is None or expansion == "completed"
+        if expanded and summary["total"] and \
+                summary["terminal"] == summary["total"]:
+            return summary
+        if on_progress is not None:
+            on_progress(summary)
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"tasks of {job_id} not terminal after {timeout}s: "
-                f"{ {t['_rk']: t.get('state') for t in tasks} }")
+                f"{summary['by_state']}"
+                + ("" if expanded else
+                   f" (expansion {expansion})"))
         time.sleep(poll_interval)
+
+
+def wait_for_tasks(store: StateStore, pool_id: str, job_id: str,
+                   timeout: float = 600.0,
+                   poll_interval: float = 0.2) -> list[dict]:
+    """Block until all tasks of a job are terminal; returns them.
+    Polls the counting summary (one aggregate read per tick) and
+    lists the full task set exactly once, at the end."""
+    wait_for_job_summary(store, pool_id, job_id, timeout=timeout,
+                         poll_interval=poll_interval)
+    return list_tasks(store, pool_id, job_id)
 
 
 def get_task_output(store: StateStore, pool_id: str, job_id: str,
@@ -438,7 +819,9 @@ def terminate_job(store: StateStore, pool_id: str, job_id: str,
                 pass
     for row in store.query_entities(names.TABLE_JOBPREP,
                                     partition_key=pk):
-        store.put_message(
+        # One message per DISTINCT per-node control queue — there is
+        # no batch to combine across queues.
+        store.put_message(  # shipyard-lint: disable=store-write-in-loop
             names.control_queue(pool_id, row["_rk"]),
             json.dumps({"type": "job_release",
                         "job_id": job_id}).encode())
@@ -508,14 +891,21 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
         "created_at": job.get("created_at"),
         "migrated_from": src_pool_id,
     })
-    dst_shards = pool_queue_shards(store, dst_pool_id)
+    dst_shards = pool_queue_shards(store, dst_pool_id, ttl=0)
     job_priority = int(job.get("spec", {}).get("priority", 0) or 0)
+    # Batched commit (the store-write-in-loop showcase fix): build
+    # every destination row and message first, then land them as
+    # batches — rows strictly before messages, so a destination
+    # agent can never claim a message whose task row is unreadable.
+    # Source-row deletes follow last: a crash mid-migrate leaves
+    # duplicate claim-proof rows (job stays disabled), never a task
+    # that exists nowhere.
+    rows: list[tuple[str, str, dict]] = []
+    by_queue: dict[str, list[bytes]] = {}
     for task in tasks:
         entity = {k: v for k, v in task.items()
                   if not k.startswith("_")}
-        store.insert_entity(names.TABLE_TASKS, dst_pk, task["_rk"],
-                            entity)
-        store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
+        rows.append((dst_pk, task["_rk"], entity))
         if entity.get("state") in names.CLAIMABLE_TASK_STATES:
             # Per-task priority routes the band, same rule as
             # submission — a hi-band task must not lose its drain
@@ -537,14 +927,12 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
                 effective = int(
                     entity.get(names.TASK_COL_GANG_SIZE)
                     or num_instances)
-                for k in range(effective):
-                    store.put_message(
-                        dst_queue,
-                        json.dumps({**message,
-                                    "instance": k}).encode())
+                by_queue.setdefault(dst_queue, []).extend(
+                    json.dumps({**message, "instance": k}).encode()
+                    for k in range(effective))
             else:
-                store.put_message(
-                    dst_queue, json.dumps(message).encode())
+                by_queue.setdefault(dst_queue, []).append(
+                    json.dumps(message).encode())
             moved += 1
         if (entity.get("spec", {}).get("multi_instance")
                 or {}).get("num_instances"):
@@ -568,6 +956,13 @@ def migrate_job(store: StateStore, src_pool_id: str, job_id: str,
                                             gang_row["_rk"])
                     except NotFoundError:
                         pass
+    for start in range(0, len(rows), _SUBMIT_CHUNK_MIN):
+        store.insert_entities(names.TABLE_TASKS,
+                              rows[start:start + _SUBMIT_CHUNK_MIN])
+    for dst_queue, payloads in by_queue.items():
+        store.put_messages(dst_queue, payloads)
+    for task in tasks:
+        store.delete_entity(names.TABLE_TASKS, src_pk, task["_rk"])
     store.delete_entity(names.TABLE_JOBS, src_pool_id, job_id)
     return moved
 
@@ -578,7 +973,9 @@ def cleanup_mi_containers(store: StateStore, pool_id: str) -> int:
     count = 0
     for node in store.query_entities(names.TABLE_NODES,
                                      partition_key=pool_id):
-        store.put_message(
+        # One message per DISTINCT per-node control queue — no batch
+        # exists across queues.
+        store.put_message(  # shipyard-lint: disable=store-write-in-loop
             names.control_queue(pool_id, node["_rk"]),
             json.dumps({"type": "cleanup_mi"}).encode())
         count += 1

@@ -103,7 +103,10 @@ def run_due_schedules(store: StateStore, pool: PoolSettings,
                 store.merge_entity(_SCHED_TABLE, pool.id, job.id,
                                    claim, if_match=etag)
             else:
-                store.insert_entity(_SCHED_TABLE, pool.id, job.id,
+                # Insert-as-claim: EntityExistsError IS the
+                # concurrent-evaluator signal; batching would
+                # destroy the per-schedule claim semantics.
+                store.insert_entity(_SCHED_TABLE, pool.id, job.id,  # shipyard-lint: disable=store-write-in-loop
                                     claim)
         except (EtagMismatchError, EntityExistsError):
             logger.info("schedule %s: recurrence %d claimed by a "

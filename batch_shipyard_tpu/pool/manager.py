@@ -183,6 +183,12 @@ def resize_pool(store: StateStore, substrate: ComputeSubstrate,
                 wait: bool = True) -> None:
     store.merge_entity(names.TABLE_POOLS, "pools", pool.id,
                        {"state": "resizing"})
+    # A resize may rewrite the pool spec (shard autoscale rides the
+    # same entity): drop this process's cached task-queue shard count
+    # so submitters re-read it instead of routing on a stale fan-out
+    # for a full TTL.
+    from batch_shipyard_tpu.jobs import manager as jobs_mgr
+    jobs_mgr.invalidate_pool_queue_shards(store, pool.id)
     substrate.resize_pool(pool, num_slices)
     if wait:
         if pool.tpu is not None:

@@ -48,7 +48,12 @@ logger = util.get_logger(__name__)
 ROLE_GANG_JANITOR = "gang-janitor"
 ROLE_PREEMPT_SWEEP = "preempt-sweep"
 ROLE_FED_ELASTIC = "fed-elastic"
-AGENT_LEADER_ROLES = (ROLE_GANG_JANITOR, ROLE_PREEMPT_SWEEP)
+# Server-side task-factory expander (jobs/expansion.py): exactly one
+# agent per pool materializes submitted generator specs into task
+# rows + queue messages, fenced per chunk like any other sweep.
+ROLE_EXPANDER = "task-expander"
+AGENT_LEADER_ROLES = (ROLE_GANG_JANITOR, ROLE_PREEMPT_SWEEP,
+                      ROLE_EXPANDER)
 
 
 class LeaderLease:

@@ -373,6 +373,21 @@ class LocalFSStateStore(base.StateStore):
             self._save_db(f"table_{table}", db)
             return etags
 
+    def count_entities_by(self, table: str, partition_key: str,
+                          column: str = "state") -> dict[str, int]:
+        """One db load, no per-row result dicts (the summary-poll
+        fast path; see base.count_entities_by)."""
+        with self._locked():
+            db = self._load_db(f"table_{table}")
+        counts: dict[str, int] = {}
+        prefix = f"{partition_key}\x01"
+        for key, record in db.items():
+            if not key.startswith(prefix):
+                continue
+            value = str(record["entity"].get(column) or "")
+            counts[value] = counts.get(value, 0) + 1
+        return counts
+
     def get_messages(self, queue: str, max_messages: int = 1,
                      visibility_timeout: float = 30.0,
                      ) -> list[QueueMessage]:

@@ -28,6 +28,7 @@ TABLE_FEDJOBS = "fedjobs"      # pk=federation_id,     rk=job id
 TABLE_SLURM = "slurm"          # pk=cluster_id,        rk=host/partition
 TABLE_REMOTEFS = "remotefs"    # pk="remotefs",        rk=cluster_id
 TABLE_REMOTEFS_NODES = "remotefs_nodes"  # pk=cluster_id, rk=node name
+TABLE_EXPANSIONS = "expansions"  # pk=pool_id,         rk=job_id
 
 
 # Entity state vocabularies. Every "state" literal written to a task
@@ -71,7 +72,22 @@ NODE_STATES = ("creating", "starting", "idle", "running", "offline",
 # registry, same AST enforcement.
 AUX_STATES = ("joined", "done", "active", "disabled", "terminated",
               "completed", "resizing", "ready", "allocation_failed",
-              "deleted", "defined", "provisioned")
+              "deleted", "defined", "provisioned", "expanding")
+
+# Server-side task-factory expansion rows (TABLE_EXPANSIONS): one row
+# per `jobs add --server-expand` job holding the raw generator spec;
+# the leader-gated pool expander (jobs/expansion.py) walks it through
+# pending -> expanding -> completed/failed, etag-fencing a resumable
+# cursor so a crashed expander's successor re-derives the factory
+# deterministically and continues where the chunk commits stopped.
+EXPANSION_STATES = ("pending", "expanding", "completed", "failed")
+#   cursor — count of tasks already materialized (rows + messages
+#            committed); the deterministic factory replays past it
+#   stats  — submit-leg breakdown stamped at completion:
+#            {expanded, expand_seconds, entity_seconds,
+#             enqueue_seconds, encode_seconds}
+EXPANSION_COL_CURSOR = "cursor"
+EXPANSION_COL_STATS = "stats"
 
 # Node-entity health columns (written by the node agent's health
 # scorer, read by claim exclusion + heimdall gauges).

@@ -43,6 +43,26 @@ concurrency protocols. Lease ops are deliberately NOT wrapped at
 all: a leader partitioned from the store must fail its renewal and
 abdicate honestly (state/leases.py), not have this wrapper pretend
 the lease extended.
+
+**Group commit** (the WAL's ordered-journal discipline turned into a
+write-combining throughput lane): inside a ``group_commit()`` block —
+or always, when constructed with ``group_commit_rows > 0`` — the two
+batch write ops (``insert_entities`` / ``put_messages``) buffer into
+an ordered in-memory journal instead of hitting the backend per call.
+Adjacent calls against the same (op, target) coalesce into ONE
+backend round trip; the buffer flushes when it reaches the row cap,
+when the oldest buffered write exceeds the flush interval, when ANY
+other managed op runs (flush-on-read: a reader can never observe the
+store ahead of writes this wrapper already accepted), and on block
+exit. Semantics are preserved at the flush boundary: semantic errors
+(EntityExistsError et al) surface from the flushing call; per-key
+ordering holds because entries never reorder and only coalesce into
+the journal tail. A transport fault mid-batch switches that entry to
+per-row idempotent repair — the replay discipline: re-insert every
+row, treating EntityExistsError as an already-applied success — so a
+faulted batch is always driven to fully-applied, never left torn.
+Queue batches retry whole (duplicates are the queue contract's
+at-least-once, which agents already tolerate).
 """
 
 from __future__ import annotations
@@ -71,8 +91,13 @@ _MANAGED_OPS = frozenset({
     "get_entity", "query_entities", "delete_entity",
     "insert_entities", "put_message", "put_messages", "get_messages",
     "delete_message", "update_message", "queue_length",
+    "count_entities_by",
     "put_object_stream", "get_object_stream",
 })
+
+# Batch write ops the group-commit layer may buffer (everything else
+# flushes the buffer first, so ordering across op kinds is preserved).
+_GROUP_COMMIT_OPS = frozenset({"insert_entities", "put_messages"})
 
 # Successful round trips wearing exception suits: never retried,
 # never journaled, always propagated.
@@ -98,6 +123,8 @@ class ResilientStore:
                  retry_base: float = 0.25, retry_cap: float = 5.0,
                  max_outage_seconds: float = 900.0,
                  probe_interval: float = 1.0,
+                 group_commit_rows: int = 0,
+                 group_commit_interval: float = 0.05,
                  stop_check=None) -> None:
         self._inner = inner
         self._journal_path = journal_path
@@ -128,6 +155,24 @@ class ResilientStore:
         self._tls = threading.local()
         self.outage_seconds_total = 0.0
         self.outages_total = 0
+        # Group-commit state. ``_gc_ambient_rows > 0`` turns the lane
+        # on for the wrapper's whole lifetime; ``group_commit()``
+        # blocks turn it on lexically. The flush lock is re-entrant
+        # because a flush can recover an outage, which emits a goodput
+        # event through SELF (see _emit_outage_event) — that advisory
+        # write must not deadlock on its own flush-on-write.
+        self._gc_ambient_rows = max(0, int(group_commit_rows))
+        self._gc_interval = group_commit_interval
+        self._gc_depth = 0
+        self._gc_ctx_rows = 0
+        self._gc_ctx_interval: Optional[float] = None
+        self._gc_buffer: list[dict] = []
+        self._gc_rows = 0
+        self._gc_opened = 0.0
+        self._gc_flush_lock = threading.RLock()
+        self.group_commits_total = 0
+        self.group_commit_rows_total = 0
+        self.group_commit_coalesced_total = 0
         self._load_journal()
 
     # ---------------------------- delegation ---------------------------
@@ -163,6 +208,13 @@ class ResilientStore:
 
     def _call(self, op: str, attr, args: tuple, kwargs: dict) -> Any:
         self._maybe_replay_backlog()
+        if op in _GROUP_COMMIT_OPS and self._group_commit_active():
+            return self._group_commit_buffer(op, args, kwargs)
+        if self._gc_buffer:
+            # Flush-on-read (and on any unbuffered write): no managed
+            # op may observe — or order itself against — the backend
+            # while accepted batch writes are still pending.
+            self.flush_group_commit()
         if op == "put_object_stream":
             return self._critical_put_stream(attr, args, kwargs)
         if op == "get_object_stream":
@@ -299,6 +351,169 @@ class ResilientStore:
             yield self
         finally:
             self._tls.deadline = prior
+
+    # --------------------------- group commit --------------------------
+
+    @contextlib.contextmanager
+    def group_commit(self, max_rows: int = 4096,
+                     flush_interval: Optional[float] = None):
+        """Write-combining region: buffer ``insert_entities`` /
+        ``put_messages`` and coalesce adjacent same-target calls into
+        one backend round trip each. Flushes on the row cap, the
+        flush interval, any other managed op, and block exit (errors
+        from the final flush propagate out of the ``with``). Nested
+        blocks inherit the outermost block's limits."""
+        with self._lock:
+            self._gc_depth += 1
+            outermost = self._gc_depth == 1
+            if outermost:
+                self._gc_ctx_rows = max(1, int(max_rows))
+                self._gc_ctx_interval = (
+                    self._gc_interval if flush_interval is None
+                    else flush_interval)
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._gc_depth -= 1
+                closing = self._gc_depth == 0
+                if closing:
+                    self._gc_ctx_rows = 0
+                    self._gc_ctx_interval = None
+            if closing:
+                self.flush_group_commit()
+
+    def _group_commit_active(self) -> bool:
+        with self._lock:
+            return self._gc_depth > 0 or self._gc_ambient_rows > 0
+
+    def _gc_limits(self) -> tuple[int, float]:
+        if self._gc_depth > 0:
+            return (self._gc_ctx_rows,
+                    self._gc_interval if self._gc_ctx_interval is None
+                    else self._gc_ctx_interval)
+        return self._gc_ambient_rows, self._gc_interval
+
+    def group_commit_pending(self) -> int:
+        """Buffered-but-unflushed row count (test observer)."""
+        with self._lock:
+            return self._gc_rows
+
+    def _group_commit_buffer(self, op: str, args: tuple,
+                             kwargs: dict) -> list:
+        if op == "put_messages":
+            target = args[0] if args else kwargs["queue"]
+            items = list(args[1] if len(args) > 1
+                         else kwargs["payloads"])
+            delay = args[2] if len(args) > 2 \
+                else kwargs.get("delay_seconds", 0.0)
+            key = (op, target, delay)
+        else:
+            target = args[0] if args else kwargs["table"]
+            items = list(args[1] if len(args) > 1 else kwargs["rows"])
+            key = (op, target)
+        if not items:
+            return []
+        do_flush = False
+        with self._lock:
+            now = time.monotonic()
+            if self._gc_buffer and self._gc_buffer[-1]["key"] == key:
+                # Adjacent same-(op, target[, delay]) calls combine.
+                # Only the TAIL is a legal merge target — reaching
+                # past a different-target entry would reorder writes
+                # the caller sequenced deliberately (e.g. task rows
+                # before their queue messages).
+                self._gc_buffer[-1]["items"].extend(items)
+                self.group_commit_coalesced_total += 1
+            else:
+                self._gc_buffer.append(
+                    {"op": op, "key": key, "items": items})
+            self._gc_rows += len(items)
+            self.group_commit_rows_total += len(items)
+            if not self._gc_opened:
+                self._gc_opened = now
+            rows_cap, interval = self._gc_limits()
+            if self._gc_rows >= rows_cap or \
+                    now - self._gc_opened >= interval:
+                do_flush = True
+        if do_flush:
+            self.flush_group_commit()
+        # Buffered writes cannot return backend etags / message ids;
+        # placeholders keep the shape. (Submission ignores them — a
+        # caller that needs real etags reads after the flush.)
+        return [_JOURNALED_ETAG] * len(items)
+
+    def flush_group_commit(self) -> None:
+        """Apply the buffered entries IN ORDER. Transport faults on
+        an entity batch demote that entry to per-row idempotent
+        repair (EntityExistsError == already applied — the WAL replay
+        discipline), so a faulted batch always ends fully applied,
+        never torn. If even the repair path exhausts the outage
+        ceiling, every unapplied entry is re-queued at the FRONT of
+        the buffer before the error propagates — accepted writes are
+        never silently dropped. Semantic errors apply the remaining
+        entries first, then the first one raises (deferred-error
+        surfacing at the flush boundary)."""
+        with self._gc_flush_lock:
+            with self._lock:
+                entries = self._gc_buffer
+                self._gc_buffer = []
+                self._gc_rows = 0
+                self._gc_opened = 0.0
+            if not entries:
+                return
+            first_semantic: Optional[BaseException] = None
+            for idx, entry in enumerate(entries):
+                try:
+                    self._gc_apply(entry)
+                except _SEMANTIC_ERRORS as exc:
+                    if first_semantic is None:
+                        first_semantic = exc
+                except Exception:
+                    with self._lock:
+                        remaining = entries[idx:]
+                        self._gc_buffer[:0] = remaining
+                        self._gc_rows += sum(len(e["items"])
+                                             for e in remaining)
+                        if not self._gc_opened:
+                            self._gc_opened = time.monotonic()
+                    raise
+            self.group_commits_total += 1
+            if first_semantic is not None:
+                raise first_semantic
+
+    def _gc_apply(self, entry: dict) -> None:
+        op, key, items = entry["op"], entry["key"], entry["items"]
+        if op == "put_messages":
+            # Whole-batch critical retry: a replayed batch can
+            # double-enqueue rows the faulted attempt already landed,
+            # which is the queue contract's at-least-once — agents
+            # already dedupe via the task-state claim transition.
+            self._critical_call(
+                "put_messages", self._inner.put_messages,
+                (key[1], items), {"delay_seconds": key[2]})
+            return
+        table = key[1]
+        if not entry.get("tolerant"):
+            try:
+                self._inner.insert_entities(table, items)
+                return
+            except _SEMANTIC_ERRORS:
+                raise
+            except Exception:  # noqa: BLE001 - transport: maybe torn
+                self._latch_outage("insert_entities")
+                entry["tolerant"] = True
+        # Per-row repair. items shrinks as rows land so a re-queued
+        # entry resumes exactly where the outage cut it off.
+        while items:
+            pk, rk, entity = items[0]
+            try:
+                self._critical_call(
+                    "insert_entity", self._inner.insert_entity,
+                    (table, pk, rk, entity), {})
+            except EntityExistsError:
+                pass  # applied before the fault — repair made whole
+            items.pop(0)
 
     def _critical_call(self, op: str, attr, args: tuple,
                        kwargs: dict) -> Any:

@@ -154,7 +154,9 @@ class LocalhostSubstrate(base.ComputeSubstrate):
                 except subprocess.TimeoutExpired:
                     proc.kill()
             else:
-                self.store.put_message(
+                # Distinct per-node control queue each iteration —
+                # nothing to batch.
+                self.store.put_message(  # shipyard-lint: disable=store-write-in-loop
                     names.control_queue(pool_id, node_id),
                     json.dumps({"type": "shutdown"}).encode())
                 # Wait for the agent's final offline heartbeat so a

@@ -284,6 +284,7 @@ def ingest_local_spans(store: StateStore, pool_id: str, path: str, *,
     if not os.path.exists(path):
         return 0
     count = 0
+    rows: list[tuple[str, str, dict]] = []
     try:
         with open(path, encoding="utf-8") as fh:
             for line in fh:
@@ -309,24 +310,29 @@ def ingest_local_spans(store: StateStore, pool_id: str, path: str, *,
                 attrs = event.get("attrs")
                 if not isinstance(attrs, dict):
                     attrs = {}
-                try:
-                    row_key = f"{start:017.6f}${uuid.uuid4().hex[:8]}"
-                    store.insert_entity(
-                        names.TABLE_TRACE, pool_id, row_key, {
-                            "kind": event["kind"],
-                            "trace_id": str(trace_id),
-                            "span_id": str(span_id),
-                            "parent_span_id":
-                                event.get("parent_span_id"),
-                            "job_id": job_id, "task_id": task_id,
-                            "node_id": node_id,
-                            "start": start, "end": end,
-                            "attrs": attrs,
-                        })
-                    count += 1
-                except Exception:  # noqa: BLE001 - best effort
-                    logger.debug("trace ingest insert failed",
-                                 exc_info=True)
+                row_key = f"{start:017.6f}${uuid.uuid4().hex[:8]}"
+                rows.append((pool_id, row_key, {
+                    "kind": event["kind"],
+                    "trace_id": str(trace_id),
+                    "span_id": str(span_id),
+                    "parent_span_id": event.get("parent_span_id"),
+                    "job_id": job_id, "task_id": task_id,
+                    "node_id": node_id,
+                    "start": start, "end": end,
+                    "attrs": attrs,
+                }))
+        # One batched insert for the whole file (a task can emit
+        # thousands of spans; per-row writes made ingestion a
+        # round-trip storm on the heartbeat path). Best effort with
+        # the same loss-over-duplication bias as the old per-row
+        # loop: the file is removed either way, so a partial batch
+        # failure drops spans rather than double-counting them on
+        # the next ingest pass.
+        try:
+            store.insert_entities(names.TABLE_TRACE, rows)
+            count = len(rows)
+        except Exception:  # noqa: BLE001 - best effort
+            logger.debug("trace ingest insert failed", exc_info=True)
         os.remove(path)
     except OSError:
         logger.debug("trace ingest failed for %s", path, exc_info=True)

@@ -627,25 +627,31 @@ def bench_ring_collectives(
     }
 
 
-def bench_scheduler_scale(num_tasks: int = 100_000, nodes: int = 8,
+def bench_scheduler_scale(num_tasks: int = 1_000_000, nodes: int = 8,
                           slots: int = 4, shards: int = 8,
                           timeout: float = 3600.0,
                           artifact: bool = True) -> dict:
-    """10^5-task end-to-end scheduler proof (ROADMAP item 3 / the TPU
+    """10^6-task end-to-end scheduler proof (ROADMAP item 3 / the TPU
     concurrency-limits scale wall, arxiv 2011.03641): drive
-    ``num_tasks`` through the REAL scheduling path — batched
-    submission, sharded queue fan-out, claims, state transitions,
-    goodput + trace emission, queue drain — on the CPU fakepod
-    substrate with the in-process task runtime (runtime: "inproc":
-    the task body is a function call in the agent's worker thread, so
-    per-task fork/exec cost stops dominating and the number measures
-    SCHEDULING). Reports end-to-end throughput plus the exact goodput
-    partition over the whole run.
+    ``num_tasks`` through the REAL scheduling path — O(1) client
+    submission of the generator spec (server_side_expansion), the
+    pool's leader-gated expander materializing rows + messages via
+    the streaming pipelined submitter, sharded queue fan-out with
+    grow-only autoscale, batched claims, state transitions, goodput +
+    trace emission, queue drain — on the CPU fakepod substrate with
+    the in-process task runtime (runtime: "inproc": the task body is
+    a function call in the agent's worker thread, so per-task
+    fork/exec cost stops dominating and the number measures
+    SCHEDULING). Reports end-to-end throughput, the submit-leg
+    breakdown (encode vs entity-insert vs enqueue vs expansion wall)
+    and the exact goodput partition over the whole run; the drain
+    loop polls the O(1) counting summary, never the task list.
 
     CPU marker: this is an orchestration measurement — no accelerator
     is involved, and none is claimed."""
     from batch_shipyard_tpu.config import settings as S
     from batch_shipyard_tpu.goodput import accounting
+    from batch_shipyard_tpu.jobs import expansion as expansion_mod
     from batch_shipyard_tpu.jobs import manager as jobs_mgr
     from batch_shipyard_tpu.pool import manager as pool_mgr
     from batch_shipyard_tpu.state import names
@@ -653,9 +659,9 @@ def bench_scheduler_scale(num_tasks: int = 100_000, nodes: int = 8,
     from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
 
     store = MemoryStateStore()
-    substrate = FakePodSubstrate(store, heartbeat_interval=2.0,
+    substrate = FakePodSubstrate(store, heartbeat_interval=1.0,
                                  node_stale_seconds=60.0)
-    # Wide visibility windows: at 10^5 tasks a redelivered duplicate
+    # Wide visibility windows: at 10^6 tasks a redelivered duplicate
     # costs a wasted claim round; nothing here crashes, so recovery
     # latency is irrelevant.
     substrate.agent_kwargs = {"claim_visibility_seconds": 120.0,
@@ -683,38 +689,65 @@ def bench_scheduler_scale(num_tasks: int = 100_000, nodes: int = 8,
                              S.global_settings(conf), conf)
         jobs = S.job_settings_list({"job_specifications": [{
             "id": "scale",
+            "server_side_expansion": True,
             "tasks": [{"task_factory": {"repeat": num_tasks},
                        "runtime": "inproc", "command": "noop"}],
         }]})
         t0 = time.perf_counter()
         jobs_mgr.add_jobs(store, pool, jobs)
-        submit_seconds = time.perf_counter() - t0
+        client_submit_seconds = time.perf_counter() - t0
         t1 = time.perf_counter()
-        tasks = jobs_mgr.wait_for_tasks(store, pool_id, "scale",
-                                        timeout=timeout,
-                                        poll_interval=2.0)
+        # Drain on the O(1) counting summary (count_entities_by): at
+        # 10^6 tasks a poll that listed every row would itself be the
+        # bottleneck. The full task list is never materialized.
+        summary = jobs_mgr.wait_for_job_summary(
+            store, pool_id, "scale", timeout=timeout,
+            poll_interval=2.0)
         run_seconds = time.perf_counter() - t1
-        by_state: dict = {}
-        for task in tasks:
-            state = task.get("state")
-            by_state[state] = by_state.get(state, 0) + 1
+        by_state = summary["by_state"]
+        # Submit-leg breakdown comes from the expansion row the
+        # pool-side expander completed: encode vs entity-insert vs
+        # enqueue seconds, plus the expansion wall (all overlapped
+        # with the agents' drain).
+        exp_row = store.get_entity(names.TABLE_EXPANSIONS, pool_id,
+                                   "scale")
+        exp_stats = dict(exp_row.get(names.EXPANSION_COL_STATS) or {})
+        expansion_wall = float(exp_stats.get("expand_seconds", 0.0))
+        submit_seconds = client_submit_seconds + expansion_wall
         result.update({
+            "server_side_expansion": True,
+            "client_submit_seconds": round(client_submit_seconds, 3),
+            # The materialization leg: client round trip + the
+            # expander's wall clock (which overlaps the drain).
             "submit_seconds": round(submit_seconds, 3),
             "submit_tasks_per_second": round(
-                num_tasks / submit_seconds, 1),
+                num_tasks / max(submit_seconds, 1e-9), 1),
+            "submit_breakdown": {
+                "encode_seconds": round(
+                    float(exp_stats.get("encode_seconds", 0.0)), 3),
+                "entity_seconds": round(
+                    float(exp_stats.get("entity_seconds", 0.0)), 3),
+                "enqueue_seconds": round(
+                    float(exp_stats.get("enqueue_seconds", 0.0)), 3),
+                "expansion_wall_seconds": round(expansion_wall, 3),
+                "chunks": int(exp_stats.get("chunks", 0)),
+                "messages": int(exp_stats.get("messages", 0)),
+                "queue_shards_final": jobs_mgr.pool_queue_shards(
+                    store, pool_id, ttl=0),
+            },
             "run_seconds": round(run_seconds, 3),
             "end_to_end_seconds": round(
-                submit_seconds + run_seconds, 3),
-            # Agents drain WHILE submission fans out, so the honest
-            # headline is end-to-end; the post-submit drain rate is
-            # reported separately.
+                client_submit_seconds + run_seconds, 3),
+            # Expansion and drain overlap, so the honest headline is
+            # end-to-end; the post-submit drain rate is reported
+            # separately.
             "end_to_end_tasks_per_second": round(
-                num_tasks / (submit_seconds + run_seconds), 1),
+                num_tasks / (client_submit_seconds + run_seconds), 1),
             "tasks_per_second": round(num_tasks / run_seconds, 1),
             "by_state": by_state,
             "completed": by_state.get("completed", 0) == num_tasks,
         })
-        # Exact goodput partition over the whole run: 10^5 tasks of
+        # Exact goodput partition over the whole run: 10^6 tasks of
         # accounting input is itself part of the proof (the sweep is
         # O(N log N); a scan that chokes here would choke a real
         # pool's heimdall poll too).
@@ -734,7 +767,9 @@ def bench_scheduler_scale(num_tasks: int = 100_000, nodes: int = 8,
             "goodput_ratio": report["goodput_ratio"],
             "badput_seconds": report["badput_seconds"],
         }
-        queues = names.task_queues(pool_id, shards)
+        final_shards = max(
+            jobs_mgr.pool_queue_shards(store, pool_id, ttl=0), shards)
+        queues = names.task_queues(pool_id, final_shards)
         result["queue_depth_after"] = sum(
             store.queue_length(q) for q in queues)
     finally:
@@ -1006,11 +1041,11 @@ def main(argv: list[str] | None = None) -> int:
         "scheduler_scale; serving_speculative, checkpoint_overhead, "
         "compile_warm, ring_collectives and scheduler_scale are "
         "opt-in — the silicon-proof pipeline runs each as its own "
-        "phase; scheduler_scale drives 10^5 in-process tasks "
+        "phase; scheduler_scale drives 10^6 in-process tasks "
         "through the CPU fakepod scheduler end-to-end)")
     parser.add_argument(
-        "--scale-tasks", type=int, default=100_000,
-        help="scheduler_scale task count (the 10^5 proof)")
+        "--scale-tasks", type=int, default=1_000_000,
+        help="scheduler_scale task count (the 10^6 proof)")
     parser.add_argument(
         "--quick", action="store_true",
         help="fewer timed iterations (tuning A/B mode)")
@@ -1051,7 +1086,7 @@ def main(argv: list[str] | None = None) -> int:
             except Exception as exc:  # noqa: BLE001
                 details["orchestration"] = {"error": str(exc)}
         if "scheduler_scale" in workloads:
-            # Pure orchestration too: the 10^5 proof runs on CPU
+            # Pure orchestration too: the 10^6 proof runs on CPU
             # thread-nodes regardless of accelerator health.
             try:
                 details["scheduler_scale"] = bench_scheduler_scale(
@@ -1209,7 +1244,7 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["orchestration"] = {"error": str(exc)}
     if "scheduler_scale" in workloads:
-        # Opt-in (the 10^5-task end-to-end scheduler proof): CPU
+        # Opt-in (the 10^6-task end-to-end scheduler proof): CPU
         # fakepod + in-process task mode, no accelerator involved.
         try:
             details["scheduler_scale"] = bench_scheduler_scale(

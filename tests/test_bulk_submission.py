@@ -1,0 +1,622 @@
+"""Streaming bulk submission, group-commit writes, batched claims.
+
+The 10^6-task scheduler PR's proof obligations:
+
+  * EQUIVALENCE: the streaming pipelined submitter produces task rows
+    and queue messages BYTE-IDENTICAL to the legacy fixed-chunk
+    submitter it replaced — trace columns, priority-band routing and
+    multi-instance fan-out included. The optimization must be
+    invisible to every consumer.
+  * GROUP COMMIT: coalesced store writes never tear — a transport
+    fault that lands mid-batch converges to exactly-once rows on
+    retry, semantic errors surface without dropping neighbors, and a
+    read inside the block sees every buffered write (flush-on-read).
+  * SERVER-SIDE EXPANSION: a generator spec submitted as ONE row is
+    materialized pool-side by the leader-gated expander and runs to
+    completion with the goodput partition exact.
+  * CLAIM BATCHING: a multi-slot agent takes k messages per poll.
+  * The O(1) counting summary and the shard-count cache behave.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+import pytest
+
+from batch_shipyard_tpu.config import settings as settings_mod
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.jobs import expansion as expansion_mod
+from batch_shipyard_tpu.jobs import manager as jobs_mgr
+from batch_shipyard_tpu.pool import manager as pool_mgr
+from batch_shipyard_tpu.state import names
+from batch_shipyard_tpu.state import resilient as state_resilient
+from batch_shipyard_tpu.state.base import (
+    EntityExistsError, NotFoundError)
+from batch_shipyard_tpu.state.memory import MemoryStateStore
+from batch_shipyard_tpu.trace import context as trace_ctx
+from batch_shipyard_tpu.utils import util
+
+POOL_ID = "bulkpool"
+JOB_ID = "bulk"
+
+
+# ------------------------- equivalence property -------------------------
+
+def _legacy_submit_tasks_batched(store, pool_id, job_id, tasks,
+                                 priority=0, trace=None):
+    """The pre-streaming submitter, verbatim (fixed 100-task chunks,
+    one json.dumps per message): the reference implementation the
+    equivalence property pins the new pipeline against."""
+    chunk_size = 100
+    pk = names.task_pk(pool_id, job_id)
+    pool = store.get_entity(names.TABLE_POOLS, "pools", pool_id)
+    shards = int(pool.get("spec", {}).get("pool_specification", {})
+                 .get("task_queue_shards", 1))
+    submitted_at = util.datetime_utcnow_iso()
+    for chunk_start in range(0, len(tasks), chunk_size):
+        chunk = tasks[chunk_start:chunk_start + chunk_size]
+        rows = []
+        for task_id, spec in chunk:
+            entity = {
+                "state": "pending", "spec": spec, "retries": 0,
+                "submitted_at": submitted_at,
+            }
+            if trace is not None:
+                entity.update(trace.child().entity_columns())
+            rows.append((pk, task_id, entity))
+        store.insert_entities(names.TABLE_TASKS, rows)
+        by_queue = {}
+        for task_id, spec in chunk:
+            queue = names.task_queue_for(
+                pool_id, task_id, shards,
+                priority=int(spec.get("priority", priority) or 0))
+            message = {"job_id": job_id, "task_id": task_id}
+            if trace is not None:
+                message["trace_id"] = trace.trace_id
+            num_instances = (spec.get("multi_instance") or {}).get(
+                "num_instances")
+            if num_instances:
+                by_queue.setdefault(queue, []).extend(
+                    json.dumps({**message, "instance": k}).encode()
+                    for k in range(num_instances))
+            else:
+                by_queue.setdefault(queue, []).append(
+                    json.dumps(message).encode())
+        for queue, payloads in by_queue.items():
+            store.put_messages(queue, payloads)
+
+
+def _make_store(shards):
+    store = MemoryStateStore()
+    store.insert_entity(names.TABLE_POOLS, "pools", POOL_ID, {
+        "state": "ready",
+        "spec": {"pool_specification": {
+            "task_queue_shards": shards}}})
+    return store
+
+
+def _mixed_tasks(n):
+    """A spec mix covering every encoding branch: generic + explicit
+    ids, per-task priority overrides (both bands), and multi-instance
+    gang fan-out."""
+    tasks = []
+    for i in range(n):
+        spec = {"command": f"echo {i}"}
+        if i % 7 == 3:
+            spec["priority"] = -1
+        elif i % 7 == 5:
+            spec["priority"] = 1
+        if i % 11 == 4:
+            spec["multi_instance"] = {"num_instances": 3}
+        tid = f"task-{i:05d}" if i % 5 else f"explicit.{i}"
+        tasks.append((tid, spec))
+    return tasks
+
+
+def _drain_queue(store, queue):
+    payloads = []
+    while True:
+        msgs = store.get_messages(queue, max_messages=32,
+                                  visibility_timeout=600.0)
+        if not msgs:
+            return payloads
+        payloads.extend(m.payload for m in msgs)
+
+
+def _snapshot(store, shards):
+    rows = {}
+    for row in store.query_entities(
+            names.TABLE_TASKS,
+            partition_key=names.task_pk(POOL_ID, JOB_ID)):
+        row = dict(row)
+        row.pop("_etag", None)
+        rows[row["_rk"]] = row
+    queues = {q: _drain_queue(store, q)
+              for q in names.task_queues(POOL_ID, shards)}
+    return rows, queues
+
+
+def _deterministic(monkeypatch):
+    counter = itertools.count()
+    monkeypatch.setattr(
+        trace_ctx, "new_span_id",
+        lambda: f"sp{next(counter):06x}")
+    monkeypatch.setattr(
+        util, "datetime_utcnow_iso",
+        lambda: "2026-01-01T00:00:00.000000Z")
+    return counter
+
+
+@pytest.mark.parametrize("count", [37, 750])
+def test_streaming_submitter_equivalent_to_legacy(monkeypatch, count):
+    """Property: for a mixed workload (priorities, gangs, explicit
+    ids) the streaming submitter's rows AND queue payloads are
+    byte-identical to the legacy chunked submitter's — including the
+    per-task trace columns and band/shard routing. 37 exercises the
+    inline path, 750 the three-leg pipeline."""
+    shards = 3
+    tasks = _mixed_tasks(count)
+    trace = trace_ctx.TraceContext(trace_id="0123456789abcdef",
+                                   span_id="feedf00d")
+
+    _deterministic(monkeypatch)
+    legacy_store = _make_store(shards)
+    _legacy_submit_tasks_batched(legacy_store, POOL_ID, JOB_ID,
+                                 tasks, priority=0, trace=trace)
+    legacy_rows, legacy_queues = _snapshot(legacy_store, shards)
+
+    _deterministic(monkeypatch)  # reset the span counter
+    new_store = _make_store(shards)
+    stats = {}
+    jobs_mgr._submit_tasks_batched(new_store, POOL_ID, JOB_ID, tasks,
+                                   priority=0, trace=trace,
+                                   stats=stats)
+    new_rows, new_queues = _snapshot(new_store, shards)
+
+    assert new_rows == legacy_rows
+    assert new_queues == legacy_queues
+    # And byte-identical, not merely ==, for the payloads:
+    for queue in legacy_queues:
+        assert [bytes(p) for p in new_queues[queue]] == \
+            [bytes(p) for p in legacy_queues[queue]]
+    assert stats["tasks"] == count
+    assert stats["messages"] == sum(
+        (spec.get("multi_instance") or {}).get("num_instances", 1)
+        for _, spec in tasks)
+    assert stats["chunks"] >= 1
+
+
+def test_streaming_submitter_no_trace_no_priority(monkeypatch):
+    """The untraced / default-priority corner emits identical bytes
+    too (no trace columns, single band)."""
+    _deterministic(monkeypatch)
+    tasks = [(f"task-{i:05d}", {"command": "noop"})
+             for i in range(150)]
+    legacy_store = _make_store(2)
+    _legacy_submit_tasks_batched(legacy_store, POOL_ID, JOB_ID, tasks)
+    new_store = _make_store(2)
+    jobs_mgr._submit_tasks_batched(new_store, POOL_ID, JOB_ID, tasks)
+    assert _snapshot(new_store, 2) == _snapshot(legacy_store, 2)
+
+
+def test_tolerant_resubmission_converges(monkeypatch):
+    """tolerate_existing (the expander's resume path): re-submitting
+    an already-landed chunk neither errors nor duplicates rows."""
+    _deterministic(monkeypatch)
+    store = _make_store(1)
+    tasks = _mixed_tasks(30)
+    jobs_mgr._submit_tasks_batched(store, POOL_ID, JOB_ID, tasks,
+                                   tolerate_existing=True)
+    jobs_mgr._submit_tasks_batched(store, POOL_ID, JOB_ID, tasks,
+                                   tolerate_existing=True)
+    rows = list(store.query_entities(
+        names.TABLE_TASKS,
+        partition_key=names.task_pk(POOL_ID, JOB_ID)))
+    assert len(rows) == 30  # exactly once despite the re-apply
+
+
+# ---------------------------- group commit ----------------------------
+
+class _TornBatchStore(MemoryStateStore):
+    """Applies the first ``tear_after`` rows of one insert_entities
+    batch, then dies with a transport error — the partial-apply shape
+    a real backend crash leaves behind."""
+
+    def __init__(self, tear_after=3):
+        super().__init__()
+        self._tear_after = tear_after
+        self._armed = 0
+        self.insert_batches = 0
+
+    def arm(self, times=1):
+        self._armed = times
+
+    def insert_entities(self, table, rows):
+        self.insert_batches += 1
+        if self._armed > 0:
+            self._armed -= 1
+            for pk, rk, entity in rows[:self._tear_after]:
+                self.insert_entity(table, pk, rk, entity)
+            raise ConnectionError("torn mid-batch")
+        return super().insert_entities(table, rows)
+
+
+def _resilient(inner, tmp_path, **kwargs):
+    return state_resilient.ResilientStore(
+        inner, journal_path=str(tmp_path / "wal.jsonl"),
+        retry_base=0.01, retry_cap=0.05, **kwargs)
+
+
+def test_group_commit_coalesces_and_flushes(tmp_path):
+    """Adjacent batch writes coalesce into combined round trips; the
+    block exit flushes everything; reads inside the block see the
+    buffered writes first (flush-on-read)."""
+    raw = MemoryStateStore()
+    rs = _resilient(raw, tmp_path)
+    pk = names.task_pk(POOL_ID, JOB_ID)
+    with rs.group_commit():
+        # Adjacent same-shape writes coalesce tail-wise; the kind
+        # switch below starts a second buffered entry.
+        for i in range(4):
+            rs.insert_entities(names.TABLE_TASKS, [
+                (pk, f"task-{4 * i + j:05d}",
+                 {"state": "pending"}) for j in range(4)])
+        for i in range(4):
+            rs.put_messages("q-0", [b"m%d" % (4 * i + j)
+                                    for j in range(4)])
+        assert rs.group_commit_pending() > 0
+        # Flush-on-read: a managed read op must observe the buffer.
+        rows = list(rs.query_entities(names.TABLE_TASKS,
+                                      partition_key=pk))
+        assert len(rows) == 16
+        assert rs.group_commit_pending() == 0
+    assert rs.group_commits_total >= 1
+    assert rs.group_commit_coalesced_total > 0
+    assert len(list(raw.query_entities(
+        names.TABLE_TASKS, partition_key=pk))) == 16
+    assert raw.queue_length("q-0") == 16
+
+
+def test_group_commit_never_tears_a_batch(tmp_path):
+    """A transport fault that lands HALF an entity batch converges on
+    retry: every row present exactly once, none lost, none doubled —
+    the idempotent per-row repair discipline."""
+    raw = _TornBatchStore(tear_after=5)
+    rs = _resilient(raw, tmp_path)
+    pk = names.task_pk(POOL_ID, JOB_ID)
+    raw.arm(times=1)
+    with rs.group_commit():
+        rs.insert_entities(names.TABLE_TASKS, [
+            (pk, f"task-{i:05d}", {"state": "pending", "n": i})
+            for i in range(12)])
+    rows = {r["_rk"]: r for r in raw.query_entities(
+        names.TABLE_TASKS, partition_key=pk)}
+    assert sorted(rows) == [f"task-{i:05d}" for i in range(12)]
+    assert all(rows[f"task-{i:05d}"]["n"] == i for i in range(12))
+
+
+def test_group_commit_defers_semantic_error_applies_rest(tmp_path):
+    """A semantic error (EntityExistsError) inside a flushed batch is
+    raised at the flush boundary — AFTER the remaining buffered
+    entries applied. Semantic errors are successful round trips, not
+    reasons to drop a neighbor's write."""
+    raw = MemoryStateStore()
+    pk = names.task_pk(POOL_ID, JOB_ID)
+    raw.insert_entity(names.TABLE_TASKS, pk, "task-00001",
+                      {"state": "pending"})
+    rs = _resilient(raw, tmp_path)
+    with pytest.raises(EntityExistsError):
+        with rs.group_commit():
+            rs.insert_entities(names.TABLE_TASKS, [
+                (pk, "task-00001", {"state": "pending"})])
+            rs.put_messages("q-0", [b"survivor"])
+    assert raw.queue_length("q-0") == 1  # the neighbor still landed
+
+
+def test_group_commit_under_chaos_store_faults(tmp_path):
+    """ChaosStore-style transient errors during the flush retry
+    through to exactly-once rows (critical-lane retry + per-row
+    repair): the drill-facing guarantee."""
+    from batch_shipyard_tpu.chaos import injectors as injectors_mod
+    raw = MemoryStateStore()
+    chaos = injectors_mod.ChaosStore(raw)
+    rs = _resilient(chaos, tmp_path)
+    pk = names.task_pk(POOL_ID, JOB_ID)
+    chaos.inject_errors(2)
+    with rs.group_commit():
+        rs.insert_entities(names.TABLE_TASKS, [
+            (pk, f"task-{i:05d}", {"state": "pending"})
+            for i in range(8)])
+        rs.put_messages("q-0", [b"x"] * 8)
+    rows = list(raw.query_entities(names.TABLE_TASKS,
+                                   partition_key=pk))
+    assert len(rows) == 8
+    # At-least-once on the queue leg: >= is the contract, duplicates
+    # are claim-deduped downstream.
+    assert raw.queue_length("q-0") >= 8
+
+
+# ------------------------ server-side expansion ------------------------
+
+def test_server_side_expansion_end_to_end():
+    """One generator row in, N completed tasks out: the client leg is
+    O(1), the pool's leader-gated expander materializes the job, the
+    summary wait gates on expansion state, and the goodput partition
+    stays exact with the expansion priced."""
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.2,
+                                 node_stale_seconds=30.0)
+    substrate.agent_kwargs = {"claim_visibility_seconds": 30.0,
+                              "gang_sweep_interval": 3600.0,
+                              "preempt_sweep_interval": 3600.0}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "task_slots_per_node": 2,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        jobs = settings_mod.job_settings_list({
+            "job_specifications": [{
+                "id": JOB_ID, "server_side_expansion": True,
+                "tasks": [{"task_factory": {"repeat": 40},
+                           "runtime": "inproc", "command": "noop"}],
+            }]})
+        submitted = jobs_mgr.add_jobs(store, pool, jobs)
+        # O(1) client leg: no rows materialized client-side.
+        assert submitted == {JOB_ID: 0}
+        assert expansion_mod.expansion_state(store, POOL_ID,
+                                             JOB_ID) in (
+            "pending", "expanding", "completed")
+        summary = jobs_mgr.wait_for_job_summary(
+            store, POOL_ID, JOB_ID, timeout=60.0, poll_interval=0.2)
+        assert summary["by_state"] == {"completed": 40}
+        assert expansion_mod.expansion_state(
+            store, POOL_ID, JOB_ID) == "completed"
+        row = store.get_entity(names.TABLE_EXPANSIONS, POOL_ID,
+                               JOB_ID)
+        stats = row[names.EXPANSION_COL_STATS]
+        assert stats["expanded"] == 40
+        assert stats["tasks"] == 40
+        report = accounting.pool_report(store, POOL_ID,
+                                        include_jobs=False)
+        total = (report["productive_seconds"]
+                 + sum(report["badput_seconds"].values())
+                 + sum(report["overlapped_seconds"].values()))
+        assert abs(total - report["wall_seconds"]) <= max(
+            1e-6 * max(1.0, report["wall_seconds"]), 1e-6)
+        assert report["badput_seconds"]["expansion"] > 0
+    finally:
+        substrate.stop_all()
+
+
+def test_expansion_bad_spec_fails_the_row():
+    """An unparseable generator spec fails the expansion row (state
+    "failed" + error) and the summary wait surfaces it instead of
+    spinning forever."""
+    store = MemoryStateStore()
+    store.insert_entity(names.TABLE_POOLS, "pools", POOL_ID, {
+        "state": "ready",
+        "spec": {"pool_specification": {"id": POOL_ID,
+                                        "substrate": "fake"}}})
+    store.insert_entity(names.TABLE_JOBS, POOL_ID, JOB_ID,
+                        {"state": "active"})
+    store.insert_entity(names.TABLE_EXPANSIONS, POOL_ID, JOB_ID, {
+        "state": "pending",
+        "spec": {"id": JOB_ID,
+                 "tasks": [{"task_factory": {"bogus": True}}]},
+        names.EXPANSION_COL_CURSOR: 0})
+    row = store.get_entity(names.TABLE_EXPANSIONS, POOL_ID, JOB_ID)
+    assert not expansion_mod.run_expansion(store, POOL_ID, row)
+    assert expansion_mod.expansion_state(store, POOL_ID,
+                                         JOB_ID) == "failed"
+    assert expansion_mod.expansion_error(store, POOL_ID, JOB_ID)
+    with pytest.raises(RuntimeError):
+        jobs_mgr.wait_for_job_summary(store, POOL_ID, JOB_ID,
+                                      timeout=1.0)
+
+
+def test_expansion_rejects_unseeded_random_factory():
+    """An unseeded `random` factory would re-expand differently on
+    leader handover — rejected at the client leg."""
+    store = _make_store(1)
+    bad = settings_mod._job_settings({
+        "id": JOB_ID,
+        "tasks": [{"task_factory": {
+            "random": {"distribution": {"uniform": {"a": 0, "b": 1}},
+                       "generate": 5}},
+            "command": "noop {0}"}]})
+    with pytest.raises(ValueError, match="deterministic"):
+        expansion_mod.submit_expansion(store, POOL_ID, bad)
+    store.insert_entity(names.TABLE_JOBS, POOL_ID, JOB_ID,
+                        {"state": "active"})
+    seeded = settings_mod._job_settings({
+        "id": JOB_ID,
+        "tasks": [{"task_factory": {
+            "random": {"seed": 7,
+                       "distribution": {"uniform": {"a": 0, "b": 1}},
+                       "generate": 5}},
+            "command": "noop {0}"}]})
+    expansion_mod.submit_expansion(store, POOL_ID, seeded)
+    assert expansion_mod.expansion_state(store, POOL_ID,
+                                         JOB_ID) == "pending"
+
+
+def test_expansion_yields_when_fenced_and_resumes():
+    """A deposed expander yields with the cursor persisted; a
+    successor re-runs the SAME deterministic factory, skips the
+    cursor prefix, re-applies the boundary chunk idempotently, and
+    completes with exactly N rows."""
+    store = _make_store(1)
+    job = settings_mod._job_settings({
+        "id": JOB_ID,
+        "tasks": [{"task_factory": {"repeat": 50},
+                   "command": "noop"}]})
+    store.insert_entity(names.TABLE_JOBS, POOL_ID, JOB_ID,
+                        {"state": "active"})
+    store.get_entity(names.TABLE_POOLS, "pools", POOL_ID)
+    # Give the pool row a full spec so the expander can rebuild
+    # PoolSettings.
+    store.merge_entity(names.TABLE_POOLS, "pools", POOL_ID, {
+        "spec": {"pool_specification": {
+            "id": POOL_ID, "substrate": "fake",
+            "task_queue_shards": 1}}})
+    expansion_mod.submit_expansion(store, POOL_ID, job)
+    row = store.get_entity(names.TABLE_EXPANSIONS, POOL_ID, JOB_ID)
+    fence_calls = itertools.count()
+    # Fence drops after two checks: the first chunk lands, then the
+    # run yields mid-flight.
+    done = expansion_mod.run_expansion(
+        store, POOL_ID, row, chunk=20,
+        fenced=lambda: next(fence_calls) < 2)
+    assert not done
+    resumed = store.get_entity(names.TABLE_EXPANSIONS, POOL_ID,
+                               JOB_ID)
+    assert resumed["state"] == "expanding"
+    cursor = int(resumed[names.EXPANSION_COL_CURSOR])
+    assert 0 < cursor < 50
+    # Successor term: completes from the cursor.
+    assert expansion_mod.run_expansion(store, POOL_ID, resumed,
+                                       chunk=20)
+    rows = list(store.query_entities(
+        names.TABLE_TASKS,
+        partition_key=names.task_pk(POOL_ID, JOB_ID)))
+    assert len(rows) == 50  # exactly once, boundary chunk included
+    assert expansion_mod.expansion_state(
+        store, POOL_ID, JOB_ID) == "completed"
+
+
+# --------------------------- batched claims ---------------------------
+
+class _CountingStore(MemoryStateStore):
+    """Counts get_messages calls and the largest batch a single task
+    queue poll returned."""
+
+    def __init__(self):
+        super().__init__()
+        self.taskq_polls = 0
+        self.max_claimed = 0
+
+    def get_messages(self, queue, max_messages=1,
+                     visibility_timeout=30.0):
+        msgs = super().get_messages(
+            queue, max_messages=max_messages,
+            visibility_timeout=visibility_timeout)
+        if "taskq" in queue:
+            self.taskq_polls += 1
+            self.max_claimed = max(self.max_claimed, len(msgs))
+        return msgs
+
+
+def test_agent_claims_in_batches():
+    """A 4-slot node claims up to slot-count messages per poll and
+    still completes everything exactly once: fewer queue round trips
+    than tasks, no lost or doubled work."""
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+    store = _CountingStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.5,
+                                 node_stale_seconds=30.0)
+    substrate.agent_kwargs = {"claim_visibility_seconds": 30.0,
+                              "gang_sweep_interval": 3600.0,
+                              "preempt_sweep_interval": 3600.0}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 1}},
+        "task_slots_per_node": 4,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        jobs = settings_mod.job_settings_list({
+            "job_specifications": [{
+                "id": JOB_ID,
+                "tasks": [{"task_factory": {"repeat": 32},
+                           "runtime": "inproc", "command": "noop"}],
+            }]})
+        jobs_mgr.add_jobs(store, pool, jobs)
+        summary = jobs_mgr.wait_for_job_summary(
+            store, POOL_ID, JOB_ID, timeout=60.0, poll_interval=0.2)
+        assert summary["by_state"] == {"completed": 32}
+        assert store.max_claimed > 1  # batched claims actually used
+    finally:
+        substrate.stop_all()
+
+
+# ------------------- counting summary + shards cache -------------------
+
+def test_count_entities_by_memory_and_localfs(tmp_path):
+    from batch_shipyard_tpu.state.localfs import LocalFSStateStore
+    for store in (MemoryStateStore(),
+                  LocalFSStateStore(str(tmp_path / "fs"))):
+        pk = names.task_pk(POOL_ID, JOB_ID)
+        states = (["completed"] * 5 + ["pending"] * 3
+                  + ["running"] * 2)
+        for i, state in enumerate(states):
+            store.insert_entity(names.TABLE_TASKS, pk,
+                                f"task-{i:05d}", {"state": state})
+        store.insert_entity(names.TABLE_TASKS, pk, "task-weird",
+                            {"note": "stateless"})
+        store.insert_entity(names.TABLE_TASKS, "otherpk", "t0",
+                            {"state": "pending"})
+        counts = store.count_entities_by(names.TABLE_TASKS, pk)
+        assert counts == {"completed": 5, "pending": 3,
+                          "running": 2, "": 1}
+        summary = jobs_mgr.job_task_summary(store, POOL_ID, JOB_ID)
+        assert summary["total"] == 11
+        assert summary["terminal"] == 5
+
+
+def test_wait_for_job_summary_timeout_reports_states():
+    store = _make_store(1)
+    pk = names.task_pk(POOL_ID, JOB_ID)
+    store.insert_entity(names.TABLE_TASKS, pk, "task-00000",
+                        {"state": "pending"})
+    with pytest.raises(TimeoutError) as err:
+        jobs_mgr.wait_for_job_summary(store, POOL_ID, JOB_ID,
+                                      timeout=0.3, poll_interval=0.1)
+    assert "pending" in str(err.value)
+
+
+def test_pool_queue_shards_cache_and_invalidation():
+    store = _make_store(2)
+    assert jobs_mgr.pool_queue_shards(store, POOL_ID) == 2
+    pool = store.get_entity(names.TABLE_POOLS, "pools", POOL_ID)
+    spec = dict(pool["spec"])
+    spec["pool_specification"] = dict(spec["pool_specification"],
+                                      task_queue_shards=4)
+    store.merge_entity(names.TABLE_POOLS, "pools", POOL_ID,
+                       {"spec": spec})
+    # Cached value survives within the TTL...
+    assert jobs_mgr.pool_queue_shards(store, POOL_ID) == 2
+    # ...ttl=0 forces a fresh read without poisoning the cache path,
+    # and explicit invalidation (the resize hook) drops it for good.
+    assert jobs_mgr.pool_queue_shards(store, POOL_ID, ttl=0) == 4
+    jobs_mgr.invalidate_pool_queue_shards(store, POOL_ID)
+    assert jobs_mgr.pool_queue_shards(store, POOL_ID) == 4
+
+
+def test_autoscale_queue_shards_grow_only():
+    store = _make_store(2)
+    # Below the per-shard rate: no change.
+    assert jobs_mgr.maybe_autoscale_queue_shards(
+        store, POOL_ID, tasks_per_second=100.0) == 2
+    grown = jobs_mgr.maybe_autoscale_queue_shards(
+        store, POOL_ID, tasks_per_second=20_000.0)
+    assert grown == 8
+    assert jobs_mgr.pool_queue_shards(store, POOL_ID) == 8
+    # Grow-only: a later lower observation never shrinks.
+    assert jobs_mgr.maybe_autoscale_queue_shards(
+        store, POOL_ID, tasks_per_second=10.0) == 8
+    # Old queue names are a strict subset of the new set, so
+    # in-flight messages routed under 2 shards stay claimable.
+    old = set(names.task_queues(POOL_ID, 2))
+    new = set(names.task_queues(POOL_ID, 8))
+    assert old < new

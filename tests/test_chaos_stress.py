@@ -187,22 +187,38 @@ def test_submission_scale_100k_queueing():
     submit_elapsed = time.monotonic() - start
     assert counts["vast"] == n
     assert submit_elapsed < 120, f"submission took {submit_elapsed:.0f}s"
-    queues = names.task_queues("s100k", 16)
+    # Sustained submission may GROW the shard count mid-stream
+    # (grow-only autoscale); count at the final width — the original
+    # 16 queue names are a strict subset, so nothing is stranded.
+    final_shards = jobs_mgr.pool_queue_shards(store, "s100k", ttl=0)
+    assert final_shards >= 16
+    assert set(names.task_queues("s100k", 16)) <= set(
+        names.task_queues("s100k", final_shards))
+    queues = names.task_queues("s100k", final_shards)
     lengths = {q: store.queue_length(q) for q in queues}
     assert sum(lengths.values()) == n
     populated = {q: c for q, c in lengths.items() if c}
-    assert len(populated) == 16, populated.keys()
-    assert min(populated.values()) > n / 32, populated
+    assert len(populated) >= 16, populated.keys()
+    # Balance is only guaranteed over the ORIGINAL width: the grown
+    # shards receive just the post-growth tail, whose share depends
+    # on when the rate threshold tripped.
+    original = [c for q, c in lengths.items()
+                if q in set(names.task_queues("s100k", 16)) and c]
+    assert min(original) > n / 64, populated
     # Pop a sample from every shard: messages parse and reference
     # real task entities.
     seen = Counter()
+    popped = 0
     for q in populated:
         for msg in store.get_messages(q, max_messages=32,
                                       visibility_timeout=60.0):
             payload = json.loads(msg.payload)
             seen[payload["task_id"]] += 1
             store.delete_message(msg)
-    assert len(seen) == 16 * 32 and max(seen.values()) == 1
+            popped += 1
+    assert popped >= 16 * 32
+    assert len(seen) == popped  # every message a distinct task
+    assert max(seen.values()) == 1
 
 
 def test_soak_concurrent_pools_with_chaos():

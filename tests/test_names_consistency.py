@@ -92,6 +92,11 @@ def test_task_and_backoff_event_constants_are_declared():
     assert accounting._KIND_CATEGORY[
         gp_events.TASK_BACKOFF] == "backoff"
     assert "backoff" in accounting.BADPUT_CATEGORIES
+    # Server-side task-factory expansion is priced as its own
+    # scheduling-badput category (the 10^6 bench's submit leg).
+    assert accounting._KIND_CATEGORY[
+        gp_events.TASK_EXPANSION] == "expansion"
+    assert "expansion" in accounting.BADPUT_CATEGORIES
 
 
 def test_preemption_and_resize_names_declared():
@@ -351,10 +356,12 @@ def test_chaos_kinds_help_lists_node_preempt_notice():
 
 
 def test_scheduler_scale_workload_dispatched_and_rendered():
-    """The 10^5 proof is wired end to end: bench.py dispatches the
+    """The 10^6 proof is wired end to end: bench.py dispatches the
     scheduler_scale workload, benchgen reads the committed
     BENCH_scheduler_scale.json artifact, and the artifact itself
-    records a complete, partition-exact run of >= 10^5 tasks."""
+    records a complete, partition-exact 10^6-task run whose submit
+    leg (server-side expansion, streaming batched submission) is no
+    longer the dominant cost."""
     import json
     bench_src = (PACKAGE.parent / "bench.py").read_text(
         encoding="utf-8")
@@ -368,9 +375,15 @@ def test_scheduler_scale_workload_dispatched_and_rendered():
         "`python bench.py --workloads scheduler_scale`")
     data = json.loads(artifact.read_text(
         encoding="utf-8"))["scheduler_scale"]
-    assert data["num_tasks"] >= 100_000
+    assert data["num_tasks"] >= 1_000_000
     assert data["completed"] is True
     assert data["goodput"]["partition_exact"] is True
+    assert data["server_side_expansion"] is True
+    # Submission must not dominate: the materialization leg is
+    # strictly cheaper than the drain, and >= 10x the pre-streaming
+    # submitter's 1648 tasks/s.
+    assert data["submit_seconds"] < data["run_seconds"]
+    assert data["submit_tasks_per_second"] >= 16_480
 
 
 def test_train_workloads_enable_the_compile_cache():

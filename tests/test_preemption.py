@@ -427,20 +427,43 @@ def test_inproc_runtime_end_to_end():
 
 def test_scheduler_scale_smoke():
     """The scheduler_scale bench phase end-to-end at a tier-1-sized
-    count: every task completes through the real scheduling path,
-    throughput is reported, and the goodput partition is exact. (The
-    committed BENCH_scheduler_scale.json artifact is the 10^5 run of
-    exactly this code.)"""
+    count (10^4): every task completes through the real scheduling
+    path — server-side expansion, streaming batched submission,
+    batched claims, summary-based drain — throughput is reported, and
+    the goodput partition is exact. (The committed
+    BENCH_scheduler_scale.json artifact is the 10^6 run of exactly
+    this code.)"""
     sys.path.insert(0, REPO_ROOT)
     import bench
     result = bench.bench_scheduler_scale(
-        num_tasks=300, nodes=2, slots=2, shards=2, timeout=120,
+        num_tasks=10_000, nodes=2, slots=2, shards=2, timeout=240,
         artifact=False)
     assert result["completed"], result
-    assert result["by_state"] == {"completed": 300}
+    assert result["by_state"] == {"completed": 10_000}
     assert result["goodput"]["partition_exact"], result
     assert result["tasks_per_second"] > 0
     assert result["queue_depth_after"] == 0
+    # The submit leg is materialized pool-side (one expansion row
+    # from the client) and its breakdown is priced.
+    assert result["server_side_expansion"] is True
+    breakdown = result["submit_breakdown"]
+    assert breakdown["messages"] == 10_000
+    assert breakdown["expansion_wall_seconds"] > 0
+    assert result["submit_seconds"] < result["run_seconds"]
+
+
+@pytest.mark.slow
+def test_scheduler_scale_million():
+    """The full 10^6-task artifact run (slow phase): the committed
+    BENCH_scheduler_scale.json is regenerated by exactly this call
+    via `python bench.py --workloads scheduler_scale`."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+    result = bench.bench_scheduler_scale(artifact=False)
+    assert result["num_tasks"] == 1_000_000
+    assert result["completed"], result
+    assert result["goodput"]["partition_exact"], result
+    assert result["submit_seconds"] < result["run_seconds"]
 
 
 @pytest.mark.slow

@@ -317,27 +317,40 @@ _SCHED_KEYS = (
     ("end_to_end_seconds", "end-to-end wall (s)"),
     ("end_to_end_tasks_per_second", "end-to-end throughput "
                                     "(tasks/s)"),
+    ("submit_seconds", "submission leg, expansion included (s)"),
     ("submit_tasks_per_second", "submission throughput (tasks/s)"),
+    ("client_submit_seconds", "client-side submit leg (s)"),
+    ("run_seconds", "run/drain leg (s)"),
     ("tasks_per_second", "post-submit drain rate (tasks/s)"),
     ("queue_depth_after", "undrained queue messages"))
 
+_SCHED_BREAKDOWN_KEYS = (
+    ("expansion_wall_seconds", "server-side expansion wall (s)"),
+    ("encode_seconds", "encode leg, overlapped (s)"),
+    ("entity_seconds", "entity-insert leg, overlapped (s)"),
+    ("enqueue_seconds", "enqueue leg, overlapped (s)"),
+    ("chunks", "adaptive chunks"),
+    ("queue_shards_final", "task-queue shards after autoscale"))
+
 
 def _scheduler_scale(out: list[str], data: dict) -> None:
-    """10^5-task scheduler proof section. The run is ALWAYS a
+    """10^6-task scheduler proof section. The run is ALWAYS a
     CPU/in-process measurement (the marker convention: label the
     substrate, never imply silicon) — the number proves the
     scheduling path, not an accelerator."""
     if not isinstance(data, dict) or not data:
         return
-    out.append("### Scheduler scale (10^5-task end-to-end proof)\n")
+    out.append("### Scheduler scale (10^6-task end-to-end proof)\n")
     if "error" in data:
         out.append(f"Not measured: `{data['error']}`\n")
         return
     out.append("**CPU fakepod, in-process task mode — an "
                "orchestration measurement, no accelerator involved "
                "or claimed.** Every task runs the real scheduling "
-               "path (batched submission, sharded queue fan-out, "
-               "claims, goodput/trace emission, queue drain); the "
+               "path (server-side expansion + streaming bulk "
+               "submission ([13-task-factory.md](13-task-factory.md)), "
+               "sharded queue fan-out, batched claims, goodput/trace "
+               "emission, queue drain); the "
                "task body is a function call, so per-task fork cost "
                "stops dominating "
                "([33-elastic-training.md](33-elastic-training.md)).\n")
@@ -346,6 +359,14 @@ def _scheduler_scale(out: list[str], data: dict) -> None:
     out.append("|---|---|")
     for key, label in _SCHED_KEYS:
         out.append(f"| {label} | {_fmt(data.get(key), 1)} |")
+    out.append(f"| server-side expansion | "
+               f"{'yes' if data.get('server_side_expansion') else 'no'}"
+               f" |")
+    breakdown = data.get("submit_breakdown") or {}
+    for key, label in _SCHED_BREAKDOWN_KEYS:
+        if key in breakdown:
+            out.append(f"| {label} | "
+                       f"{_fmt(breakdown.get(key), 1)} |")
     completed = data.get("completed")
     out.append(f"| all tasks completed | "
                f"{'yes' if completed else 'NO'} |")
