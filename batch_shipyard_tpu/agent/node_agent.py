@@ -42,6 +42,7 @@ from batch_shipyard_tpu.config.settings import (
     JaxDistributedSettings, MultiInstanceSettings, PoolSettings)
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.jobs import launcher
+from batch_shipyard_tpu.sched import policy as sched_policy
 from batch_shipyard_tpu.state import leases as state_leases
 from batch_shipyard_tpu.state import names
 from batch_shipyard_tpu.state import resilient as state_resilient
@@ -342,6 +343,23 @@ class NodeAgent:
         self._health_probation_seconds = health_probation_seconds
         self._quarantined_at = 0.0
         self._health_lock = threading.Lock()
+        # Shared scheduling policy (sched/policy.py): knobs derived
+        # once from pool settings; claim_scoring opts the claim path
+        # into warm-cache affinity deferral. The preemption sweep's
+        # goodput-cost victim ordering and the health/backoff debits
+        # are always on — with no hints/failures they price to 0.0
+        # and reduce to the historical (priority, task_id) order.
+        self._policy_knobs = sched_policy.knobs_from_settings(
+            getattr(pool, "sched_policy", None))
+        self._claim_scoring = bool(
+            getattr(getattr(pool, "sched_policy", None),
+                    "claim_scoring", False))
+        # Recent task-failure count for the claim-scoring backoff
+        # debit: bumped on failure/wedge, drained by successes.
+        self._recent_failures = 0
+        # Last synced sched-hints JSON per live task, so the
+        # heartbeat mirror writes the row only on change.
+        self._sched_hints_sent: dict[tuple[str, str], str] = {}
         # Chaos injection seam: heartbeats are suppressed while
         # wall-clock < this (simulated network partition).
         self.heartbeat_blackout_until = 0.0
@@ -576,6 +594,7 @@ class NodeAgent:
                     self._forward_profile_requests()
                     self._forward_preempt_requests()
                     self._ingest_live_trace_spans()
+                    self._sync_sched_hints()
             except state_resilient.StoreOutageError:
                 logger.warning(
                     "store outage: coordination sweeps skipped "
@@ -1033,6 +1052,23 @@ class NodeAgent:
                 msg, visibility_timeout=self.poll_interval)
             time.sleep(self.poll_interval)
             return
+        # Warm-cache affinity window (shared sched/policy.py, the
+        # same functions the fleet simulator prices): when this claim
+        # would pay a material expected-badput cost — cold persistent
+        # compile cache for the task's declared identity, degraded
+        # health, recent failures — and the task is still YOUNG, hand
+        # the message back briefly so a warm/healthy node can claim
+        # it. Past the affinity window any node claims: deferral
+        # trades bounded queueing badput for compile badput, never
+        # starvation.
+        if self._claim_scoring and self._should_defer_claim(entity,
+                                                            spec):
+            self.store.update_message(
+                msg, visibility_timeout=max(0.5, min(
+                    5.0,
+                    self._policy_knobs.claim_affinity_wait_seconds
+                    / 4.0)))
+            return
         deps = self._deps_status(job_id, spec)
         if deps == "blocked":
             try:
@@ -1058,6 +1094,28 @@ class NodeAgent:
         else:
             self._run_gang_instance(
                 slot, job_id, task_id, entity, instance, msg)
+
+    def _should_defer_claim(self, entity: dict, spec: dict) -> bool:
+        """Price THIS node's claim with the shared scoring policy and
+        ask the shared affinity-window rule whether to hand the task
+        back. Identical decision code to the fleet simulator's claim
+        path — a simulated affinity delta is evidence about this
+        function's behavior in production."""
+        identity = spec.get("compile_cache_identity")
+        warm = bool(identity) and identity in cc_manager.\
+            list_identity_dirs(self._compile_cache_dir())
+        with self._health_lock:
+            health = self._health
+            failures = self._recent_failures
+        score = sched_policy.claim_score(
+            warm=warm, health=health, recent_failures=failures,
+            has_identity=bool(identity), knobs=self._policy_knobs)
+        since = goodput_events.iso_to_epoch(
+            entity.get("requeued_at") or entity.get("submitted_at"))
+        queued = 0.0 if since is None else max(0.0,
+                                               time.time() - since)
+        return sched_policy.should_defer_claim(
+            score, queued, knobs=self._policy_knobs)
 
     def _cached_job_state(self, job_id: str) -> Optional[str]:
         return self._cached_job(job_id)[0]
@@ -1419,6 +1477,47 @@ class NodeAgent:
             for path in candidates:
                 self._drain_trace_file(path, job_id, task_id)
 
+    def _sync_sched_hints(self) -> None:
+        """Mirror LIVE tasks' sched-hints files
+        (agent/progress.py record_sched_hints) into their task rows'
+        sched_hints column, where the preemption sweep's shared
+        victim-cost policy prices replay rework. Advisory and cheap:
+        one local read per live task per beat, a store write only
+        when the hints CHANGED (a step-cadenced writer is throttled
+        by content, not another timer). For a gang, the instance
+        with the highest step wins — rework is priced by the
+        furthest-ahead shard that would replay."""
+        for job_id, task_id in list(self._live_procs.keys()):
+            root = os.path.join(self.work_dir, "tasks", job_id,
+                                task_id)
+            candidates = [os.path.join(root, "sched_hints.json")]
+            try:
+                candidates += [
+                    os.path.join(root, d, "sched_hints.json")
+                    for d in os.listdir(root) if d.startswith("i")]
+            except OSError:
+                continue
+            best: Optional[dict] = None
+            for path in candidates:
+                hints = progress_mod.read_sched_hints(path)
+                if hints is None:
+                    continue
+                if best is None or (hints.get("step") or 0) > \
+                        (best.get("step") or 0):
+                    best = hints
+            if best is None:
+                continue
+            fingerprint = json.dumps(best, sort_keys=True)
+            key = (job_id, task_id)
+            if self._sched_hints_sent.get(key) == fingerprint:
+                continue
+            try:
+                self._merge_task(job_id, task_id,
+                                 {names.TASK_COL_SCHED_HINTS: best})
+                self._sched_hints_sent[key] = fingerprint
+            except (NotFoundError, EtagMismatchError):
+                continue
+
     # ----------------------- profiling hooks ---------------------------
 
     def _forward_profile_requests(self) -> None:
@@ -1595,14 +1694,28 @@ class NodeAgent:
                     continue
                 if request:
                     continue  # malformed stamp; never a victim twice
-                victims.append((priority, row))
+                # Goodput-cost victim ordering (shared
+                # sched/policy.py, the functions the fleet simulator
+                # prices): lowest priority first, then CHEAPEST
+                # expected rework — replay steps past the last
+                # committed checkpoint plus warm compile state
+                # destroyed, from the sched_hints column the
+                # heartbeat mirrors — then task id. Hint-less tasks
+                # price 0.0, so the order degrades to the
+                # deterministic (priority, task_id) tie-break instead
+                # of scan order (dict/row order must never elect a
+                # victim).
+                cost = sched_policy.victim_cost_from_row(
+                    row, knobs=self._policy_knobs)
+                victims.append((sched_policy.victim_sort_key(
+                    priority, cost, row["_rk"]), row))
         if not starved or not victims:
             return
         starved.sort(key=lambda t: (-t[0], t[1]))
         victims.sort(key=lambda t: t[0])
         from batch_shipyard_tpu.jobs import manager as jobs_mgr
         for priority, _since, row in starved:
-            if not victims or victims[0][0] >= priority:
+            if not victims or victims[0][0][0] >= priority:
                 break  # nothing running is strictly lower anymore
             # Fencing re-check BEFORE each stamp (satellite audit):
             # the scan above can outlive the term, and a preemption
@@ -1611,7 +1724,8 @@ class NodeAgent:
             # exactly the double-fire the partition drill forbids.
             if not lease.fenced(epoch):
                 return
-            victim_priority, victim = victims.pop(0)
+            victim_key, victim = victims.pop(0)
+            victim_priority = victim_key[0]
             victim_job = victim["_pk"][len(prefix):]
             starved_job = row["_pk"][len(prefix):]
             stamped = jobs_mgr.request_preemption(
@@ -2509,10 +2623,14 @@ class NodeAgent:
         with self._health_lock:
             if ok:
                 self._health = min(1.0, self._health + 0.1)
+                self._recent_failures = max(
+                    0, self._recent_failures - 1)
             elif wedged:
                 self._health *= 0.5
+                self._recent_failures += 1
             else:
                 self._health *= 0.7
+                self._recent_failures += 1
             was = self._node_quarantined
             self._node_quarantined = (
                 self._health < self._health_quarantine_threshold)
@@ -4398,6 +4516,20 @@ class NodeAgent:
             env.setdefault(
                 progress_mod.PROGRESS_DEADLINE_ENV,
                 str(spec["progress_deadline_seconds"]))
+        # Scheduling-hints contract: instrumented workloads publish
+        # {step, ckpt_step, step_seconds, cache_identity} here
+        # (agent/progress.py record_sched_hints); the heartbeat loop
+        # mirrors the file into the task row's sched_hints column for
+        # the preemption sweep's victim-cost policy.
+        env.setdefault(
+            progress_mod.SCHED_HINTS_FILE_ENV,
+            os.path.join(task_dir.rstrip("/"), "sched_hints.json"))
+        # Declared compile-cache identity (claim affinity's key),
+        # exported so the workload enables the persistent cache under
+        # the same identity the scheduler placed it by.
+        if spec.get("compile_cache_identity"):
+            env.setdefault("SHIPYARD_COMPILE_CACHE_IDENTITY",
+                           str(spec["compile_cache_identity"]))
         # Cooperative-preemption contract: the heartbeat loop drops a
         # preempt request here; instrumented workloads poll it each
         # step (PreemptWatcher), drain, force-commit, and exit

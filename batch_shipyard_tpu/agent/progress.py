@@ -20,6 +20,7 @@ unchanged outside pools, exactly like the goodput recorder.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional
@@ -102,4 +103,70 @@ def last_beat(path: str) -> Optional[float]:
     try:
         return os.stat(path).st_mtime
     except OSError:
+        return None
+
+
+# --------------------------- sched hints ---------------------------
+#
+# The liveness beat proves a task is MOVING; scheduling hints say what
+# it would COST to stop it. Instrumented workloads publish
+# {step, ckpt_step, step_seconds, cache_identity} to the hints file
+# the agent exports ($SHIPYARD_SCHED_HINTS_FILE); the agent mirrors it
+# into the task row's sched_hints column on each heartbeat, where the
+# preemption sweep's shared victim-cost policy
+# (sched/policy.py victim_cost_from_row) prices replay rework from it.
+# Purely advisory, same contract as beats: no sink → no-op, a failed
+# write never fails the work it describes.
+
+SCHED_HINTS_FILE_ENV = "SHIPYARD_SCHED_HINTS_FILE"
+
+
+def sched_hints_path() -> Optional[str]:
+    """The hints file for THIS process, or None (hints disabled)."""
+    return os.environ.get(SCHED_HINTS_FILE_ENV) or None
+
+
+def record_sched_hints(step: Optional[int] = None,
+                       ckpt_step: Optional[int] = None,
+                       step_seconds: Optional[float] = None,
+                       cache_identity: Optional[str] = None) -> None:
+    """Publish this task's preemption-cost inputs (atomic
+    tmp+rename, so the agent's heartbeat read never sees a torn
+    write). Fields left None are omitted — callers report what they
+    know (a checkpointer knows ckpt_step, a step loop knows
+    step/step_seconds)."""
+    path = sched_hints_path()
+    if path is None:
+        return
+    hints: dict = {}
+    if step is not None:
+        hints["step"] = int(step)
+    if ckpt_step is not None:
+        hints["ckpt_step"] = int(ckpt_step)
+    if step_seconds is not None:
+        hints["step_seconds"] = float(step_seconds)
+    if cache_identity:
+        hints["cache_identity"] = str(cache_identity)
+    if not hints:
+        return
+    try:
+        prior = read_sched_hints(path) or {}
+        prior.update(hints)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(prior, fh, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def read_sched_hints(path: str) -> Optional[dict]:
+    """The hints dict at ``path``, or None (absent/corrupt — a torn
+    or junk file is advisory data lost, never an agent crash)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        return data if isinstance(data, dict) else None
+    except (OSError, ValueError):
         return None

@@ -12,4 +12,4 @@ from batch_shipyard_tpu.analysis.core import (  # noqa: F401
 # Rule modules register themselves on import (the @rule decorator).
 from batch_shipyard_tpu.analysis import (  # noqa: F401,E402
     rules_env, rules_jax, rules_loops, rules_registry, rules_shell,
-    rules_store, rules_wiring)
+    rules_sim, rules_store, rules_wiring)

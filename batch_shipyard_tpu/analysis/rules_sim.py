@@ -1,0 +1,87 @@
+"""Simulator-determinism rules.
+
+The fleet simulator's contract is byte-identical reports for the same
+(seed, trace, policy) — tests/test_fleet_sim.py asserts it, and the
+goodput-delta methodology (docs/35-fleet-simulator.md) depends on it:
+a policy comparison is only evidence when the ONLY difference between
+two runs is the policy. One stray wall-clock read anywhere in
+``batch_shipyard_tpu/sim/`` breaks that silently — reports still look
+plausible, they just stop replaying.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from batch_shipyard_tpu.analysis.core import (
+    AnalysisContext, Finding, rule)
+
+SIM_PREFIX = "batch_shipyard_tpu/sim/"
+# The one module allowed to even think about time sources: virtual
+# time lives here (it starts at 0.0 and advances only by popping the
+# event heap, so in practice it needs no wall clock either).
+CLOCK_MODULE = SIM_PREFIX + "clock.py"
+
+_BANNED_TIME_ATTRS = {"time", "monotonic", "perf_counter",
+                      "monotonic_ns", "perf_counter_ns", "time_ns"}
+_BANNED_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _wall_clock_call(node: ast.Call) -> Optional[str]:
+    """'time.monotonic' / 'datetime.now' / 'datetime.datetime.now'
+    when the call reads a wall clock; None otherwise."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Name):
+        if base.id == "time" and func.attr in _BANNED_TIME_ATTRS:
+            return f"time.{func.attr}"
+        if base.id == "datetime" and \
+                func.attr in _BANNED_DATETIME_ATTRS:
+            return f"datetime.{func.attr}"
+    # datetime.datetime.now() / datetime.date.today()
+    if isinstance(base, ast.Attribute) and \
+            isinstance(base.value, ast.Name) and \
+            base.value.id == "datetime" and \
+            func.attr in _BANNED_DATETIME_ATTRS:
+        return f"datetime.{base.attr}.{func.attr}"
+    return None
+
+
+@rule("sim-wall-clock", family="sim")
+def check_sim_wall_clock(ctx: AnalysisContext) -> list[Finding]:
+    """A wall-clock read (``time.time``/``time.monotonic``/
+    ``time.perf_counter``/``datetime.now`` and friends) anywhere in
+    ``batch_shipyard_tpu/sim/`` outside the clock module: the
+    simulator's virtual clock (sim/clock.py) is the package's ONLY
+    time source, and a single wall-clock read makes two runs of the
+    same (seed, trace, policy) produce different reports — the
+    byte-identical determinism contract the policy-delta methodology
+    rests on.
+
+    Provenance: the live agent's heartbeat/goodput plumbing is built
+    on ``time.time()`` everywhere, so any code lifted from it into a
+    sim adapter carries a wall-clock read by default — this rule is
+    what makes that an error instead of a latent flake."""
+    findings = []
+    for src in ctx.python_files:
+        if not src.rel.startswith(SIM_PREFIX):
+            continue
+        if src.rel == CLOCK_MODULE:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            banned = _wall_clock_call(node)
+            if banned:
+                findings.append(Finding(
+                    rule="sim-wall-clock", path=src.rel,
+                    line=node.lineno,
+                    message=(f"{banned}() in the simulator package; "
+                             f"sim code must take time from the "
+                             f"virtual clock (sim/clock.py) — a "
+                             f"wall-clock read breaks byte-identical "
+                             f"replay of (seed, trace, policy)")))
+    return findings

@@ -342,6 +342,178 @@ def _await_no_gang_rows(store, invariants: dict,
     assert not leftover, leftover
 
 
+def run_victim_selection_drill(seed: int = 0, steps: int = 160,
+                               step_seconds: float = 0.05,
+                               wait_timeout: float = 120.0) -> dict:
+    """Victim-SELECTION drill: the preemption drill's missing half.
+    The preemption drill proves a victim drains correctly; this one
+    proves the sweep picks the RIGHT victim. Two eligible victims run
+    side by side on a two-node pool:
+
+      * ``aa-costly`` — never commits mid-run and advertises a warm
+        compile-cache identity: killing it destroys warm state and
+        replays every executed step (high goodput cost). Its task id
+        sorts FIRST, so the pre-policy (priority, task_id) tie-break
+        would elect it.
+      * ``zz-cheap``  — commits EVERY step (steps-since-commit ~= 0)
+        and holds nothing warm: killing it costs almost nothing.
+
+    A strictly higher-priority task then starves. The sweep's shared
+    goodput-cost ordering (sched/policy.py ``victim_cost_from_row`` +
+    ``victim_sort_key``, the very functions the fleet simulator
+    prices) must deterministically elect ``zz-cheap`` — the id order
+    guarantees the choice can only come from the cost term, pinning
+    the policy in the LIVE sweep path. Asserts:
+
+      * both victims' sched hints were mirrored into their task rows
+        (the heartbeat `_sync_sched_hints` leg) and priced the costly
+        victim strictly dearer BEFORE the starver existed,
+      * ``zz-cheap`` was preempted (cooperatively, zero retries) and
+        ``aa-costly`` was NOT touched (no preempt, no evict),
+      * the starver and both victims all completed,
+      * the goodput partition stayed exact with the
+        preemption_recovery leg populated."""
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    from batch_shipyard_tpu.state.memory import MemoryStateStore
+    from batch_shipyard_tpu.substrate.fakepod import FakePodSubstrate
+
+    store = MemoryStateStore()
+    substrate = FakePodSubstrate(store, heartbeat_interval=0.2,
+                                 node_stale_seconds=5.0)
+    substrate.agent_kwargs = {
+        "claim_visibility_seconds": 5.0, "gang_sweep_interval": 1.0,
+        # One election per starvation episode: the sweep interval must
+        # dwarf drain + re-claim latency (~0.5s), or a second sweep
+        # fires while the starver is still queued and elects the
+        # costly victim too — the drill asserts it is never touched.
+        "preempt_sweep_interval": 2.5,
+        "preempt_grace_seconds": 1.0}
+    conf = {"pool_specification": {
+        "id": POOL_ID, "substrate": "fake",
+        "vm_configuration": {"vm_count": {"dedicated": 2}},
+        "task_slots_per_node": 1,
+        "max_wait_time_seconds": 60}}
+    pool = settings_mod.pool_settings(conf)
+    report: dict = {"seed": seed, "fingerprint": f"victim-sel-{seed}",
+                    "applied": [], "invariants": {}}
+    work = os.path.join(substrate.work_root, "probe")
+    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+    victims_job = "victims"
+    starver_job = "starver"
+    try:
+        pool_mgr.create_pool(store, substrate, pool,
+                             settings_mod.global_settings({}), conf)
+        probe = (f"{sys.executable} -m batch_shipyard_tpu"
+                 f".workloads.preempt_probe "
+                 f"--steps {steps} --step-seconds {step_seconds} ")
+        jobs = settings_mod.job_settings_list({"job_specifications": [{
+            "id": victims_job,
+            "priority": 0,
+            "tasks": [
+                {"id": "aa-costly",
+                 "command": (probe +
+                             f"--cache-identity drill-warm "
+                             f"--ckpt {work}/costly.json"),
+                 "environment_variables": {"PYTHONPATH": repo_root},
+                 "max_task_retries": 3},
+                {"id": "zz-cheap",
+                 "command": (probe +
+                             f"--checkpoint-every 1 "
+                             f"--ckpt {work}/cheap.json"),
+                 "environment_variables": {"PYTHONPATH": repo_root},
+                 "max_task_retries": 3},
+            ]}]})
+        _submit_jobs(store, pool, jobs)
+        # Gate the starver on mirrored hints: the election is only a
+        # policy decision once both victims' costs are priceable from
+        # their rows.
+        pk = names.task_pk(POOL_ID, victims_job)
+        deadline = time.monotonic() + wait_timeout / 2.0
+        rows: dict = {}
+        while time.monotonic() < deadline:
+            rows = {r["_rk"]: r for r in store.query_entities(
+                names.TABLE_TASKS, partition_key=pk)}
+            costly = rows.get("aa-costly", {})
+            cheap = rows.get("zz-cheap", {})
+            ch = costly.get(names.TASK_COL_SCHED_HINTS)
+            zh = cheap.get(names.TASK_COL_SCHED_HINTS)
+            if (costly.get("state") == "running"
+                    and cheap.get("state") == "running"
+                    and isinstance(ch, dict)
+                    and ch.get("cache_identity")
+                    and isinstance(zh, dict)
+                    and float(zh.get("ckpt_step", 0) or 0) >= 1):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"sched hints never mirrored into victim rows: {rows}")
+        cost_costly = sched_policy.victim_cost_from_row(
+            rows["aa-costly"])
+        cost_cheap = sched_policy.victim_cost_from_row(
+            rows["zz-cheap"])
+        report["invariants"]["victim_costs"] = {
+            "aa-costly": cost_costly, "zz-cheap": cost_cheap}
+        assert cost_costly > cost_cheap, (
+            f"policy priced the warm never-committer cheaper: "
+            f"{report['invariants']['victim_costs']}")
+        _submit_jobs(store, pool, settings_mod.job_settings_list(
+            {"job_specifications": [{
+                "id": starver_job,
+                "priority": 100,
+                "tasks": [{"id": "hipri",
+                           "command": (f"{sys.executable} -c "
+                                       f"'import time; "
+                                       f"time.sleep(0.5)'")}],
+            }]}))
+        jobs_mgr.wait_for_tasks(store, POOL_ID, starver_job,
+                                timeout=wait_timeout,
+                                poll_interval=0.25)
+        victim_rows = jobs_mgr.wait_for_tasks(
+            store, POOL_ID, victims_job, timeout=wait_timeout,
+            poll_interval=0.25)
+        _check_victim_selection_invariants(store, victim_rows, report)
+    finally:
+        substrate.stop_all()
+    return report
+
+
+def _check_victim_selection_invariants(store, victim_rows: list,
+                                       report: dict) -> None:
+    invariants = report["invariants"]
+    rows = {r["_rk"]: r for r in victim_rows}
+    for rk, row in rows.items():
+        assert row.get("state") == "completed", row
+        assert int(row.get("retries", 0)) == 0, (
+            f"preemption consumed retry budget: {row}")
+    invariants["retries"] = max(
+        int(row.get("retries", 0)) for row in rows.values())
+    cheap = rows["zz-cheap"]
+    costly = rows["aa-costly"]
+    invariants["cheap_preempt_count"] = int(
+        cheap.get(names.TASK_COL_PREEMPT_COUNT, 0) or 0)
+    invariants["costly_preempt_count"] = int(
+        costly.get(names.TASK_COL_PREEMPT_COUNT, 0) or 0)
+    invariants["costly_evict_count"] = int(
+        costly.get(names.TASK_COL_EVICT_COUNT, 0) or 0)
+    assert invariants["cheap_preempt_count"] >= 1, (
+        f"the cheap victim was never elected: {invariants}")
+    assert invariants["costly_preempt_count"] == 0, (
+        f"the sweep touched the EXPENSIVE victim — goodput-cost "
+        f"ordering did not drive the election: {invariants}")
+    assert invariants["costly_evict_count"] == 0, invariants
+    pool_report = _assert_partition_exact(store, POOL_ID, invariants)
+    recovery = pool_report["badput_seconds"].get(
+        "preemption_recovery", 0.0)
+    invariants["preemption_recovery_seconds"] = recovery
+    assert recovery > 0.0, pool_report["badput_seconds"]
+    report["goodput"] = {
+        "goodput_ratio": pool_report["goodput_ratio"],
+        "badput_seconds": pool_report["badput_seconds"],
+    }
+    invariants["ok"] = True
+
+
 def run_eviction_drill(seed: int = 0, steps: int = 140,
                        step_seconds: float = 0.05,
                        checkpoint_every: int = 8,

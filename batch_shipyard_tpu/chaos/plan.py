@@ -174,6 +174,32 @@ class ChaosPlan:
         return cls(seed=seed, duration=duration, num_nodes=num_nodes,
                    injections=tuple(out))
 
+    @classmethod
+    def preemption_wave(cls, seed: int, at: float, num_nodes: int,
+                        fraction: float = 0.3,
+                        revive_after: float = 60.0,
+                        stagger: float = 5.0) -> "ChaosPlan":
+        """A provider preemption WAVE: ``fraction`` of the fleet is
+        reclaimed without notice inside a short window starting at
+        ``at`` (targets and offsets drawn from a seed-keyed RNG —
+        distinct nodes, staggered like a real zone reclaim, and a
+        pure function of the arguments). The fleet-simulator's
+        chaos-schedule scenario; also drivable against a live pool
+        via the generic injector path."""
+        rng = random.Random(seed)
+        count = max(1, int(num_nodes * fraction))
+        targets = rng.sample(range(max(1, num_nodes)),
+                             min(count, max(1, num_nodes)))
+        out = [Injection(
+            at=round(at + rng.uniform(0.0, stagger), 3),
+            kind="node_preempt", node_index=idx,
+            params=tuple(sorted(
+                {"revive_after": revive_after}.items())))
+            for idx in targets]
+        out.sort(key=lambda i: (i.at, i.kind, i.node_index))
+        return cls(seed=seed, duration=at + revive_after + stagger,
+                   num_nodes=num_nodes, injections=tuple(out))
+
     def to_dict(self) -> dict:
         return {"seed": self.seed, "duration": self.duration,
                 "num_nodes": self.num_nodes,

@@ -795,6 +795,12 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
                    "4-node gang — cooperative drain, forced "
                    "COMMITTED checkpoint, zero lost steps, retry "
                    "budget and node health untouched")
+@click.option("--victim", is_flag=True, default=False,
+              help="Run the victim-selection drill: two eligible "
+                   "victims (warm never-committer vs per-step "
+                   "committer) under a higher-priority starver — "
+                   "the sweep's goodput-cost ordering must elect "
+                   "the CHEAP victim against the id tie-break")
 @click.option("--evict", is_flag=True, default=False,
               help="Run the forcible-eviction drill: a seeded "
                    "victim_ignore_notice schedule against an "
@@ -840,7 +846,7 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
                    "retries==0, adoption leg priced)")
 @click.pass_context
 def chaos_drill(click_ctx, seed, tasks, duration, kinds,
-                injections_per_kind, preempt, evict, resize,
+                injections_per_kind, preempt, victim, evict, resize,
                 migrate, outage, partition, restart):
     """Run the seeded drill on a local fakepod pool and assert the
     recovery invariants (nonzero exit = a self-healing regression)."""
@@ -848,10 +854,70 @@ def chaos_drill(click_ctx, seed, tasks, duration, kinds,
         None, seed, tasks=tasks, duration=duration,
         kinds=_parse_kinds(kinds),
         injections_per_kind=injections_per_kind,
-        preempt=preempt, evict=evict, resize=resize,
+        preempt=preempt, victim=victim, evict=evict, resize=resize,
         migrate=migrate, outage=outage, partition=partition,
         restart=restart,
         raw=click_ctx.obj["raw"])
+
+
+# -------------------------------- sim ----------------------------------
+
+@cli.group()
+def sim():
+    """Discrete-event fleet simulator (sim/): thousands of virtual
+    nodes under the REAL scheduling policies (sched/policy.py) and
+    the REAL goodput pricing engine — deterministic, zero wall-time
+    sleeps, chaos schedules replayed in virtual time."""
+
+
+@sim.command("run")
+@click.option("--scenario", default="steady",
+              help="Scenario name (see `shipyard sim scenarios`)")
+@click.option("--policy", default="baseline",
+              help="Policy bundle: baseline, affinity, victim_cost, "
+                   "autoscale, or combined")
+@click.option("--seed", type=int, default=0,
+              help="Trace/schedule seed (same seed, same report)")
+@click.option("--nodes", type=int, default=200,
+              help="Virtual fleet width")
+@click.option("--tasks", type=int, default=2000,
+              help="Tasks in the arrival trace")
+@click.pass_context
+def sim_run(click_ctx, scenario, policy, seed, nodes, tasks):
+    """Run one simulation and print its goodput report (byte-
+    identical for the same seed/scenario/shape/policy)."""
+    fleet.action_sim_run(
+        None, scenario=scenario, policy=policy, seed=seed,
+        nodes=nodes, tasks=tasks, raw=click_ctx.obj["raw"])
+
+
+@sim.command("scenarios")
+@click.pass_context
+def sim_scenarios(click_ctx):
+    """List the scenario registry and the policy bundles."""
+    fleet.action_sim_scenarios(None, raw=click_ctx.obj["raw"])
+
+
+@sim.command("compare")
+@click.option("--scenario", default="steady",
+              help="Scenario name (see `shipyard sim scenarios`)")
+@click.option("--policies", default="",
+              help="Comma-separated policy bundles (baseline is "
+                   "always included); default: all")
+@click.option("--seed", type=int, default=0,
+              help="Trace/schedule seed (same seed, same report)")
+@click.option("--nodes", type=int, default=200,
+              help="Virtual fleet width")
+@click.option("--tasks", type=int, default=2000,
+              help="Tasks in the arrival trace")
+@click.pass_context
+def sim_compare(click_ctx, scenario, policies, seed, nodes, tasks):
+    """Run one scenario under several policy bundles and print each
+    policy's goodput delta vs baseline."""
+    fleet.action_sim_compare(
+        None, scenario=scenario,
+        policies=_parse_kinds(policies), seed=seed, nodes=nodes,
+        tasks=tasks, raw=click_ctx.obj["raw"])
 
 
 # ------------------------------- data ----------------------------------

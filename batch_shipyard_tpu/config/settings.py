@@ -243,6 +243,28 @@ class PoolServicesSettings:
 
 
 @dataclasses.dataclass(frozen=True)
+class SchedPolicySettings:
+    """Pool-level scheduling-policy configuration. The knob fields
+    mirror sched/policy.py ``PolicyKnobs`` ONE-TO-ONE by name
+    (enforced by tests/test_names_consistency.py); None falls back to
+    the PolicyKnobs default — ``sched.policy.knobs_from_settings``
+    derives the knob set every consumer (agent claim path, preemption
+    sweep, autoscale, fleet simulator) prices decisions with."""
+    # Opt-in for warm-cache affinity deferral at claim time (the
+    # claim itself is never blocked past the affinity window).
+    claim_scoring: bool
+    warm_cache_bonus_seconds: Optional[float]
+    health_debit_seconds: Optional[float]
+    backoff_debit_seconds: Optional[float]
+    claim_affinity_wait_seconds: Optional[float]
+    victim_warm_cost_seconds: Optional[float]
+    victim_step_cost_weight: Optional[float]
+    provision_seconds_per_node: Optional[float]
+    avg_task_seconds: Optional[float]
+    queue_tolerance_seconds: Optional[float]
+
+
+@dataclasses.dataclass(frozen=True)
 class PoolSettings:
     id: str
     substrate: str  # tpu_vm | fake | localhost
@@ -282,6 +304,7 @@ class PoolSettings:
     node_exporter: PrometheusExporterSettings
     cadvisor: PrometheusExporterSettings
     pool_services: "PoolServicesSettings" = None  # set by parser
+    sched_policy: Optional["SchedPolicySettings"] = None
 
     @property
     def is_tpu_pool(self) -> bool:
@@ -364,6 +387,27 @@ def pool_settings(config: dict) -> PoolSettings:
         scenario=scenario,
         formula=_get(spec, "autoscale", "formula"),
     )
+    sched_policy = None
+    if _get(spec, "sched_policy") is not None:
+        sp = _get(spec, "sched_policy")
+        sched_policy = SchedPolicySettings(
+            claim_scoring=_get(sp, "claim_scoring", default=False),
+            warm_cache_bonus_seconds=_get(
+                sp, "warm_cache_bonus_seconds"),
+            health_debit_seconds=_get(sp, "health_debit_seconds"),
+            backoff_debit_seconds=_get(sp, "backoff_debit_seconds"),
+            claim_affinity_wait_seconds=_get(
+                sp, "claim_affinity_wait_seconds"),
+            victim_warm_cost_seconds=_get(
+                sp, "victim_warm_cost_seconds"),
+            victim_step_cost_weight=_get(
+                sp, "victim_step_cost_weight"),
+            provision_seconds_per_node=_get(
+                sp, "provision_seconds_per_node"),
+            avg_task_seconds=_get(sp, "avg_task_seconds"),
+            queue_tolerance_seconds=_get(
+                sp, "queue_tolerance_seconds"),
+        )
     return PoolSettings(
         id=spec["id"],
         substrate=_get(spec, "substrate", default="tpu_vm"),
@@ -428,6 +472,7 @@ def pool_settings(config: dict) -> PoolSettings:
                 spec, "pool_services", "poll_interval_seconds",
                 default=5.0),
         ),
+        sched_policy=sched_policy,
     )
 
 
@@ -496,6 +541,14 @@ class TaskSettings:
     # Wedge watchdog opt-in: kill + requeue the task when it emits no
     # progress beat ($SHIPYARD_PROGRESS_FILE) for this long.
     progress_deadline_seconds: Optional[int]
+    # Compile-cache identity digest (compilecache/manager.py
+    # identity_key) this task's program compiles under. Advisory
+    # placement hint: the claim path's warm-cache affinity policy
+    # (sched/policy.py) prefers nodes whose persistent cache already
+    # holds this identity; exported as
+    # $SHIPYARD_COMPILE_CACHE_IDENTITY for the workload to enable the
+    # cache with.
+    compile_cache_identity: Optional[str]
     retention_time_seconds: Optional[int]
     multi_instance: Optional[MultiInstanceSettings]
     input_data: tuple[dict, ...]
@@ -734,6 +787,7 @@ def task_settings(task: dict, job: JobSettings,
         priority=_get(task, "priority", default=job.priority),
         progress_deadline_seconds=_get(task,
                                        "progress_deadline_seconds"),
+        compile_cache_identity=_get(task, "compile_cache_identity"),
         retention_time_seconds=_get(task, "retention_time_seconds"),
         multi_instance=mi,
         input_data=tuple(_get(task, "input_data", default=[])),

@@ -761,6 +761,7 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                        kinds: Optional[tuple[str, ...]] = None,
                        injections_per_kind: int = 1,
                        preempt: bool = False,
+                       victim: bool = False,
                        evict: bool = False,
                        resize: bool = False,
                        migrate: bool = False,
@@ -778,7 +779,11 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     node_preempt_notice schedule against a running 4-node gang —
     cooperative drain, forced COMMITTED checkpoint, zero lost steps,
     retry budget + node health untouched, preemption_recovery
-    populated.
+    populated. ``victim=True`` runs the victim-SELECTION drill: two
+    eligible victims (a warm-cache never-committer vs a per-step
+    committer), a strictly higher-priority starver — the sweep's
+    goodput-cost ordering (sched/policy.py) must elect the cheap
+    victim even though the id tie-break points at the costly one.
 
     The fleet-elasticity drills (one flag each, ISSUE 12):
     ``evict=True`` — an --ignore-notice victim burns its grace
@@ -804,6 +809,7 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     ledger (one start, retries==0, the ``adoption`` leg priced)."""
     from batch_shipyard_tpu.chaos import drill
     picked = [flag for flag, on in (("preempt", preempt),
+                                    ("victim", victim),
                                     ("evict", evict),
                                     ("resize", resize),
                                     ("migrate", migrate),
@@ -816,6 +822,8 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     if preempt:
         report = drill.run_preemption_drill(seed=seed,
                                             duration=duration)
+    elif victim:
+        report = drill.run_victim_selection_drill(seed=seed)
     elif evict:
         report = drill.run_eviction_drill(seed=seed,
                                           duration=duration)
@@ -841,6 +849,84 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
            "applied": report["applied"],
            "goodput": report.get("goodput", {})}, raw)
     return report
+
+
+# ------------------------------ fleet sim ------------------------------
+
+def action_sim_run(ctx_or_none, scenario: str = "steady",
+                   policy: str = "baseline", seed: int = 0,
+                   nodes: int = 200, tasks: int = 2000,
+                   raw: bool = False) -> dict:
+    """One discrete-event fleet simulation (sim/simulator.py): a named
+    scenario (sim/scenarios.py) at ``nodes`` virtual nodes under one
+    policy bundle (sched/policy.py POLICIES), priced by the real
+    goodput engine. Deterministic: same (seed, scenario, shape,
+    policy) ⇒ byte-identical report (the fingerprint pins it). Needs
+    no live pool or config context."""
+    from batch_shipyard_tpu.sim import scenarios as sim_scenarios
+    from batch_shipyard_tpu.sim import simulator as sim_mod
+    kwargs = sim_scenarios.build(scenario, seed, nodes, tasks)
+    report = sim_mod.run_sim(policy=policy, **kwargs)
+    report["scenario"] = scenario
+    report["seed"] = seed
+    _emit(report, raw)
+    return report
+
+
+def action_sim_scenarios(ctx_or_none, raw: bool = False) -> dict:
+    """List the scenario registry (sim/scenarios.py) and the policy
+    bundles it can be run under."""
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    from batch_shipyard_tpu.sim import scenarios as sim_scenarios
+    payload = {
+        "scenarios": dict(sorted(sim_scenarios.DESCRIPTIONS.items())),
+        "policies": {
+            name: {"claim_scoring": cfg.claim_scoring,
+                   "victim_by_cost": cfg.victim_by_cost,
+                   "autoscale_goodput": cfg.autoscale_goodput}
+            for name, cfg in sched_policy.POLICIES.items()},
+    }
+    _emit(payload, raw)
+    return payload
+
+
+def action_sim_compare(ctx_or_none, scenario: str = "steady",
+                       policies: Optional[tuple[str, ...]] = None,
+                       seed: int = 0, nodes: int = 200,
+                       tasks: int = 2000, raw: bool = False) -> dict:
+    """Run one scenario under several policy bundles (always including
+    ``baseline``) and report each policy's goodput delta vs baseline —
+    the before/after partition the fleet simulator exists to produce.
+    The summary keeps the full per-policy reports under ``runs``."""
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    from batch_shipyard_tpu.sim import scenarios as sim_scenarios
+    from batch_shipyard_tpu.sim import simulator as sim_mod
+    names_list = list(policies) if policies else \
+        list(sched_policy.POLICIES)
+    if "baseline" not in names_list:
+        names_list.insert(0, "baseline")
+    reports = {}
+    for name in names_list:
+        kwargs = sim_scenarios.build(scenario, seed, nodes, tasks)
+        reports[name] = sim_mod.run_sim(policy=name, **kwargs)
+    compared = sim_mod.compare(reports)
+    summary = {"scenario": scenario, "seed": seed, "nodes": nodes,
+               "tasks": tasks, "policies": {}}
+    for name, entry in compared.items():
+        rep = entry["report"]
+        row = {"goodput_ratio": rep["goodput"]["goodput_ratio"],
+               "fingerprint": rep["fingerprint"]}
+        if "delta_vs_baseline" in entry:
+            row["goodput_ratio_delta"] = \
+                entry["delta_vs_baseline"]["goodput_ratio_delta"]
+            row["badput_seconds_delta"] = \
+                entry["delta_vs_baseline"]["badput_seconds_delta"]
+            row["queue_wait_mean_delta"] = \
+                entry["queue_wait_mean_delta"]
+        summary["policies"][name] = row
+    _emit(summary, raw)
+    summary["runs"] = reports
+    return summary
 
 
 def action_data_stream(ctx: Context, job_id: str, task_id: str,

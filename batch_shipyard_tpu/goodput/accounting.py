@@ -193,6 +193,15 @@ def _split_step_windows(windows: list[dict]) -> list[tuple]:
 
 
 def _split_step_windows_one_job(windows: list[dict]) -> list[tuple]:
+    return [(start, end, category) for (_event, start, end, category)
+            in _split_step_windows_one_job_detailed(windows)]
+
+
+def _split_step_windows_one_job_detailed(windows: list[dict]
+                                         ) -> list[tuple]:
+    """(event, start, end, category) pieces — the event reference
+    lets callers re-attribute a piece to the node that executed it
+    (see _replay_recovery_spans)."""
     pieces: list[tuple] = []
     completed: list[tuple] = []  # (end_time, step_end)
     for event in sorted(windows, key=lambda e: (e.get("start", 0.0),
@@ -204,7 +213,7 @@ def _split_step_windows_one_job(windows: list[dict]) -> list[tuple]:
         step_end = _as_int(attrs.get("step_end"))
         if step_start is None or step_end is None or \
                 step_end <= step_start:
-            pieces.append((start, end, PRODUCTIVE))
+            pieces.append((event, start, end, PRODUCTIVE))
             continue
         # High-water mark over windows that ENDED before this one
         # started — concurrent (overlapping) gang instances never
@@ -217,11 +226,47 @@ def _split_step_windows_one_job(windows: list[dict]) -> list[tuple]:
         frac = min(1.0, replayed / (step_end - step_start))
         cut = start + (end - start) * frac
         if frac > 0:
-            pieces.append((start, cut, "preemption_recovery"))
+            pieces.append((event, start, cut, "preemption_recovery"))
         if frac < 1.0:
-            pieces.append((cut, end, PRODUCTIVE))
+            pieces.append((event, cut, end, PRODUCTIVE))
         completed.append((end, step_end))
     return pieces
+
+
+def _replay_recovery_spans(event_list: list[dict]) -> list[dict]:
+    """Explicit recovery spans for replayed step-window prefixes,
+    computed over the FULL event set.
+
+    ``_split_step_windows`` tracks the per-job step high-water mark,
+    but ``decompose_by_node`` sweeps each node's events on its own
+    timeline — a task preempted on node A whose replay runs on node B
+    has its two windows in different groups, and B's sweep would
+    price the rework as productive (it never sees A's completed
+    range). This pre-pass finds every replayed prefix globally and
+    emits a synthetic preemption-recovery span tagged to the node
+    that EXECUTED the replay, so the per-node sweep prices it no
+    matter where the task resumed. Same-node replay is double-covered
+    (split piece + synthetic span) — harmless, the priority sweep
+    charges each elementary second once."""
+    windows = [e for e in event_list
+               if e.get("kind") == ev.PROGRAM_STEP_WINDOW]
+    spans: list[dict] = []
+    by_job: dict = {}
+    for event in windows:
+        by_job.setdefault(event.get("job_id"), []).append(event)
+    for group in by_job.values():
+        for event, start, end, category in \
+                _split_step_windows_one_job_detailed(group):
+            if category != "preemption_recovery" or end <= start:
+                continue
+            spans.append({
+                "kind": ev.TASK_PREEMPT_RECOVERY,
+                "start": start, "end": end,
+                "node_id": event.get("node_id"),
+                "job_id": event.get("job_id"),
+                "task_id": event.get("task_id"),
+                "attrs": {"synthetic": "cross_node_replay"}})
+    return spans
 
 
 def _sweep(intervals: list[tuple], wall_start: float,
@@ -410,6 +455,9 @@ def decompose_by_node(event_list: list[dict],
     spans, pool resize, ingested program phases that predate node
     tagging) form their own group. ``left_cutoff`` clips each group's
     wall at the trailing-window boundary."""
+    # Cross-node replay must be priced BEFORE per-node grouping —
+    # see _replay_recovery_spans.
+    event_list = list(event_list) + _replay_recovery_spans(event_list)
     groups: dict = {}
     for event in event_list:
         groups.setdefault(event.get("node_id"), []).append(event)
@@ -563,6 +611,45 @@ def fleet_report(store: StateStore,
         "compile_saved_seconds": compile_saved,
         "compile_cache_hits": compile_hits,
         "compile_cache_misses": compile_misses,
+    }
+
+
+def report_delta(baseline: dict[str, Any],
+                 candidate: dict[str, Any]) -> dict[str, Any]:
+    """Category-exact comparison of two decompositions (sim policy
+    runs, before/after drill snapshots): per-category badput deltas,
+    the three goodput legs, and the headline ratio — candidate minus
+    baseline, so negative badput deltas are seconds the candidate
+    bought back. Pure over report dicts; the fleet-sim bench and
+    ``shipyard sim compare`` both render from this."""
+    def _f(report: dict, key: str) -> float:
+        return float(report.get(key, 0.0) or 0.0)
+
+    badput = {}
+    for category in BADPUT_CATEGORIES:
+        base = float((baseline.get("badput_seconds") or {})
+                     .get(category, 0.0) or 0.0)
+        cand = float((candidate.get("badput_seconds") or {})
+                     .get(category, 0.0) or 0.0)
+        badput[category] = cand - base
+    return {
+        "goodput_ratio_delta": (_f(candidate, "goodput_ratio")
+                                - _f(baseline, "goodput_ratio")),
+        "availability_goodput_delta": (
+            _f(candidate, "availability_goodput")
+            - _f(baseline, "availability_goodput")),
+        "resource_goodput_delta": (
+            _f(candidate, "resource_goodput")
+            - _f(baseline, "resource_goodput")),
+        "program_goodput_delta": (
+            _f(candidate, "program_goodput")
+            - _f(baseline, "program_goodput")),
+        "productive_seconds_delta": (
+            _f(candidate, "productive_seconds")
+            - _f(baseline, "productive_seconds")),
+        "wall_seconds_delta": (_f(candidate, "wall_seconds")
+                               - _f(baseline, "wall_seconds")),
+        "badput_seconds_delta": badput,
     }
 
 

@@ -59,6 +59,7 @@ def _task_spec(task: TaskSettings, job: JobSettings,
         "max_task_retries": task.max_task_retries,
         "max_wall_time_seconds": task.max_wall_time_seconds,
         "progress_deadline_seconds": task.progress_deadline_seconds,
+        "compile_cache_identity": task.compile_cache_identity,
         "retention_time_seconds": task.retention_time_seconds,
         "remove_container_after_exit": task.remove_container_after_exit,
         "shm_size": task.shm_size,

@@ -137,6 +137,23 @@ def evaluate(store: StateStore, pool: PoolSettings,
             target = _clamp(needed, scenario, samples.current_nodes)
             reason = (f"{name}: backlog={backlog} "
                       f"slots/node={samples.task_slots_per_node}")
+        elif name == "goodput":
+            # Goodput-as-controller: size the fleet where the marginal
+            # node stops paying for its own provisioning badput with
+            # saved queueing badput (sched/policy.py autoscale_target —
+            # the SAME function the fleet simulator prices, so the sim's
+            # measured goodput deltas transfer to this live path).
+            from batch_shipyard_tpu.sched import policy as sched_policy
+            knobs = sched_policy.knobs_from_settings(
+                getattr(pool, "sched_policy", None))
+            raw, why = sched_policy.autoscale_target(
+                pending_tasks=samples.pending_tasks,
+                active_tasks=samples.active_tasks,
+                current_nodes=samples.current_nodes,
+                slots_per_node=samples.task_slots_per_node,
+                knobs=knobs)
+            target = _clamp(raw, scenario, samples.current_nodes)
+            reason = f"goodput: {why}"
         elif name in ("workday", "weekday", "weekend",
                       "workday_with_offpeak_max_low_priority"):
             in_range = _in_time_range(samples.now, name,

@@ -1,0 +1,12 @@
+"""Discrete-event fleet simulator (virtual clock, real policies).
+
+Thousands of virtual nodes, synthetic/replayed arrival traces, and
+the chaos inventory expressed as scenario schedules — priced by the
+REAL goodput engine (goodput/accounting.py) and decided by the REAL
+scheduling policies (sched/policy.py), so a simulated policy delta is
+evidence about production decision code.
+
+Wall-clock reads are banned in this package outside ``clock.py`` —
+enforced by the ``sim-wall-clock`` analyzer rule (shipyard lint): a
+single ``time.time()`` silently corrupts virtual-time determinism.
+"""

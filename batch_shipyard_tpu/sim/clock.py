@@ -1,0 +1,68 @@
+"""Virtual clock + event heap: the simulator's only notion of time.
+
+THE one module in ``batch_shipyard_tpu/sim/`` allowed to even import
+wall-clock sources (it doesn't need to: virtual time starts at 0.0
+and advances only by popping the heap). Everything else in the
+package is banned from ``time.time()``/``time.monotonic()``/
+``datetime.now()`` by the ``sim-wall-clock`` analyzer rule — one
+stray wall-clock read makes reports differ across runs and kills the
+byte-identical determinism contract (tests/test_fleet_sim.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, Optional
+
+
+class VirtualClock:
+    """Monotonic virtual time; advances only via the event heap."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        if t < self._now:
+            raise ValueError(
+                f"virtual time went backwards: {t} < {self._now}")
+        self._now = t
+
+
+class EventHeap:
+    """Deterministic priority queue of (time, seq, fn, payload).
+
+    The monotonically increasing ``seq`` breaks same-time ties by
+    schedule order — never by hash/dict order — so two runs with the
+    same seed pop events identically."""
+
+    def __init__(self, clock: VirtualClock) -> None:
+        self._clock = clock
+        self._heap: list = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def schedule(self, at: float, fn: Callable,
+                 payload: Any = None) -> None:
+        if at < self._clock.now:
+            at = self._clock.now
+        self._seq += 1
+        heapq.heappush(self._heap, (at, self._seq, fn, payload))
+
+    def schedule_in(self, delay: float, fn: Callable,
+                    payload: Any = None) -> None:
+        self.schedule(self._clock.now + max(0.0, delay), fn, payload)
+
+    def pop(self) -> Optional[tuple]:
+        """Advance the clock to the next event and return
+        (fn, payload); None when drained."""
+        if not self._heap:
+            return None
+        at, _seq, fn, payload = heapq.heappop(self._heap)
+        self._clock.advance_to(at)
+        return fn, payload

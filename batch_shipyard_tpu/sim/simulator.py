@@ -1,0 +1,889 @@
+"""Discrete-event fleet simulator: real policies, real pricing.
+
+One event heap, one virtual clock (sim/clock.py), thousands of
+``SimNode`` slots, and an arrival trace (sim/traces.py). Decisions —
+which node claims, whether a cold node defers to a warm one, which
+running task a starved high-priority task preempts, how many nodes
+the fleet should hold — are made by the SHARED policy functions in
+``sched/policy.py`` (the same code the live agent/autoscale paths
+import). Every lifecycle edge emits the same goodput event dicts the
+live system logs, and the final report is priced by the REAL engine
+(``goodput.accounting.decompose_by_node``), so a policy's simulated
+goodput delta is a statement about production decision code under
+the production pricing model.
+
+Determinism contract: same (seed, trace, policy) ⇒ byte-identical
+report (tests/test_fleet_sim.py). Everything is a pure function of
+the inputs — seeded RNGs only, heap ties broken by schedule order,
+and zero wall-clock reads (the ``sim-wall-clock`` analyzer rule
+bans them in this package outside clock.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import json
+from collections import deque
+from typing import Any, Optional
+
+from batch_shipyard_tpu.goodput import accounting
+from batch_shipyard_tpu.goodput import events as ev
+from batch_shipyard_tpu.sched import policy as sched_policy
+from batch_shipyard_tpu.sim import clock as sim_clock
+from batch_shipyard_tpu.sim.traces import SimTask
+
+# Control-plane constants (virtual seconds): claim round trip, the
+# defer-retry poll, the cooperative-drain notice latency, and the
+# sweep cadence — fixed, not knobs: they model the substrate, not
+# the policy under study.
+CLAIM_LATENCY = 0.1
+DEFER_RETRY_SECONDS = 1.0
+NOTICE_LATENCY = 0.5
+SWEEP_INTERVAL = 15.0
+SWEEP_GRACE = 30.0
+AUTOSCALE_TICK = 30.0
+
+
+class SimNode:
+    __slots__ = ("idx", "name", "up", "free", "health",
+                 "fail_count", "warm", "pause_until", "born",
+                 "retired_at")
+
+    def __init__(self, idx: int, slots: int, born: float) -> None:
+        self.idx = idx
+        self.name = f"n{idx:05d}"
+        self.up = True
+        self.free = slots
+        self.health = 1.0
+        self.fail_count = 0
+        self.warm: set = set()
+        self.pause_until = 0.0
+        self.born = born
+        self.retired_at: Optional[float] = None
+
+
+class _Running:
+    __slots__ = ("task", "node", "attempt", "start_step",
+                 "work_start", "drain_at", "preempt_pending")
+
+    def __init__(self, task: SimTask, node: SimNode, attempt: int,
+                 start_step: int, work_start: float) -> None:
+        self.task = task
+        self.node = node
+        self.attempt = attempt
+        self.start_step = start_step
+        self.work_start = work_start
+        self.drain_at: Optional[float] = None
+        self.preempt_pending = False
+
+
+class _Pending:
+    __slots__ = ("task", "resume_step", "queue_since", "recovery",
+                 "killed_at", "deferrals")
+
+    def __init__(self, task: SimTask, resume_step: int = 0,
+                 queue_since: Optional[float] = None,
+                 recovery: Optional[str] = None,
+                 killed_at: Optional[float] = None) -> None:
+        self.task = task
+        self.resume_step = resume_step
+        self.queue_since = (task.arrival if queue_since is None
+                            else queue_since)
+        self.recovery = recovery  # None | "preempt" | "evict"
+        self.killed_at = killed_at
+        self.deferrals = 0
+
+
+class FleetSimulator:
+    """One simulation run. Build, ``run()``, read ``report()``."""
+
+    def __init__(self, *, trace: list, nodes: int,
+                 slots_per_node: int = 1,
+                 policy: str = "baseline",
+                 knobs: Optional[sched_policy.PolicyKnobs] = None,
+                 injections: tuple = (),
+                 autoscale: bool = False,
+                 min_nodes: int = 1,
+                 max_nodes: Optional[int] = None,
+                 provision_seconds: float = 120.0,
+                 horizon: Optional[float] = None) -> None:
+        self.policy = sched_policy.POLICIES[policy] \
+            if isinstance(policy, str) else policy
+        self.knobs = knobs or sched_policy.PolicyKnobs()
+        self.clock = sim_clock.VirtualClock()
+        self.heap = sim_clock.EventHeap(self.clock)
+        self.slots = max(1, slots_per_node)
+        self.autoscale = autoscale
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes if max_nodes is not None else nodes
+        self.provision_seconds = provision_seconds
+        self.horizon = horizon
+        self.nodes: list[SimNode] = [
+            SimNode(i, self.slots, 0.0) for i in range(nodes)]
+        self._free_heap: list = list(range(nodes))
+        heapq.heapify(self._free_heap)
+        # Max-index twin of the free heap: cold claims under the
+        # affinity policy spread AWAY from the warm low-index core
+        # (anti-affinity), so a freed warm node survives until its
+        # identity's next task retries instead of being snatched as
+        # the "best" cold node.
+        self._free_heap_max: list = [-i for i in range(nodes)]
+        heapq.heapify(self._free_heap_max)
+        self._warm_free: dict[str, list] = {}
+        self._warm_count: dict[str, int] = {}
+        self.events: list[dict] = []
+        self._pending: dict[int, deque] = {}   # priority -> deque
+        self._running: dict[str, _Running] = {}
+        self._attempts: dict[str, int] = {}
+        # chaos state
+        self._claim_freeze_until = 0.0
+        self._claim_extra_latency = 0.0
+        self._claim_backoff_until = 0.0
+        self._sweep_frozen_until = 0.0
+        self.metrics: dict[str, Any] = {
+            "tasks_total": len(trace), "tasks_completed": 0,
+            "queue_wait_total": 0.0, "queue_wait_max": 0.0,
+            "deferrals": 0, "sweep_victims": 0, "preemptions": 0,
+            "evictions": 0, "replayed_steps": 0, "kills": 0,
+            "nodes_added": 0, "nodes_removed": 0,
+        }
+        for task in trace:
+            self.heap.schedule(task.arrival, self._on_arrival,
+                               _Pending(task))
+        for inj in injections:
+            self.heap.schedule(inj.at, self._on_injection, inj)
+        self.heap.schedule(SWEEP_INTERVAL, self._on_sweep, None)
+        if autoscale:
+            self.heap.schedule(AUTOSCALE_TICK, self._on_autoscale,
+                               None)
+
+    # ------------------------- event emission -------------------------
+
+    def _emit(self, kind: str, start: float, end: float,
+              node: Optional[SimNode] = None,
+              task_id: Optional[str] = None,
+              **attrs) -> None:
+        self.events.append({
+            "kind": kind, "start": start, "end": end,
+            "node_id": node.name if node is not None else None,
+            "job_id": task_id, "task_id": task_id,
+            "attrs": attrs or {}})
+
+    # --------------------------- free index ---------------------------
+
+    def _node_claimable(self, node: SimNode) -> bool:
+        return (node.up and node.free > 0
+                and node.pause_until <= self.clock.now)
+
+    def _push_free(self, node: SimNode) -> None:
+        heapq.heappush(self._free_heap, node.idx)
+        heapq.heappush(self._free_heap_max, -node.idx)
+        for identity in node.warm:
+            heapq.heappush(
+                self._warm_free.setdefault(identity, []), node.idx)
+
+    def _pop_free(self, skip: Optional[int] = None,
+                  coldest: bool = False) -> Optional[SimNode]:
+        heap = self._free_heap_max if coldest else self._free_heap
+        sign = -1 if coldest else 1
+        stash = None
+        while heap:
+            idx = sign * heapq.heappop(heap)
+            node = self.nodes[idx]
+            if idx == skip:
+                if stash is None and self._node_claimable(node):
+                    stash = idx
+                continue
+            if self._node_claimable(node):
+                if stash is not None:
+                    heapq.heappush(heap, sign * stash)
+                return node
+        if stash is not None:
+            heapq.heappush(heap, sign * stash)
+        return None
+
+    def _pop_warm_free(self, identity: str) -> Optional[SimNode]:
+        heap = self._warm_free.get(identity)
+        while heap:
+            idx = heapq.heappop(heap)
+            node = self.nodes[idx]
+            if self._node_claimable(node) and identity in node.warm:
+                return node
+        return None
+
+    # --------------------------- dispatch ----------------------------
+
+    def _on_arrival(self, pend: _Pending) -> None:
+        self._enqueue(pend)
+        self._dispatch()
+
+    def _enqueue(self, pend: _Pending) -> None:
+        self._pending.setdefault(
+            pend.task.priority, deque()).append(pend)
+
+    def _claimable_now(self) -> bool:
+        return self._claim_freeze_until <= self.clock.now
+
+    def _dispatch(self) -> None:
+        if not self._claimable_now():
+            return
+        while True:
+            queue = None
+            for priority in sorted(self._pending, reverse=True):
+                if self._pending[priority]:
+                    queue = self._pending[priority]
+                    break
+            if queue is None:
+                return
+            pend = queue[0]
+            node, warm, score = self._pick_node(pend.task)
+            if node is None:
+                return
+            queue.popleft()
+            if self._maybe_defer(pend, node, warm, score):
+                continue
+            self._start(pend, node, warm)
+
+    def _pick_node(self, task: SimTask) -> tuple:
+        """(node, warm, score) via the SHARED claim-scoring policy:
+        the best warm candidate and the best cold candidate are
+        scored by sched_policy.claim_score and the cheaper claim
+        wins (ties to the lower node index — deterministic)."""
+        identity = task.cache_identity
+        if not (self.policy.claim_scoring and identity):
+            node = self._pop_free()
+            if node is None:
+                return None, False, 0.0
+            return node, bool(identity) and identity in node.warm, 0.0
+        warm_node = self._pop_warm_free(identity)
+        cold_node = self._pop_free(
+            skip=warm_node.idx if warm_node else None, coldest=True)
+        best = None
+        for node, warm in ((warm_node, True), (cold_node, False)):
+            if node is None:
+                continue
+            score = sched_policy.claim_score(
+                warm=warm, health=node.health,
+                recent_failures=node.fail_count,
+                has_identity=True, knobs=self.knobs)
+            key = (score, node.idx)
+            if best is None or key < best[0]:
+                if best is not None:
+                    self._push_free(best[1])
+                best = (key, node, warm)
+            else:
+                self._push_free(node)
+        if best is None:
+            return None, False, 0.0
+        return best[1], best[2], best[0][0]
+
+    def _maybe_defer(self, pend: _Pending, node: SimNode,
+                     warm: bool, score: float) -> bool:
+        """Affinity window (shared should_defer_claim): a cold claim
+        for an identity some busy node is warm for hands the task
+        back for a beat; past the window it always places."""
+        if not self.policy.claim_scoring or warm:
+            return False
+        identity = pend.task.cache_identity
+        if not identity or not self._warm_count.get(identity):
+            return False
+        queued = self.clock.now - pend.queue_since
+        if not sched_policy.should_defer_claim(score, queued,
+                                               self.knobs):
+            return False
+        self._push_free(node)
+        pend.deferrals += 1
+        self.metrics["deferrals"] += 1
+        self.heap.schedule_in(DEFER_RETRY_SECONDS, self._on_arrival,
+                              pend)
+        return True
+
+    def _start(self, pend: _Pending, node: SimNode,
+               warm: bool) -> None:
+        now = self.clock.now
+        task = pend.task
+        node.free -= 1
+        claim_t = now + CLAIM_LATENCY + self._claim_extra_latency
+        if self._claim_backoff_until > now:
+            # store_error window: the first claim round trip fails
+            # and the retry supervisor's backoff is paid explicitly.
+            self._emit(ev.TASK_BACKOFF, now, now + 1.0, node,
+                       task.task_id)
+            claim_t += 1.0
+        wait = claim_t - pend.queue_since
+        self.metrics["queue_wait_total"] += wait
+        if wait > self.metrics["queue_wait_max"]:
+            self.metrics["queue_wait_max"] = wait
+        self._emit(ev.TASK_QUEUED, pend.queue_since, claim_t, node,
+                   task.task_id)
+        if pend.recovery == "preempt":
+            self._emit(ev.TASK_PREEMPT_RECOVERY, pend.killed_at,
+                       claim_t, node, task.task_id)
+        elif pend.recovery == "evict":
+            self._emit(ev.TASK_EVICTION_RECOVERY, pend.killed_at,
+                       claim_t, node, task.task_id)
+        work_start = claim_t
+        identity = task.cache_identity
+        if identity:
+            if warm and identity in node.warm:
+                self._emit(ev.PROGRAM_COMPILE, claim_t, claim_t,
+                           node, task.task_id, cache_hit=True,
+                           saved_seconds=task.compile_seconds)
+            else:
+                work_start = claim_t + task.compile_seconds
+                self._emit(ev.PROGRAM_COMPILE, claim_t, work_start,
+                           node, task.task_id, cache_hit=False)
+                if identity not in node.warm:
+                    node.warm.add(identity)
+                    self._warm_count[identity] = \
+                        self._warm_count.get(identity, 0) + 1
+        attempt = self._attempts.get(task.task_id, 0) + 1
+        self._attempts[task.task_id] = attempt
+        run = _Running(task, node, attempt, pend.resume_step,
+                       work_start)
+        self._running[task.task_id] = run
+        end = work_start + self._attempt_seconds(run)
+        self.heap.schedule(end, self._on_complete,
+                           (task.task_id, attempt))
+
+    def _attempt_seconds(self, run: _Running) -> float:
+        task = run.task
+        remaining = max(0, task.steps - run.start_step)
+        seconds = remaining * task.step_seconds
+        if task.ckpt_every > 0 and task.ckpt_seconds > 0.0:
+            commits = (task.steps // task.ckpt_every
+                       - run.start_step // task.ckpt_every)
+            seconds += max(0, commits) * task.ckpt_seconds
+        return seconds
+
+    def _on_complete(self, payload: tuple) -> None:
+        task_id, attempt = payload
+        run = self._running.get(task_id)
+        if run is None or run.attempt != attempt:
+            return  # attempt superseded by a kill/preempt
+        now = self.clock.now
+        task = run.task
+        del self._running[task_id]
+        if task.steps > run.start_step:
+            self._emit(ev.PROGRAM_STEP_WINDOW, run.work_start, now,
+                       run.node, task_id,
+                       step_start=run.start_step,
+                       step_end=task.steps)
+        if task.ckpt_every > 0 and task.ckpt_seconds > 0.0:
+            commits = max(0, task.steps // task.ckpt_every
+                          - run.start_step // task.ckpt_every)
+            if commits:
+                dur = commits * task.ckpt_seconds
+                self._emit(ev.PROGRAM_CHECKPOINT_SAVE, now - dur,
+                           now, run.node, task_id)
+        self.metrics["tasks_completed"] += 1
+        self._free_slot(run.node)
+        self._dispatch()
+
+    def _free_slot(self, node: SimNode) -> None:
+        node.free += 1
+        if node.up:
+            self._push_free(node)
+
+    # ----------------------- kills and preemption ----------------------
+
+    def _executed_steps(self, run: _Running, at: float) -> int:
+        if at <= run.work_start:
+            return run.start_step
+        done = run.start_step + int(
+            (at - run.work_start) / run.task.step_seconds)
+        return min(run.task.steps, max(run.start_step, done))
+
+    def _committed_step(self, run: _Running, executed: int) -> int:
+        if run.task.ckpt_every <= 0:
+            return min(run.start_step, executed)
+        cadenced = (executed // run.task.ckpt_every) \
+            * run.task.ckpt_every
+        return max(run.start_step, min(cadenced, executed))
+
+    def _kill(self, run: _Running, *, drained: bool,
+              recovery: Optional[str], free_slot: bool = True,
+              requeue: bool = True) -> None:
+        """End a running attempt at virtual-now. ``drained`` means
+        the victim got to flush a cooperative step-boundary commit —
+        zero replay, but only for a task that checkpoints at all; a
+        never-committing workload loses everything it executed no
+        matter how polite the notice was. Hard kills always resume
+        from the last COMMITTED step and the engine prices the
+        replayed overlap as rework."""
+        now = self.clock.now
+        task = run.task
+        self._running.pop(task.task_id, None)
+        executed = self._executed_steps(run, now)
+        if executed > run.start_step and now > run.work_start:
+            self._emit(ev.PROGRAM_STEP_WINDOW, run.work_start, now,
+                       run.node, task.task_id,
+                       step_start=run.start_step, step_end=executed)
+        resume = executed if drained and task.ckpt_every > 0 \
+            else self._committed_step(run, executed)
+        self.metrics["kills"] += 1
+        self.metrics["replayed_steps"] += executed - resume
+        if recovery == "preempt":
+            self.metrics["preemptions"] += 1
+        elif recovery == "evict":
+            self.metrics["evictions"] += 1
+        if free_slot:
+            self._free_slot(run.node)
+        if requeue:
+            self._enqueue(_Pending(task, resume_step=resume,
+                                   queue_since=now,
+                                   recovery=recovery,
+                                   killed_at=now))
+
+    def _drain(self, run: _Running, recovery: str = "preempt",
+               notice: float = NOTICE_LATENCY) -> None:
+        """Cooperative preemption: the victim commits at its next
+        step boundary after the notice lands, then exits preempted —
+        the live drain protocol (agent/preemption.py) in virtual
+        time."""
+        if run.preempt_pending:
+            return
+        run.preempt_pending = True
+        now = self.clock.now + notice
+        step_s = run.task.step_seconds
+        if now <= run.work_start:
+            boundary = run.work_start
+        else:
+            k = -(-(now - run.work_start) // step_s)  # ceil
+            boundary = run.work_start + k * step_s
+        run.attempt += 1  # invalidate the scheduled completion
+        self._attempts[run.task.task_id] = run.attempt
+        self.heap.schedule(boundary, self._on_drained,
+                           (run, recovery))
+
+    def _on_drained(self, payload: tuple) -> None:
+        run, recovery = payload
+        if self._running.get(run.task.task_id) is not run:
+            return  # killed harder in the meantime
+        self._kill(run, drained=True, recovery=recovery)
+        self._dispatch()
+
+    # ---------------------------- the sweep ----------------------------
+
+    def _on_sweep(self, _payload) -> None:
+        self.heap.schedule_in(SWEEP_INTERVAL, self._on_sweep, None)
+        if self._sweep_frozen_until > self.clock.now:
+            return
+        now = self.clock.now
+        starved = []
+        for priority in sorted(self._pending, reverse=True):
+            for pend in self._pending[priority]:
+                if now - pend.queue_since >= SWEEP_GRACE:
+                    starved.append((priority, pend.queue_since,
+                                    pend.task.task_id))
+        if not starved:
+            return
+        starved.sort(key=lambda t: (-t[0], t[1], t[2]))
+        victims = []
+        for run in self._running.values():
+            if run.preempt_pending:
+                continue
+            cost = 0.0
+            if self.policy.victim_by_cost:
+                executed = self._executed_steps(run, now)
+                cost = sched_policy.victim_cost(
+                    warm=bool(run.task.cache_identity),
+                    steps_since_commit=(
+                        executed - self._committed_step(run,
+                                                        executed)),
+                    step_seconds=run.task.step_seconds,
+                    gang_size=run.task.gang_size, knobs=self.knobs)
+            victims.append((sched_policy.victim_sort_key(
+                run.task.priority, cost, run.task.task_id), run))
+        victims.sort(key=lambda t: t[0])
+        i = 0
+        for priority, _since, _tid in starved:
+            if i >= len(victims) or victims[i][0][0] >= priority:
+                break
+            self._drain(victims[i][1], recovery="preempt")
+            self.metrics["sweep_victims"] += 1
+            i += 1
+
+    # --------------------------- autoscale -----------------------------
+
+    def _up_nodes(self) -> list:
+        return [n for n in self.nodes if n.up]
+
+    def _on_autoscale(self, _payload) -> None:
+        self.heap.schedule_in(AUTOSCALE_TICK, self._on_autoscale,
+                              None)
+        pending = sum(len(q) for q in self._pending.values())
+        active = len(self._running)
+        up = self._up_nodes()
+        current = len(up)
+        if self.policy.autoscale_goodput:
+            target, _reason = sched_policy.autoscale_target(
+                pending_tasks=pending, active_tasks=active,
+                current_nodes=current, slots_per_node=self.slots,
+                knobs=self.knobs)
+        else:
+            # Reactive baseline (pool/autoscale.py "pending_tasks"
+            # scenario shape): size straight to the backlog.
+            target = -(-(active + pending) // self.slots)
+        target = max(self.min_nodes, min(self.max_nodes, target))
+        if target > current:
+            self._scale_up(target - current)
+        elif target < current:
+            self._scale_down(current - target)
+
+    def _scale_up(self, count: int) -> None:
+        now = self.clock.now
+        for _ in range(count):
+            idx = len(self.nodes)
+            node = SimNode(idx, self.slots, now)
+            node.up = False  # joins after provisioning
+            self.nodes.append(node)
+            self._emit(ev.NODE_PROVISIONING, now,
+                       now + self.provision_seconds, node)
+            self.heap.schedule(now + self.provision_seconds,
+                               self._on_node_up, node)
+            self.metrics["nodes_added"] += 1
+
+    def _on_node_up(self, node: SimNode) -> None:
+        node.up = True
+        node.retired_at = None
+        self._push_free(node)
+        self._dispatch()
+
+    def _scale_down(self, count: int) -> None:
+        removed = 0
+        for node in reversed(self.nodes):
+            if removed >= count:
+                break
+            if node.up and node.free == self.slots:
+                self._retire_node(node)
+                removed += 1
+        self.metrics["nodes_removed"] += removed
+
+    def _retire_node(self, node: SimNode) -> None:
+        node.up = False
+        node.retired_at = self.clock.now
+        for identity in node.warm:
+            self._warm_count[identity] = max(
+                0, self._warm_count.get(identity, 0) - 1)
+        node.warm.clear()
+
+    # ------------------------- chaos adapters --------------------------
+    # Applied via sim/scenarios.KIND_ADAPTERS (every chaos/plan.py
+    # INJECTION_KINDS entry maps to one of these or is declared
+    # excluded — enforced by tests/test_names_consistency.py).
+
+    def _on_injection(self, inj) -> None:
+        from batch_shipyard_tpu.sim import scenarios
+        adapter = scenarios.KIND_ADAPTERS.get(inj.kind)
+        if adapter is not None:
+            adapter(self, inj)
+            self._dispatch()
+
+    def _node_for(self, inj) -> Optional[SimNode]:
+        up = self._up_nodes()
+        if not up:
+            return None
+        return up[inj.node_index % len(up)]
+
+    def _runs_on(self, node: SimNode) -> list:
+        return sorted((r for r in self._running.values()
+                       if r.node is node),
+                      key=lambda r: r.task.task_id)
+
+    def chaos_store_delay(self, inj) -> None:
+        params = dict(inj.params)
+        delay = float(params.get("delay", 0.5))
+        window = float(params.get("window",
+                                  params.get("duration", 5.0)))
+        self._claim_extra_latency += delay
+        self.heap.schedule_in(window, self._chaos_store_delay_end,
+                              delay)
+
+    def _chaos_store_delay_end(self, delay: float) -> None:
+        self._claim_extra_latency = max(
+            0.0, self._claim_extra_latency - delay)
+
+    def chaos_store_error(self, inj) -> None:
+        params = dict(inj.params)
+        self._claim_backoff_until = max(
+            self._claim_backoff_until,
+            self.clock.now + float(params.get(
+                "window", params.get("duration", 5.0))))
+
+    def chaos_heartbeat_blackout(self, inj) -> None:
+        node = self._node_for(inj)
+        if node is None:
+            return
+        params = dict(inj.params)
+        node.pause_until = self.clock.now + float(params.get(
+            "window", params.get("duration", 10.0)))
+
+    def chaos_task_kill(self, inj) -> None:
+        node = self._node_for(inj)
+        runs = self._runs_on(node) if node else []
+        if runs:
+            self._emit(ev.TASK_RETRY, self.clock.now,
+                       self.clock.now, node, runs[0].task.task_id)
+            self._kill(runs[0], drained=False, recovery=None)
+
+    def chaos_task_wedge(self, inj) -> None:
+        """Wedged-but-breathing: no progress from now, watchdog kill
+        after the wedge window, retry-supervisor backoff priced."""
+        node = self._node_for(inj)
+        runs = self._runs_on(node) if node else []
+        if not runs:
+            return
+        run = runs[0]
+        params = dict(inj.params)
+        wedge = float(params.get("window",
+                                 params.get("duration", 5.0)))
+        now = self.clock.now
+        self._kill(run, drained=False, recovery=None,
+                   requeue=False)
+        self._emit(ev.TASK_BACKOFF, now, now + wedge, run.node,
+                   run.task.task_id)
+        pend = _Pending(run.task,
+                        resume_step=self._committed_step(
+                            run, self._executed_steps(run, now)),
+                        queue_since=now)
+        self.heap.schedule(now + wedge, self._on_arrival, pend)
+
+    def _node_down(self, node: SimNode, down_seconds: float,
+                   *, drained: bool, permanent: bool = False,
+                   recovery: str = "preempt") -> None:
+        now = self.clock.now
+        for run in self._runs_on(node):
+            self._kill(run, drained=drained, recovery=recovery,
+                       free_slot=False)
+        node.free = self.slots
+        self._retire_node(node)
+        if permanent:
+            return
+        self._emit(ev.NODE_PREEMPTED, now, now, node)  # count marker
+        self._emit(ev.NODE_PREEMPTED, now, now + down_seconds, node)
+        self.heap.schedule(now + down_seconds, self._on_node_up,
+                           node)
+
+    def chaos_node_preempt(self, inj) -> None:
+        node = self._node_for(inj)
+        if node is not None:
+            params = dict(inj.params)
+            self._node_down(node, float(params.get(
+                "revive_after", params.get("down", 30.0))),
+                drained=False)
+
+    def chaos_node_preempt_notice(self, inj) -> None:
+        """Provider preemption WITH notice: running work drains
+        cooperatively (zero replay), then the node goes away."""
+        node = self._node_for(inj)
+        if node is None:
+            return
+        params = dict(inj.params)
+        notice = float(params.get("notice", 2.5))
+        down = float(params.get("revive_after",
+                                params.get("down", 30.0)))
+        for run in self._runs_on(node):
+            self._drain(run, recovery="preempt", notice=notice)
+        node.pause_until = self.clock.now + notice + down
+        self.heap.schedule_in(notice + 0.01,
+                              self._chaos_notice_down, (node, down))
+
+    def _chaos_notice_down(self, payload: tuple) -> None:
+        node, down = payload
+        self._node_down(node, down, drained=True)
+
+    def chaos_victim_ignore_notice(self, inj) -> None:
+        """An uncooperative victim squats through the notice; the
+        escalation ladder hard-kills it after the grace window and
+        the exit prices as the eviction leg."""
+        node = self._node_for(inj)
+        runs = self._runs_on(node) if node else []
+        if not runs:
+            return
+        run = runs[0]
+        grace = float(dict(inj.params).get("grace", 5.0))
+        run.preempt_pending = True
+        self.heap.schedule_in(grace, self._chaos_evict, run)
+
+    def _chaos_evict(self, run: _Running) -> None:
+        if self._running.get(run.task.task_id) is not run:
+            return
+        self._kill(run, drained=False, recovery="evict")
+        self._dispatch()
+
+    def chaos_host_loss_resize(self, inj) -> None:
+        node = self._node_for(inj)
+        if node is None:
+            return
+        self._emit(ev.GANG_RESIZE, self.clock.now, self.clock.now,
+                   node)
+        self._node_down(node, 0.0, drained=False, permanent=True)
+
+    def chaos_pool_capacity_loss(self, inj) -> None:
+        frac = float(dict(inj.params).get("fraction", 0.25))
+        up = self._up_nodes()
+        for node in up[:max(1, int(len(up) * frac))]:
+            self._node_down(node, 0.0, drained=False,
+                            permanent=True)
+
+    def chaos_store_outage(self, inj) -> None:
+        params = dict(inj.params)
+        dur = float(params.get("window",
+                               params.get("duration", 10.0)))
+        now = self.clock.now
+        self._emit(ev.STORE_OUTAGE, now, now + dur)
+        self._claim_freeze_until = max(self._claim_freeze_until,
+                                       now + dur)
+        self.heap.schedule(now + dur, self._on_thaw, None)
+
+    def _on_thaw(self, _payload) -> None:
+        self._dispatch()
+
+    def chaos_leader_partition(self, inj) -> None:
+        params = dict(inj.params)
+        dur = float(params.get("window",
+                               params.get("duration", 15.0)))
+        self._sweep_frozen_until = max(self._sweep_frozen_until,
+                                       self.clock.now + dur)
+
+    def chaos_agent_restart(self, inj) -> None:
+        node = self._node_for(inj)
+        if node is None:
+            return
+        params = dict(inj.params)
+        gap = float(params.get("revive_after",
+                               params.get("gap", 2.0)))
+        node.pause_until = self.clock.now + gap
+        for run in self._runs_on(node):
+            self._emit(ev.TASK_ADOPTION, self.clock.now,
+                       self.clock.now + gap, node,
+                       run.task.task_id)
+
+    # ----------------------------- run/report --------------------------
+
+    def run(self, max_events: int = 50_000_000) -> "FleetSimulator":
+        popped = 0
+        while True:
+            if self.horizon is not None and \
+                    self.clock.now >= self.horizon:
+                break
+            if not self._pending_work():
+                break
+            item = self.heap.pop()
+            if item is None:
+                break
+            fn, payload = item
+            fn(payload)
+            popped += 1
+            if popped >= max_events:
+                raise RuntimeError(
+                    f"simulation exceeded {max_events} events")
+        self._finalize()
+        return self
+
+    def _pending_work(self) -> bool:
+        if self._running:
+            return True
+        if any(self._pending.values()):
+            return True
+        # Only recurring ticks (sweep/autoscale) left? Then the
+        # workload is done — peeking would never terminate.
+        return any(fn not in (self._on_sweep, self._on_autoscale)
+                   for _t, _s, fn, _p in self.heap._heap)
+
+    def _finalize(self) -> None:
+        """One idle span per node over its lifetime (birth → sim end,
+        or permanent retirement): the sweep overlays every busier
+        category on top, so uncovered node time prices as the idle
+        badput it is — 1,999 idle nodes can never hide behind one
+        busy one."""
+        end = self.clock.now
+        for node in self.nodes:
+            upto = node.retired_at if node.retired_at is not None \
+                else end
+            if node.born < upto:
+                self._emit(ev.NODE_IDLE, node.born, upto, node)
+
+    def report(self) -> dict:
+        """The run's full report: the REAL engine's node-seconds
+        goodput partition + scheduler metrics + a canonical-JSON
+        fingerprint (the byte-identity the determinism test pins)."""
+        engine = accounting.decompose_by_node(self.events)
+        partition = (engine["productive_seconds"]
+                     + sum(engine["badput_seconds"].values())
+                     + sum(engine["overlapped_seconds"].values()))
+        wall = engine["wall_seconds"]
+        completed = self.metrics["tasks_completed"]
+        report = {
+            "policy": self.policy.name,
+            "nodes": len(self.nodes),
+            "slots_per_node": self.slots,
+            "virtual_seconds": round(self.clock.now, 6),
+            "goodput": {
+                "goodput_ratio": engine["goodput_ratio"],
+                "availability_goodput":
+                    engine["availability_goodput"],
+                "resource_goodput": engine["resource_goodput"],
+                "program_goodput": engine["program_goodput"],
+                "wall_seconds": engine["wall_seconds"],
+                "productive_seconds": engine["productive_seconds"],
+                "badput_seconds": engine["badput_seconds"],
+                "overlapped_seconds": engine["overlapped_seconds"],
+                "compile_cache_hits": engine["compile_cache_hits"],
+                "compile_cache_misses":
+                    engine["compile_cache_misses"],
+                "compile_saved_seconds":
+                    engine["compile_saved_seconds"],
+                "steps": engine["steps"],
+                "preemptions": engine["preemptions"],
+            },
+            "partition_exact": abs(partition - wall) <= max(
+                1e-6 * max(1.0, wall), 1e-6),
+            "partition_error": partition - wall,
+            "scheduler": dict(
+                self.metrics,
+                queue_wait_mean=(
+                    self.metrics["queue_wait_total"] / completed
+                    if completed else 0.0)),
+        }
+        report["fingerprint"] = hashlib.sha256(
+            json.dumps(report, sort_keys=True).encode()
+        ).hexdigest()[:16]
+        return report
+
+
+def run_sim(*, trace: list, nodes: int, policy: str = "baseline",
+            knobs: Optional[sched_policy.PolicyKnobs] = None,
+            slots_per_node: int = 1, injections: tuple = (),
+            autoscale: bool = False, min_nodes: int = 1,
+            max_nodes: Optional[int] = None,
+            provision_seconds: float = 120.0,
+            horizon: Optional[float] = None) -> dict:
+    """Build, run, report — the one-call surface the CLI, bench, and
+    tests share."""
+    sim = FleetSimulator(
+        trace=trace, nodes=nodes, slots_per_node=slots_per_node,
+        policy=policy, knobs=knobs, injections=injections,
+        autoscale=autoscale, min_nodes=min_nodes,
+        max_nodes=max_nodes, provision_seconds=provision_seconds,
+        horizon=horizon)
+    return sim.run().report()
+
+
+def compare(reports: dict) -> dict:
+    """Per-policy deltas vs the ``baseline`` entry, priced by the
+    shared accounting delta helper."""
+    base = reports.get("baseline")
+    out: dict = {}
+    for name, rep in reports.items():
+        entry: dict = {"report": rep}
+        if base is not None and name != "baseline":
+            entry["delta_vs_baseline"] = accounting.report_delta(
+                base["goodput"], rep["goodput"])
+            entry["queue_wait_mean_delta"] = (
+                rep["scheduler"]["queue_wait_mean"]
+                - base["scheduler"]["queue_wait_mean"])
+        out[name] = entry
+    return out

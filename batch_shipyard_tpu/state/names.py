@@ -119,6 +119,14 @@ TASK_COL_GANG_SIZE = "gang_size"
 #                 rendezvous attempt like preempt_count)
 TASK_COL_EVICTED_AT = "evicted_at"
 TASK_COL_EVICT_COUNT = "evict_count"
+# Scheduling hints the agent mirrors from the workload's hints file
+# (agent/progress.py record_sched_hints) on each heartbeat:
+#   {"step", "ckpt_step", "step_seconds", "cache_identity"} — the
+# inputs the shared victim-cost policy (sched/policy.py
+# victim_cost_from_row) prices preemption rework from. Advisory: a
+# task that never writes hints costs 0.0 and tie-breaks on
+# (priority, task_id) exactly as before.
+TASK_COL_SCHED_HINTS = "sched_hints"
 
 
 def task_pk(pool_id: str, job_id: str) -> str:

@@ -54,6 +54,7 @@ import threading
 from typing import Any, Optional
 
 from batch_shipyard_tpu.agent import preemption
+from batch_shipyard_tpu.agent import progress
 from batch_shipyard_tpu.goodput import events as goodput_events
 from batch_shipyard_tpu.trace import spans as trace_spans
 from batch_shipyard_tpu.utils import util
@@ -617,6 +618,12 @@ class TrainCheckpointer:
             saved = save(self.checkpoint_dir, step, params, opt_state)
             if saved is not None and self.keep_last:
                 retention_gc(self.checkpoint_dir, self.keep_last)
+        # Scheduling hint: steps-since-last-commit is the dominant
+        # term in victim-cost pricing (sched/policy.py victim_cost) —
+        # advertising the commit makes this task CHEAP to preempt
+        # right after a save and progressively dearer as unsaved work
+        # accumulates.
+        progress.record_sched_hints(ckpt_step=step)
 
     def step_save(self, completed_steps: int, params: Any,
                   opt_state: Any) -> bool:

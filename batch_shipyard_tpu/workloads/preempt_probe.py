@@ -77,6 +77,12 @@ def main() -> int:
     parser.add_argument("--checkpoint-every", type=int, default=0,
                         help="cadenced commits every N steps (the "
                              "preempt drain commits regardless)")
+    parser.add_argument("--cache-identity",
+                        default=os.environ.get(
+                            "SHIPYARD_COMPILE_CACHE_IDENTITY"),
+                        help="compile-cache identity advertised via "
+                             "sched hints (victim-cost pricing reads "
+                             "it from the task row)")
     parser.add_argument("--ignore-notice", action="store_true",
                         help="UNCOOPERATIVE victim mode (eviction "
                              "drills): observe the preempt request, "
@@ -90,6 +96,15 @@ def main() -> int:
     instance = int(os.environ.get("SHIPYARD_TASK_INSTANCE", "0"))
     writer = instance == 0
     start_step = _restore(args.ckpt)
+    # Advertise scheduling hints up front: the agent mirrors the hints
+    # file into the task row on heartbeats, and the preempt sweep's
+    # victim-cost pricing (sched/policy.py victim_cost_from_row) reads
+    # them — a victim with a committed checkpoint + warm cache identity
+    # is cheap to kill, one without is expensive.
+    progress.record_sched_hints(
+        step=start_step, ckpt_step=start_step,
+        step_seconds=args.step_seconds,
+        cache_identity=args.cache_identity)
     watcher = preemption.PreemptWatcher()
     window_started = time.time()
     executed: list[int] = []
@@ -107,6 +122,7 @@ def main() -> int:
         progress.beat()
         executed.append(step)
         done = step + 1
+        progress.record_sched_hints(step=done)
         if watcher.poll() is not None:
             if args.ignore_notice:
                 # The uncooperative shape eviction exists for: a
@@ -131,6 +147,7 @@ def main() -> int:
             # single-writer convention real save pipelines follow).
             if writer:
                 _commit(args.ckpt, done)
+                progress.record_sched_hints(ckpt_step=done)
                 with open(args.ckpt + ".steps.log", "a",
                           encoding="utf-8") as fh:
                     fh.write(f"i{instance} {executed[0]}..{done} "
@@ -140,6 +157,7 @@ def main() -> int:
         if writer and not ignoring and args.checkpoint_every and \
                 done % args.checkpoint_every == 0:
             _commit(args.ckpt, done)
+            progress.record_sched_hints(ckpt_step=done)
     if writer:
         _commit(args.ckpt, args.steps)
         with open(args.ckpt + ".steps.log", "a",

@@ -885,6 +885,84 @@ def bench_control_plane(seed: int = 1,
     return result
 
 
+def bench_fleet_sim(seed: int = 1, nodes: int = 2000,
+                    tasks: int = 100_000,
+                    artifact: bool = True) -> dict:
+    """Fleet-simulator policy proof (ISSUE 17): run the discrete-
+    event simulator (sim/) at fleet scale — >=2,000 virtual nodes,
+    >=10^5 tasks — under every policy bundle (sched/policy.py
+    POLICIES) on three scenarios, and record each policy's FULL
+    goodput partition plus its delta vs the baseline bundle:
+
+      * ``steady``          — warm-cache claim affinity territory,
+      * ``preemption_wave`` — the chaos-schedule scenario (a seeded
+        provider wave kills 30% of the fleet mid-run in virtual
+        time),
+      * ``priority_burst``  — goodput-cost victim selection
+        territory (a narrow high-priority burst must elect victims).
+
+    The policies under test are the same pure functions the live
+    agent claim path, preemption sweep, and pool autoscaler import
+    (no forked copies — asserted by tests/test_fleet_sim.py), so a
+    delta here is a statement about production decision code under
+    the production pricing engine (goodput/accounting.py). Every
+    recorded partition is exact: productive + badput + overlapped ==
+    node-seconds wall to fp tolerance.
+
+    CPU marker: a discrete-event simulation on a virtual clock — no
+    accelerator is involved, and none is claimed."""
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    from batch_shipyard_tpu.sim import scenarios as sim_scenarios
+    from batch_shipyard_tpu.sim import simulator as sim_mod
+
+    result: dict = {"seed": seed, "nodes": nodes, "tasks": tasks,
+                    "cpu_marker": True,
+                    "policies": sorted(sched_policy.POLICIES),
+                    "scenarios": {}}
+    for scenario in ("steady", "preemption_wave", "priority_burst"):
+        reports: dict = {}
+        wall: dict = {}
+        for policy in sched_policy.POLICIES:
+            started = time.monotonic()
+            kwargs = sim_scenarios.build(scenario, seed, nodes, tasks)
+            reports[policy] = sim_mod.run_sim(policy=policy, **kwargs)
+            wall[policy] = round(time.monotonic() - started, 2)
+        compared = sim_mod.compare(reports)
+        section: dict = {}
+        for policy, entry in compared.items():
+            rep = entry["report"]
+            row = {
+                "fingerprint": rep["fingerprint"],
+                "partition_exact": rep["partition_exact"],
+                "virtual_seconds": rep["virtual_seconds"],
+                "bench_wall_seconds": wall[policy],
+                "goodput": rep["goodput"],
+                "scheduler": {
+                    k: rep["scheduler"][k]
+                    for k in ("tasks_completed", "queue_wait_mean",
+                              "deferrals", "sweep_victims",
+                              "preemptions", "evictions",
+                              "replayed_steps", "nodes_added",
+                              "nodes_removed")
+                    if k in rep["scheduler"]},
+            }
+            if "delta_vs_baseline" in entry:
+                row["delta_vs_baseline"] = entry["delta_vs_baseline"]
+                row["queue_wait_mean_delta"] = \
+                    entry["queue_wait_mean_delta"]
+            section[policy] = row
+        result["scenarios"][scenario] = section
+    result["all_partitions_exact"] = all(
+        row["partition_exact"]
+        for section in result["scenarios"].values()
+        for row in section.values())
+    if artifact:
+        with open(REPO_ROOT / "BENCH_fleet_sim.json", "w",
+                  encoding="utf-8") as fh:
+            json.dump({"fleet_sim": result}, fh, indent=2)
+    return result
+
+
 def bench_orchestration_latency() -> dict:
     """pool-add -> task-start latency through the framework (the
     second BASELINE.md metric), on the LOCALHOST substrate: real
@@ -1038,11 +1116,13 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated subset to run (resnet, transformer, "
         "serving, serving_speculative, checkpoint_overhead, "
         "compile_warm, ring_collectives, orchestration, "
-        "scheduler_scale; serving_speculative, checkpoint_overhead, "
-        "compile_warm, ring_collectives and scheduler_scale are "
-        "opt-in — the silicon-proof pipeline runs each as its own "
-        "phase; scheduler_scale drives 10^6 in-process tasks "
-        "through the CPU fakepod scheduler end-to-end)")
+        "scheduler_scale, fleet_sim; serving_speculative, "
+        "checkpoint_overhead, compile_warm, ring_collectives, "
+        "scheduler_scale and fleet_sim are opt-in — the "
+        "silicon-proof pipeline runs each as its own phase; "
+        "scheduler_scale drives 10^6 in-process tasks through the "
+        "CPU fakepod scheduler end-to-end; fleet_sim runs the "
+        "discrete-event policy simulator at 2000 virtual nodes)")
     parser.add_argument(
         "--scale-tasks", type=int, default=1_000_000,
         help="scheduler_scale task count (the 10^6 proof)")
@@ -1107,6 +1187,13 @@ def main(argv: list[str] | None = None) -> int:
                 details["control_plane"] = bench_control_plane()
             except Exception as exc:  # noqa: BLE001
                 details["control_plane"] = {"error": str(exc)}
+        if "fleet_sim" in workloads:
+            # Discrete-event simulator on a virtual clock: no
+            # accelerator involved.
+            try:
+                details["fleet_sim"] = bench_fleet_sim()
+            except Exception as exc:  # noqa: BLE001
+                details["fleet_sim"] = {"error": str(exc)}
         details["error"] = (f"accelerator unreachable "
                             f"({probe_error}); compute benches "
                             f"not run")
@@ -1266,6 +1353,15 @@ def main(argv: list[str] | None = None) -> int:
             details["control_plane"] = bench_control_plane()
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["control_plane"] = {"error": str(exc)}
+    if "fleet_sim" in workloads:
+        # Opt-in (the ISSUE 17 fleet-simulator policy proof): the
+        # discrete-event simulator at >=2,000 virtual nodes under
+        # every policy bundle — virtual clock, no accelerator
+        # involved.
+        try:
+            details["fleet_sim"] = bench_fleet_sim()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["fleet_sim"] = {"error": str(exc)}
     with open(details_out, "w", encoding="utf-8") as fh:
         json.dump(details, fh, indent=2)
     if resnet is not None:

@@ -32,9 +32,9 @@ def _rules_of(sources: dict, rule_id: str):
 def test_every_rule_has_family_and_provenance():
     assert len(analysis.RULES) >= 20
     families = {r.family for r in analysis.RULES.values()}
-    # The five tentpole families plus wiring and shell.
+    # The five tentpole families plus wiring, shell, and sim.
     assert {"store", "loop", "env", "registry", "jax", "wiring",
-            "shell"} <= families
+            "shell", "sim"} <= families
     for r in analysis.RULES.values():
         assert r.doc.strip(), r.id
         assert "Provenance" in r.doc, (
@@ -702,6 +702,52 @@ def test_wiring_compile_cache_optout_fires():
         "    compilecache.add_compile_cache_args(parser)\n"
         "    compilecache.enable_from_args(args)\n")}
     assert not _rules_of(blessed, "wiring-compile-cache-optout")
+
+
+# ------------------------------ sim family -----------------------------
+
+def test_sim_wall_clock_fires_on_time_reads_in_sim():
+    """Wall-clock reads inside sim/ break the determinism contract
+    (same seed+trace+policy => byte-identical report); every banned
+    source form must fire."""
+    firing = {"batch_shipyard_tpu/sim/simulator.py": (
+        "import time\n"
+        "def run():\n"
+        "    return time.time()\n")}
+    assert len(_rules_of(firing, "sim-wall-clock")) == 1
+    mono = {"batch_shipyard_tpu/sim/scenarios.py": (
+        "import time\n"
+        "def build():\n"
+        "    return time.monotonic()\n")}
+    assert len(_rules_of(mono, "sim-wall-clock")) == 1
+    dt = {"batch_shipyard_tpu/sim/scenarios.py": (
+        "import datetime\n"
+        "def build():\n"
+        "    return datetime.datetime.now()\n")}
+    assert len(_rules_of(dt, "sim-wall-clock")) == 1
+
+
+def test_sim_wall_clock_blessed_shapes_pass():
+    """clock.py is the ONE module allowed near wall-clock sources;
+    non-sim files are out of scope (the live agent is built on
+    time.time()); suppression works like every other rule."""
+    clock = {"batch_shipyard_tpu/sim/clock.py": (
+        "import time\n"
+        "def _debug_now():\n"
+        "    return time.time()\n")}
+    assert not _rules_of(clock, "sim-wall-clock")
+    live = {"batch_shipyard_tpu/agent/mod.py": (
+        "import time\n"
+        "def heartbeat():\n"
+        "    return time.time()\n")}
+    assert not _rules_of(live, "sim-wall-clock")
+    suppressed_src = {"batch_shipyard_tpu/sim/simulator.py": (
+        "import time\n"
+        "def run():\n"
+        "    return time.time()  "
+        "# shipyard-lint: disable=sim-wall-clock\n")}
+    active, suppressed = _run(suppressed_src, "sim-wall-clock")
+    assert not active and len(suppressed) == 1
 
 
 # ----------------------------- shell family ----------------------------

@@ -337,6 +337,102 @@ def test_control_plane_dispatched_and_rendered():
     assert data.get("cpu_marker") is True
 
 
+def test_chaos_kinds_all_expressible_in_the_simulator():
+    """ISSUE 17: every chaos injection kind maps to a simulator
+    adapter (sim/scenarios.py KIND_ADAPTERS) or is explicitly listed
+    in SIM_EXCLUDED_KINDS — a kind in neither set is a chaos mode
+    the fleet simulator silently cannot model. Today the exclusion
+    set is empty: the full inventory is expressible as scenario
+    schedules."""
+    from batch_shipyard_tpu.chaos.plan import INJECTION_KINDS
+    from batch_shipyard_tpu.sim import scenarios as sim_scenarios
+    unmapped = set(INJECTION_KINDS) - set(
+        sim_scenarios.KIND_ADAPTERS) - set(
+        sim_scenarios.SIM_EXCLUDED_KINDS)
+    assert not unmapped, (
+        f"chaos kinds with no sim adapter and no exclusion entry: "
+        f"{sorted(unmapped)}")
+    # No dead adapters either: every adapter key is a real kind.
+    dead = set(sim_scenarios.KIND_ADAPTERS) - set(INJECTION_KINDS)
+    assert not dead, f"sim adapters for unknown kinds: {sorted(dead)}"
+    assert not set(sim_scenarios.SIM_EXCLUDED_KINDS) & set(
+        sim_scenarios.KIND_ADAPTERS)
+
+
+def test_policy_knobs_mirrored_in_settings_and_schema():
+    """The sched_policy knob surface is single-sourced: every
+    PolicyKnobs field (sched/policy.py) appears by NAME in
+    SchedPolicySettings (config/settings.py) and in the pool.yaml
+    schema's sched_policy block — a knob added in one place but not
+    the others would silently fall back to defaults for every pool
+    spec."""
+    import dataclasses
+
+    from batch_shipyard_tpu.config import settings as S
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    knob_fields = {f.name for f in
+                   dataclasses.fields(sched_policy.PolicyKnobs)}
+    settings_fields = {f.name for f in
+                       dataclasses.fields(S.SchedPolicySettings)}
+    missing = knob_fields - settings_fields
+    assert not missing, (
+        f"PolicyKnobs fields absent from SchedPolicySettings: "
+        f"{sorted(missing)}")
+    schema_src = (PACKAGE / "config" / "schemas" / "pool.yaml"
+                  ).read_text(encoding="utf-8")
+    for field in sorted(knob_fields):
+        assert f"{field}:" in schema_src, (
+            f"pool.yaml schema sched_policy block lacks {field}")
+    # knobs_from_settings round-trips a fully-populated settings
+    # object field-for-field (None falls back to defaults).
+    populated = S.SchedPolicySettings(
+        claim_scoring=True,
+        **{name: 7.0 for name in knob_fields})
+    knobs = sched_policy.knobs_from_settings(populated)
+    assert all(getattr(knobs, name) == 7.0 for name in knob_fields)
+    defaults = sched_policy.knobs_from_settings(None)
+    assert defaults == sched_policy.PolicyKnobs()
+
+
+def test_fleet_sim_dispatched_and_rendered():
+    """The fleet-simulator policy proof is wired end to end: bench.py
+    dispatches the fleet_sim workload, benchgen renders the committed
+    BENCH_fleet_sim.json artifact, and the artifact records >=2,000
+    virtual nodes, >=10^5 tasks, every policy bundle on >=3 scenarios
+    (including the preemption-wave chaos scenario) with exact
+    partitions throughout and per-policy deltas vs baseline."""
+    import json
+
+    from batch_shipyard_tpu.sched import policy as sched_policy
+    bench_src = (PACKAGE.parent / "bench.py").read_text(
+        encoding="utf-8")
+    assert '"fleet_sim" in workloads' in bench_src
+    benchgen_src = (PACKAGE.parent / "tools" / "benchgen.py"
+                    ).read_text(encoding="utf-8")
+    assert "BENCH_fleet_sim.json" in benchgen_src
+    artifact = PACKAGE.parent / "BENCH_fleet_sim.json"
+    assert artifact.exists(), (
+        "BENCH_fleet_sim.json not committed — run "
+        "`python bench.py --workloads fleet_sim`")
+    data = json.loads(artifact.read_text(
+        encoding="utf-8"))["fleet_sim"]
+    assert data["nodes"] >= 2000
+    assert data["tasks"] >= 100_000
+    assert data["all_partitions_exact"] is True
+    assert data.get("cpu_marker") is True
+    assert set(data["policies"]) == set(sched_policy.POLICIES)
+    assert len(data["scenarios"]) >= 3
+    assert "preemption_wave" in data["scenarios"]
+    for scenario, section in data["scenarios"].items():
+        assert set(section) == set(sched_policy.POLICIES), scenario
+        for policy, row in section.items():
+            assert row["partition_exact"] is True, (scenario, policy)
+            assert row["fingerprint"]
+            if policy != "baseline":
+                assert "goodput_ratio_delta" in \
+                    row["delta_vs_baseline"], (scenario, policy)
+
+
 def test_chaos_kinds_help_lists_node_preempt_notice():
     """The --kinds help derives from INJECTION_KINDS (analyzer rule
     wiring-kinds-help-stale) and the rendered help really names the

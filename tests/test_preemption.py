@@ -476,3 +476,22 @@ def test_preemption_drill_acceptance():
     assert report["invariants"]["ok"]
     assert report["invariants"]["retries"] == 0
     assert report["invariants"]["preempt_count"] >= 1
+
+
+@pytest.mark.slow
+def test_victim_selection_drill_acceptance():
+    """ISSUE 17's live victim-cost proof (chaos drill --victim): two
+    equal-priority eligible victims where the deterministic
+    (priority, task_id) tie-break points at the EXPENSIVE one
+    ("aa-costly" sorts before "zz-cheap") — the sweep must elect the
+    cheap victim anyway, proving the goodput-cost term from synced
+    sched hints decided the election, not scan order or id order.
+    All invariants asserted inside run_victim_selection_drill."""
+    from batch_shipyard_tpu.chaos import drill
+    report = drill.run_victim_selection_drill(seed=0)
+    assert report["invariants"]["ok"]
+    assert report["invariants"]["retries"] == 0
+    assert report["invariants"]["cheap_preempt_count"] >= 1
+    assert report["invariants"]["costly_preempt_count"] == 0
+    costs = report["invariants"]["victim_costs"]
+    assert costs["aa-costly"] > costs["zz-cheap"]

@@ -518,6 +518,53 @@ def _control_plane(out: list[str]) -> None:
     out.append("")
 
 
+def _fleet_sim(out: list[str]) -> None:
+    """Fleet-simulation section: the ISSUE-17 policy proof from the
+    committed BENCH_fleet_sim.json artifact — every policy bundle's
+    goodput partition on each scenario, and the delta vs baseline.
+    The policies are the same pure functions the live claim path,
+    preemption sweep, and autoscaler import (sched/policy.py — no
+    forked copies), priced by the production goodput engine."""
+    report = (_load(ARTIFACTS / "BENCH_fleet_sim.json")
+              or {}).get("fleet_sim")
+    if report is None:
+        return
+    out.append("## Fleet simulation (policy goodput deltas)\n")
+    out.append(
+        f"Discrete-event fleet simulator "
+        f"([35-fleet-simulator.md](35-fleet-simulator.md)): "
+        f"{_fmt(report.get('nodes'))} virtual nodes, "
+        f"{_fmt(report.get('tasks'))} tasks per run, seed "
+        f"{report.get('seed', '-')}, priced by the production "
+        f"goodput engine (`shipyard sim compare`). Deltas are vs "
+        f"the `baseline` policy bundle on the same scenario and "
+        f"seed; every partition is exact "
+        f"(all_partitions_exact="
+        f"{report.get('all_partitions_exact')}).\n")
+    if report.get("cpu_marker"):
+        out.append("**CPU marker**: a discrete-event simulation on "
+                   "a virtual clock — no accelerator involved or "
+                   "claimed.\n")
+    out.append("| scenario | policy | goodput ratio | Δ ratio vs "
+               "baseline | Δ badput (s) | Δ queue wait mean (s) | "
+               "partition exact | wall (s) |")
+    out.append("|---|---|---|---|---|---|---|---|")
+    for scenario, section in (report.get("scenarios") or {}).items():
+        for policy, row in (section or {}).items():
+            goodput = row.get("goodput") or {}
+            delta = row.get("delta_vs_baseline") or {}
+            badput_delta = delta.get("badput_seconds_delta") or {}
+            out.append(
+                f"| {scenario} | {policy} | "
+                f"{_fmt(goodput.get('goodput_ratio'), 4)} | "
+                f"{_fmt(delta.get('goodput_ratio_delta'), 4)} | "
+                f"{_fmt(sum(badput_delta.values()), 1) if badput_delta else '—'} | "
+                f"{_fmt(row.get('queue_wait_mean_delta'), 2)} | "
+                f"{'yes' if row.get('partition_exact') else 'NO'} | "
+                f"{_fmt(row.get('bench_wall_seconds'), 1)} |")
+    out.append("")
+
+
 def _goodput(out: list[str]) -> None:
     """ML-productivity goodput section: always names goodput_ratio,
     the three decomposition legs, and EVERY badput category (the
@@ -670,6 +717,7 @@ def render() -> str:
     _chaos_drill(out)
     _fleet_elasticity(out)
     _control_plane(out)
+    _fleet_sim(out)
     _silicon_proof(out)
     return "\n".join(out).rstrip() + "\n"
 
