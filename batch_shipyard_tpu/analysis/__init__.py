@@ -11,5 +11,5 @@ from batch_shipyard_tpu.analysis.core import (  # noqa: F401
 
 # Rule modules register themselves on import (the @rule decorator).
 from batch_shipyard_tpu.analysis import (  # noqa: F401,E402
-    rules_env, rules_jax, rules_loops, rules_registry, rules_shell,
-    rules_sim, rules_store, rules_wiring)
+    rules_env, rules_jax, rules_loops, rules_registry, rules_serving,
+    rules_shell, rules_sim, rules_store, rules_wiring)

@@ -198,7 +198,7 @@ RULES: dict[str, Rule] = {}
 
 # Rule families (docs/34-static-analysis.md inventories them).
 FAMILIES = ("store", "loop", "env", "registry", "jax", "wiring",
-            "shell", "sim")
+            "shell", "sim", "serving")
 
 
 def rule(rule_id: str, family: str):

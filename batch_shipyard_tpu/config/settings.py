@@ -265,6 +265,65 @@ class SchedPolicySettings:
 
 
 @dataclasses.dataclass(frozen=True)
+class SloClassSettings:
+    """One serving SLO class: per-request latency targets attached at
+    admission (models/serving.Request). None disables that target
+    (best-effort on that axis)."""
+    name: str
+    ttft_ms: Optional[float]
+    tpot_ms: Optional[float]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingSloSettings:
+    """Request-level SLO scheduling configuration for the serving
+    front end (models/server.py): named classes map to TTFT/TPOT
+    targets, shed_grace_ms arms overload shedding in the engine, and
+    tpot_stall_factor bounds admission's prefill-stall tolerance
+    (models/serving.ContinuousBatcher)."""
+    classes: tuple[SloClassSettings, ...]
+    shed_grace_ms: Optional[float]
+    tpot_stall_factor: float
+
+    def class_targets(self) -> dict:
+        """name -> {"ttft_ms": ..., "tpot_ms": ...} for the front
+        end's slo_classes parameter."""
+        return {c.name: {"ttft_ms": c.ttft_ms, "tpot_ms": c.tpot_ms}
+                for c in self.classes}
+
+
+# Default classes: interactive chat, standard API traffic, and
+# untargeted batch/offline work (the class FIFO falls back to).
+DEFAULT_SLO_CLASSES = (
+    SloClassSettings("interactive", ttft_ms=500.0, tpot_ms=100.0),
+    SloClassSettings("standard", ttft_ms=2000.0, tpot_ms=250.0),
+    SloClassSettings("batch", ttft_ms=None, tpot_ms=None),
+)
+
+
+def serving_slo_settings(config: dict | None) -> ServingSloSettings:
+    """Parse serving.slo from a config mapping; absent sections fall
+    back to the default class table with shedding disarmed."""
+    spec = _get(config, "serving", "slo", default={}) or {}
+    entries = _get(spec, "classes")
+    if entries is None:
+        classes = DEFAULT_SLO_CLASSES
+    else:
+        classes = tuple(
+            SloClassSettings(
+                name=_get(entry, "name"),
+                ttft_ms=_get(entry, "ttft_ms"),
+                tpot_ms=_get(entry, "tpot_ms"))
+            for entry in entries)
+    return ServingSloSettings(
+        classes=classes,
+        shed_grace_ms=_get(spec, "shed_grace_ms"),
+        tpot_stall_factor=_get(spec, "tpot_stall_factor",
+                               default=4.0),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class PoolSettings:
     id: str
     substrate: str  # tpu_vm | fake | localhost

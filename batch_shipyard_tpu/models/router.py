@@ -31,6 +31,7 @@ this socket layer.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
@@ -89,12 +90,29 @@ class ServingRouter:
                  host: str = "127.0.0.1", port: int = 0,
                  health_interval: float = 2.0,
                  probe_timeout: float = 5.0,
-                 request_timeout: float = 300.0) -> None:
+                 request_timeout: float = 300.0,
+                 owner_ttl: float = 600.0,
+                 affinity_prefix_tokens: int = 32,
+                 affinity_load_slack: int = 2) -> None:
         if not replica_urls:
             raise ValueError("router needs at least one replica URL")
         self._replicas = [_Replica(u) for u in replica_urls]
         self._lock = threading.Lock()
         self._owner: dict[str, _Replica] = {}  # request_id -> replica
+        # Last-write stamp per ownership entry: the TTL retirement
+        # sweep (_retire_stale) uses it to find entries that leaked
+        # past their completion path under sustained traffic.
+        self._owner_stamp: dict[str, float] = {}
+        self._owner_ttl = owner_ttl
+        # Prefix-affinity routing: prefix key -> (replica, stamp).
+        # Same-prefix requests steer to the replica whose paged KV
+        # pool already holds the prefix pages (server-side prefix
+        # cache, models/serving.py) — the key is client-supplied
+        # ("prefix_key") or derived from the first N prompt tokens.
+        self._affinity: dict[str, tuple[_Replica, float]] = {}
+        self._affinity_prefix_tokens = affinity_prefix_tokens
+        self._affinity_load_slack = affinity_load_slack
+        self.affinity_routed = 0
         # Timed-out dispatches whose runs may still be live on their
         # replica (reconciled by the health loop).
         self._orphaned: dict[str, _Replica] = {}
@@ -336,6 +354,63 @@ class ServingRouter:
     def _health_loop(self) -> None:
         while not self._stop.wait(self._health_interval):
             self._reconcile_orphans()
+            self._retire_stale()
+
+    def _retire_stale(self) -> None:
+        """TTL retirement for the sticky/duplicate-id ownership map
+        and the affinity table: under sustained traffic, entries that
+        leak past their completion path (a client that vanished
+        between claim and finish, a replica that crashed with ids
+        mapped) would otherwise accumulate forever. Retirement keeps
+        the failover-race guarantees: a stale RESERVED claim retires
+        unconditionally (reservations live for one dispatch call),
+        but a stale LIVE mapping drops only after the owning replica
+        demonstrably no longer knows the id (the orphan-reconciliation
+        probe) — a long decode's duplicate gate and sticky cancel
+        survive any TTL. A retired id is immediately safe to
+        resubmit."""
+        now = time.time()
+        live: list = []
+        with self._lock:
+            for key in [k for k, (_r, stamp)
+                        in self._affinity.items()
+                        if now - stamp > self._owner_ttl]:
+                # Routing hints, not correctness state: pure TTL.
+                del self._affinity[key]
+            for rid in list(self._owner_stamp):
+                if rid not in self._owner:
+                    del self._owner_stamp[rid]  # desync backstop
+                    continue
+                if now - self._owner_stamp[rid] <= self._owner_ttl:
+                    continue
+                if rid in self._orphaned:
+                    continue  # orphan reconciliation owns this id
+                owner = self._owner[rid]
+                if owner is None:
+                    self._owner.pop(rid, None)
+                    self._owner_stamp.pop(rid, None)
+                else:
+                    live.append((rid, owner))
+        for rid, owner in live:
+            forgotten = False
+            try:
+                with urllib.request.urlopen(
+                        f"{owner.url}/v1/requests/{rid}",
+                        timeout=self._probe_timeout) as resp:
+                    forgotten = resp.status != 200
+            except urllib.error.HTTPError as exc:
+                forgotten = exc.code == 404
+            except (urllib.error.URLError, OSError):
+                forgotten = True  # replica gone: the run went with it
+            with self._lock:
+                if forgotten:
+                    if self._owner.get(rid) is owner:
+                        self._owner.pop(rid, None)
+                        self._owner_stamp.pop(rid, None)
+                elif rid in self._owner_stamp:
+                    # Alive and still decoding: refresh so the sweep
+                    # doesn't re-probe it every interval.
+                    self._owner_stamp[rid] = time.time()
 
     def healthy_count(self) -> int:
         with self._lock:
@@ -347,9 +422,32 @@ class ServingRouter:
 
     # ----------------------------- dispatch ----------------------------
 
-    def _pick(self, exclude: set) -> _Replica:
+    def _affinity_key(self, spec: dict) -> Optional[str]:
+        """Prefix key for affinity routing: client-supplied
+        ("prefix_key" — e.g. a system-prompt/template id) or derived
+        from the first affinity_prefix_tokens prompt tokens. Prompts
+        shorter than the window get no key (nothing worth steering
+        for)."""
+        key = spec.get("prefix_key")
+        if key:
+            return f"client:{key}"
+        prompt = spec.get("prompt")
+        n = self._affinity_prefix_tokens
+        if not isinstance(prompt, list) or len(prompt) < n or n <= 0:
+            return None
+        head = ",".join(str(t) for t in prompt[:n])
+        return hashlib.blake2b(head.encode(),
+                               digest_size=16).hexdigest()
+
+    def _pick(self, exclude: set,
+              affinity_key: Optional[str] = None) -> _Replica:
         """Least-loaded healthy replica (router inflight + last
-        scraped engine backlog)."""
+        scraped engine backlog). With an affinity key, prefer the
+        replica that last served this prefix — its paged KV pool
+        holds the prefix pages, so prefill there is a gather instead
+        of a recompute — unless it is unhealthy, excluded, or more
+        than affinity_load_slack ahead of the least-loaded choice
+        (prefix stickiness must not create hot spots)."""
         with self._lock:
             candidates = [r for r in self._replicas
                           if r.healthy and r.url not in exclude]
@@ -359,9 +457,21 @@ class ServingRouter:
                     f"({len(self._replicas)} registered)")
             best = min(candidates, key=lambda r: (r.load(),
                                                   r.dispatched))
-            best.inflight += 1
-            best.dispatched += 1
-            return best
+            chosen = best
+            if affinity_key is not None:
+                entry = self._affinity.get(affinity_key)
+                if entry is not None:
+                    sticky = entry[0]
+                    if (sticky.healthy and sticky.url not in exclude
+                            and sticky.load() <= best.load() +
+                            self._affinity_load_slack):
+                        if sticky is not best:
+                            chosen = sticky
+                        self.affinity_routed += 1
+                self._affinity[affinity_key] = (chosen, time.time())
+            chosen.inflight += 1
+            chosen.dispatched += 1
+            return chosen
 
     def finish(self, replica: _Replica, request_id: Optional[str],
                ok: bool, retrying: bool = False) -> None:
@@ -383,8 +493,10 @@ class ServingRouter:
                     self._owner.get(request_id) is replica:
                 if retrying:
                     self._owner[request_id] = None  # back to reserved
+                    self._owner_stamp[request_id] = time.time()
                 else:
                     self._owner.pop(request_id, None)
+                    self._owner_stamp.pop(request_id, None)
 
     def _orphan_inflight(self, replica: _Replica,
                          request_id: Optional[str]) -> None:
@@ -413,6 +525,7 @@ class ServingRouter:
                 raise DuplicateRequestError(
                     f"request_id {request_id} in flight")
             self._owner[request_id] = None  # reserved
+            self._owner_stamp[request_id] = time.time()
 
     def _release_claim(self, request_id: Optional[str]) -> None:
         """Drop a reservation that never reached a replica (e.g. no
@@ -421,12 +534,14 @@ class ServingRouter:
             with self._lock:
                 if self._owner.get(request_id) is None:
                     self._owner.pop(request_id, None)
+                    self._owner_stamp.pop(request_id, None)
 
     def _remember(self, request_id: Optional[str],
                   replica: _Replica) -> None:
         if request_id:
             with self._lock:
                 self._owner[request_id] = replica
+                self._owner_stamp[request_id] = time.time()
 
     def _orphan(self, request_id: Optional[str],
                 replica: _Replica) -> None:
@@ -457,6 +572,7 @@ class ServingRouter:
                     self._orphaned.pop(request_id, None)
                     if self._owner.get(request_id) is replica:
                         self._owner.pop(request_id, None)
+                        self._owner_stamp.pop(request_id, None)
 
     def _mark_unhealthy(self, replica: _Replica, exc: Exception
                         ) -> None:
@@ -470,11 +586,12 @@ class ServingRouter:
         """Route one non-streaming generate; fail over across
         replicas on connection errors."""
         request_id = spec.get("request_id")
+        affinity_key = self._affinity_key(spec)
         self._claim(request_id)
         tried: set = set()
         while True:
             try:
-                replica = self._pick(tried)
+                replica = self._pick(tried, affinity_key)
             except NoHealthyReplicaError:
                 self._release_claim(request_id)
                 raise
@@ -536,11 +653,12 @@ class ServingRouter:
         replica, request_id). Failover happens here (before any byte
         reaches the client)."""
         request_id = spec.get("request_id")
+        affinity_key = self._affinity_key(spec)
         self._claim(request_id)
         tried: set = set()
         while True:
             try:
-                replica = self._pick(tried)
+                replica = self._pick(tried, affinity_key)
             except NoHealthyReplicaError:
                 self._release_claim(request_id)
                 raise
@@ -608,7 +726,16 @@ class ServingRouter:
             "dispatched_total": stats["dispatched"],
             "completed_total": stats["completed"],
             "failed_total": stats["failed"],
+            "affinity_routed_total": stats["affinity_routed"],
         })
+        prefix = stats.get("prefix_cache")
+        if prefix:
+            lines.extend(prometheus_lines("shipyard_router", {
+                "prefix_hit_rate": prefix["hit_rate"],
+                "prefix_hit_tokens_total": prefix["hit_tokens"],
+                "prefix_prompt_tokens_total":
+                    prefix["total_prompt_tokens"],
+            }))
         for snap in stats["per_replica"]:
             lines.extend(prometheus_lines(
                 "shipyard_router_replica", {
@@ -649,6 +776,7 @@ class ServingRouter:
             "dispatched": sum(s["dispatched"] for s in snaps),
             "completed": sum(s["completed"] for s in snaps),
             "failed": sum(s["failed"] for s in snaps),
+            "affinity_routed": self.affinity_routed,
             "completed_requests": sum(
                 s.get("completed_requests", 0)
                 for s in stats.values()),
@@ -687,6 +815,27 @@ class ServingRouter:
                 "proposed": proposed,
                 "accepted": accepted,
                 "acceptance_rate": accepted / proposed,
+            }
+        # Fleet-wide prefix-cache effectiveness: hit/total token sums
+        # across replicas (token-level hit rate — exactly what each
+        # replica reports, merged losslessly). Replicas with the
+        # cache disabled simply don't contribute.
+        prefix_reports = [s.get("prefix_cache") for s in stats.values()
+                         if s.get("prefix_cache")]
+        if prefix_reports:
+            hit = sum(p.get("hit_tokens", 0) for p in prefix_reports)
+            total = sum(p.get("total_prompt_tokens", 0)
+                        for p in prefix_reports)
+            agg["prefix_cache"] = {
+                "lookups": sum(p.get("lookups", 0)
+                               for p in prefix_reports),
+                "hit_tokens": hit,
+                "total_prompt_tokens": total,
+                "hit_rate": hit / total if total else 0.0,
+                "published_pages": sum(p.get("published_pages", 0)
+                                       for p in prefix_reports),
+                "evictions": sum(p.get("evictions", 0)
+                                 for p in prefix_reports),
             }
         return agg
 

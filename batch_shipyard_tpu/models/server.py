@@ -57,6 +57,12 @@ class RequestCancelled(Exception):
     """The request was aborted via the cancel API."""
 
 
+class RequestShed(Exception):
+    """The engine dropped the request under overload (its TTFT
+    deadline was blown past the shed grace) — surfaced as 503 so
+    clients/routers treat it as back-pressure, not failure."""
+
+
 class JsonRequestHandler(BaseHTTPRequestHandler):
     """Shared handler base for the serving HTTP surfaces (this front
     end and models/router.py): HTTP/1.1 (required for chunked
@@ -130,7 +136,7 @@ class _Pending:
     __slots__ = ("request", "event", "submitted_at", "submitted_wall",
                  "admitted_at", "first_token_at",
                  "finished_at", "tokens", "error", "token_queue",
-                 "cancelled")
+                 "cancelled", "shed")
 
     def __init__(self, request: Request,
                  stream: bool = False) -> None:
@@ -148,6 +154,7 @@ class _Pending:
         self.tokens: Optional[list[int]] = None
         self.error: Optional[str] = None
         self.cancelled = False
+        self.shed = False
         # Streaming mode: the engine thread feeds (index, token)
         # pairs here as they decode; None terminates the stream.
         self.token_queue: Optional["queue.Queue"] = (
@@ -170,10 +177,20 @@ class ServingFrontEnd:
     ContinuousBatcher."""
 
     def __init__(self, engine: ContinuousBatcher,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 slo_classes: Optional[dict] = None) -> None:
+        """slo_classes maps class name ->
+        {"ttft_ms": float|None, "tpot_ms": float|None}
+        (config/settings.ServingSloSettings.class_targets()). A
+        request's "slo_class" resolves to those targets at admission;
+        explicit "ttft_target_ms"/"tpot_target_ms" in the request
+        body override its class. With no classes configured, class
+        names pass through untargeted."""
         self.engine = engine
+        self.slo_classes = dict(slo_classes or {})
         engine.on_token = self._on_token
         engine.on_admit = self._on_admit
+        engine.on_shed = self._on_shed
         self._submit_q: "queue.Queue[_Pending]" = queue.Queue()
         self._inflight: dict[str, _Pending] = {}
         self._inflight_lock = threading.Lock()
@@ -206,6 +223,9 @@ class ServingFrontEnd:
         # recent detail.
         self._ttft_hist = LatencyHistogram()
         self._tpot_hist = LatencyHistogram()
+        # Per-SLO-class attainment accounting (under _stats_lock):
+        # class -> {requests, ttft_ok, tpot_ok, shed}.
+        self._class_stats: dict[str, dict] = {}
         self._started_at = time.perf_counter()
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="serving-engine", daemon=True)
@@ -272,6 +292,12 @@ class ServingFrontEnd:
                 except RequestCancelled as exc:
                     self._reply(409, {"error": str(exc)})
                     return
+                except RequestShed as exc:
+                    # Overload back-pressure, not failure: clients
+                    # should retry elsewhere/later.
+                    self._reply(503, {"error": str(exc),
+                                      "shed": True})
+                    return
                 except ValueError as exc:
                     self._reply(400, {"error": str(exc)})
                     return
@@ -326,7 +352,7 @@ class ServingFrontEnd:
                         for event in stream:
                             _chunk(event)
                     except (ValueError, TimeoutError,
-                            RequestCancelled) as exc:
+                            RequestCancelled, RequestShed) as exc:
                         _chunk({"error": str(exc)})
                     except Exception as exc:  # defensive
                         logger.exception("stream failed")
@@ -379,11 +405,32 @@ class ServingFrontEnd:
         except (TypeError, ValueError) as exc:
             raise ValueError(
                 f"max_new_tokens/priority must be integers: {exc}")
+        slo_class = str(spec.get("slo_class") or "standard")
+        if self.slo_classes and "slo_class" in spec and \
+                slo_class not in self.slo_classes:
+            raise ValueError(
+                f"unknown slo_class {slo_class!r}; configured: "
+                f"{sorted(self.slo_classes)}")
+        targets = self.slo_classes.get(slo_class, {})
+
+        def _target(key):
+            value = spec.get(key, targets.get(
+                key.replace("_target", "")))
+            if value is None:
+                return None
+            try:
+                return float(value)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(f"{key} must be a number: {exc}")
+
         request = Request(
             request_id=request_id, prompt=prompt,
             max_new_tokens=max_new_tokens,
             eos_id=spec.get("eos_id"),
-            priority=priority)
+            priority=priority,
+            ttft_target_ms=_target("ttft_target_ms"),
+            tpot_target_ms=_target("tpot_target_ms"),
+            slo_class=slo_class)
         pending = _Pending(request, stream=stream)
         with self._inflight_lock:
             if (request_id in self._inflight or
@@ -408,8 +455,21 @@ class ServingFrontEnd:
             "tpot_ms": tpot * 1e3,
             "latency_ms": (pending.finished_at -
                            pending.submitted_at) * 1e3,
+            "slo_class": pending.request.slo_class,
         }
+        req = pending.request
         with self._stats_lock:
+            cls = self._class_stats.setdefault(
+                req.slo_class,
+                {"requests": 0, "ttft_ok": 0, "tpot_ok": 0,
+                 "shed": 0})
+            cls["requests"] += 1
+            if req.ttft_target_ms is None or \
+                    result["ttft_ms"] <= req.ttft_target_ms:
+                cls["ttft_ok"] += 1
+            if req.tpot_target_ms is None or \
+                    result["tpot_ms"] <= req.tpot_target_ms:
+                cls["tpot_ok"] += 1
             self._completed.append({
                 "ttft_ms": result["ttft_ms"],
                 "tpot_ms": result["tpot_ms"],
@@ -533,6 +593,8 @@ class ServingFrontEnd:
                 f"after {timeout}s")
         if pending.cancelled:
             raise RequestCancelled(pending.error)
+        if pending.shed:
+            raise RequestShed(pending.error)
         if pending.error is not None:
             raise ValueError(pending.error)
 
@@ -571,6 +633,31 @@ class ServingFrontEnd:
                 "spec_accepted_tokens_total": spec["accepted"],
                 "spec_acceptance_rate": spec["acceptance_rate"],
             }))
+        prefix = stats.get("prefix_cache")
+        if prefix:
+            lines.extend(prometheus_lines("shipyard_serving", {
+                "prefix_hit_rate": prefix["hit_rate"],
+                "prefix_hit_tokens_total": prefix["hit_tokens"],
+                "prefix_prompt_tokens_total":
+                    prefix["total_prompt_tokens"],
+                "prefix_indexed_pages": prefix["indexed_pages"],
+                "prefix_published_pages_total":
+                    prefix["published_pages"],
+                "prefix_evictions_total": prefix["evictions"],
+            }))
+        slo = stats.get("slo") or {}
+        lines.extend(prometheus_lines("shipyard_serving", {
+            "slo_sheds_total": slo.get("sheds"),
+            "slo_deferrals_total": slo.get("deferrals"),
+        }))
+        for name, counters in (slo.get("classes") or {}).items():
+            lines.extend(prometheus_lines(
+                "shipyard_serving", {
+                    "slo_class_requests_total": counters["requests"],
+                    "slo_class_ttft_ok_total": counters["ttft_ok"],
+                    "slo_class_tpot_ok_total": counters["tpot_ok"],
+                    "slo_class_shed_total": counters["shed"],
+                }, labels={"slo_class": name}))
         return lines
 
     def knows(self, request_id: str) -> bool:
@@ -605,6 +692,8 @@ class ServingFrontEnd:
             tpot_hist = self._tpot_hist.to_dict()
             ttft_pcts = self._ttft_hist.percentiles((50, 90, 99))
             tpot_pcts = self._tpot_hist.percentiles((50, 90, 99))
+            class_stats = {name: dict(counters) for name, counters
+                           in self._class_stats.items()}
         elapsed = time.perf_counter() - self._started_at
         with self._inflight_lock:
             inflight = len(self._inflight)
@@ -634,6 +723,29 @@ class ServingFrontEnd:
         spec = self.engine.spec_stats()
         if spec is not None:
             out["speculative"] = spec
+        # Request-level SLO scheduling: per-class attainment plus the
+        # engine's shed/deferral counters and live cost estimates.
+        engine_slo = self.engine.slo_stats()
+        out["slo"] = {
+            "classes": {
+                name: dict(
+                    counters,
+                    targets=self.slo_classes.get(name),
+                    ttft_attainment=(
+                        counters["ttft_ok"] / counters["requests"]
+                        if counters["requests"] else None),
+                    tpot_attainment=(
+                        counters["tpot_ok"] / counters["requests"]
+                        if counters["requests"] else None))
+                for name, counters in class_stats.items()},
+            **engine_slo,
+        }
+        # Prefix-cache effectiveness (None when the engine runs
+        # dense or with the cache disabled); the router aggregates
+        # hit_tokens/total_prompt_tokens fleet-wide.
+        prefix = self.engine.prefix_stats()
+        if prefix is not None:
+            out["prefix_cache"] = prefix
         return out
 
     # --------------------------- engine thread -------------------------
@@ -644,6 +756,29 @@ class ServingFrontEnd:
         pending = self._active_runs.get(request_id)
         if pending is not None and pending.admitted_at is None:
             pending.admitted_at = time.perf_counter()
+
+    def _on_shed(self, request_id: str, reason: str) -> None:
+        # Engine-thread hook (inside engine.step's _shed_expired):
+        # the engine dropped a queued request under overload —
+        # complete its waiter as shed (503) and count it against its
+        # class's attainment.
+        pending = self._active_runs.pop(request_id, None)
+        with self._inflight_lock:
+            self._engine_active.discard(request_id)
+        if pending is None:
+            return
+        with self._stats_lock:
+            cls = self._class_stats.setdefault(
+                pending.request.slo_class,
+                {"requests": 0, "ttft_ok": 0, "tpot_ok": 0,
+                 "shed": 0})
+            cls["shed"] += 1
+        pending.error = f"request {request_id} shed: {reason}"
+        pending.shed = True
+        pending.finished_at = time.perf_counter()
+        if pending.token_queue is not None:
+            pending.token_queue.put(None)
+        pending.event.set()
 
     def _on_token(self, request_id: str, token: int, index: int) -> None:
         # _active_runs is engine-thread-owned and this hook runs on

@@ -20,8 +20,11 @@ host-side Python, compute is two compiled functions (prefill, step).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import hashlib
+import time
 import uuid
 from typing import Callable, Optional
 
@@ -256,6 +259,118 @@ def _prefill_paged(model, prefill_chunk, page, params, cache, slot,
     return scatter(cache, small), last
 
 
+@functools.partial(jax.jit, static_argnames=("model", "prefill_chunk",
+                                             "page"))
+def _prefill_paged_shared(model, prefill_chunk, page, params, cache,
+                          slot, suffix, prefix_ids, table_row,
+                          suffix_row, prefix_len, prompt_len):
+    """Shared-prefix paged prefill: the request matched ``prefix_len``
+    tokens (a whole number of pages, ids in ``prefix_ids``) in the
+    engine's prefix index, so prefill SKIPS them — the forward runs
+    only over ``suffix`` [1, S_bucket].
+
+    Mechanics: (1) seed a batch-1 dense cache with the prefix K/V
+    gathered straight out of the page pool
+    (transformer.prefix_rows_from_pages) and set its write index to
+    prefix_len; (2) run the suffix chunks through the model with
+    GLOBAL positions prefix_len.. — the multi-token insert path
+    attends causally over the seeded prefix exactly as a cold prefill
+    would, and in fp32 produces the same bytes (the shared rows ARE
+    the rows a cold prefill writes); (3) scatter only the suffix rows
+    into the slot's freshly allocated pages (``suffix_row``, scratch-
+    padded) and install the full block-table row + true length.
+
+    prefix_len/prompt_len are dynamic (traced), so compiles key on the
+    SUFFIX length bucket alone — a 1,000-token cached system prompt
+    costs one gather (memory-bound) plus a short-bucket forward
+    instead of a long-bucket prefill. prefix_ids is fixed-width
+    (max_decode_len/page entries, scratch-padded): the gather reads a
+    full cache width of pool rows per layer, which is the memcpy-class
+    cost the skipped prefill FLOPs pay for."""
+    small = inf.init_cache(model, params, 1)
+
+    def seed(big, sm):
+        if isinstance(big, dict) and "k_pages" in big:
+            rows = tfm.prefix_rows_from_pages(big, prefix_ids, page)
+            nrows = rows["k"].shape[0]
+            out = dict(sm)
+            out["k"] = sm["k"].at[0, :nrows].set(
+                rows["k"].astype(sm["k"].dtype))
+            out["v"] = sm["v"].at[0, :nrows].set(
+                rows["v"].astype(sm["v"].dtype))
+            out["index"] = jnp.full_like(sm["index"], prefix_len)
+            if "k_scale" in sm:
+                out["k_scale"] = sm["k_scale"].at[0, :nrows].set(
+                    rows["k_scale"])
+                out["v_scale"] = sm["v_scale"].at[0, :nrows].set(
+                    rows["v_scale"])
+            return out
+        return {key: seed(big[key], sm[key]) for key in sm}
+
+    small = seed(cache, small)
+    total = suffix.shape[1]
+    chunk = min(prefill_chunk or total, total)
+    hiddens = []
+    for off in range(0, total, chunk):
+        seg = suffix[:, off:off + chunk]
+        h, mut = model.apply(
+            {"params": params, "cache": small}, seg,
+            return_hidden=True,
+            positions=prefix_len + jnp.arange(
+                off, off + seg.shape[1], dtype=jnp.int32),
+            mutable=["cache"])
+        small = mut["cache"]
+        hiddens.append(h)
+    hidden = (hiddens[0] if len(hiddens) == 1
+              else jnp.concatenate(hiddens, axis=1))
+    last_h = jnp.take(hidden[0], prompt_len - prefix_len - 1, axis=0)
+    embedding = params["embed"]["embedding"]
+    last = jnp.dot(embedding.astype(jnp.float32),
+                   last_h.astype(jnp.float32))
+    # Suffix rows live at SMALL-cache rows prefix_len.. — dynamic
+    # slices per page. Starts are page-multiples (prefix_len is a
+    # whole number of pages), so the only slices that can clamp at
+    # the buffer edge are bucket-padding blocks, and those target the
+    # scratch page via suffix_row.
+    n_blocks = -(-total // page)
+
+    def scatter(big, sm):
+        if isinstance(big, dict) and "k_pages" in big:
+            kp, vp = big["k_pages"], big["v_pages"]
+            for b in range(n_blocks):
+                start = prefix_len + b * page
+                krows = jax.lax.dynamic_slice_in_dim(
+                    sm["k"][0], start, page)
+                vrows = jax.lax.dynamic_slice_in_dim(
+                    sm["v"][0], start, page)
+                kp = kp.at[suffix_row[b]].set(krows.astype(kp.dtype))
+                vp = vp.at[suffix_row[b]].set(vrows.astype(vp.dtype))
+            out = {
+                "k_pages": kp, "v_pages": vp,
+                "block_table":
+                    big["block_table"].at[slot].set(table_row),
+                "length":
+                    big["length"].at[slot].set(prompt_len),
+            }
+            if "k_page_scales" in big:
+                ksc = big["k_page_scales"]
+                vsc = big["v_page_scales"]
+                for b in range(n_blocks):
+                    start = prefix_len + b * page
+                    ksc = ksc.at[suffix_row[b]].set(
+                        jax.lax.dynamic_slice_in_dim(
+                            sm["k_scale"][0], start, page))
+                    vsc = vsc.at[suffix_row[b]].set(
+                        jax.lax.dynamic_slice_in_dim(
+                            sm["v_scale"][0], start, page))
+                out["k_page_scales"] = ksc
+                out["v_page_scales"] = vsc
+            return out
+        return {key: scatter(big[key], sm[key]) for key in big}
+
+    return scatter(cache, small), last
+
+
 @dataclasses.dataclass
 class Request:
     request_id: str
@@ -267,6 +382,17 @@ class Request:
     # this orders the wait line, like job.priority orders task
     # queues.
     priority: int = 0
+    # Request-level SLO targets (None = best-effort): admission
+    # orders same-priority entries by TTFT deadline, deferral guards
+    # active slots' TPOT headroom against long prefill stalls, and —
+    # when the engine is configured with a shed grace — overload
+    # drops the deepest-deadline-violating entries instead of
+    # serving them pointlessly late. Per-class defaults come from
+    # config (config/settings.py ServingSloSettings); the front end
+    # resolves slo_class -> targets before submit.
+    ttft_target_ms: Optional[float] = None
+    tpot_target_ms: Optional[float] = None
+    slo_class: str = "standard"
 
 
 @dataclasses.dataclass
@@ -306,6 +432,9 @@ class _QueueEntry:
     continuation is identical to the uninterrupted run."""
     request: Request
     resumed: list[int] = dataclasses.field(default_factory=list)
+    # Monotonic submission stamp: the anchor for TTFT deadlines
+    # (EDF ordering within a priority class, overload shedding).
+    submitted_at: float = 0.0
 
 
 class ContinuousBatcher:
@@ -330,7 +459,10 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = None,
                  on_token: Optional[
                      Callable[[str, int, int], None]] = None,
-                 speculative: Optional[SpeculativeConfig] = None):
+                 speculative: Optional[SpeculativeConfig] = None,
+                 prefix_cache: bool = True,
+                 slo_shed_grace_ms: Optional[float] = None,
+                 tpot_stall_factor: float = 4.0):
         """kv_page_size enables the PAGED KV cache (vLLM-style): K/V
         live in a shared kv_num_pages-page pool and slots hold block
         tables covering only their live tokens, so HBM is sized for
@@ -351,6 +483,27 @@ class ContinuousBatcher:
             re-queued at the head) and later resumed by re-prefilling
             prompt + already-generated tokens. Short actual
             generations then share a pool far below worst-case.
+
+        prefix_cache (paged mode only) enables CROSS-REQUEST PREFIX
+        REUSE: every full prompt page is indexed by a chained content
+        hash at prefill, and a later request whose prompt starts with
+        the same pages pins them (refcounted) instead of recomputing
+        — its prefill runs only over the suffix
+        (_prefill_paged_shared). Unreferenced indexed pages park in
+        an LRU and are evicted only when the allocator runs dry, so
+        the reuse window is however much pool slack the workload
+        leaves. Greedy outputs are unchanged (the shared rows are the
+        bytes a cold prefill writes).
+
+        slo_shed_grace_ms, when set, arms overload shedding: a queued
+        request whose TTFT deadline has been missed by more than the
+        grace is dropped (deepest violation first) instead of served
+        pointlessly late — on_shed fires and the front end surfaces
+        the drop as an error. tpot_stall_factor bounds admission's
+        prefill-stall tolerance: a prefill predicted to stall active
+        decodes longer than factor * (tightest active TPOT target) is
+        deferred unless the candidate's own TTFT deadline is about to
+        blow.
 
         prefill_chunk caps the CHUNKED PREFILL segment length: long
         prompts prefill in fixed-size multi-token inserts (each chunk
@@ -446,6 +599,42 @@ class ContinuousBatcher:
                                   self._scratch_page, np.int32)
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(num_slots)]
+            # Prefix-cache state. Page lifecycle: FREE (_free_pages)
+            # -> OWNED (a slot's private _slot_pages) -> PINNED
+            # (indexed, refcount >= 1, referenced via _slot_shared)
+            # -> LRU (indexed, refcount 0, evictable) -> FREE.
+            # Accounting invariant: _avail_pages =
+            # total - pinned - sum(_slot_reserved) — LRU pages still
+            # count as available because _alloc_page can always evict
+            # them; pinned pages cannot be reclaimed while referenced.
+            self._slot_shared: list[list[int]] = [
+                [] for _ in range(num_slots)]
+            self._prefix_index: dict[bytes, int] = {}
+            self._page_key: dict[int, bytes] = {}
+            self._page_ref: dict[int, int] = {}
+            self._lru: "collections.OrderedDict[int, None]" = \
+                collections.OrderedDict()
+        self.prefix_cache = bool(prefix_cache) and self.paged
+        self.prefix_lookups = 0
+        self.prefix_hit_pages = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_total_tokens = 0
+        self.prefix_published = 0
+        self.prefix_evictions = 0
+        # SLO scheduling state: live EWMA estimates of prefill cost
+        # per bucket token and of the decode step feed admission's
+        # stall prediction; sheds/deferrals are the overload
+        # counters per-class attainment reporting builds on.
+        self.slo_shed_grace_ms = slo_shed_grace_ms
+        self.tpot_stall_factor = tpot_stall_factor
+        self.slo_sheds = 0
+        self.sheds_by_class: dict[str, int] = {}
+        self.slo_deferrals = 0
+        self.on_shed: Optional[Callable[[str, str], None]] = None
+        self._prefill_ms_per_token: Optional[float] = None
+        self._step_ms: Optional[float] = None
+        self._timed_buckets: set = set()
+        self._step_samples = 0
         self.model = tfm.TransformerLM(self.config)
         self.params = params
         self.num_slots = num_slots
@@ -480,6 +669,9 @@ class ContinuousBatcher:
             _prefill_dense, dense_model, self.prefill_chunk)
         self._prefill_paged = functools.partial(
             _prefill_paged, dense_model, self.prefill_chunk, page)
+        self._prefill_shared = functools.partial(
+            _prefill_paged_shared, dense_model, self.prefill_chunk,
+            page)
 
         if speculative is not None:
             # Draft engine state: a dense cache with gamma+1 extra
@@ -544,18 +736,52 @@ class ContinuousBatcher:
                     if -(-(length + max_new_tokens)
                          // self.page_size) <= self._total_pages]
         warmed: list[int] = []
+
+        def drain(length: int) -> None:
+            self.submit(Request(
+                request_id=f"__warmup__{uuid.uuid4().hex[:8]}",
+                prompt=[(i % 7) + 1 for i in range(length)],
+                max_new_tokens=max_new_tokens))
+            while self.pending():
+                self.step()
+
         with goodput_events.phase(goodput_events.PROGRAM_WARMUP,
                                   what="serving_engine",
                                   buckets=len(lengths)) as attrs, \
                 cc_manager.tracked(attrs, "serving_warmup"):
             for length in lengths:
-                self.submit(Request(
-                    request_id=f"__warmup__{uuid.uuid4().hex[:8]}",
-                    prompt=[(i % 7) + 1 for i in range(length)],
-                    max_new_tokens=max_new_tokens))
-                while self.pending():
-                    self.step()
+                if self.prefix_cache:
+                    # The warm-up prompts share prefixes, so with the
+                    # index live a long bucket would match the
+                    # previous bucket's published pages and compile
+                    # the SHARED path instead of its cold prefill —
+                    # and the first novel long prompt in real traffic
+                    # would then pay that compile mid-measurement.
+                    # Match against an empty index so every bucket
+                    # compiles cold.
+                    self.prefix_cache_clear()
+                drain(length)
                 warmed.append(self._bucket_length(length))
+            if self.prefix_cache and len(lengths) > 1:
+                # Second pass compiles the shared-prefill suffix
+                # buckets: starting from an empty index, each chained
+                # prompt matches the full pages the previous bucket's
+                # request published, leaving only the suffix to
+                # prefill.
+                self.prefix_cache_clear()
+                for length in lengths:
+                    drain(length)
+        if self.prefix_cache:
+            # Real traffic should start against an empty index, and
+            # the stats should describe real traffic only — not the
+            # warm-up's synthetic lookups and publishes.
+            self.prefix_cache_clear()
+            self.prefix_lookups = 0
+            self.prefix_hit_pages = 0
+            self.prefix_hit_tokens = 0
+            self.prefix_total_tokens = 0
+            self.prefix_published = 0
+            self.prefix_evictions = 0
         return warmed
 
     def precompile(self) -> int:
@@ -649,7 +875,8 @@ class ContinuousBatcher:
                 f"{request.request_id}: prompt+generation "
                 f"{len(request.prompt)}+{request.max_new_tokens} "
                 f"exceeds max_decode_len {self.max_decode_len}")
-        self._enqueue(_QueueEntry(request))
+        self._enqueue(_QueueEntry(request,
+                                  submitted_at=time.monotonic()))
 
     def pending(self) -> int:
         return len(self._queue) + sum(
@@ -698,11 +925,13 @@ class ContinuousBatcher:
             return emitted + self._step_speculative()
         if self.paged:
             self._grow_pages()
+        t0 = time.monotonic()
         self._key, step_key = jax.random.split(self._key)
         self.cache, self._tokens, self._positions, next_tok = \
             self._decode_step(self.params, self.cache, self._tokens,
                               self._positions, self._active, step_key)
         next_host = np.asarray(next_tok)
+        self._record_step_time(t0)
         for i, slot in enumerate(self._slots):
             req = slot.request
             if req is None:
@@ -727,12 +956,14 @@ class ContinuousBatcher:
         per-token eos/max_new checks so a slot can stop mid-block."""
         if self.paged:
             self._grow_pages(span=self.gamma)
+        t0 = time.monotonic()
         (self.cache, self._draft_cache, self._tokens, self._positions,
          block, a_slot) = self._spec_step(
             self.params, self._draft_params, self.cache,
             self._draft_cache, self._tokens, self._positions,
             self._active)
         block_host = np.asarray(block)
+        self._record_step_time(t0)
         a_host = np.asarray(a_slot)
         emitted: list[tuple[str, list[int]]] = []
         n_active = 0
@@ -784,50 +1015,141 @@ class ContinuousBatcher:
         self._slots[i] = _Slot()
         self._active = self._active.at[i].set(False)
         if self.paged:
-            self._free_pages.extend(self._slot_pages[i])
-            self._slot_pages[i] = []
-            self._avail_pages += self._slot_reserved[i]
-            self._slot_reserved[i] = 0
+            self._release_pages(slot=i)
             # The freed slot keeps decoding (masked) in the full-batch
             # step: its table must stop referencing returned pages
             # BEFORE they are reallocated.
             self._table[i] = self._scratch_page
             self._push_tables()
 
+    def _alloc_page(self, grow_slot: Optional[int] = None) -> int:
+        """THE single page-allocation path: free list first, then
+        LRU-evict an unreferenced indexed page (dropping its index
+        entry — a pinned page is never evicted), then, in overcommit
+        mode during decode growth, preempt a victim slot. Every page
+        a slot's table comes to reference is handed out here;
+        _release_pages is the only way back (the serving-page-refcount
+        lint rule pins both)."""
+        while True:
+            if self._free_pages:
+                return self._free_pages.pop()
+            if self._lru:
+                pid, _ = self._lru.popitem(last=False)
+                key = self._page_key.pop(pid)
+                if self._prefix_index.get(key) == pid:
+                    del self._prefix_index[key]
+                del self._page_ref[pid]
+                self.prefix_evictions += 1
+                return pid
+            if not self.overcommit or grow_slot is None:
+                raise RuntimeError(
+                    "paged KV pool exhausted mid-decode; size "
+                    "kv_num_pages >= num_slots * max_decode_len / "
+                    "page_size to rule this out, or enable "
+                    "overcommit=True for preemption")
+            self._preempt(exclude=grow_slot)
+
+    def _release_pages(self, slot: Optional[int] = None,
+                       pages: Optional[list] = None) -> None:
+        """THE single page-release path (the serving-page-refcount
+        lint rule's counterpart to _alloc_page). slot=i returns slot
+        i's OWNED pages to the free list, drops its SHARED-page
+        references (a refcount reaching zero parks the page in the
+        LRU — never the free list, so no page is freed while another
+        slot's table still reads it), and releases its reservation.
+        pages=[...] frees already-unindexed pages directly
+        (prefix_cache_clear's evictions)."""
+        if pages:
+            self._free_pages.extend(pages)
+        if slot is None:
+            return
+        self._free_pages.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        for pid in self._slot_shared[slot]:
+            self._page_ref[pid] -= 1
+            if self._page_ref[pid] == 0:
+                self._lru[pid] = None
+                self._avail_pages += 1
+        self._slot_shared[slot] = []
+        self._avail_pages += self._slot_reserved[slot]
+        self._slot_reserved[slot] = 0
+
+    def prefix_cache_clear(self) -> int:
+        """Evict every UNREFERENCED indexed page back to the free
+        list (pinned pages stay — they are still read by active
+        slots). Returns the number of pages reclaimed."""
+        dropped = []
+        while self._lru:
+            pid, _ = self._lru.popitem(last=False)
+            key = self._page_key.pop(pid)
+            if self._prefix_index.get(key) == pid:
+                del self._prefix_index[key]
+            del self._page_ref[pid]
+            dropped.append(pid)
+        self._release_pages(pages=dropped)
+        return len(dropped)
+
+    def prefix_stats(self) -> Optional[dict]:
+        """Prefix-cache counters, or None when disabled. hit_rate is
+        TOKEN-level: cached prompt tokens / total prompt tokens seen
+        by paged admission — the fraction of prefill work the index
+        converted into a gather."""
+        if not self.prefix_cache:
+            return None
+        return {
+            "lookups": self.prefix_lookups,
+            "hit_pages": self.prefix_hit_pages,
+            "hit_tokens": self.prefix_hit_tokens,
+            "total_prompt_tokens": self.prefix_total_tokens,
+            "hit_rate": (
+                self.prefix_hit_tokens / self.prefix_total_tokens
+                if self.prefix_total_tokens else 0.0),
+            "indexed_pages": len(self._page_ref),
+            "lru_pages": len(self._lru),
+            "published_pages": self.prefix_published,
+            "evictions": self.prefix_evictions,
+        }
+
+    def slo_stats(self) -> dict:
+        """SLO scheduling counters + the live cost estimates
+        admission decides with."""
+        return {
+            "sheds": self.slo_sheds,
+            "sheds_by_class": dict(self.sheds_by_class),
+            "deferrals": self.slo_deferrals,
+            "prefill_ms_per_token": self._prefill_ms_per_token,
+            "step_ms": self._step_ms,
+        }
+
     def _grow_pages(self, span: int = 0) -> None:
         """Allocate pages so every active slot's table covers its next
         write positions pos..min(pos+span, total-1) — span=0 is the
         plain one-token decode step (at most one new block per slot);
         span=gamma is the speculative verify block, which can cross
-        several page boundaries in one step. Allocation is capped at
-        the slot's worst-case commit range (speculative tail writes
-        past it land on the scratch page via the table default), so it
-        never exceeds the admission reservation. Pushes the updated
-        tables into every layer's cache copy. In overcommit mode an
-        empty free list preempts a victim instead of raising."""
+        several page boundaries in one step. A slot's block count is
+        shared prefix pages + owned pages; growth only ever appends
+        OWNED pages (decode writes land strictly past the shared
+        prefix). Allocation is capped at the slot's worst-case commit
+        range (speculative tail writes past it land on the scratch
+        page via the table default), so it never exceeds the
+        admission reservation. Pushes the updated tables into every
+        layer's cache copy. In overcommit mode an empty free list
+        preempts a victim instead of raising (a preempted victim's
+        request empties, so the loop skips it)."""
         positions = np.asarray(self._positions)
-        active = np.asarray(self._active).copy()
         changed = False
         for i in range(self.num_slots):
-            if not active[i] or self._slots[i].request is None:
+            if self._slots[i].request is None:
                 continue
             req = self._slots[i].request
             total = len(req.prompt) + req.max_new_tokens
             pos = int(positions[i])
             needed = min(pos + span, total - 1) // self.page_size + 1
-            while len(self._slot_pages[i]) < needed:
-                block = len(self._slot_pages[i])
-                while not self._free_pages:
-                    if not self.overcommit:
-                        raise RuntimeError(
-                            "paged KV pool exhausted mid-decode; size "
-                            "kv_num_pages >= num_slots * "
-                            "max_decode_len / page_size to rule this "
-                            "out, or enable overcommit=True for "
-                            "preemption")
-                    victim = self._preempt(exclude=i)
-                    active[victim] = False
-                pagenum = self._free_pages.pop()
+            while (len(self._slot_shared[i]) +
+                   len(self._slot_pages[i])) < needed:
+                block = (len(self._slot_shared[i]) +
+                         len(self._slot_pages[i]))
+                pagenum = self._alloc_page(grow_slot=i)
                 self._slot_pages[i].append(pagenum)
                 self._table[i, block] = pagenum
                 changed = True
@@ -894,61 +1216,303 @@ class ContinuousBatcher:
 
     def _enqueue(self, entry: "_QueueEntry") -> None:
         """Insert keeping the queue sorted by descending priority,
-        FIFO within a priority class."""
+        then earliest TTFT deadline within a priority class (EDF;
+        entries without a target sort last and stay FIFO among
+        themselves — with no SLO targets anywhere this is exactly
+        the old priority+FIFO order)."""
         priority = entry.request.priority
+        deadline = self._ttft_deadline(entry)
+        deadline = float("inf") if deadline is None else deadline
         for k in range(len(self._queue) - 1, -1, -1):
-            if self._queue[k].request.priority >= priority:
+            other = self._queue[k]
+            other_deadline = self._ttft_deadline(other)
+            if other_deadline is None:
+                other_deadline = float("inf")
+            if (other.request.priority > priority or
+                    (other.request.priority == priority and
+                     other_deadline <= deadline)):
                 self._queue.insert(k + 1, entry)
                 return
         self._queue.insert(0, entry)
 
+    def _ttft_deadline(self, entry: "_QueueEntry") -> Optional[float]:
+        """Absolute (monotonic-clock) TTFT deadline, or None when the
+        request carries no target."""
+        target = entry.request.ttft_target_ms
+        if target is None:
+            return None
+        return entry.submitted_at + target / 1000.0
+
+    def _shed_expired(self, now: float) -> None:
+        """Overload shedding (armed by slo_shed_grace_ms): drop every
+        queued entry whose TTFT deadline is blown by more than the
+        grace, deepest violation first — serving it would be pure
+        badput while fresher requests still have budget. Preempted
+        (resumed) entries are exempt: their first token already
+        shipped, so their TTFT is history and their partial work
+        would be wasted."""
+        if self.slo_shed_grace_ms is None:
+            return
+        while True:
+            worst_k, worst_over = None, 0.0
+            for k, entry in enumerate(self._queue):
+                if entry.resumed:
+                    continue
+                deadline = self._ttft_deadline(entry)
+                if deadline is None:
+                    continue
+                over = ((now - deadline) * 1000.0 -
+                        self.slo_shed_grace_ms)
+                if over > worst_over:
+                    worst_k, worst_over = k, over
+            if worst_k is None:
+                return
+            entry = self._queue.pop(worst_k)
+            self.slo_sheds += 1
+            cls = entry.request.slo_class
+            self.sheds_by_class[cls] = \
+                self.sheds_by_class.get(cls, 0) + 1
+            if self.on_shed is not None:
+                self.on_shed(entry.request.request_id,
+                             "ttft deadline exceeded")
+
+    def _should_defer(self, entry: "_QueueEntry",
+                      now: float) -> bool:
+        """Batch-composition guard: admitting a long prompt stalls
+        every active decode for its whole prefill. When that
+        predicted stall (live EWMA prefill cost x bucket length)
+        exceeds tpot_stall_factor x the tightest active TPOT target,
+        hold the candidate back — unless its own TTFT deadline would
+        blow while waiting, at which point its SLO outranks the
+        actives' headroom."""
+        if self._prefill_ms_per_token is None:
+            return False
+        targets = [
+            s.request.tpot_target_ms for s in self._slots
+            if s.request is not None and
+            s.request.tpot_target_ms is not None]
+        if not targets:
+            return False
+        tokens = len(entry.request.prompt) + len(entry.resumed)
+        if self.prefix_cache:
+            # Predict the POST-MATCH suffix cost: a cached prefix
+            # pays a gather, not a prefill.
+            matched = self._match_prefix(self._page_keys(
+                entry.request.prompt + entry.resumed), tokens)
+            tokens -= len(matched) * self.page_size
+        stall = self._bucket_length(tokens) * \
+            self._prefill_ms_per_token
+        if stall <= min(targets) * self.tpot_stall_factor:
+            return False
+        deadline = self._ttft_deadline(entry)
+        if deadline is not None and \
+                now + stall / 1000.0 >= deadline:
+            return False
+        return True
+
+    def _page_keys(self, tokens: list[int]) -> list[bytes]:
+        """Chained content hash per FULL page: key_b covers tokens
+        [0, (b+1)*page) via H(key_{b-1} || tokens of page b), so a
+        key identifies the entire prefix up to its page boundary —
+        matching never needs to compare token ids, and equal pages
+        under different prefixes never collide."""
+        keys: list[bytes] = []
+        prev = b""
+        page = self.page_size
+        for b in range(len(tokens) // page):
+            digest = hashlib.blake2b(
+                prev + np.asarray(tokens[b * page:(b + 1) * page],
+                                  np.int64).tobytes(),
+                digest_size=16).digest()
+            keys.append(digest)
+            prev = digest
+        return keys
+
+    def _match_prefix(self, keys: list[bytes],
+                      num_tokens: int) -> list[int]:
+        """Longest indexed page chain, capped so at least one suffix
+        token remains (the first sample needs real last-token logits
+        from a forward)."""
+        limit = (num_tokens - 1) // self.page_size
+        matched: list[int] = []
+        for b in range(min(len(keys), limit)):
+            pid = self._prefix_index.get(keys[b])
+            if pid is None:
+                break
+            matched.append(pid)
+        return matched
+
+    def _publish_pages(self, i: int, keys: list[bytes], m: int,
+                       row: np.ndarray, num_tokens: int) -> None:
+        """Index this admission's fresh FULL pages under their chain
+        keys so later same-prefix requests can share them. A
+        published page moves from the slot's OWNED list into its
+        SHARED set with refcount 1 (held by this slot until it
+        frees): pinned grows by one while the slot's reservation
+        shrinks by one, so availability is unchanged. Only full
+        pages publish — the partial tail stays owned (copy-on-extend:
+        decode keeps writing into it privately)."""
+        full = num_tokens // self.page_size
+        for b in range(m, full):
+            key = keys[b]
+            if key in self._prefix_index:
+                # Duplicate content (an exact-length twin admitted in
+                # the same drain could not match its own final full
+                # page): keep this copy private rather than aliasing
+                # two owners onto one index entry.
+                continue
+            pid = int(row[b])
+            self._slot_pages[i].remove(pid)
+            self._slot_shared[i].append(pid)
+            self._prefix_index[key] = pid
+            self._page_key[pid] = key
+            self._page_ref[pid] = 1
+            if self.overcommit:
+                self._avail_pages -= 1
+            else:
+                self._slot_reserved[i] -= 1
+            self.prefix_published += 1
+
+    def _record_prefill_time(self, key, t0: float,
+                             n_tokens: int) -> None:
+        """EWMA prefill cost per bucket token; the first sample of
+        each compile bucket is discarded (it measures jit
+        compilation, not prefill)."""
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        if key not in self._timed_buckets:
+            self._timed_buckets.add(key)
+            return
+        per_token = dt_ms / max(1, n_tokens)
+        if self._prefill_ms_per_token is None:
+            self._prefill_ms_per_token = per_token
+        else:
+            self._prefill_ms_per_token = (
+                0.7 * self._prefill_ms_per_token + 0.3 * per_token)
+
+    def _record_step_time(self, t0: float) -> None:
+        """EWMA decode-step wall time (the engine-side TPOT floor);
+        the first sample is discarded as compile."""
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        self._step_samples += 1
+        if self._step_samples == 1:
+            return
+        if self._step_ms is None:
+            self._step_ms = dt_ms
+        else:
+            self._step_ms = 0.7 * self._step_ms + 0.3 * dt_ms
+
     def _admit(self) -> None:
+        now = time.monotonic()
+        self._shed_expired(now)
         for i, slot in enumerate(self._slots):
             if slot.request is not None or not self._queue:
                 continue
             entry = self._queue[0]
             req = entry.request
+            if self._should_defer(entry, now):
+                # Head-of-line hold: admitting now would stall active
+                # decodes past their TPOT headroom.
+                self.slo_deferrals += 1
+                break
             # Resumed (preempted) requests re-prefill prompt + what
             # they had already generated, in one batched pass.
             tokens = req.prompt + entry.resumed
             bucket = self._bucket_length(len(tokens))
             padded = tokens + [0] * (bucket - len(tokens))
             prompt = jnp.asarray([padded], jnp.int32)
+            t0 = time.monotonic()
+            timed_key = ("dense", bucket)
+            timed_tokens = bucket
             if self.paged:
                 blocks_needed = -(-len(tokens) // self.page_size)
                 remaining = req.max_new_tokens - len(entry.resumed)
                 worst = -(-(len(tokens) + remaining)
                           // self.page_size)
+                keys: list[bytes] = []
+                matched: list[int] = []
+                if self.prefix_cache:
+                    keys = self._page_keys(tokens)
+                    matched = self._match_prefix(keys, len(tokens))
+                m = len(matched)
+                lru_m = sum(1 for pid in matched
+                            if self._page_ref[pid] == 0)
                 if self.overcommit:
                     # Take only the prompt's pages (+1 block of
                     # decode headroom against immediate re-thrash);
-                    # exhaustion during decode preempts.
-                    want = min(blocks_needed + (1 if remaining else 0),
-                               worst)
-                    if len(self._free_pages) < want:
+                    # exhaustion during decode preempts. Matched
+                    # pages cost nothing fresh; pinning an
+                    # LRU-parked page consumes one evictable unit.
+                    want = min(blocks_needed - m +
+                               (1 if remaining else 0), worst - m)
+                    if (len(self._free_pages) + len(self._lru)
+                            - lru_m) < want:
                         break
                 else:
-                    if self._avail_pages < worst:
+                    if self._avail_pages < (worst - m) + lru_m:
                         # Not enough budget for this request's worst
                         # case: wait for frees rather than risking a
                         # mid-decode exhaustion deadlock between
-                        # half-grown slots.
+                        # half-grown slots. The shared prefix
+                        # discounts the budget — reuse IS admission
+                        # headroom.
                         break
-                    self._avail_pages -= worst
-                    self._slot_reserved[i] = worst
+                    self._avail_pages -= worst - m
+                    self._slot_reserved[i] = worst - m
                 self._queue.pop(0)
                 if self.on_admit is not None:
                     self.on_admit(req.request_id)
-                pages = [self._free_pages.pop()
-                         for _ in range(blocks_needed)]
-                self._slot_pages[i] = pages
+                # Pin the matched chain: shared pages are immutable
+                # (decode writes land strictly past the last full
+                # prompt page) and never evictable while referenced.
+                for pid in matched:
+                    if self._page_ref[pid] == 0:
+                        del self._lru[pid]
+                        self._avail_pages -= 1
+                    self._page_ref[pid] += 1
+                self._slot_shared[i] = list(matched)
+                if self.prefix_cache:
+                    self.prefix_lookups += 1
+                    self.prefix_hit_pages += m
+                    self.prefix_hit_tokens += m * self.page_size
+                    self.prefix_total_tokens += len(tokens)
+                fresh = [self._alloc_page()
+                         for _ in range(blocks_needed - m)]
+                self._slot_pages[i] = fresh
                 row = np.full((self.max_blocks,), self._scratch_page,
                               np.int32)
-                row[:blocks_needed] = pages
+                row[:m] = matched
+                row[m:blocks_needed] = fresh
                 self._table[i] = row
-                self.cache, last_logits = self._prefill_paged(
-                    self.params, self.cache, i, prompt,
-                    jnp.asarray(row), len(tokens))
+                if m:
+                    prefix_len = m * self.page_size
+                    suffix_tokens = tokens[prefix_len:]
+                    sbucket = self._bucket_length(len(suffix_tokens))
+                    timed_key = ("shared", sbucket)
+                    timed_tokens = sbucket
+                    suffix = jnp.asarray(
+                        [suffix_tokens +
+                         [0] * (sbucket - len(suffix_tokens))],
+                        jnp.int32)
+                    prefix_ids = np.full(
+                        (self.max_decode_len // self.page_size,),
+                        self._scratch_page, np.int32)
+                    prefix_ids[:m] = matched
+                    suffix_row = np.full((self.max_blocks,),
+                                         self._scratch_page,
+                                         np.int32)
+                    suffix_row[:blocks_needed - m] = fresh
+                    self.cache, last_logits = self._prefill_shared(
+                        self.params, self.cache, i, suffix,
+                        jnp.asarray(prefix_ids), jnp.asarray(row),
+                        jnp.asarray(suffix_row), prefix_len,
+                        len(tokens))
+                else:
+                    timed_key = ("paged", bucket)
+                    self.cache, last_logits = self._prefill_paged(
+                        self.params, self.cache, i, prompt,
+                        jnp.asarray(row), len(tokens))
+                if self.prefix_cache:
+                    self._publish_pages(i, keys, m, row, len(tokens))
             else:
                 self._queue.pop(0)
                 if self.on_admit is not None:
@@ -977,3 +1541,6 @@ class ContinuousBatcher:
             self._tokens = self._tokens.at[i, 0].set(first[0])
             self._positions = self._positions.at[i].set(len(tokens))
             self._active = self._active.at[i].set(True)
+            # int(first[0]) above forced the prefill to complete, so
+            # t0..now is a faithful admission-stall sample.
+            self._record_prefill_time(timed_key, t0, timed_tokens)

@@ -488,6 +488,42 @@ class Attention(nn.Module):
 
 
 
+def prefix_rows_from_pages(layer_cache: dict, page_ids,
+                           page: int) -> dict:
+    """Gather a shared-prefix page chain out of ONE layer's paged
+    pool into dense-cache row layout — the paged prefill entry point
+    for cross-request prefix reuse (models/serving.py).
+
+    The serving engine's prefix index stores immutable full pages by
+    content hash; a request that matches n pages skips their prefill
+    entirely and instead seeds a batch-1 dense cache with these rows
+    (index = n*page), then runs only its suffix through the model.
+    The gather works because a page id indexes EVERY layer's pool at
+    the same position (the engine pushes one shared block table into
+    all layers), so one id list reconstructs the prefix in each layer.
+
+    layer_cache: one attention layer's paged leaves (k_pages
+    [P, page, H, D], v_pages, and the int8 k_page_scales/v_page_scales
+    [P, page, H] when present). page_ids: [n] int32 page indices —
+    entries past the true prefix may point at the scratch page; their
+    garbage rows are masked-on-read by the dense cache's index leaf.
+    Returns {"k": [n*page, H, D], "v": ..., ("k_scale": [n*page, H],
+    "v_scale": ...)} in the pool's storage dtype (int8 rows + fp32
+    scales pass through untouched, so a shared prefix dequantizes to
+    exactly the bytes the original prefill produced)."""
+    k = layer_cache["k_pages"][page_ids]          # [n, page, H, D]
+    rows = k.shape[0] * page
+    out = {"k": k.reshape(rows, *k.shape[2:]),
+           "v": layer_cache["v_pages"][page_ids].reshape(
+               rows, *k.shape[2:])}
+    if "k_page_scales" in layer_cache:
+        ks = layer_cache["k_page_scales"][page_ids]
+        out["k_scale"] = ks.reshape(rows, ks.shape[-1])
+        out["v_scale"] = layer_cache["v_page_scales"][
+            page_ids].reshape(rows, ks.shape[-1])
+    return out
+
+
 class QuantDense(nn.Module):
     """Bias-free linear layer running on the int8 MXU path.
 

@@ -82,6 +82,30 @@ def poisson_trace(seed: int, num_tasks: int, rate_per_second: float,
     return tasks
 
 
+def diurnal_arrivals(seed: int, num: int, day_seconds: float,
+                     peak_rate: float, trough_rate: float,
+                     ) -> list[float]:
+    """Arrival times of an inhomogeneous Poisson process whose rate
+    swings sinusoidally between trough and peak over a virtual day
+    (thinning against the peak envelope). Factored out of
+    ``diurnal_trace`` so the serving load generator
+    (models/loadgen.py arrival="diurnal") replays the SAME arrival
+    curve the fleet simulator schedules — one day/night shape across
+    both layers, deterministic per (seed, params)."""
+    rng = random.Random(seed)
+    arrivals: list[float] = []
+    t = 0.0
+    while len(arrivals) < num:
+        t += rng.expovariate(peak_rate)
+        phase = math.sin(2.0 * math.pi * t / day_seconds)
+        rate = trough_rate + (peak_rate - trough_rate) * \
+            (0.5 + 0.5 * phase)
+        if rng.random() * peak_rate > rate:
+            continue
+        arrivals.append(t)
+    return arrivals
+
+
 def diurnal_trace(seed: int, num_tasks: int, day_seconds: float,
                   peak_rate: float, trough_rate: float,
                   steps: int = 60, step_seconds: float = 0.5,
@@ -90,20 +114,15 @@ def diurnal_trace(seed: int, num_tasks: int, day_seconds: float,
                   ckpt_every: int = 20,
                   ) -> list[SimTask]:
     """Sinusoidal arrival rate between trough and peak over a virtual
-    day (inhomogeneous Poisson via thinning): the load swing that
-    makes provisioning-vs-queueing badput a real trade."""
-    rng = random.Random(seed)
+    day: the load swing that makes provisioning-vs-queueing badput a
+    real trade. Arrivals come from ``diurnal_arrivals``; task
+    attributes draw from an independent stream so attribute sampling
+    cannot perturb the arrival curve (or vice versa)."""
+    arrivals = diurnal_arrivals(seed, num_tasks, day_seconds,
+                                peak_rate, trough_rate)
+    rng = random.Random((seed << 1) ^ 0x5eed)
     tasks = []
-    t = 0.0
-    i = 0
-    while i < num_tasks:
-        # Thinning against the peak envelope.
-        t += rng.expovariate(peak_rate)
-        phase = math.sin(2.0 * math.pi * t / day_seconds)
-        rate = trough_rate + (peak_rate - trough_rate) * \
-            (0.5 + 0.5 * phase)
-        if rng.random() * peak_rate > rate:
-            continue
+    for i, t in enumerate(arrivals):
         identity = f"id-{rng.randrange(identities):04d}" \
             if rng.random() < 0.7 else None
         tasks.append(SimTask(
@@ -113,7 +132,6 @@ def diurnal_trace(seed: int, num_tasks: int, day_seconds: float,
             cache_identity=identity,
             compile_seconds=compile_seconds,
             ckpt_every=ckpt_every, ckpt_seconds=0.5))
-        i += 1
     return tasks
 
 

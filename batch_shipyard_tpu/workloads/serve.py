@@ -15,6 +15,7 @@ final stdout line, and exits nonzero if any request failed.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 
@@ -117,8 +118,32 @@ def build_draft(args) -> serving.SpeculativeConfig:
                                      gamma=args.gamma)
 
 
+def build_slo(args):
+    """Resolve the serving SLO configuration (config/settings.py
+    serving_slo_settings): --slo-config default -> the built-in class
+    table; --slo-config PATH -> a JSON config mapping with a
+    serving.slo section; neither -> SLO scheduling off (requests pass
+    through untargeted). CLI --shed-grace-ms / --tpot-stall-factor
+    override the parsed values."""
+    from batch_shipyard_tpu.config.settings import serving_slo_settings
+    if not args.slo_config:
+        return None
+    if args.slo_config == "default":
+        slo = serving_slo_settings(None)
+    else:
+        with open(args.slo_config, encoding="utf-8") as fh:
+            slo = serving_slo_settings(json.load(fh))
+    if args.shed_grace_ms is not None:
+        slo = dataclasses.replace(slo,
+                                  shed_grace_ms=args.shed_grace_ms)
+    if args.tpot_stall_factor is not None:
+        slo = dataclasses.replace(
+            slo, tpot_stall_factor=args.tpot_stall_factor)
+    return slo
+
+
 def build_engine(args, config=None, params=None,
-                 speculative=None) -> serving.ContinuousBatcher:
+                 speculative=None, slo=None) -> serving.ContinuousBatcher:
     if config is None:
         config = build_config(args)
     if params is None:
@@ -135,6 +160,9 @@ def build_engine(args, config=None, params=None,
         kv_num_pages=args.kv_num_pages,
         overcommit=args.overcommit,
         prefill_chunk=args.prefill_chunk,
+        prefix_cache=not args.no_prefix_cache,
+        slo_shed_grace_ms=slo.shed_grace_ms if slo else None,
+        tpot_stall_factor=(slo.tpot_stall_factor if slo else 4.0),
         speculative=speculative)
 
 
@@ -161,6 +189,24 @@ def main() -> int:
                         help="Chunked prefill segment length (bounds "
                         "long-prompt prefill memory; power of two)")
     parser.add_argument("--overcommit", action="store_true")
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="Disable cross-request prefix/KV-cache "
+                        "reuse in the paged pool (the control arm of "
+                        "BENCH_serving_slo)")
+    parser.add_argument("--slo-config", default=None,
+                        help="SLO scheduling config: 'default' for "
+                        "the built-in class table, or a JSON config "
+                        "file with a serving.slo section "
+                        "(config/settings.py serving_slo_settings)")
+    parser.add_argument("--shed-grace-ms", type=float, default=None,
+                        help="Arm overload shedding: queued requests "
+                        "past their TTFT deadline by this grace are "
+                        "rejected 503 (requires --slo-config)")
+    parser.add_argument("--tpot-stall-factor", type=float,
+                        default=None,
+                        help="Admission defers prefills that would "
+                        "stall active decodes past this multiple of "
+                        "the tightest TPOT target")
     # Speculative decoding inside the engine: a small draft model
     # proposes gamma tokens per slot per step; ONE batched target
     # forward verifies every slot's block; commits are per-slot
@@ -185,9 +231,20 @@ def main() -> int:
     parser.add_argument("--port", type=int, default=8900)
     # Benchmark mode
     parser.add_argument("--loadgen", type=int, default=0,
-                        help="Run N Poisson requests then exit")
+                        help="Run N benchmark requests then exit")
     parser.add_argument("--rate", type=float, default=8.0,
-                        help="Poisson arrival rate (req/s)")
+                        help="Arrival rate (req/s; diurnal peak)")
+    parser.add_argument("--arrival", choices=("poisson", "diurnal"),
+                        default="poisson",
+                        help="Loadgen arrival process (diurnal "
+                        "replays the fleet simulator's day/night "
+                        "curve)")
+    parser.add_argument("--shared-prefix-groups", type=int,
+                        default=0,
+                        help="Loadgen shared prompt-prefix groups "
+                        "(exercises the prefix cache and affinity "
+                        "routing)")
+    parser.add_argument("--shared-prefix-len", type=int, default=0)
     parser.add_argument("--prompt-len", type=int, nargs=2,
                         default=(4, 32), metavar=("MIN", "MAX"))
     parser.add_argument("--gen-tokens", type=int, nargs=2,
@@ -212,6 +269,8 @@ def main() -> int:
 
     fronts = []
     router = None
+    slo = build_slo(args)
+    slo_classes = slo.class_targets() if slo else None
     if args.replicas > 1:
         # Fleet mode: replicas bind ephemeral loopback ports; the
         # router is the public surface (same wire API).
@@ -221,7 +280,8 @@ def main() -> int:
         # Like the target params, the draft tree is built once and
         # shared across every replica engine.
         speculative = build_draft(args) if args.speculative else None
-        engines = [build_engine(args, config, params, speculative)
+        engines = [build_engine(args, config, params, speculative,
+                                slo=slo)
                    for _ in range(args.replicas)]
         # Warm every replica BEFORE it starts taking traffic (jit
         # compiles recorded as engine warm-up goodput; must run before
@@ -230,7 +290,8 @@ def main() -> int:
         # the rest reuse.
         for e in engines:
             warm_engine(args, e)
-        fronts = [ServingFrontEnd(e, port=0).start()
+        fronts = [ServingFrontEnd(e, port=0,
+                                  slo_classes=slo_classes).start()
                   for e in engines]
         router = ServingRouter([f.url for f in fronts],
                                host=args.host,
@@ -239,10 +300,11 @@ def main() -> int:
         print(f"fleet router on {url} over {len(fronts)} "
               f"replica(s)", flush=True)
     else:
-        engine = build_engine(args)
+        engine = build_engine(args, slo=slo)
         warm_engine(args, engine)
         fronts = [ServingFrontEnd(engine, host=args.host,
-                                  port=args.port).start()]
+                                  port=args.port,
+                                  slo_classes=slo_classes).start()]
         url = fronts[0].url
         print(f"serving on {url}", flush=True)
 
@@ -270,9 +332,22 @@ def main() -> int:
         url, args.loadgen, rate_hz=args.rate,
         prompt_len=tuple(args.prompt_len),
         max_new_tokens=tuple(args.gen_tokens),
-        vocab_size=args.vocab, seed=args.seed)
+        vocab_size=args.vocab, seed=args.seed,
+        arrival=args.arrival,
+        shared_prefix_groups=args.shared_prefix_groups,
+        shared_prefix_len=args.shared_prefix_len,
+        slo_classes=slo_classes)
     if router is not None:
         report["router"] = router.stats()
+    prefix = [f.engine.prefix_stats() for f in fronts]
+    if any(prefix):
+        hits = sum(p["hit_tokens"] for p in prefix if p)
+        total = sum(p["total_prompt_tokens"] for p in prefix if p)
+        report["prefix_cache"] = {
+            "hit_tokens": hits,
+            "total_prompt_tokens": total,
+            "hit_rate": hits / total if total else 0.0,
+        }
     if args.speculative:
         spec = [f.engine.spec_stats() for f in fronts]
         proposed = sum(s["proposed"] for s in spec)

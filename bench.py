@@ -332,6 +332,119 @@ def bench_serving_fleet(num_replicas: int = 2,
             f.shutdown()
 
 
+def bench_serving_slo(num_requests: int = 24, rate_hz: float = 16.0,
+                      num_slots: int = 4, max_decode_len: int = 128,
+                      kv_page_size: int = 16,
+                      shared_prefix_len: int = 96,
+                      seed: int = 0,
+                      artifact: bool = True) -> dict:
+    """Cross-request prefix-cache + SLO phase (ISSUE 18): the SAME
+    shared-prefix diurnal workload (identical seed => identical
+    arrivals, prompts, and greedy outputs) through two engines that
+    differ ONLY in ``prefix_cache`` — the treated arm reuses indexed
+    KV pages across requests, the control re-prefills every prompt
+    from scratch. Reports token-level prefix hit rate, per-class SLO
+    attainment, and the exact (unbinned) TTFT mean/p99 deltas, and
+    asserts the two arms' outputs are byte-identical (sha256 over
+    every request's token ids) — the reuse must be free in tokens,
+    paid for only in work skipped.
+
+    fp32 end to end so "byte-identical" is a statement about the
+    gather-vs-recompute paths, not about accumulated rounding.
+
+    CPU marker: sized for the CPU bench container (d_model=256,
+    4 layers); the deltas are honest relative measurements on
+    whatever backend runs them."""
+    import jax
+    import jax.numpy as jnp
+    from batch_shipyard_tpu.models import inference as inf
+    from batch_shipyard_tpu.models import serving
+    from batch_shipyard_tpu.models import transformer as tfm
+    from batch_shipyard_tpu.models.loadgen import run_load
+    from batch_shipyard_tpu.models.server import ServingFrontEnd
+    config = tfm.TransformerConfig(
+        vocab_size=4096, d_model=256, n_layers=4, n_heads=4,
+        d_head=64, d_ff=1024, max_seq_len=max_decode_len,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+    model = tfm.TransformerLM(config)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    slo_classes = {
+        "interactive": {"ttft_ms": 5000.0, "tpot_ms": 500.0},
+        "standard": {"ttft_ms": 20000.0, "tpot_ms": 2000.0},
+        "batch": {"ttft_ms": None, "tpot_ms": None},
+    }
+    pages = num_slots * (max_decode_len // kv_page_size) + \
+        2 * (shared_prefix_len // kv_page_size) + 4
+
+    def run_arm(prefix_cache: bool) -> dict:
+        engine = serving.ContinuousBatcher(
+            config, params, num_slots=num_slots,
+            max_decode_len=max_decode_len,
+            kv_page_size=kv_page_size, kv_num_pages=pages,
+            prefix_cache=prefix_cache,
+            sampling=inf.SamplingConfig())
+        # Warm every prefill bucket AND (via the shared warm-up
+        # prompts) the shared-prefill suffix buckets before traffic,
+        # so no arm pays a mid-run compile; warmup clears the prefix
+        # index afterwards, so the treated arm still starts cold.
+        engine.warmup()
+        front = ServingFrontEnd(engine, port=0,
+                                slo_classes=slo_classes).start()
+        try:
+            front.generate({"prompt": [1, 2, 3],
+                            "max_new_tokens": 2})
+            report = run_load(
+                front.url, num_requests, rate_hz=rate_hz,
+                prompt_len=(9, 16), max_new_tokens=(4, 12),
+                vocab_size=config.vocab_size, seed=seed,
+                arrival="diurnal", day_seconds=20.0,
+                shared_prefix_groups=2,
+                shared_prefix_len=shared_prefix_len,
+                slo_classes=slo_classes)
+            report["prefix_cache"] = engine.prefix_stats()
+            report["engine_slo"] = engine.slo_stats()
+        finally:
+            front.shutdown()
+        return report
+
+    on = run_arm(True)
+    off = run_arm(False)
+    keep = ("completed", "failed", "shed", "ttft_mean_ms",
+            "tpot_mean_ms", "ttft_exact_ms", "tpot_exact_ms",
+            "ttft_ms", "tpot_ms", "tokens_per_second",
+            "slo_attainment", "outputs_sha256")
+    result = {
+        "seed": seed,
+        "cpu_marker": True,
+        "platform": jax.default_backend(),
+        "num_requests": num_requests,
+        "arrival": "diurnal",
+        "shared_prefix_groups": 2,
+        "shared_prefix_len": shared_prefix_len,
+        "kv_page_size": kv_page_size,
+        "prefix_cache_on": {k: on[k] for k in keep if k in on},
+        "prefix_cache_off": {k: off[k] for k in keep if k in off},
+        "prefix_hit_rate": on["prefix_cache"]["hit_rate"],
+        "prefix_hit_tokens": on["prefix_cache"]["hit_tokens"],
+        "prefix_published_pages":
+            on["prefix_cache"]["published_pages"],
+        "outputs_identical":
+            on["outputs_sha256"] == off["outputs_sha256"],
+        "ttft_mean_delta_ms":
+            on["ttft_mean_ms"] - off["ttft_mean_ms"],
+        "ttft_p99_delta_ms": (on["ttft_exact_ms"]["p99"] -
+                              off["ttft_exact_ms"]["p99"]),
+        "tpot_mean_delta_ms":
+            on["tpot_mean_ms"] - off["tpot_mean_ms"],
+    }
+    if artifact:
+        with open(REPO_ROOT / "BENCH_serving_slo.json", "w",
+                  encoding="utf-8") as fh:
+            json.dump({"serving_slo": result}, fh, indent=2)
+    return result
+
+
 def bench_checkpoint_overhead(num_saves: int = 3,
                               payload_mb: int = 64) -> dict:
     """Checkpoint stall phase: blocking ms/save of the sync
@@ -1116,9 +1229,10 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated subset to run (resnet, transformer, "
         "serving, serving_speculative, checkpoint_overhead, "
         "compile_warm, ring_collectives, orchestration, "
-        "scheduler_scale, fleet_sim; serving_speculative, "
+        "scheduler_scale, fleet_sim, serving_slo; "
+        "serving_speculative, "
         "checkpoint_overhead, compile_warm, ring_collectives, "
-        "scheduler_scale and fleet_sim are opt-in — the "
+        "scheduler_scale, fleet_sim and serving_slo are opt-in — the "
         "silicon-proof pipeline runs each as its own phase; "
         "scheduler_scale drives 10^6 in-process tasks through the "
         "CPU fakepod scheduler end-to-end; fleet_sim runs the "
@@ -1194,6 +1308,13 @@ def main(argv: list[str] | None = None) -> int:
                 details["fleet_sim"] = bench_fleet_sim()
             except Exception as exc:  # noqa: BLE001
                 details["fleet_sim"] = {"error": str(exc)}
+        if "serving_slo" in workloads:
+            # Prefix-cache A/B + SLO attainment: runs on whatever
+            # backend jax falls back to (cpu_marker in artifact).
+            try:
+                details["serving_slo"] = bench_serving_slo()
+            except Exception as exc:  # noqa: BLE001
+                details["serving_slo"] = {"error": str(exc)}
         details["error"] = (f"accelerator unreachable "
                             f"({probe_error}); compute benches "
                             f"not run")
@@ -1362,6 +1483,15 @@ def main(argv: list[str] | None = None) -> int:
             details["fleet_sim"] = bench_fleet_sim()
         except Exception as exc:  # noqa: BLE001 - secondary metric
             details["fleet_sim"] = {"error": str(exc)}
+    if "serving_slo" in workloads:
+        # Opt-in (the ISSUE 18 prefix-cache proof): the SAME
+        # shared-prefix diurnal workload through prefix-cache-on and
+        # -off engines at one seed — hit rate, SLO attainment, exact
+        # TTFT deltas, byte-identical greedy outputs.
+        try:
+            details["serving_slo"] = bench_serving_slo()
+        except Exception as exc:  # noqa: BLE001 - secondary metric
+            details["serving_slo"] = {"error": str(exc)}
     with open(details_out, "w", encoding="utf-8") as fh:
         json.dump(details, fh, indent=2)
     if resnet is not None:

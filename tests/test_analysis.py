@@ -779,6 +779,60 @@ def test_shell_backtick_subst_fires():
     assert not _rules_of(blessed, "shell-backtick-subst")
 
 
+# ---------------------------- serving family ---------------------------
+
+def test_serving_page_refcount_fires_on_direct_free():
+    """Every direct `_free_pages` mutation shape outside the release
+    helper fires: mutating method calls, reassignment, item
+    assignment, augassign, and del."""
+    firing = {"batch_shipyard_tpu/models/mod.py": (
+        "class Pool:\n"
+        "    def _preempt(self, i):\n"
+        "        self._free_pages.extend(self._slot_pages[i])\n"
+        "    def reset(self):\n"
+        "        self._free_pages = []\n"
+        "    def patch(self, k, v):\n"
+        "        self._free_pages[k] = v\n"
+        "    def grow(self, pages):\n"
+        "        self._free_pages += pages\n"
+        "    def nuke(self):\n"
+        "        del self._free_pages[0]\n")}
+    found = _rules_of(firing, "serving-page-refcount")
+    assert len(found) == 5, [f.render() for f in found]
+    assert "_release_pages" in found[0].message
+
+
+def test_serving_page_refcount_blessed_shapes_pass():
+    """The allowed owners — __init__ seeding, the allocator popping,
+    the release helper returning — plus read-only uses stay silent;
+    module-level mutation outside a def still fires."""
+    blessed = {"batch_shipyard_tpu/models/mod.py": (
+        "class Pool:\n"
+        "    def __init__(self, n):\n"
+        "        self._free_pages = list(range(n))\n"
+        "    def _alloc_page(self):\n"
+        "        return self._free_pages.pop()\n"
+        "    def _release_pages(self, pages):\n"
+        "        self._free_pages.extend(pages)\n"
+        "    def stats(self):\n"
+        "        return len(self._free_pages)\n"
+        "    def peek(self):\n"
+        "        return list(self._free_pages)\n")}
+    assert not _rules_of(blessed, "serving-page-refcount")
+    module_level = {"batch_shipyard_tpu/models/mod.py": (
+        "pool._free_pages.clear()\n")}
+    found = _rules_of(module_level, "serving-page-refcount")
+    assert len(found) == 1 and "<module>" in found[0].message
+    suppressed_src = {"batch_shipyard_tpu/models/mod.py": (
+        "class Pool:\n"
+        "    def drain(self):\n"
+        "        self._free_pages.clear()  "
+        "# shipyard-lint: disable=serving-page-refcount\n")}
+    active, suppressed = _run(suppressed_src,
+                              "serving-page-refcount")
+    assert not active and len(suppressed) == 1
+
+
 # ------------------------------ the gate -------------------------------
 
 def test_repo_is_lint_clean():

@@ -433,6 +433,41 @@ def test_fleet_sim_dispatched_and_rendered():
                     row["delta_vs_baseline"], (scenario, policy)
 
 
+def test_serving_slo_dispatched_and_rendered():
+    """The prefix-cache/SLO proof is wired end to end: bench.py
+    dispatches the serving_slo workload, benchgen renders the
+    committed BENCH_serving_slo.json, and the artifact clears the
+    acceptance gates — prefix hit rate > 0.5, prefix-cache-on mean
+    AND p99 TTFT strictly below the cache-off control at the same
+    seed, and byte-identical greedy outputs between the two arms."""
+    import json
+
+    bench_src = (PACKAGE.parent / "bench.py").read_text(
+        encoding="utf-8")
+    assert '"serving_slo" in workloads' in bench_src
+    benchgen_src = (PACKAGE.parent / "tools" / "benchgen.py"
+                    ).read_text(encoding="utf-8")
+    assert "BENCH_serving_slo.json" in benchgen_src
+    artifact = PACKAGE.parent / "BENCH_serving_slo.json"
+    assert artifact.exists(), (
+        "BENCH_serving_slo.json not committed — run "
+        "`python bench.py --workloads serving_slo`")
+    data = json.loads(artifact.read_text(
+        encoding="utf-8"))["serving_slo"]
+    assert data.get("cpu_marker") is True
+    assert data["prefix_hit_rate"] > 0.5
+    assert data["outputs_identical"] is True
+    on, off = data["prefix_cache_on"], data["prefix_cache_off"]
+    assert on["completed"] == off["completed"] == \
+        data["num_requests"]
+    assert on["ttft_mean_ms"] < off["ttft_mean_ms"]
+    assert on["ttft_exact_ms"]["p99"] < off["ttft_exact_ms"]["p99"]
+    assert on["outputs_sha256"] == off["outputs_sha256"]
+    for arm in (on, off):
+        assert set(arm["slo_attainment"]) == {
+            "interactive", "standard", "batch"}
+
+
 def test_chaos_kinds_help_lists_node_preempt_notice():
     """The --kinds help derives from INJECTION_KINDS (analyzer rule
     wiring-kinds-help-stale) and the rendered help really names the

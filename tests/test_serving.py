@@ -143,7 +143,11 @@ def test_paged_pool_overcommit_admission_waits(params):
     for r in reqs:
         assert results[r.request_id] == reference_greedy(
             params, r.prompt, r.max_new_tokens)
-    assert len(engine._free_pages) == 3  # all pages returned
+    # All pages reclaimable after drain: free, or parked unreferenced
+    # in the prefix-cache LRU (indexed for reuse, evictable on
+    # demand) — none pinned.
+    assert len(engine._free_pages) + len(engine._lru) == 3
+    assert all(ref == 0 for ref in engine._page_ref.values())
 
 
 def test_paged_freed_slot_cannot_corrupt_recycled_pages(params):
@@ -286,7 +290,8 @@ def test_overcommit_preemption_matches_greedy(params):
     for r in reqs:
         assert results[r.request_id] == reference_greedy(
             params, r.prompt, r.max_new_tokens), r.request_id
-    assert len(engine._free_pages) == 5
+    assert len(engine._free_pages) + len(engine._lru) == 5
+    assert all(ref == 0 for ref in engine._page_ref.values())
 
 
 def test_overcommit_beats_reservation_when_generations_are_short():

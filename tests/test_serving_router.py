@@ -538,6 +538,121 @@ def test_router_midstream_timeout_orphans_ownership(params):
         front.shutdown()
 
 
+def test_owner_ttl_retires_stale_entries_resubmit_safe(fleet):
+    """TTL retirement of finished/leaked ownership entries: a stale
+    RESERVED claim retires unconditionally, a stale LIVE entry retires
+    once the owning replica provably forgot the id (404 probe) — and a
+    retired id is immediately safe to resubmit (the regression the
+    sweep must not introduce: dropping an id reopens the duplicate
+    gate cleanly, without double-decode)."""
+    router, fronts = fleet
+    past = time.time() - 10_000
+    with router._lock:
+        replica = next(r for r in router._replicas
+                       if r.url == fronts[0].url)
+        router._owner["stale-reserved"] = None
+        router._owner_stamp["stale-reserved"] = past
+        router._owner["stale-live"] = replica
+        router._owner_stamp["stale-live"] = past
+    router._retire_stale()
+    assert "stale-reserved" not in router._owner
+    # The replica never knew "stale-live": the probe 404s, so the
+    # leaked mapping is dropped too.
+    assert "stale-live" not in router._owner
+    assert not router._owner_stamp
+    for rid in ("stale-reserved", "stale-live"):
+        out = _post(router.url, {"request_id": rid, "prompt": [1, 2],
+                                 "max_new_tokens": 2})
+        assert out["num_tokens"] == 2
+
+
+def test_owner_ttl_spares_live_decode(fleet):
+    """The PR 10 failover-race guarantee survives any TTL: an id the
+    owning replica still knows (a genuinely long decode) is NOT
+    retired — its stamp refreshes instead, so the duplicate gate and
+    sticky cancel keep working."""
+    router, fronts = fleet
+    result = {}
+
+    def _long():
+        result["r"] = _post(router.url, {
+            "request_id": "ttl-live", "prompt": [2, 2],
+            "max_new_tokens": 40}, timeout=240)
+
+    t = threading.Thread(target=_long, daemon=True)
+    t.start()
+    assert _poll(lambda: any(f.knows("ttl-live") for f in fronts))
+    with router._lock:
+        router._owner_stamp["ttl-live"] = time.time() - 10_000
+    router._retire_stale()
+    assert "ttl-live" in router._owner
+    assert time.time() - router._owner_stamp["ttl-live"] < 100, \
+        "stamp not refreshed after a live probe"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(router.url, {"request_id": "ttl-live", "prompt": [1],
+                           "max_new_tokens": 1})
+    assert exc.value.code == 400  # gate still shut
+    t.join(120)
+    assert result["r"]["num_tokens"] == 40
+
+
+def test_prefix_affinity_routes_to_same_replica(fleet):
+    """Requests sharing a client prefix key land on the replica whose
+    KV pool holds the prefix pages; derived keys hash the first-N
+    prompt tokens; affinity entries are pure hints retired by TTL."""
+    router, _fronts = fleet
+    urls = set()
+    for k in range(4):
+        out = _post(router.url, {"prompt": [k, 1, 2],
+                                 "prefix_key": "tmpl-A",
+                                 "max_new_tokens": 2})
+        urls.add(out["_replica"])
+    assert len(urls) == 1, "affinity failed to stick"
+    assert router.affinity_routed >= 3
+    _status, stats = _get(router.url, "/v1/stats")
+    assert stats["affinity_routed"] >= 3
+    # Derived keys: identical heads agree, short prompts get none.
+    head = list(range(32))
+    k1 = router._affinity_key({"prompt": head + [99]})
+    k2 = router._affinity_key({"prompt": head + [7, 8]})
+    assert k1 is not None and k1 == k2
+    assert router._affinity_key({"prompt": [5] * 31}) is None
+    assert router._affinity_key(
+        {"prefix_key": "x", "prompt": head}) == "client:x"
+    # TTL drops affinity hints (no probe needed — they are not
+    # correctness state).
+    with router._lock:
+        for key in list(router._affinity):
+            router._affinity[key] = (router._affinity[key][0],
+                                     time.time() - 10_000)
+    router._retire_stale()
+    assert not router._affinity
+
+
+def test_prefix_affinity_yields_under_load_imbalance(fleet):
+    """Stickiness must not create hot spots: when the sticky replica
+    is more than affinity_load_slack ahead of the least-loaded one,
+    the request routes away (and re-homes the prefix there)."""
+    router, _fronts = fleet
+    out = _post(router.url, {"prompt": [1, 2], "prefix_key": "hot",
+                             "max_new_tokens": 1})
+    sticky_url = out["_replica"]
+    with router._lock:
+        for r in router._replicas:
+            if r.url == sticky_url:
+                r.inflight += 10  # simulated hot spot
+    try:
+        out2 = _post(router.url, {"prompt": [3, 4],
+                                  "prefix_key": "hot",
+                                  "max_new_tokens": 1})
+        assert out2["_replica"] != sticky_url
+    finally:
+        with router._lock:
+            for r in router._replicas:
+                if r.url == sticky_url:
+                    r.inflight -= 10
+
+
 def test_stalled_probe_does_not_delay_other_replica_detection(params):
     """ADVICE r5 (low): with long-lived per-replica probers, a hung
     probe on replica A must not stretch fault detection for replica B

@@ -565,6 +565,70 @@ def _fleet_sim(out: list[str]) -> None:
     out.append("")
 
 
+def _serving_slo(out: list[str]) -> None:
+    """Prefix-cache/SLO section: the ISSUE-18 A/B proof from the
+    committed BENCH_serving_slo.json artifact — the SAME shared-prefix
+    diurnal workload (identical seed) through a prefix-cache-on engine
+    and a cache-off control, with token-level hit rate, exact TTFT
+    deltas, byte-identical greedy outputs, and per-class SLO
+    attainment."""
+    report = (_load(ARTIFACTS / "BENCH_serving_slo.json")
+              or {}).get("serving_slo")
+    if report is None:
+        return
+    out.append("## Serving, cross-request prefix cache + SLO "
+               "classes\n")
+    out.append(
+        f"Shared-prefix diurnal workload "
+        f"([36-prefix-caching.md](36-prefix-caching.md)): "
+        f"{_fmt(report.get('num_requests'))} requests, "
+        f"{_fmt(report.get('shared_prefix_groups'))} prefix groups x "
+        f"{_fmt(report.get('shared_prefix_len'))} shared tokens, "
+        f"seed {report.get('seed', '-')}, identical arrivals and "
+        f"prompts on both arms. Token-level prefix hit rate "
+        f"{_fmt(report.get('prefix_hit_rate'), 3)}; greedy outputs "
+        f"byte-identical across arms: "
+        f"{report.get('outputs_identical')}.\n")
+    if report.get("cpu_marker"):
+        out.append("**CPU marker**: a relative A/B measurement on "
+                   "whatever backend ran it — no accelerator "
+                   "figures claimed.\n")
+    on = report.get("prefix_cache_on") or {}
+    off = report.get("prefix_cache_off") or {}
+    out.append("| arm | completed | shed | TTFT mean (ms) | "
+               "TTFT p99 (ms) | TPOT mean (ms) |")
+    out.append("|---|---|---|---|---|---|")
+    for name, arm in (("prefix cache ON", on),
+                      ("prefix cache OFF (control)", off)):
+        exact = arm.get("ttft_exact_ms") or {}
+        out.append(
+            f"| {name} | {_fmt(arm.get('completed'))} | "
+            f"{_fmt(arm.get('shed'))} | "
+            f"{_fmt(arm.get('ttft_mean_ms'), 2)} | "
+            f"{_fmt(exact.get('p99'), 2)} | "
+            f"{_fmt(arm.get('tpot_mean_ms'), 2)} |")
+    out.append("")
+    out.append(
+        f"TTFT deltas (ON − OFF): mean "
+        f"{_fmt(report.get('ttft_mean_delta_ms'), 2)} ms, p99 "
+        f"{_fmt(report.get('ttft_p99_delta_ms'), 2)} ms.\n")
+    attain = (on.get("slo_attainment") or {})
+    if attain:
+        out.append("| SLO class | requests | TTFT target (ms) | "
+                   "TTFT attainment | TPOT target (ms) | "
+                   "TPOT attainment |")
+        out.append("|---|---|---|---|---|---|")
+        for name in sorted(attain):
+            row = attain[name] or {}
+            out.append(
+                f"| {name} | {_fmt(row.get('requests'))} | "
+                f"{_fmt(row.get('ttft_target_ms'))} | "
+                f"{_fmt(row.get('ttft_attainment'), 3)} | "
+                f"{_fmt(row.get('tpot_target_ms'))} | "
+                f"{_fmt(row.get('tpot_attainment'), 3)} |")
+        out.append("")
+
+
 def _goodput(out: list[str]) -> None:
     """ML-productivity goodput section: always names goodput_ratio,
     the three decomposition legs, and EVERY badput category (the
@@ -718,6 +782,7 @@ def render() -> str:
     _fleet_elasticity(out)
     _control_plane(out)
     _fleet_sim(out)
+    _serving_slo(out)
     _silicon_proof(out)
     return "\n".join(out).rstrip() + "\n"
 
