@@ -115,3 +115,68 @@ def check_serving_page_refcount(ctx: AnalysisContext) -> list[Finding]:
                              f"write can reissue a page a cached "
                              f"prefix still references)")))
     return findings
+
+
+def _is_admission_call(node: ast.AST) -> bool:
+    """A call that admits work into a ContinuousBatcher: the engine's
+    slot-admission hook firing (``*.on_admit(...)``) or a front end
+    enqueueing into the engine (``*.engine.submit(...)``)."""
+    if not (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr == "on_admit":
+        return True
+    return (node.func.attr == "submit" and
+            isinstance(node.func.value, ast.Attribute) and
+            node.func.value.attr == "engine")
+
+
+@rule("serving-drain-no-admit", family="serving")
+def check_serving_drain_no_admit(ctx: AnalysisContext
+                                 ) -> list[Finding]:
+    """A function that admits work into the ContinuousBatcher —
+    firing the slot-admission hook (``on_admit``) or submitting into
+    the engine (``*.engine.submit``) — without consulting the
+    ``draining`` flag anywhere in its body. The drain ladder's whole
+    guarantee is 'no admissions after the preempt/evict notice':
+    every admission path must check ``draining`` before seating work,
+    or a draining replica keeps accepting decodes the router already
+    failed over — the same request then decodes on two replicas and
+    the exactly-once stream contract breaks.
+
+    Provenance: the drain feature landed with the check in
+    ``_admit``; a later admission path (chunked-prefill fast path,
+    a new batch front door) that forgets the flag would pass every
+    drain test that doesn't exercise that specific path. This rule
+    closes the class structurally."""
+    findings = []
+    for src in ctx.python_files:
+        # Group nodes by enclosing function, then require any
+        # admitting function to also reference ``draining``.
+        by_func: dict[str, list[ast.AST]] = {}
+        for func_name, node in _walk_functions(src.tree):
+            by_func.setdefault(func_name, []).append(node)
+        for func_name, nodes in by_func.items():
+            admissions = [n for n in nodes if _is_admission_call(n)]
+            if not admissions:
+                continue
+            checks_drain = any(
+                (isinstance(n, ast.Attribute) and
+                 n.attr == "draining") or
+                (isinstance(n, ast.Name) and n.id == "draining")
+                for n in nodes)
+            if checks_drain:
+                continue
+            for call in admissions:
+                findings.append(Finding(
+                    rule="serving-drain-no-admit", path=src.rel,
+                    line=call.lineno,
+                    message=(f"{func_name or '<module>'}() admits "
+                             f"into the ContinuousBatcher without "
+                             f"checking the draining flag; every "
+                             f"admission path must refuse work once "
+                             f"drain starts, or a draining replica "
+                             f"seats decodes the router already "
+                             f"resumed elsewhere (double decode, "
+                             f"broken exactly-once stream)")))
+    return findings

@@ -67,12 +67,29 @@ from typing import Optional
 #                        while its task subprocesses keep running,
 #                        then restarts on the same work_dir: the
 #                        crash-restart adoption shape
+#   replica_kill       — SIGKILL-shaped death of a serving replica
+#                        mid-decode (socket torn down, no drain, no
+#                        final stream line): the router must resume
+#                        every live stream on a sibling with
+#                        exactly-once token delivery
+#   replica_drain_notice — a preempt/evict notice lands on a serving
+#                        replica: it must flip to draining (healthz
+#                        503+marker, no new admissions, in-flight
+#                        decodes run to the grace deadline) while the
+#                        router routes around it and resumes any
+#                        drain-abandoned decode elsewhere
+#   router_restart     — the serving ROUTER process dies mid-stream
+#                        and a fresh one takes over the same fleet:
+#                        clients re-submit with resume_tokens
+#                        (cancel-then-resume), and the replicas'
+#                        duplicate gates keep delivery exactly-once
 INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
                    "task_kill", "task_wedge", "node_preempt",
                    "node_preempt_notice", "victim_ignore_notice",
                    "host_loss_resize", "pool_capacity_loss",
                    "store_outage", "leader_partition",
-                   "agent_restart")
+                   "agent_restart", "replica_kill",
+                   "replica_drain_notice", "router_restart")
 
 # Kinds a GENERIC drill's recovery invariants can absorb — the
 # default schedule. The fleet-elasticity kinds are excluded: they
@@ -84,7 +101,11 @@ INJECTION_KINDS = ("store_delay", "store_error", "heartbeat_blackout",
 # dedicated-drill shapes: a sustained outage without the resilient
 # wrapper armed is unrecoverable by construction, and the other two
 # need their drills' orchestrated setups to make the invariants
-# non-vacuous.
+# non-vacuous. The serving kinds (replica_kill /
+# replica_drain_notice / router_restart) target a serving fleet —
+# replicas + router, not a batch pool — so they only make sense
+# inside the serving drills (chaos/serving_drill.py), which stand
+# that fleet up around the plan.
 DEFAULT_DRILL_KINDS = ("store_delay", "store_error",
                        "heartbeat_blackout", "task_kill",
                        "task_wedge", "node_preempt",
@@ -167,6 +188,12 @@ class ChaosPlan:
                 elif kind == "agent_restart":
                     params = {"revive_after":
                               round(rng.uniform(0.3, 0.8), 3)}
+                elif kind == "replica_drain_notice":
+                    params = {"grace":
+                              round(rng.uniform(0.5, 2.0), 3)}
+                elif kind == "router_restart":
+                    params = {"downtime":
+                              round(rng.uniform(0.1, 0.4), 3)}
                 out.append(Injection(
                     at=at, kind=kind, node_index=node_index,
                     params=tuple(sorted(params.items()))))

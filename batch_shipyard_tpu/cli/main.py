@@ -844,10 +844,28 @@ def chaos_plan(click_ctx, seed, duration, num_nodes, kinds,
                    "under a running task — the revived agent "
                    "re-adopts it from the slot ledger (one start, "
                    "retries==0, adoption leg priced)")
+@click.option("--serve-kill", is_flag=True, default=False,
+              help="Run the serving replica-kill drill: a replica "
+                   "dies SIGKILL-style under live token streams — "
+                   "the router resumes every stream on the sibling, "
+                   "exactly-once and byte-identical to a clean "
+                   "greedy decode, serving_recovery leg priced")
+@click.option("--serve-drain", is_flag=True, default=False,
+              help="Run the serving replica-drain drill: a preempt "
+                   "notice drains a replica through the full ladder "
+                   "(healthz 503+marker, 503+Retry-After admissions, "
+                   "cooperative-not-fault rotation, grace-deadline "
+                   "abandons resumed on the sibling)")
+@click.option("--serve-router", is_flag=True, default=False,
+              help="Run the serving router-restart drill: the "
+                   "router crashes mid-stream and clients cancel-"
+                   "then-resume through a successor — the replicas' "
+                   "duplicate gates keep delivery exactly-once")
 @click.pass_context
 def chaos_drill(click_ctx, seed, tasks, duration, kinds,
                 injections_per_kind, preempt, victim, evict, resize,
-                migrate, outage, partition, restart):
+                migrate, outage, partition, restart, serve_kill,
+                serve_drain, serve_router):
     """Run the seeded drill on a local fakepod pool and assert the
     recovery invariants (nonzero exit = a self-healing regression)."""
     fleet.action_chaos_drill(
@@ -856,7 +874,8 @@ def chaos_drill(click_ctx, seed, tasks, duration, kinds,
         injections_per_kind=injections_per_kind,
         preempt=preempt, victim=victim, evict=evict, resize=resize,
         migrate=migrate, outage=outage, partition=partition,
-        restart=restart,
+        restart=restart, serve_kill=serve_kill,
+        serve_drain=serve_drain, serve_router=serve_router,
         raw=click_ctx.obj["raw"])
 
 

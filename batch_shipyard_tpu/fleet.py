@@ -768,6 +768,9 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                        outage: bool = False,
                        partition: bool = False,
                        restart: bool = False,
+                       serve_kill: bool = False,
+                       serve_drain: bool = False,
+                       serve_router: bool = False,
                        raw: bool = False) -> dict:
     """Run a seeded chaos drill against a self-contained fakepod pool
     (chaos/drill.py) and report the recovery invariants: every task
@@ -806,7 +809,20 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
     successor term's fencing epoch, with exactly one live lease at
     the end; ``restart=True`` — the agent process dies under a
     running task and the revived agent re-adopts it from the slot
-    ledger (one start, retries==0, the ``adoption`` leg priced)."""
+    ledger (one start, retries==0, the ``adoption`` leg priced).
+
+    The serving-tier drills (one flag each, chaos/serving_drill.py):
+    ``serve_kill=True`` — a serving replica dies SIGKILL-style under
+    live token streams; the router resumes every stream on the
+    sibling, exactly-once and byte-identical to a clean greedy
+    decode; ``serve_drain=True`` — a preempt notice drains a replica
+    through the full ladder (healthz 503+marker, 503+Retry-After
+    admissions, router routes around it as cooperative-not-fault,
+    grace-deadline abandons resumed elsewhere); ``serve_router=True``
+    — the serving router itself crashes mid-stream and clients
+    cancel-then-resume through a successor, the replicas' duplicate
+    gates keeping delivery exactly-once. All three price their
+    recoveries into the ``serving_recovery`` goodput leg."""
     from batch_shipyard_tpu.chaos import drill
     picked = [flag for flag, on in (("preempt", preempt),
                                     ("victim", victim),
@@ -815,7 +831,11 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
                                     ("migrate", migrate),
                                     ("outage", outage),
                                     ("partition", partition),
-                                    ("restart", restart)) if on]
+                                    ("restart", restart),
+                                    ("serve-kill", serve_kill),
+                                    ("serve-drain", serve_drain),
+                                    ("serve-router", serve_router),
+                                    ) if on]
     if len(picked) > 1:
         raise ValueError(
             f"pick at most one drill flag, got {picked}")
@@ -839,6 +859,14 @@ def action_chaos_drill(ctx_or_none, seed: int, tasks: int = 16,
         report = drill.run_leader_partition_drill(seed=seed)
     elif restart:
         report = drill.run_agent_restart_drill(seed=seed)
+    elif serve_kill or serve_drain or serve_router:
+        from batch_shipyard_tpu.chaos import serving_drill
+        if serve_kill:
+            report = serving_drill.run_replica_kill_drill(seed=seed)
+        elif serve_drain:
+            report = serving_drill.run_replica_drain_drill(seed=seed)
+        else:
+            report = serving_drill.run_router_restart_drill(seed=seed)
     else:
         report = drill.run_drill(
             seed=seed, tasks=tasks, duration=duration, kinds=kinds,

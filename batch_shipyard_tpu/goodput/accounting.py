@@ -40,7 +40,8 @@ from batch_shipyard_tpu.state.base import StateStore
 BADPUT_CATEGORIES = (
     "provisioning", "queueing", "expansion", "backoff", "image_pull",
     "compile", "checkpoint", "preemption_recovery", "eviction",
-    "migration", "adoption", "store_outage", "idle", "unaccounted",
+    "migration", "adoption", "serving_recovery", "store_outage",
+    "idle", "unaccounted",
 )
 
 PRODUCTIVE = "productive"
@@ -87,6 +88,12 @@ _KIND_CATEGORY = {
     # task ran through it — so the leg prices pure coordination
     # downtime.
     ev.TASK_ADOPTION: "adoption",
+    # Serving-tier mid-stream failover (models/router.py): replica
+    # death/drain detected -> resumed stream open on a sibling. The
+    # re-prefill of prompt+emitted tokens and the drain-abandoned
+    # decode are real lost work on the serving path — a priced leg,
+    # not an invisible 5xx (arxiv 2502.06982 extended to serving).
+    ev.SERVE_RECOVERY: "serving_recovery",
     # State-store outage window (state/resilient.py latch): the
     # control plane was down; whatever productive step windows cover
     # of it stays productive (the sweep ranks productive higher), and
@@ -137,7 +144,12 @@ _PRIORITY = (
     # "adoption" rides with them: the restart gap is a recovery wait
     # on the task's timeline, charged to its specific cause before
     # any generic wait could claim the seconds.
+    # "serving_recovery" rides at the same rank: a serving failover
+    # window is a recovery wait on the request's timeline, charged to
+    # its specific cause before productive step windows could absorb
+    # the seconds.
     "migration", "eviction", "preemption_recovery", "adoption",
+    "serving_recovery",
     "checkpoint", "compile", PRODUCTIVE,
     "checkpoint_async",
     # "store_outage" sits below the work-shaped categories (a task

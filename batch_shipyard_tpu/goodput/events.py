@@ -144,6 +144,13 @@ PROGRAM_CHECKPOINT_RESTORE = "checkpoint_restore"
 # scores it productive-overlapped rather than checkpoint badput.
 PROGRAM_CHECKPOINT_ASYNC = "checkpoint_async"
 PROGRAM_EVAL = "eval"
+# Serving-tier recovery (models/router.py mid-stream failover):
+# INTERVAL from detecting a dead/draining replica mid-decode to the
+# resumed stream opening on a sibling — the re-prefill of
+# prompt+emitted tokens plus drain-abandoned decode work, priced as
+# the "serving_recovery" badput leg; attrs carry request_id and
+# resumed_tokens.
+SERVE_RECOVERY = "serve_recovery"
 
 EVENT_KINDS = frozenset({
     NODE_PROVISIONING, NODE_PREP, NODE_IDLE, NODE_PREEMPTED,
@@ -156,6 +163,7 @@ EVENT_KINDS = frozenset({
     PROGRAM_COMPILE, PROGRAM_WARMUP, PROGRAM_STEP_WINDOW,
     PROGRAM_CHECKPOINT_SAVE, PROGRAM_CHECKPOINT_RESTORE,
     PROGRAM_CHECKPOINT_ASYNC, PROGRAM_EVAL,
+    SERVE_RECOVERY,
 })
 
 

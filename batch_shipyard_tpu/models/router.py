@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import socket
 import threading
 import time
 import urllib.error
@@ -40,6 +41,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Optional, Sequence
 
+from batch_shipyard_tpu.goodput import events as gp_events
 from batch_shipyard_tpu.models.server import (
     JsonRequestHandler, prometheus_lines)
 from batch_shipyard_tpu.utils import util
@@ -58,7 +60,9 @@ class DuplicateRequestError(ValueError):
 class _Replica:
     __slots__ = ("url", "healthy", "inflight", "backlog",
                  "last_probe_at", "last_error", "stats",
-                 "dispatched", "completed", "failed")
+                 "dispatched", "completed", "failed",
+                 "consecutive_failures", "draining",
+                 "unhealthy_total")
 
     def __init__(self, url: str) -> None:
         self.url = url.rstrip("/")
@@ -71,6 +75,18 @@ class _Replica:
         self.dispatched = 0
         self.completed = 0
         self.failed = 0
+        # Prober backoff state: consecutive failed probes (reset on
+        # any success); past the threshold the prober re-probes this
+        # replica on an exponentially backed-off cadence.
+        self.consecutive_failures = 0
+        # Cooperative drain (healthz 503 + draining marker): out of
+        # rotation like unhealthy, but NOT a fault — no probe
+        # backoff, no unhealthy_total increment, and cancel still
+        # reaches it (it may own live decodes finishing out).
+        self.draining = False
+        # healthy->unhealthy transitions (probe or dispatch failure);
+        # exported as shipyard_router_replica_unhealthy_total.
+        self.unhealthy_total = 0
 
     def load(self) -> int:
         return self.inflight + self.backlog
@@ -78,9 +94,12 @@ class _Replica:
     def snapshot(self) -> dict:
         return {
             "url": self.url, "healthy": self.healthy,
+            "draining": self.draining,
             "inflight": self.inflight, "backlog": self.backlog,
             "dispatched": self.dispatched,
             "completed": self.completed, "failed": self.failed,
+            "consecutive_failures": self.consecutive_failures,
+            "unhealthy_total": self.unhealthy_total,
             "last_error": self.last_error,
         }
 
@@ -93,10 +112,34 @@ class ServingRouter:
                  request_timeout: float = 300.0,
                  owner_ttl: float = 600.0,
                  affinity_prefix_tokens: int = 32,
-                 affinity_load_slack: int = 2) -> None:
+                 affinity_load_slack: int = 2,
+                 retry_budget: int = 4,
+                 retry_backoff_base: float = 0.05,
+                 retry_backoff_cap: float = 1.0,
+                 probe_failure_threshold: int = 3,
+                 probe_backoff_cap: float = 30.0) -> None:
         if not replica_urls:
             raise ValueError("router needs at least one replica URL")
         self._replicas = [_Replica(u) for u in replica_urls]
+        # Retry storm control: a request fails over at most
+        # retry_budget times, with capped exponential backoff between
+        # attempts — one dead replica must not amplify into a
+        # synchronized hammering of the survivors.
+        self._retry_budget = retry_budget
+        self._retry_backoff_base = retry_backoff_base
+        self._retry_backoff_cap = retry_backoff_cap
+        self._probe_failure_threshold = probe_failure_threshold
+        self._probe_backoff_cap = probe_backoff_cap
+        # Mid-stream recovery bookkeeping: resume attempts begun,
+        # streams completed after >=1 resume, streams given up on,
+        # and a bounded recent-recovery log (the bench's TTFT-delta
+        # source).
+        self.recoveries = 0
+        self.recovered_requests = 0
+        self.lost_streams = 0
+        import collections
+        self.recovery_log: "collections.deque" = collections.deque(
+            maxlen=256)
         self._lock = threading.Lock()
         self._owner: dict[str, _Replica] = {}  # request_id -> replica
         # Last-write stamp per ownership entry: the TTL retirement
@@ -133,9 +176,27 @@ class ServingRouter:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="router-health",
             daemon=True)
+        # Live client sockets (handler setup/finish): kill() severs
+        # them to reproduce a router-process crash for the chaos
+        # drill — clients see a dead stream and must cancel-then-
+        # resume against the successor router.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         router = self
 
         class Handler(JsonRequestHandler):
+            def setup(self):
+                super().setup()
+                with router._conns_lock:
+                    router._conns.add(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    with router._conns_lock:
+                        router._conns.discard(self.connection)
+
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
                     healthy = router.healthy_count()
@@ -187,10 +248,19 @@ class ServingRouter:
                 self._reply(code, payload)
 
             def _stream(self, spec: dict) -> None:
-                """Streaming proxy: forward the replica's NDJSON
-                chunk stream. Failover only before the first
-                upstream byte — a half-relayed stream cannot be
-                replayed on another replica."""
+                """Streaming proxy with mid-stream recovery: forward
+                the replica's NDJSON chunk stream, journaling every
+                emitted token. If the replica dies (bare EOF before
+                the final result line, a connection reset) or drains
+                the decode out from under us (a marked error line),
+                the request is resumed on a sibling via
+                resume_tokens — the sibling re-prefills prompt +
+                emitted and continues the greedy stream byte-
+                identically; an index-based dedupe keeps token
+                delivery to the client exactly-once across the
+                failover. Read TIMEOUTS never resume (slow is not
+                dead: the run may still be live — resuming would
+                decode it twice)."""
                 try:
                     upstream, replica, request_id = \
                         router.open_stream(spec)
@@ -202,6 +272,7 @@ class ServingRouter:
                     return
                 except urllib.error.HTTPError as exc:
                     self._reply(exc.code,
+                                getattr(exc, "payload", None) or
                                 _json_or_error(exc.read()))
                     return
                 except (urllib.error.URLError, OSError,
@@ -210,71 +281,199 @@ class ServingRouter:
                                                f"out: {exc}"})
                     return
                 import http.client as http_client
-                upstream_ok = True
-                timed_out = False
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/x-ndjson")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
+                except OSError:
+                    upstream.close()
+                    router.finish(replica, request_id, ok=True)
+                    return
+
+                def _relay(line: bytes) -> bool:
+                    try:
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode()
+                            + line + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except (BrokenPipeError, ConnectionResetError):
+                        return False
+
+                # Progress journal for this request: greedy tokens
+                # relayed so far (by global index) — exactly what a
+                # sibling needs to resume, and the dedupe source for
+                # exactly-once delivery. Seeded from the client's own
+                # resume_tokens (a cancel-then-resume after a ROUTER
+                # crash): token indexes are global across the whole
+                # request, so the journal must start where the client
+                # already is — a replica replaying the full run then
+                # dedupes to exactly the missing tail, and a second
+                # failover resumes with the full prefix, not just the
+                # tokens this router relayed.
+                emitted: list[int] = [
+                    int(t) for t in
+                    (spec.get("resume_tokens") or [])]
+                resumes = 0
+                timed_out = False
+                saw_final = False
+                failed_urls = {replica.url}
+                # outcome: "final" (result line relayed), "timeout"
+                # (slow-is-not-dead orphan), "client_gone",
+                # "synthesized" / "lost" (recovery path did its own
+                # accounting).
+                outcome = None
+                while outcome is None:  # one pass per replica
+                    client_ok = True
+                    resume_needed = False
                     # http.client strips the upstream chunked
                     # framing; re-chunk line-by-line downstream.
                     # Upstream read failures and downstream write
                     # failures are distinguished: a replica dying
-                    # mid-stream is a health event; a client
+                    # mid-stream is a recovery event; a client
                     # disconnect is not (the replica finishes fine).
                     while True:
                         try:
                             line = upstream.readline()
                         except (OSError,
                                 http_client.HTTPException) as exc:
-                            upstream_ok = False
-                            # Same 'slow is not dead' policy as
-                            # dispatch(): a read timeout on a
-                            # saturated replica is not a health
-                            # event; a reset/hangup is.
                             timed_out = _is_timeout(exc)
-                            if not timed_out:
+                            if timed_out:
+                                outcome = "timeout"
+                            else:
                                 router._mark_unhealthy(replica, exc)
+                                resume_needed = True
                             break
                         if not line:
+                            if saw_final:
+                                outcome = "final"
+                            else:
+                                # Bare EOF with no final result line:
+                                # the replica was killed mid-decode.
+                                resume_needed = True
                             break
                         try:
-                            self.wfile.write(
-                                f"{len(line):x}\r\n".encode()
-                                + line + b"\r\n")
-                            self.wfile.flush()
-                        except (BrokenPipeError,
-                                ConnectionResetError):
-                            break  # client went away
-                    try:
-                        if not upstream_ok:
-                            # Clean stream end for the client: a
-                            # final error line (a dangling chunked
-                            # stream would hang strict readers).
-                            line = json.dumps(
-                                {"error": "replica failed "
-                                          "mid-stream"}).encode() \
-                                + b"\n"
-                            self.wfile.write(
-                                f"{len(line):x}\r\n".encode()
-                                + line + b"\r\n")
-                        self.wfile.write(b"0\r\n\r\n")
-                    except (BrokenPipeError, ConnectionResetError):
-                        pass
-                finally:
+                            event = json.loads(line)
+                        except ValueError:
+                            event = None
+                        if isinstance(event, dict) and \
+                                "token" in event and "index" in event:
+                            idx = event["index"]
+                            if idx < len(emitted):
+                                continue  # replayed after a resume
+                            emitted.append(int(event["token"]))
+                            if not _relay(line):
+                                client_ok = False
+                                outcome = "client_gone"
+                                break
+                            continue
+                        if isinstance(event, dict) and \
+                                event.get("error") and \
+                                event.get("draining"):
+                            # Drain-abandoned decode: resume on a
+                            # sibling instead of surfacing the error.
+                            resume_needed = True
+                            break
+                        if isinstance(event, dict) and (
+                                "tokens" in event or
+                                event.get("error")):
+                            # Terminal line (result, or an error the
+                            # replica means: shed/cancel/validation).
+                            saw_final = True
+                        if not _relay(line):
+                            client_ok = False
+                            outcome = "client_gone"
+                            break
                     upstream.close()
-                    if timed_out:
-                        # The run may still be live on the (slow)
-                        # replica: keep ownership — duplicate gate +
-                        # sticky cancel stay correct — and let orphan
-                        # reconciliation release the id once the
-                        # replica forgets it (ADVICE r5).
-                        router._orphan_inflight(replica, request_id)
-                    else:
-                        router.finish(replica, request_id,
-                                      ok=upstream_ok)
+                    if outcome is not None or not resume_needed:
+                        if outcome is None:
+                            outcome = "final" if saw_final \
+                                else "client_gone"
+                        break
+                    # --- recovery path -------------------------------
+                    detect_at = time.monotonic()
+                    router.finish(replica, request_id, ok=False,
+                                  retrying=True)
+                    max_new = int(spec.get("max_new_tokens", 16) or 16)
+                    eos_id = spec.get("eos_id")
+                    if len(emitted) >= max_new or (
+                            eos_id is not None and emitted and
+                            emitted[-1] == eos_id):
+                        # Everything was already delivered; only the
+                        # final result line was lost — synthesize it.
+                        _relay(json.dumps(
+                            {"request_id": request_id,
+                             "tokens": emitted,
+                             "num_tokens": len(emitted),
+                             "recovered": True,
+                             "resumes": resumes}).encode()
+                            + b"\n")
+                        router._release_claim(request_id)
+                        router._note_recovery(
+                            request_id, replica.url, None,
+                            len(emitted), 0.0, synthesized=True)
+                        outcome = "synthesized"
+                        break
+                    resumes += 1
+                    if resumes > router._retry_budget:
+                        _relay(json.dumps(
+                            {"error": "stream lost: retry budget "
+                                      f"({router._retry_budget}) "
+                                      "exhausted"}).encode() + b"\n")
+                        router._release_claim(request_id)
+                        router._note_lost(request_id)
+                        outcome = "lost"
+                        break
+                    router._retry_wait(resumes - 1)
+                    try:
+                        upstream, to_replica = router.resume_stream(
+                            spec, request_id, emitted,
+                            exclude=failed_urls)
+                    except (NoHealthyReplicaError,
+                            urllib.error.HTTPError,
+                            urllib.error.URLError, OSError,
+                            TimeoutError) as exc:
+                        _relay(json.dumps(
+                            {"error": f"stream lost: resume failed: "
+                                      f"{exc}"}).encode() + b"\n")
+                        router._release_claim(request_id)
+                        router._note_lost(request_id)
+                        outcome = "lost"
+                        break
+                    router._note_recovery(
+                        request_id, replica.url, to_replica.url,
+                        len(emitted),
+                        time.monotonic() - detect_at)
+                    replica = to_replica
+                    failed_urls.add(replica.url)
+                    # loop: relay from the sibling
+                try:
+                    if client_ok:
+                        if outcome == "timeout":
+                            _relay(json.dumps(
+                                {"error": "replica failed "
+                                          "mid-stream"}).encode()
+                                + b"\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                if outcome == "timeout":
+                    # The run may still be live on the (slow)
+                    # replica: keep ownership — duplicate gate +
+                    # sticky cancel stay correct — and let orphan
+                    # reconciliation release the id once the
+                    # replica forgets it (ADVICE r5).
+                    router._orphan_inflight(replica, request_id)
+                elif outcome in ("final", "client_gone"):
+                    # A vanished client doesn't fail the replica —
+                    # its engine finishes the run on its own.
+                    if resumes and outcome == "final":
+                        router._note_recovered(request_id)
+                    router.finish(replica, request_id, ok=True)
+                # "synthesized"/"lost": the recovery path already
+                # released accounting and the claim.
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -306,14 +505,51 @@ class ServingRouter:
             # don't block shutdown on them.
             t.join(timeout=0.5)
 
+    def kill(self) -> None:
+        """The router-process-crash failure shape (chaos drills):
+        stop serving AND sever every live client connection mid-
+        stream — no final lines, no clean terminators. Clients must
+        recover through a successor router with cancel-then-resume;
+        the replicas keep decoding untouched (their duplicate gates
+        are what keeps delivery exactly-once across the handoff)."""
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._health_thread.join(timeout=5.0)
+
     # ------------------------------ health -----------------------------
 
     def _probe(self, replica: _Replica) -> None:
+        draining = False
         try:
-            with urllib.request.urlopen(
-                    f"{replica.url}/healthz",
-                    timeout=self._probe_timeout) as resp:
-                ok = resp.status == 200
+            try:
+                with urllib.request.urlopen(
+                        f"{replica.url}/healthz",
+                        timeout=self._probe_timeout) as resp:
+                    ok = resp.status == 200
+            except urllib.error.HTTPError as exc:
+                # A draining replica answers healthz 503 with a
+                # marker: cooperative shutdown, not a fault — keep
+                # scraping its stats (live decodes are finishing out)
+                # but take it out of rotation without probe backoff.
+                payload = _json_or_error(exc.read())
+                if not payload.get("draining"):
+                    raise
+                ok, draining = False, True
             stats = {}
             with urllib.request.urlopen(
                     f"{replica.url}/v1/stats",
@@ -321,13 +557,26 @@ class ServingRouter:
                 stats = json.loads(resp.read())
         except (urllib.error.URLError, OSError, ValueError) as exc:
             with self._lock:
+                if replica.healthy:
+                    replica.unhealthy_total += 1
                 replica.healthy = False
+                replica.draining = False
+                replica.consecutive_failures += 1
                 replica.last_error = str(exc)
                 replica.last_probe_at = time.time()
             return
         with self._lock:
+            if replica.healthy and not ok and not draining:
+                replica.unhealthy_total += 1
             replica.healthy = ok
-            replica.last_error = None if ok else "healthz != 200"
+            replica.draining = draining
+            replica.last_error = (None if ok else
+                                  "draining" if draining
+                                  else "healthz != 200")
+            if ok or draining:
+                replica.consecutive_failures = 0
+            else:
+                replica.consecutive_failures += 1
             replica.backlog = int(stats.get("engine_backlog", 0))
             replica.stats = stats
             replica.last_probe_at = time.time()
@@ -344,11 +593,25 @@ class ServingRouter:
         for t in threads:
             t.join(self._probe_timeout * 2 + 1)
 
+    def _probe_delay(self, replica: _Replica) -> float:
+        """Probe cadence: the base interval while healthy (or within
+        the failure threshold), then exponential backoff capped at
+        probe_backoff_cap — a flapping or long-dead replica stops
+        being hammered at full cadence, and its first passing probe
+        resets the cadence."""
+        with self._lock:
+            failures = replica.consecutive_failures
+        if failures <= self._probe_failure_threshold:
+            return self._health_interval
+        exp = min(failures - self._probe_failure_threshold, 6)
+        return min(self._probe_backoff_cap,
+                   self._health_interval * (2 ** exp))
+
     def _probe_loop(self, replica: _Replica) -> None:
         """Per-replica steady-state prober: this replica's probe may
         hang for probe_timeout without delaying any other replica's
         cadence."""
-        while not self._stop.wait(self._health_interval):
+        while not self._stop.wait(self._probe_delay(replica)):
             self._probe(replica)
 
     def _health_loop(self) -> None:
@@ -579,8 +842,36 @@ class ServingRouter:
         logger.warning("replica %s failed dispatch: %s", replica.url,
                        exc)
         with self._lock:
+            if replica.healthy:
+                replica.unhealthy_total += 1
             replica.healthy = False
+            replica.consecutive_failures += 1
             replica.last_error = str(exc)
+
+    def _mark_draining(self, replica: _Replica) -> None:
+        """A dispatch saw the replica's 503+draining answer: converge
+        rotation state ahead of the next probe."""
+        with self._lock:
+            replica.healthy = False
+            replica.draining = True
+            replica.last_error = "draining"
+
+    def _retry_wait(self, attempt: int) -> None:
+        """Capped exponential backoff between failover attempts
+        (retry storm control); interruptible by shutdown."""
+        delay = min(self._retry_backoff_cap,
+                    self._retry_backoff_base * (2 ** attempt))
+        self._stop.wait(delay)
+
+    @staticmethod
+    def _is_backpressure(code: int, payload: dict) -> bool:
+        """Replica answers that mean 'try a sibling', not 'the
+        request failed': drain refusals and 429 concurrency caps.
+        A shed 503 is NOT included — the request's TTFT deadline is
+        already blown fleet-wide; relaying it is honest."""
+        return (code in (503, 429) and isinstance(payload, dict) and
+                bool(payload.get("draining") or
+                     payload.get("backpressure")))
 
     def dispatch(self, spec: dict) -> tuple[int, dict]:
         """Route one non-streaming generate; fail over across
@@ -589,6 +880,7 @@ class ServingRouter:
         affinity_key = self._affinity_key(spec)
         self._claim(request_id)
         tried: set = set()
+        attempts = 0
         while True:
             try:
                 replica = self._pick(tried, affinity_key)
@@ -622,10 +914,29 @@ class ServingRouter:
                 payload["_replica"] = replica.url
                 return status, payload
             except urllib.error.HTTPError as exc:
+                payload = _json_or_error(exc.read())
+                if self._is_backpressure(exc.code, payload):
+                    # Drain refusal / 429 cap: the request is fine,
+                    # the replica just won't take it — fail over
+                    # within the retry budget instead of relaying.
+                    if exc.code == 503:
+                        self._mark_draining(replica)
+                    self.finish(replica, request_id, ok=False,
+                                retrying=True)
+                    attempts += 1
+                    if attempts > self._retry_budget:
+                        self._release_claim(request_id)
+                        return 503, {
+                            "error": f"request_id {request_id}: "
+                                     f"retry budget "
+                                     f"({self._retry_budget}) "
+                                     f"exhausted", "retryable": True}
+                    self._retry_wait(attempts - 1)
+                    continue
                 # The replica answered (4xx/5xx): not a health event,
                 # relay verbatim.
                 self.finish(replica, request_id, ok=False)
-                return exc.code, _json_or_error(exc.read())
+                return exc.code, payload
             except (urllib.error.URLError, OSError,
                     TimeoutError) as exc:
                 if _is_timeout(exc):
@@ -646,6 +957,14 @@ class ServingRouter:
                 self.finish(replica, request_id, ok=False,
                             retrying=True)
                 self._mark_unhealthy(replica, exc)
+                attempts += 1
+                if attempts > self._retry_budget:
+                    self._release_claim(request_id)
+                    return 503, {
+                        "error": f"request_id {request_id}: retry "
+                                 f"budget ({self._retry_budget}) "
+                                 f"exhausted", "retryable": True}
+                self._retry_wait(attempts - 1)
                 # loop: try the next healthy replica
 
     def open_stream(self, spec: dict):
@@ -656,6 +975,7 @@ class ServingRouter:
         affinity_key = self._affinity_key(spec)
         self._claim(request_id)
         tried: set = set()
+        attempts = 0
         while True:
             try:
                 replica = self._pick(tried, affinity_key)
@@ -673,8 +993,25 @@ class ServingRouter:
                 upstream = urllib.request.urlopen(
                     req, timeout=self._request_timeout)
                 return upstream, replica, request_id
-            except urllib.error.HTTPError:
+            except urllib.error.HTTPError as exc:
+                payload = _json_or_error(exc.read())
+                if self._is_backpressure(exc.code, payload):
+                    if exc.code == 503:
+                        self._mark_draining(replica)
+                    self.finish(replica, request_id, ok=False,
+                                retrying=True)
+                    attempts += 1
+                    if attempts > self._retry_budget:
+                        self._release_claim(request_id)
+                        raise NoHealthyReplicaError(
+                            f"retry budget ({self._retry_budget}) "
+                            f"exhausted") from exc
+                    self._retry_wait(attempts - 1)
+                    continue
                 self.finish(replica, request_id, ok=False)
+                # The body was consumed above; stash the parsed
+                # payload for the handler's relay.
+                exc.payload = payload
                 raise
             except (urllib.error.URLError, OSError,
                     TimeoutError) as exc:
@@ -684,15 +1021,99 @@ class ServingRouter:
                 self.finish(replica, request_id, ok=False,
                             retrying=True)
                 self._mark_unhealthy(replica, exc)
+                attempts += 1
+                if attempts > self._retry_budget:
+                    self._release_claim(request_id)
+                    raise NoHealthyReplicaError(
+                        f"retry budget ({self._retry_budget}) "
+                        f"exhausted") from exc
+                self._retry_wait(attempts - 1)
+
+    def resume_stream(self, spec: dict, request_id: Optional[str],
+                      emitted: list[int], exclude: set):
+        """Re-dispatch a broken stream on a sibling: same spec plus
+        resume_tokens (the journaled progress) so the sibling's
+        engine re-prefills prompt+emitted in one pass and the greedy
+        decode continues byte-identically. The caller still holds the
+        id's reserved claim (finish(retrying=True)) — no re-claim
+        here; exclude carries the replicas that already failed this
+        request. Returns (upstream response, replica). Raises
+        NoHealthyReplicaError when no sibling can take it."""
+        resume_spec = dict(spec, resume_tokens=list(emitted))
+        affinity_key = self._affinity_key(spec)
+        tried: set = set(exclude)
+        body = json.dumps(resume_spec).encode()
+        while True:
+            replica = self._pick(tried, affinity_key)
+            tried.add(replica.url)
+            self._remember(request_id, replica)
+            req = urllib.request.Request(
+                f"{replica.url}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                upstream = urllib.request.urlopen(
+                    req, timeout=self._request_timeout)
+                self.recoveries += 1
+                return upstream, replica
+            except urllib.error.HTTPError as exc:
+                payload = _json_or_error(exc.read())
+                self.finish(replica, request_id, ok=False,
+                            retrying=True)
+                if self._is_backpressure(exc.code, payload):
+                    if exc.code == 503:
+                        self._mark_draining(replica)
+                    continue  # next sibling
+                exc.payload = payload
+                raise
+            except (urllib.error.URLError, OSError,
+                    TimeoutError) as exc:
+                self.finish(replica, request_id, ok=False,
+                            retrying=True)
+                if _is_timeout(exc):
+                    raise  # slow is not dead; do not double-dispatch
+                self._mark_unhealthy(replica, exc)
+
+    def _note_recovery(self, request_id: Optional[str],
+                       from_url: str, to_url: Optional[str],
+                       resumed_tokens: int, recovery_seconds: float,
+                       synthesized: bool = False) -> None:
+        with self._lock:
+            self.recovery_log.append({
+                "request_id": request_id, "from": from_url,
+                "to": to_url, "resumed_tokens": resumed_tokens,
+                "recovery_seconds": recovery_seconds,
+                "synthesized": synthesized, "at": time.time()})
+            if synthesized:
+                self.recovered_requests += 1
+        # Price the re-dispatch as serving-recovery badput when this
+        # router runs inside a pool task (no-op otherwise).
+        gp_events.record(
+            gp_events.SERVE_RECOVERY,
+            time.time() - recovery_seconds, time.time(),
+            request_id=request_id or "",
+            resumed_tokens=resumed_tokens)
+
+    def _note_recovered(self, request_id: Optional[str]) -> None:
+        with self._lock:
+            self.recovered_requests += 1
+
+    def _note_lost(self, request_id: Optional[str]) -> None:
+        logger.warning("stream %s lost: recovery failed", request_id)
+        with self._lock:
+            self.lost_streams += 1
 
     def cancel(self, request_id: str) -> tuple[int, dict]:
         """Cancel on the owning replica when known; otherwise
         broadcast — replicas 404 unknown ids (server.py do_DELETE),
-        so the probe keeps going until the owner answers 202."""
+        so the probe keeps going until the owner answers 202.
+        Draining replicas stay in the broadcast: they may own live
+        decodes finishing out."""
         with self._lock:
             replica = self._owner.get(request_id)
             targets = ([replica] if replica is not None
-                       else [r for r in self._replicas if r.healthy])
+                       else [r for r in self._replicas
+                             if r.healthy or r.draining])
         last: tuple[int, dict] = (404, {"error": f"unknown "
                                                  f"request_id "
                                                  f"{request_id}"})
@@ -727,6 +1148,9 @@ class ServingRouter:
             "completed_total": stats["completed"],
             "failed_total": stats["failed"],
             "affinity_routed_total": stats["affinity_routed"],
+            "recoveries_total": stats["recoveries"],
+            "recovered_requests_total": stats["recovered_requests"],
+            "lost_streams_total": stats["lost_streams"],
         })
         prefix = stats.get("prefix_cache")
         if prefix:
@@ -745,6 +1169,8 @@ class ServingRouter:
                     "dispatched_total": snap["dispatched"],
                     "completed_total": snap["completed"],
                     "failed_total": snap["failed"],
+                    "draining": 1 if snap["draining"] else 0,
+                    "unhealthy_total": snap["unhealthy_total"],
                 }, labels={"replica": snap["url"]}))
         # Fleet-wide latency: quantile gauges + the merged histogram
         # in native _bucket exposition (stats() merged the replicas'
@@ -777,6 +1203,14 @@ class ServingRouter:
             "completed": sum(s["completed"] for s in snaps),
             "failed": sum(s["failed"] for s in snaps),
             "affinity_routed": self.affinity_routed,
+            # Mid-stream recovery: attempts begun, streams completed
+            # after >=1 resume (or with a synthesized final), streams
+            # given up on, and the recent-recovery detail the bench's
+            # TTFT-delta report reads.
+            "recoveries": self.recoveries,
+            "recovered_requests": self.recovered_requests,
+            "lost_streams": self.lost_streams,
+            "recovery_log": list(self.recovery_log),
             "completed_requests": sum(
                 s.get("completed_requests", 0)
                 for s in stats.values()),

@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import math
 import queue
+import socket
 import threading
 import time
 import uuid
@@ -63,6 +64,29 @@ class RequestShed(Exception):
     clients/routers treat it as back-pressure, not failure."""
 
 
+class RequestDraining(Exception):
+    """This replica is draining (preempt/evict notice): the request
+    was refused, or its decode was abandoned at the grace deadline.
+    Surfaced as 503 + Retry-After with a "draining" marker so the
+    router fails over (and, mid-stream, resumes on a sibling) instead
+    of treating the replica as failed."""
+
+
+class TooManyRequests(Exception):
+    """Front-door concurrency cap exceeded — 429 back-pressure; the
+    router backs off and retries a sibling."""
+
+
+class CompletedReplay(Exception):
+    """A resume landed for a request this replica already finished:
+    serve the cached result instead of decoding again (exactly-once
+    across a router failover that raced completion)."""
+
+    def __init__(self, result: dict) -> None:
+        super().__init__(result["request_id"])
+        self.result = result
+
+
 class JsonRequestHandler(BaseHTTPRequestHandler):
     """Shared handler base for the serving HTTP surfaces (this front
     end and models/router.py): HTTP/1.1 (required for chunked
@@ -75,11 +99,14 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: N802
         pass
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(body)
 
@@ -136,10 +163,11 @@ class _Pending:
     __slots__ = ("request", "event", "submitted_at", "submitted_wall",
                  "admitted_at", "first_token_at",
                  "finished_at", "tokens", "error", "token_queue",
-                 "cancelled", "shed")
+                 "cancelled", "shed", "draining", "resumed",
+                 "emitted")
 
-    def __init__(self, request: Request,
-                 stream: bool = False) -> None:
+    def __init__(self, request: Request, stream: bool = False,
+                 resumed: Optional[list[int]] = None) -> None:
         self.request = request
         self.event = threading.Event()
         self.submitted_at = time.perf_counter()
@@ -155,6 +183,17 @@ class _Pending:
         self.error: Optional[str] = None
         self.cancelled = False
         self.shed = False
+        # Drain: the replica abandoned/refused this request while
+        # shutting down — the waiter surfaces RequestDraining and the
+        # router resumes elsewhere.
+        self.draining = False
+        # Router recovery: tokens a prior replica already emitted
+        # (the engine re-prefills them; on_token indexes continue
+        # globally from len(resumed)).
+        self.resumed: Optional[list[int]] = resumed
+        # Highest emitted-token count (global index + 1): the
+        # /v1/requests/<id> phase probe's progress source of truth.
+        self.emitted = len(resumed) if resumed else 0
         # Streaming mode: the engine thread feeds (index, token)
         # pairs here as they decode; None terminates the stream.
         self.token_queue: Optional["queue.Queue"] = (
@@ -178,16 +217,39 @@ class ServingFrontEnd:
 
     def __init__(self, engine: ContinuousBatcher,
                  host: str = "127.0.0.1", port: int = 0,
-                 slo_classes: Optional[dict] = None) -> None:
+                 slo_classes: Optional[dict] = None,
+                 max_inflight: Optional[int] = None,
+                 io_timeout_s: Optional[float] = None,
+                 drain_grace_s: float = 30.0) -> None:
         """slo_classes maps class name ->
         {"ttft_ms": float|None, "tpot_ms": float|None}
         (config/settings.ServingSloSettings.class_targets()). A
         request's "slo_class" resolves to those targets at admission;
         explicit "ttft_target_ms"/"tpot_target_ms" in the request
         body override its class. With no classes configured, class
-        names pass through untargeted."""
+        names pass through untargeted.
+
+        Front-door hardening: max_inflight caps accepted-but-
+        unfinished requests (excess gets 429 back-pressure; resumes
+        are exempt — a recovery must not bounce), io_timeout_s sets a
+        per-connection socket read/write deadline so one wedged
+        client cannot pin a handler thread forever, drain_grace_s is
+        the default budget drain() gives in-flight decodes before
+        abandoning them."""
         self.engine = engine
         self.slo_classes = dict(slo_classes or {})
+        self.max_inflight = max_inflight
+        self.drain_grace_s = drain_grace_s
+        # Drain ladder state: _draining flips once (preempt/evict
+        # notice or explicit drain()); handlers refuse new work with
+        # 503+Retry-After, healthz reports draining so the router
+        # stops routing here, and the engine thread lets active
+        # decodes run until _drain_deadline.
+        self._draining = threading.Event()
+        self._drain_deadline: Optional[float] = None
+        self._drain_reason = ""
+        self._drain_engine_done = False
+        self.drain_rejections = 0
         engine.on_token = self._on_token
         engine.on_admit = self._on_admit
         engine.on_shed = self._on_shed
@@ -206,6 +268,12 @@ class ServingFrontEnd:
         # is single-threaded by design; cancel mutates slot state).
         self._cancel_q: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
+        # Live client sockets (handler setup/finish): kill() severs
+        # them all to reproduce the SIGKILL failure shape — streams
+        # end in a reset/bare EOF with no drain marker and no final
+        # line, exactly what the router's recovery path must absorb.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         # Recent-request detail only (bounded): totals and
         # percentiles come from the running counters + histograms
@@ -214,6 +282,13 @@ class ServingFrontEnd:
         import collections
         self._completed: "collections.deque" = collections.deque(
             maxlen=2048)
+        # Finished-result replay cache (bounded), written atomically
+        # with the _inflight pop under _inflight_lock: a resume that
+        # races completion finds the cached result here instead of
+        # being admitted as a fresh (duplicate) decode.
+        self._recent_results: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._recent_results_cap = 2048
         self._total_completed = 0
         self._total_tokens = 0
         # Mergeable fixed-log-bucket latency histograms
@@ -232,6 +307,18 @@ class ServingFrontEnd:
         front = self
 
         class Handler(JsonRequestHandler):
+            def setup(self):
+                super().setup()
+                with front._conns_lock:
+                    front._conns.add(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    with front._conns_lock:
+                        front._conns.discard(self.connection)
+
             def do_DELETE(self):  # noqa: N802
                 request_id = self._delete_request_id()
                 if request_id is None:
@@ -248,20 +335,28 @@ class ServingFrontEnd:
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
-                    self._reply(200, {"ok": True})
+                    # Draining replicas answer 503 so the router's
+                    # status==200 health check pulls them from
+                    # rotation before the kill lands.
+                    if front.draining:
+                        self._reply(503, {"ok": False,
+                                          "draining": True})
+                    else:
+                        self._reply(200, {"ok": True})
                 elif self.path == "/metrics":
                     self._reply_metrics(front.prometheus_metrics())
                 elif self.path == "/v1/stats":
                     self._reply(200, front.stats())
                 elif self.path.startswith("/v1/requests/"):
-                    # Liveness of one request id (the fleet router's
-                    # orphan reconciliation probes this): 200 while
-                    # the run is in flight here, 404 once finished or
-                    # never seen.
+                    # Liveness + progress of one request id (the
+                    # fleet router's orphan reconciliation AND its
+                    # mid-stream recovery probe this — one source of
+                    # truth): 200 while the run is in flight here,
+                    # 404 once finished or never seen.
                     request_id = self.path[len("/v1/requests/"):]
-                    if front.knows(request_id):
-                        self._reply(200, {"request_id": request_id,
-                                          "in_flight": True})
+                    status = front.request_status(request_id)
+                    if status is not None:
+                        self._reply(200, status)
                     else:
                         self._reply(404, {"request_id": request_id,
                                           "in_flight": False})
@@ -289,6 +384,22 @@ class ServingFrontEnd:
                     return
                 try:
                     result = front.generate(spec)
+                except CompletedReplay as exc:
+                    # Resume of an already-finished run: exactly-once
+                    # means replaying the cached result, not decoding
+                    # a duplicate.
+                    self._reply(200, dict(exc.result, cached=True))
+                    return
+                except RequestDraining as exc:
+                    self._reply(503, {"error": str(exc),
+                                      "draining": True},
+                                headers={"Retry-After": "1"})
+                    return
+                except TooManyRequests as exc:
+                    self._reply(429, {"error": str(exc),
+                                      "backpressure": True},
+                                headers={"Retry-After": "1"})
+                    return
                 except RequestCancelled as exc:
                     self._reply(409, {"error": str(exc)})
                     return
@@ -316,8 +427,23 @@ class ServingFrontEnd:
                 {"error": ...} NDJSON line + clean terminating chunk
                 (a second HTTP response inside the open stream would
                 corrupt the framing)."""
+                stream = None
                 try:
                     request_id, stream = front.generate_stream(spec)
+                except CompletedReplay as exc:
+                    # Replay the cached run as a stream: the router's
+                    # index dedupe drops what the client already saw.
+                    result, request_id = exc.result, None
+                except RequestDraining as exc:
+                    self._reply(503, {"error": str(exc),
+                                      "draining": True},
+                                headers={"Retry-After": "1"})
+                    return
+                except TooManyRequests as exc:
+                    self._reply(429, {"error": str(exc),
+                                      "backpressure": True},
+                                headers={"Retry-After": "1"})
+                    return
                 except ValueError as exc:
                     self._reply(400, {"error": str(exc)})
                     return
@@ -337,7 +463,8 @@ class ServingFrontEnd:
                     # the front-end registration explicitly (the
                     # engine-side guard still protects the id until
                     # decode completes).
-                    front.abandon(request_id)
+                    if request_id is not None:
+                        front.abandon(request_id)
                     return
 
                 def _chunk(obj: dict) -> None:
@@ -347,12 +474,35 @@ class ServingFrontEnd:
                         b"\r\n")
                     self.wfile.flush()
 
+                if stream is None:
+                    # CompletedReplay: token lines then the cached
+                    # final result, same framing as a live stream.
+                    try:
+                        for i, token in enumerate(result["tokens"]):
+                            _chunk({"token": token, "index": i})
+                        _chunk(dict(result, cached=True))
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    return
                 try:
                     try:
                         for event in stream:
                             _chunk(event)
+                    except (BrokenPipeError, ConnectionResetError):
+                        # Client went away mid-relay: not a stream
+                        # failure — the outer handler ignores it and
+                        # the engine finishes the run on its own.
+                        raise
+                    except RequestDraining as exc:
+                        # Mid-stream drain-abandon: the marker tells
+                        # the router to resume on a sibling rather
+                        # than surface a failure.
+                        _chunk({"error": str(exc), "draining": True})
+                    except RequestShed as exc:
+                        _chunk({"error": str(exc), "shed": True})
                     except (ValueError, TimeoutError,
-                            RequestCancelled, RequestShed) as exc:
+                            RequestCancelled) as exc:
                         _chunk({"error": str(exc)})
                     except Exception as exc:  # defensive
                         logger.exception("stream failed")
@@ -363,6 +513,11 @@ class ServingFrontEnd:
                 finally:
                     stream.close()  # run the iterator's cleanup NOW
 
+        if io_timeout_s is not None:
+            # socketserver applies Handler.timeout as the connection
+            # socket timeout (settimeout) — per-request read/write
+            # deadlines so a wedged client can't pin a thread.
+            Handler.timeout = io_timeout_s
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, name="serving-http",
@@ -390,6 +545,83 @@ class ServingFrontEnd:
         self._httpd.server_close()
         self._engine_thread.join(timeout=10.0)
 
+    def kill(self) -> None:
+        """The SIGKILL failure shape (chaos drills): stop the engine,
+        close the listening socket, AND sever every live client
+        connection mid-write — no drain ladder, no draining markers,
+        no final stream lines. Downstream (the fleet router) sees a
+        reset or a bare EOF without a final line, which is exactly
+        the signal its mid-stream recovery keys on."""
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._engine_thread.join(timeout=10.0)
+
+    # ------------------------------ draining ---------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, grace_s: Optional[float] = None,
+              reason: str = "drain requested") -> None:
+        """Flip this replica into the drain ladder: healthz turns
+        503/draining (the router stops routing here), new admissions
+        get 503+Retry-After, the engine stops seating queued work,
+        and in-flight decodes get ``grace_s`` seconds to finish
+        before they are abandoned with a draining marker (the router
+        resumes them on a sibling). Idempotent."""
+        if self._draining.is_set():
+            return
+        grace = self.drain_grace_s if grace_s is None else grace_s
+        self._drain_deadline = time.perf_counter() + max(0.0, grace)
+        self._drain_reason = reason
+        self._draining.set()
+        logger.info("serving front end draining (%s): grace %.1fs",
+                    reason, grace)
+
+    def arm_preempt_drain(self, path: Optional[str] = None,
+                          grace_s: Optional[float] = None,
+                          poll_interval: float = 0.2) -> bool:
+        """Watch the node agent's preempt/evict notice file
+        (agent/preemption.py: $SHIPYARD_PREEMPT_REQUEST_FILE) and
+        drain when it lands — the serving analog of the training
+        checkpoint-on-notice path. Returns False (unarmed) when no
+        notice channel is configured."""
+        from batch_shipyard_tpu.agent.preemption import PreemptWatcher
+        watcher = PreemptWatcher(path)
+        if not watcher.armed:
+            return False
+
+        def _watch() -> None:
+            while not self._stop.is_set():
+                notice = watcher.poll()
+                if notice:
+                    self.drain(
+                        grace_s,
+                        reason="preempt notice: "
+                        f"{notice.get('reason') or 'unspecified'}")
+                    return
+                time.sleep(poll_interval)
+
+        threading.Thread(target=_watch, name="serving-drain-watch",
+                         daemon=True).start()
+        return True
+
     # ------------------------------ serving ----------------------------
 
     def _make_pending(self, spec: dict,
@@ -398,6 +630,12 @@ class ServingFrontEnd:
         if not isinstance(prompt, list) or not all(
                 isinstance(t, int) for t in prompt):
             raise ValueError("prompt must be a list of token ids")
+        resume = spec.get("resume_tokens")
+        if resume is not None and (
+                not isinstance(resume, list) or not all(
+                    isinstance(t, int) for t in resume)):
+            raise ValueError(
+                "resume_tokens must be a list of token ids")
         request_id = str(spec.get("request_id") or uuid.uuid4().hex[:12])
         try:
             max_new_tokens = int(spec.get("max_new_tokens", 16))
@@ -431,12 +669,42 @@ class ServingFrontEnd:
             ttft_target_ms=_target("ttft_target_ms"),
             tpot_target_ms=_target("tpot_target_ms"),
             slo_class=slo_class)
-        pending = _Pending(request, stream=stream)
+        pending = _Pending(request, stream=stream, resumed=resume)
         with self._inflight_lock:
+            if resume is not None and \
+                    request_id in self._recent_results:
+                # The prior replica's run actually finished here (the
+                # failover raced completion): replay, don't re-decode.
+                raise CompletedReplay(
+                    self._recent_results[request_id])
             if (request_id in self._inflight or
                     request_id in self._engine_active):
                 raise ValueError(f"request_id {request_id} in flight")
+            if self._draining.is_set():
+                self.drain_rejections += 1
+                raise RequestDraining(
+                    f"request {request_id} refused: replica draining"
+                    f" ({self._drain_reason})")
+            if (self.max_inflight is not None and resume is None and
+                    len(self._inflight) >= self.max_inflight):
+                raise TooManyRequests(
+                    f"request {request_id} refused: "
+                    f"{len(self._inflight)} in flight >= cap "
+                    f"{self.max_inflight}")
             self._inflight[request_id] = pending
+        if resume and (
+                len(resume) >= request.max_new_tokens or
+                (request.eos_id is not None and
+                 resume[-1] == request.eos_id)):
+            # The resumed progress already satisfies the request:
+            # complete without touching the engine (callers skip
+            # submission when the event is pre-set).
+            pending.tokens = list(resume)
+            pending.finished_at = time.perf_counter()
+            pending.first_token_at = pending.finished_at
+            if pending.token_queue is not None:
+                pending.token_queue.put(None)
+            pending.event.set()
         return pending
 
     def _result(self, pending: _Pending) -> dict:
@@ -481,6 +749,15 @@ class ServingFrontEnd:
             self._ttft_hist.observe(result["ttft_ms"])
             self._tpot_hist.observe(result["tpot_ms"])
             seq = self._total_completed
+        # Retire the registration and publish the replay-cache entry
+        # under ONE lock hold: a resume landing between "popped from
+        # _inflight" and "result visible" would otherwise be admitted
+        # as a duplicate decode.
+        with self._inflight_lock:
+            self._recent_results[request_id] = result
+            while len(self._recent_results) > self._recent_results_cap:
+                self._recent_results.popitem(last=False)
+            self._inflight.pop(request_id, None)
         self._record_request_spans(pending, result, seq)
         return result
 
@@ -552,7 +829,8 @@ class ServingFrontEnd:
         Validation happens HERE (before any bytes hit the wire) — the
         returned iterator only pulls tokens."""
         pending = self._make_pending(spec, stream=True)
-        self._submit_q.put(pending)
+        if not pending.event.is_set():  # pre-satisfied resumes skip
+            self._submit_q.put(pending)
         return (pending.request.request_id,
                 self._stream_tokens(pending, timeout))
 
@@ -578,9 +856,13 @@ class ServingFrontEnd:
                 index, token = item
                 yield {"token": token, "index": index}
             self._wait_complete(pending, timeout)
-        finally:
+        except BaseException:
+            # Error/cancel/close path retires the registration here;
+            # the success path retires it inside _result, atomically
+            # with the replay-cache publish (racing-resume guard).
             with self._inflight_lock:
                 self._inflight.pop(request_id, None)
+            raise
         yield self._result(pending)
 
     def _wait_complete(self, pending: _Pending,
@@ -591,6 +873,8 @@ class ServingFrontEnd:
             raise TimeoutError(
                 f"request {pending.request.request_id} timed out "
                 f"after {timeout}s")
+        if pending.draining:
+            raise RequestDraining(pending.error)
         if pending.cancelled:
             raise RequestCancelled(pending.error)
         if pending.shed:
@@ -611,6 +895,8 @@ class ServingFrontEnd:
             "uptime_seconds": stats["uptime_seconds"],
             "inflight": stats["inflight"],
             "engine_backlog": stats["engine_backlog"],
+            "draining": 1.0 if stats["draining"] else 0.0,
+            "drain_rejections_total": stats["drain_rejections"],
         })
         for metric in ("ttft_ms", "tpot_ms"):
             for pct, value in stats[metric].items():
@@ -667,6 +953,32 @@ class ServingFrontEnd:
             return (request_id in self._inflight or
                     request_id in self._engine_active)
 
+    def request_status(self, request_id: str) -> Optional[dict]:
+        """Progress of one in-flight request — the shared source of
+        truth for the router's resubmit probe and its mid-stream
+        recovery: phase (queued/prefill/decode/draining) and the
+        emitted-token count. None once finished or never seen (the
+        404 the router's orphan reconciliation keys on)."""
+        with self._inflight_lock:
+            pending = self._inflight.get(request_id)
+            if pending is None and request_id in self._engine_active:
+                # Abandoned stream still decoding: the engine-side
+                # run holds the progress.
+                pending = self._active_runs.get(request_id)
+        if pending is None:
+            return None
+        if self._draining.is_set():
+            phase = "draining"
+        elif pending.admitted_at is None:
+            phase = "queued"
+        elif pending.emitted <= len(pending.resumed or []):
+            phase = "prefill"
+        else:
+            phase = "decode"
+        return {"request_id": request_id, "in_flight": True,
+                "phase": phase,
+                "emitted_tokens": int(pending.emitted)}
+
     def cancel(self, request_id: str) -> None:
         """Request an abort; the engine thread performs it and the
         waiting client completes with a 'cancelled' error."""
@@ -676,12 +988,14 @@ class ServingFrontEnd:
         """Blocking generate: enqueue to the engine thread, wait for
         completion, return tokens + latency breakdown."""
         pending = self._make_pending(spec)
-        self._submit_q.put(pending)
+        if not pending.event.is_set():  # pre-satisfied resumes skip
+            self._submit_q.put(pending)
         try:
             self._wait_complete(pending, timeout)
-        finally:
+        except BaseException:
             with self._inflight_lock:
                 self._inflight.pop(pending.request.request_id, None)
+            raise
         return self._result(pending)
 
     def stats(self) -> dict:
@@ -715,6 +1029,11 @@ class ServingFrontEnd:
             # and the engine's queued+active total.
             "inflight": inflight,
             "engine_backlog": self.engine.pending(),
+            # Drain ladder visibility: the router's probe reads
+            # "draining" to distinguish cooperative shutdown from
+            # failure.
+            "draining": self._draining.is_set(),
+            "drain_rejections": self.drain_rejections,
         }
         # Speculative-decode counters when the engine runs a draft
         # model (the measured acceptance rate is the tuning signal
@@ -788,8 +1107,12 @@ class ServingFrontEnd:
         pending = self._active_runs.get(request_id)
         if pending is None:
             return
-        if index == 0 and pending.first_token_at is None:
+        if pending.first_token_at is None:
+            # First token THIS replica produced — for a resumed run
+            # that is the re-prefill completion (index > 0), still
+            # the TTFT that matters here.
             pending.first_token_at = time.perf_counter()
+        pending.emitted = max(pending.emitted, index + 1)
         if pending.token_queue is not None:
             pending.token_queue.put((index, token))
 
@@ -813,6 +1136,8 @@ class ServingFrontEnd:
                     self._cancel(self._cancel_q.get_nowait())
                 except queue.Empty:
                     break
+            if self._draining.is_set():
+                self._drain_tick()
             if not self.engine.pending():
                 continue
             try:
@@ -833,7 +1158,38 @@ class ServingFrontEnd:
                     pending.token_queue.put(None)  # end of stream
                 pending.event.set()
 
-    def _cancel(self, request_id: str) -> None:
+    def _drain_tick(self) -> None:
+        # Engine-thread side of the drain ladder: evict the queue
+        # once (those waiters fail over immediately — they hold no
+        # pages and no progress), then let active decodes run until
+        # the grace deadline, after which they are abandoned with a
+        # draining marker the router resumes from.
+        if not self._drain_engine_done:
+            for request_id in self.engine.drain():
+                self._complete_draining(
+                    request_id, "queued work evicted at drain")
+            self._drain_engine_done = True
+            return
+        if self._drain_deadline is not None and \
+                time.perf_counter() >= self._drain_deadline:
+            for request_id in self.engine.active_request_ids():
+                self._cancel(request_id, draining=True)
+
+    def _complete_draining(self, request_id: str, why: str) -> None:
+        pending = self._active_runs.pop(request_id, None)
+        with self._inflight_lock:
+            self._engine_active.discard(request_id)
+        if pending is None:
+            return
+        pending.error = f"request {request_id} draining: {why}"
+        pending.draining = True
+        pending.finished_at = time.perf_counter()
+        if pending.token_queue is not None:
+            pending.token_queue.put(None)
+        pending.event.set()
+
+    def _cancel(self, request_id: str,
+                draining: bool = False) -> None:
         if not self.engine.cancel(request_id):
             return  # unknown/already finished
         pending = self._active_runs.pop(request_id, None)
@@ -841,16 +1197,35 @@ class ServingFrontEnd:
             self._engine_active.discard(request_id)
         if pending is None:
             return
-        pending.error = f"request {request_id} cancelled"
-        pending.cancelled = True
+        if draining:
+            pending.error = (f"request {request_id} draining: grace "
+                             f"deadline, decode abandoned")
+            pending.draining = True
+        else:
+            pending.error = f"request {request_id} cancelled"
+            pending.cancelled = True
         pending.finished_at = time.perf_counter()
         if pending.token_queue is not None:
             pending.token_queue.put(None)
         pending.event.set()
 
     def _submit(self, pending: _Pending) -> None:
+        if self._draining.is_set() or self.engine.draining:
+            # Drain ladder: requests already queued toward the engine
+            # when the notice landed must not be admitted — complete
+            # their waiters as draining so the router fails over.
+            request_id = pending.request.request_id
+            pending.error = (f"request {request_id} draining: not "
+                             f"admitted, replica shutting down")
+            pending.draining = True
+            pending.finished_at = time.perf_counter()
+            if pending.token_queue is not None:
+                pending.token_queue.put(None)
+            pending.event.set()
+            return
         try:
-            self.engine.submit(pending.request)
+            self.engine.submit(pending.request,
+                               resumed=pending.resumed)
         except ValueError as exc:
             pending.error = str(exc)
             pending.finished_at = time.perf_counter()

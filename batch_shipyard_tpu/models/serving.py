@@ -631,6 +631,10 @@ class ContinuousBatcher:
         self.sheds_by_class: dict[str, int] = {}
         self.slo_deferrals = 0
         self.on_shed: Optional[Callable[[str, str], None]] = None
+        # Drain mode (serving fault tolerance): once set, _admit
+        # refuses to seat new work — active decodes run to completion
+        # while the queue is handed back to the caller for failover.
+        self.draining = False
         self._prefill_ms_per_token: Optional[float] = None
         self._step_ms: Optional[float] = None
         self._timed_buckets: set = set()
@@ -854,13 +858,29 @@ class ContinuousBatcher:
                     count += 1
         return count
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request,
+               resumed: Optional[list[int]] = None) -> None:
+        """Enqueue a request. ``resumed`` carries tokens already
+        emitted by a prior (killed or drained) replica: the entry
+        re-prefills prompt+resumed in one pass and decoding continues
+        from there, so a greedy stream is byte-identical to an
+        uninterrupted run. Refused while draining — the caller must
+        fail over to a sibling."""
+        if self.draining:
+            raise ValueError(
+                f"{request.request_id}: engine is draining")
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"{request.request_id}: max_new_tokens must be >= 1")
         if not request.prompt:
             raise ValueError(
                 f"{request.request_id}: prompt must be non-empty")
+        resumed = [int(t) for t in (resumed or [])]
+        if len(resumed) >= request.max_new_tokens:
+            raise ValueError(
+                f"{request.request_id}: resumed tokens "
+                f"{len(resumed)} >= max_new_tokens "
+                f"{request.max_new_tokens} — nothing left to decode")
         if self.paged:
             worst = -(-(len(request.prompt) + request.max_new_tokens)
                       // self.page_size)
@@ -875,12 +895,31 @@ class ContinuousBatcher:
                 f"{request.request_id}: prompt+generation "
                 f"{len(request.prompt)}+{request.max_new_tokens} "
                 f"exceeds max_decode_len {self.max_decode_len}")
-        self._enqueue(_QueueEntry(request,
+        self._enqueue(_QueueEntry(request, resumed=resumed,
                                   submitted_at=time.monotonic()))
 
     def pending(self) -> int:
         return len(self._queue) + sum(
             1 for s in self._slots if s.request is not None)
+
+    def drain(self) -> list[str]:
+        """Flip the engine into drain mode: _admit stops seating new
+        work, queued entries (which hold no pages) are evicted and
+        their ids returned so the front end can 503 their waiters for
+        router failover, and active decodes keep stepping until they
+        finish (or the front end's grace deadline cancels them).
+        Idempotent; must be called from the engine's stepping
+        thread — it mutates the queue like _admit does."""
+        self.draining = True
+        evicted = [e.request.request_id for e in self._queue]
+        self._queue.clear()
+        return evicted
+
+    def active_request_ids(self) -> list[str]:
+        """Ids currently decoding in a slot (in-flight work a drain
+        lets run to completion)."""
+        return [s.request.request_id for s in self._slots
+                if s.request is not None]
 
     def cancel(self, request_id: str) -> bool:
         """Abort a queued or actively-decoding request (the vLLM-class
@@ -1251,7 +1290,10 @@ class ContinuousBatcher:
         (resumed) entries are exempt: their first token already
         shipped, so their TTFT is history and their partial work
         would be wasted."""
-        if self.slo_shed_grace_ms is None:
+        if self.slo_shed_grace_ms is None or self.draining:
+            # Draining owns the queue: drain() already evicted it for
+            # failover, and anything a draining replica can still
+            # finish must not be shed out from under the router.
             return
         while True:
             worst_k, worst_over = None, 0.0
@@ -1402,6 +1444,11 @@ class ContinuousBatcher:
             self._step_ms = 0.7 * self._step_ms + 0.3 * dt_ms
 
     def _admit(self) -> None:
+        if self.draining:
+            # Drain ladder: no new admissions once the preempt/evict
+            # notice lands — active slots finish, the queue was
+            # already evicted by drain().
+            return
         now = time.monotonic()
         self._shed_expired(now)
         for i, slot in enumerate(self._slots):

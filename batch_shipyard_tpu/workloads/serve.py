@@ -229,6 +229,19 @@ def main() -> int:
                         "worst-case acceptance demo)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8900)
+    # Front-door hardening + drain (37-serving-resilience.md).
+    parser.add_argument("--max-inflight", type=int, default=None,
+                        help="Cap accepted-but-unfinished requests "
+                        "per replica; excess gets 429 back-pressure "
+                        "(resumes are exempt)")
+    parser.add_argument("--io-timeout-s", type=float, default=None,
+                        help="Per-connection socket read/write "
+                        "deadline (a wedged client cannot pin a "
+                        "handler thread)")
+    parser.add_argument("--drain-grace-s", type=float, default=30.0,
+                        help="On a preempt/evict notice, let "
+                        "in-flight decodes finish for this long "
+                        "before abandoning them to sibling resume")
     # Benchmark mode
     parser.add_argument("--loadgen", type=int, default=0,
                         help="Run N benchmark requests then exit")
@@ -291,7 +304,11 @@ def main() -> int:
         for e in engines:
             warm_engine(args, e)
         fronts = [ServingFrontEnd(e, port=0,
-                                  slo_classes=slo_classes).start()
+                                  slo_classes=slo_classes,
+                                  max_inflight=args.max_inflight,
+                                  io_timeout_s=args.io_timeout_s,
+                                  drain_grace_s=args.drain_grace_s
+                                  ).start()
                   for e in engines]
         router = ServingRouter([f.url for f in fronts],
                                host=args.host,
@@ -304,9 +321,18 @@ def main() -> int:
         warm_engine(args, engine)
         fronts = [ServingFrontEnd(engine, host=args.host,
                                   port=args.port,
-                                  slo_classes=slo_classes).start()]
+                                  slo_classes=slo_classes,
+                                  max_inflight=args.max_inflight,
+                                  io_timeout_s=args.io_timeout_s,
+                                  drain_grace_s=args.drain_grace_s
+                                  ).start()]
         url = fronts[0].url
         print(f"serving on {url}", flush=True)
+    # A preempt/evict notice (agent/preemption.py) drains every
+    # replica: no new admissions, in-flight decodes finish within
+    # the grace, the router resumes the rest on siblings.
+    for front in fronts:
+        front.arm_preempt_drain(grace_s=args.drain_grace_s)
 
     def _shutdown():
         if router is not None:

@@ -833,6 +833,48 @@ def test_serving_page_refcount_blessed_shapes_pass():
     assert not active and len(suppressed) == 1
 
 
+def test_serving_drain_no_admit_fires_on_unchecked_admission():
+    """Both admission shapes — firing the on_admit hook and
+    submitting into the engine — fire when the enclosing function
+    never consults the draining flag."""
+    firing = {"batch_shipyard_tpu/models/mod.py": (
+        "class Front:\n"
+        "    def fast_path(self, req):\n"
+        "        self.engine.submit(req)\n"
+        "    def seat(self, req):\n"
+        "        self.on_admit(req.request_id)\n")}
+    found = _rules_of(firing, "serving-drain-no-admit")
+    assert len(found) == 2, [f.render() for f in found]
+    assert "draining" in found[0].message
+
+
+def test_serving_drain_no_admit_blessed_shapes_pass():
+    """An admission path that checks the draining flag (attribute or
+    bare name, anywhere in the function body) stays silent; inline
+    suppression works; non-admitting engine calls never fire."""
+    blessed = {"batch_shipyard_tpu/models/mod.py": (
+        "class Front:\n"
+        "    def submit(self, req):\n"
+        "        if self.draining:\n"
+        "            raise RuntimeError('draining')\n"
+        "        self.engine.submit(req)\n"
+        "    def seat(self, req, draining):\n"
+        "        if draining:\n"
+        "            return\n"
+        "        self.on_admit(req.request_id)\n"
+        "    def stats(self):\n"
+        "        return self.engine.stats()\n")}
+    assert not _rules_of(blessed, "serving-drain-no-admit")
+    suppressed_src = {"batch_shipyard_tpu/models/mod.py": (
+        "class Front:\n"
+        "    def fast_path(self, req):\n"
+        "        self.engine.submit(req)  "
+        "# shipyard-lint: disable=serving-drain-no-admit\n")}
+    active, suppressed = _run(suppressed_src,
+                              "serving-drain-no-admit")
+    assert not active and len(suppressed) == 1
+
+
 # ------------------------------ the gate -------------------------------
 
 def test_repo_is_lint_clean():

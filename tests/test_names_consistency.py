@@ -341,9 +341,10 @@ def test_chaos_kinds_all_expressible_in_the_simulator():
     """ISSUE 17: every chaos injection kind maps to a simulator
     adapter (sim/scenarios.py KIND_ADAPTERS) or is explicitly listed
     in SIM_EXCLUDED_KINDS — a kind in neither set is a chaos mode
-    the fleet simulator silently cannot model. Today the exclusion
-    set is empty: the full inventory is expressible as scenario
-    schedules."""
+    the fleet simulator silently cannot model. The exclusion set
+    holds exactly the serving kinds (replica/router), which target a
+    serving fleet rather than a batch pool and are drilled live
+    (chaos/serving_drill.py) instead."""
     from batch_shipyard_tpu.chaos.plan import INJECTION_KINDS
     from batch_shipyard_tpu.sim import scenarios as sim_scenarios
     unmapped = set(INJECTION_KINDS) - set(

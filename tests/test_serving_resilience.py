@@ -1,0 +1,405 @@
+"""Serving-tier fault tolerance (37-serving-resilience.md): the
+drain ladder on the front end, mid-stream resume with the
+exactly-once token contract, front-door hardening (429 cap, resume
+exemption), the router prober's failure threshold + backoff, the
+/v1/requests progress probe, shed-vs-drain interplay, and the three
+seeded serving chaos drills end to end."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from batch_shipyard_tpu.chaos.serving_drill import _throttle
+from batch_shipyard_tpu.models import serving
+from batch_shipyard_tpu.models import transformer as tfm
+from batch_shipyard_tpu.models.server import ServingFrontEnd
+
+CFG = tfm.TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32,
+    param_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = tfm.TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(7),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _front(params, step_delay=0.0, **kwargs):
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                       max_decode_len=64)
+    if step_delay:
+        _throttle(engine, step_delay)
+    return ServingFrontEnd(engine, port=0, **kwargs).start()
+
+
+def _post_raw(url, payload, path="/v1/generate"):
+    """POST without raising: (status, body-json, headers)."""
+    req = urllib.request.Request(
+        f"{url}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read()), dict(exc.headers)
+
+
+def _get_raw(url, path):
+    try:
+        with urllib.request.urlopen(f"{url}{path}",
+                                    timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+class _Stream(threading.Thread):
+    """Background NDJSON streaming client: token lines, then the
+    final object (a result, or an error marker)."""
+
+    def __init__(self, url, spec):
+        super().__init__(daemon=True)
+        self.spec = dict(spec, stream=True)
+        self.url = url
+        self.tokens = []
+        self.indexes = []
+        self.final = None
+        self.start()
+
+    def run(self):
+        req = urllib.request.Request(
+            f"{self.url}/v1/generate",
+            data=json.dumps(self.spec).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for line in resp:
+                event = json.loads(line)
+                if "index" in event:
+                    self.tokens.append(event["token"])
+                    self.indexes.append(event["index"])
+                else:
+                    self.final = event
+
+    def await_tokens(self, n, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while len(self.tokens) < n:
+            assert time.monotonic() < deadline, \
+                f"no {n} tokens within {timeout}s"
+            time.sleep(0.02)
+
+
+# ---------------------------- drain ladder -----------------------------
+
+def test_drain_refuses_admissions_and_healthz_reports(params):
+    front = _front(params)
+    try:
+        front.drain(grace_s=5.0, reason="test")
+        assert front.draining
+        status, body, headers = _post_raw(
+            front.url, {"prompt": [1, 2], "max_new_tokens": 2})
+        assert status == 503 and body.get("draining") is True
+        assert "Retry-After" in headers
+        status, body = _get_raw(front.url, "/healthz")
+        assert status == 503 and body.get("draining") is True
+        assert front.drain_rejections >= 1
+        # Idempotent: a second notice must not reset the deadline.
+        deadline = front._drain_deadline
+        front.drain(grace_s=99.0, reason="again")
+        assert front._drain_deadline == deadline
+    finally:
+        front.shutdown()
+
+
+def test_drain_abandons_actives_and_evicts_queued(params):
+    front = _front(params, step_delay=0.05, drain_grace_s=0.2)
+    try:
+        # Two actives occupy both slots; the third waits in line.
+        actives = [_Stream(front.url,
+                           {"request_id": f"drain-a{i}",
+                            "prompt": [3 + i, 7], "max_new_tokens": 50})
+                   for i in range(2)]
+        for s in actives:
+            s.await_tokens(2)
+        queued = _Stream(front.url, {"request_id": "drain-q",
+                                     "prompt": [9, 4],
+                                     "max_new_tokens": 50})
+        deadline = time.monotonic() + 30
+        while True:
+            status, body = _get_raw(front.url,
+                                    "/v1/requests/drain-q")
+            if status == 200 and body["phase"] == "queued":
+                break
+            assert time.monotonic() < deadline, \
+                "third request never reached the wait line"
+            time.sleep(0.02)
+        front.drain(reason="test")
+        for s in actives + [queued]:
+            s.join(timeout=30)
+            assert not s.is_alive()
+        # 50 tokens x 50ms/step cannot finish inside the 0.2s grace:
+        # actives were abandoned mid-decode with the draining marker
+        # (the router's signal to resume on a sibling).
+        for s in actives:
+            assert s.final is not None
+            assert s.final.get("draining") is True
+            assert 0 < len(s.tokens) < 50
+        # The queued request never decoded: evicted immediately.
+        assert queued.final is not None
+        assert queued.final.get("draining") is True
+        assert queued.tokens == []
+    finally:
+        front.shutdown()
+
+
+def test_arm_preempt_drain_fires_on_notice(params, tmp_path):
+    from batch_shipyard_tpu.agent import preemption
+    notice = str(tmp_path / "preempt.json")
+    front = _front(params)
+    try:
+        assert front.arm_preempt_drain(path=notice, grace_s=1.0,
+                                       poll_interval=0.02)
+        assert not front.draining
+        preemption.write_request(notice, reason="test notice")
+        deadline = time.monotonic() + 10
+        while not front.draining:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert "test notice" in front._drain_reason
+    finally:
+        front.shutdown()
+
+
+# ----------------- mid-stream resume / exactly-once --------------------
+
+def test_resume_reprefill_is_byte_identical(params):
+    prompt, n = [5, 17, 31, 2], 8
+    front = _front(params)
+    try:
+        _status, ref, _ = _post_raw(
+            front.url, {"prompt": prompt, "max_new_tokens": n})
+    finally:
+        front.shutdown()
+    # A sibling re-prefills prompt + the tokens the dead replica
+    # already emitted; global indexes continue where they left off
+    # and the assembled stream equals the unbroken reference.
+    sibling = _front(params)
+    try:
+        resume = ref["tokens"][:3]
+        client = _Stream(sibling.url,
+                         {"request_id": ref["request_id"],
+                          "prompt": prompt, "max_new_tokens": n,
+                          "resume_tokens": resume})
+        client.join(timeout=60)
+        assert client.final is not None
+        assert "error" not in client.final
+        assert client.indexes == list(range(3, n))
+        assert resume + client.tokens == ref["tokens"]
+        assert client.final["tokens"] == ref["tokens"]
+    finally:
+        sibling.shutdown()
+
+
+def test_resume_rejected_while_in_flight_then_replays(params):
+    """The racing-resume regression: a resume for an id still
+    decoding must be refused (400, not a second decode), and a
+    resume after completion must replay the cached result without
+    touching the engine — exactly one decode ever happens."""
+    front = _front(params, step_delay=0.05)
+    try:
+        spec = {"request_id": "race-1", "prompt": [8, 3],
+                "max_new_tokens": 20}
+        live = _Stream(front.url, spec)
+        live.await_tokens(2)
+        status, body, _ = _post_raw(
+            front.url, dict(spec, resume_tokens=live.tokens[:1]))
+        assert status == 400 and "in flight" in body["error"]
+        live.join(timeout=60)
+        assert live.final is not None and "error" not in live.final
+        assert front.stats()["completed_requests"] == 1
+        # Completed: two racing resumes both replay the SAME cached
+        # tokens (the _recent_results lookup wins before the
+        # in-flight admission under one lock), decode count frozen.
+        results = []
+
+        def _resume():
+            results.append(_post_raw(
+                front.url, dict(spec,
+                                resume_tokens=live.final["tokens"][:4])))
+
+        racers = [threading.Thread(target=_resume) for _ in range(2)]
+        for t in racers:
+            t.start()
+        for t in racers:
+            t.join(timeout=60)
+        assert len(results) == 2
+        for status, body, _ in results:
+            assert status == 200
+            assert body["tokens"] == live.final["tokens"]
+        assert front.stats()["completed_requests"] == 1
+        # A fresh id: two racing resume admissions — exactly one
+        # wins the in-flight slot and decodes; the loser is refused,
+        # never a second concurrent decode of the same stream.
+        fresh = {"request_id": "race-2", "prompt": [4, 12],
+                 "max_new_tokens": 24, "resume_tokens": [19, 3]}
+        results.clear()
+        racers = [threading.Thread(
+            target=lambda: results.append(
+                _post_raw(front.url, fresh))) for _ in range(2)]
+        for t in racers:
+            t.start()
+        for t in racers:
+            t.join(timeout=60)
+        codes = sorted(r[0] for r in results)
+        assert codes == [200, 400], codes
+        loser = next(r for r in results if r[0] == 400)
+        assert "in flight" in loser[1]["error"]
+        assert front.stats()["completed_requests"] == 2
+    finally:
+        front.shutdown()
+
+
+# ------------------------- shed-vs-drain interplay ---------------------
+
+def test_shed_suspended_while_draining_and_resumed_exempt(params):
+    engine = serving.ContinuousBatcher(CFG, params, num_slots=2,
+                                       max_decode_len=64,
+                                       slo_shed_grace_ms=1.0)
+    shed_ids = []
+    engine.on_shed = lambda rid, why: shed_ids.append(rid)
+    expired = serving.Request("shed-me", [1, 2], 8,
+                              ttft_target_ms=0.01)
+    resumed = serving.Request("resumed", [1, 2], 8,
+                              ttft_target_ms=0.01)
+    engine.submit(expired)
+    engine.submit(resumed, resumed=[5])
+    far_future = time.monotonic() + 60.0
+    # Draining owns the queue: nothing is shed out from under the
+    # router's failover, however blown the deadlines are.
+    engine.draining = True
+    engine._shed_expired(far_future)
+    assert engine.slo_sheds == 0 and not shed_ids
+    # Not draining: the expired fresh request sheds, but the resumed
+    # entry is exempt (its first token already shipped — shedding it
+    # would discard delivered work).
+    engine.draining = False
+    engine._shed_expired(far_future)
+    assert shed_ids == ["shed-me"]
+    assert [e.request.request_id for e in engine._queue] == ["resumed"]
+
+
+# ------------------------- front-door hardening ------------------------
+
+def test_max_inflight_429_and_resume_exempt(params):
+    front = _front(params, step_delay=0.05, max_inflight=1)
+    try:
+        live = _Stream(front.url, {"request_id": "cap-live",
+                                   "prompt": [2, 9],
+                                   "max_new_tokens": 30})
+        live.await_tokens(1)
+        status, body, _ = _post_raw(
+            front.url, {"request_id": "cap-extra", "prompt": [4],
+                        "max_new_tokens": 2})
+        assert status == 429 and "cap" in body["error"]
+        # A recovery resume must not bounce off the cap it is
+        # trying to drain.
+        status, body, _ = _post_raw(
+            front.url, {"request_id": "cap-resume", "prompt": [6, 1],
+                        "max_new_tokens": 4, "resume_tokens": [11]})
+        assert status == 200 and len(body["tokens"]) == 4
+        live.join(timeout=60)
+    finally:
+        front.shutdown()
+
+
+def test_request_status_reports_phase_and_progress(params):
+    front = _front(params, step_delay=0.05)
+    try:
+        live = _Stream(front.url, {"request_id": "probe-1",
+                                   "prompt": [7, 2],
+                                   "max_new_tokens": 20})
+        live.await_tokens(2)
+        status, body = _get_raw(front.url, "/v1/requests/probe-1")
+        assert status == 200
+        assert body["phase"] == "decode"
+        assert body["emitted_tokens"] >= 2
+        live.join(timeout=60)
+        status, _body = _get_raw(front.url, "/v1/requests/probe-1")
+        assert status == 404
+    finally:
+        front.shutdown()
+
+
+# ------------------------- router prober backoff -----------------------
+
+def test_prober_failure_threshold_backoff_and_metric(params):
+    from batch_shipyard_tpu.models.router import ServingRouter
+    fronts = [_front(params) for _ in range(2)]
+    router = None
+    try:
+        router = ServingRouter([f.url for f in fronts],
+                               health_interval=0.05,
+                               probe_timeout=1.0,
+                               probe_failure_threshold=2).start()
+        victim = fronts[1]
+        victim.kill()
+        replica = router._replicas[1]
+        deadline = time.monotonic() + 20
+        while replica.consecutive_failures <= 2:
+            assert time.monotonic() < deadline, \
+                "prober never crossed the failure threshold"
+            router._probe(replica)
+            time.sleep(0.01)
+        assert not replica.healthy
+        # healthy->unhealthy is counted ONCE per transition, not per
+        # failed probe.
+        assert replica.unhealthy_total == 1
+        # Past the threshold the re-probe cadence backs off
+        # exponentially (capped); a healthy replica keeps the base
+        # cadence.
+        assert router._probe_delay(replica) > 0.05
+        assert router._probe_delay(router._replicas[0]) == 0.05
+        metrics = urllib.request.urlopen(
+            f"{router.url}/metrics", timeout=10).read().decode()
+        assert "shipyard_router_replica_unhealthy_total" in metrics
+        assert 'unhealthy_total{replica="%s"} 1' % victim.url \
+            in metrics
+    finally:
+        if router is not None:
+            router.shutdown()
+        fronts[0].shutdown()
+
+
+# ----------------------------- the drills ------------------------------
+
+def test_replica_drain_drill_end_to_end():
+    from batch_shipyard_tpu.chaos import serving_drill
+    report = serving_drill.run_replica_drain_drill(seed=1)
+    assert report["invariants"]["ok"]
+    assert report["invariants"]["recoveries"] >= 1
+    assert report["goodput"]["badput_seconds"]["serving_recovery"] > 0
+
+
+def test_router_restart_drill_end_to_end():
+    from batch_shipyard_tpu.chaos import serving_drill
+    report = serving_drill.run_router_restart_drill(seed=1)
+    assert report["invariants"]["ok"]
+    assert report["invariants"]["resumed_clients"] >= 1
+
+
+@pytest.mark.slow
+def test_replica_kill_drill_end_to_end():
+    from batch_shipyard_tpu.chaos import serving_drill
+    report = serving_drill.run_replica_kill_drill(seed=1)
+    assert report["invariants"]["ok"]
+    assert report["invariants"]["recoveries"] >= 1

@@ -629,6 +629,52 @@ def _serving_slo(out: list[str]) -> None:
         out.append("")
 
 
+def _serving_resilience(out: list[str]) -> None:
+    """Serving fault-tolerance section: the ISSUE-20 drill results
+    from the committed BENCH_serving_resilience.json artifact —
+    seeds, invariants checked, pass/fail, and the priced
+    serving_recovery leg seconds. Every 'pass' was ASSERTED inside
+    the drill (chaos/serving_drill.py): zero lost requests,
+    exactly-once token delivery, byte-identical greedy streams
+    across the fault, exact goodput partition."""
+    report = (_load(ARTIFACTS / "BENCH_serving_resilience.json")
+              or {}).get("serving_resilience")
+    if report is None:
+        return
+    out.append("## Serving resilience (kill / drain / router "
+               "drills)\n")
+    out.append("Mid-stream replica kill with sibling resume, "
+               "graceful drain on a preempt notice (no new "
+               "admissions, in-flight decodes finish), and a router "
+               "crash ridden out by client cancel-then-resume — "
+               "each pinned by a seeded deterministic chaos drill "
+               "(`shipyard chaos drill "
+               "--serve-kill|--serve-drain|--serve-router`, "
+               "[37-serving-resilience.md](37-serving-resilience"
+               ".md)).\n")
+    if report.get("cpu_marker"):
+        out.append("**CPU marker**: real HTTP replicas + router "
+                   "over tiny fp32 CPU engines — no accelerator "
+                   "involved or claimed.\n")
+    out.append("| drill | seed | invariants checked | pass | "
+               "recovery leg | leg seconds | wall (s) |")
+    out.append("|---|---|---|---|---|---|---|")
+    for name in ("replica_kill", "replica_drain",
+                 "router_restart"):
+        entry = (report.get("drills") or {}).get(name) or {}
+        checked = entry.get("invariants_checked") or []
+        out.append(
+            f"| {name} | {entry.get('seed', '-')} | "
+            f"{len(checked)} | "
+            f"{'yes' if entry.get('passed') else 'NO'} | "
+            f"{entry.get('recovery_leg', '-')} | "
+            f"{_fmt(entry.get('recovery_leg_seconds'), 3)} | "
+            f"{_fmt(entry.get('wall_seconds'), 1)} |")
+        if entry.get("error"):
+            out.append(f"| | | `{entry['error']}` | | | | |")
+    out.append("")
+
+
 def _goodput(out: list[str]) -> None:
     """ML-productivity goodput section: always names goodput_ratio,
     the three decomposition legs, and EVERY badput category (the
@@ -783,6 +829,7 @@ def render() -> str:
     _control_plane(out)
     _fleet_sim(out)
     _serving_slo(out)
+    _serving_resilience(out)
     _silicon_proof(out)
     return "\n".join(out).rstrip() + "\n"
 
